@@ -1,0 +1,209 @@
+"""Eager autograd: tape of GradNodes + reverse-topological backward.
+
+TPU-native redesign of the reference's eager autograd
+(``egr::GradNodeBase``/``Edge`` at paddle/fluid/eager/grad_node_info.h:168 and
+``egr::Backward``/``RunBackward`` at paddle/fluid/eager/backward.cc:421,104).
+
+Key difference from the reference: instead of hand-written/generated GradNode
+classes per op, every eager op call gets its pullback from ``jax.vjp`` over the
+op's pure jax implementation — one mechanism, exact gradients, and the same
+code path later compiles under ``jax.jit`` where the tape is bypassed entirely
+(jit training steps use ``jax.grad`` on the functionalized model).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+class GradNode:
+    """One recorded op application.
+
+    ``vjp_fn`` maps the output cotangent pytree to per-tensor-input cotangents.
+    ``inputs`` are the input Tensors (in the order vjp_fn returns cotangents).
+    ``out_template`` is the primal output pytree (of jax.ShapeDtypeStruct) used
+    to build zero cotangents for outputs that received none.
+    """
+
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_treedef", "n_outputs")
+
+    def __init__(self, name, vjp_fn, inputs, out_avals, out_treedef):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs
+        self.out_avals = out_avals  # list of ShapeDtypeStruct, flattened outputs
+        self.out_treedef = out_treedef
+        self.n_outputs = len(out_avals)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = None
+
+
+def _is_float0(x):
+    return getattr(x, "dtype", None) == jax.dtypes.float0
+
+
+def _topo_order(root_nodes):
+    """Reverse postorder over producer edges = consumers before producers."""
+    order = []
+    visited = set()
+    for root in root_nodes:
+        if id(root) in visited:
+            continue
+        stack = [(root, False)]
+        while stack:
+            node, emit = stack.pop()
+            if emit:
+                order.append(node)
+                continue
+            if id(node) in visited:
+                continue
+            visited.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs or ():
+                prod = getattr(t, "_node", None)
+                if prod is not None and id(prod) not in visited:
+                    stack.append((prod, False))
+    order.reverse()
+    return order
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
+    """Run reverse accumulation from ``tensors``.
+
+    Default mode writes into leaf ``.grad`` slots (parity: ``egr::Backward``
+    at paddle/fluid/eager/backward.cc:421).  With ``sinks`` (a dict
+    ``id(tensor) -> [tensor, cotangent-or-None]``), cotangents accumulate
+    ONLY into the sinks — leaf ``.grad`` is untouched and non-leaf sinks
+    receive their gradient too (the ``paddle.grad``/GeneralGrad mode).
+    """
+    from ..core.tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # pending cotangents: id(node) -> {out_idx: cotangent}
+    pending = {}
+    roots = []
+
+    def _apply_hooks(t, g):
+        for hook in t._backward_hooks:
+            out = hook(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+        return g
+
+    def _deposit(t, g):
+        """Route one cotangent arriving at tensor ``t``."""
+        if sinks is not None and id(t) in sinks:
+            g = _apply_hooks(t, g)
+            slot = sinks[id(t)]
+            slot[1] = g if slot[1] is None else slot[1] + g
+            # keep flowing upstream: other sinks may sit above this one
+            prod = t._node
+            if prod is not None:
+                s = pending.setdefault(id(prod), {})
+                s[t._out_idx] = s.get(t._out_idx, 0) + g
+            return
+        if t.stop_gradient:
+            return
+        prod = t._node
+        if prod is not None:
+            g = _apply_hooks(t, g)
+            s = pending.setdefault(id(prod), {})
+            s[t._out_idx] = s.get(t._out_idx, 0) + g
+        elif sinks is None:
+            g = _apply_hooks(t, g)
+            if t.grad is None:
+                t.grad = Tensor(g, stop_gradient=True)
+            else:
+                t.grad = Tensor(t.grad._data + g, stop_gradient=True)
+
+    def _seed(t, g):
+        if t.stop_gradient and not (sinks is not None and id(t) in sinks):
+            return
+        if g is None:
+            if t._data.size != 1:
+                raise RuntimeError(
+                    f"grad can be implicitly created only for scalar outputs, "
+                    f"got shape {t.shape}")
+            g = jnp.ones_like(t._data)
+        else:
+            g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        if t._node is not None:
+            roots.append(t._node)
+        _deposit(t, g)
+
+    for t, g in zip(tensors, grad_tensors):
+        _seed(t, g)
+
+    if not roots:
+        return
+
+    for node in _topo_order(roots):
+        slot = pending.pop(id(node), None)
+        if slot is None:
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"Trying to backward through node {node.name} a second time; "
+                f"set retain_graph=True if you need to.")
+        cots = []
+        for i, aval in enumerate(node.out_avals):
+            if i in slot:
+                cots.append(slot[i])
+            else:
+                cots.append(jnp.zeros(aval.shape, aval.dtype))
+        cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
+        in_cots = node.vjp_fn(cot_tree)
+        for t, g in zip(node.inputs, in_cots):
+            if t is None or _is_float0(g):
+                continue
+            _deposit(t, g)
+        if not retain_graph:
+            node.release()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
+    """``paddle.grad`` parity (GeneralGrad, paddle/fluid/eager/general_grad.h:38).
+
+    Computes grads of ``outputs`` wrt ``inputs`` without touching ``.grad``.
+    Implemented by running the tape with temporary accumulation targets.
+    ``create_graph`` (higher-order eager grad) is not yet supported — use the
+    functional ``jax.grad`` path for higher-order derivatives.
+    """
+    from ..core.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True in eager mode is not supported yet; "
+            "use paddle_tpu.incubate.autograd (jax.grad) for higher-order.")
+    single_out = isinstance(outputs, Tensor)
+    if single_out:
+        outputs = [outputs]
+    single_in = isinstance(inputs, Tensor)
+    if single_in:
+        inputs = [inputs]
+
+    sinks = {id(t): [t, None] for t in inputs}
+    backward(outputs, grad_tensors=grad_outputs,
+             retain_graph=bool(retain_graph), sinks=sinks)
+    results = []
+    for t in inputs:
+        g = sinks[id(t)][1]
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused; "
+                    "pass allow_unused=True to return None for it.")
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results[0] if single_in else results

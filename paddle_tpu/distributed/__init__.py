@@ -1,0 +1,39 @@
+"""paddle_tpu.distributed (reference python/paddle/distributed/).
+
+Collectives are XLA HLOs over device meshes (SURVEY §5.8); groups are mesh
+slices; hybrid parallelism lives in ``fleet``; the SPMD planner in
+``auto_parallel``.
+"""
+
+from .communication import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    p2p_permute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .group import (  # noqa: F401
+    Group,
+    destroy_process_group,
+    get_group,
+    new_group,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from . import fleet  # noqa: F401

@@ -1,0 +1,115 @@
+"""DataLoader (reference python/paddle/io/reader.py:218).
+
+Single-process iterator with numpy collation; batches become device Tensors
+lazily (jax moves data async on first use).  ``num_workers`` is accepted for
+parity; a thread-pool prefetcher covers the common TPU-VM case where host
+CPUs outrun one chip's consumption.
+"""
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, dtype=np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, dtype=np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch_factor = prefetch_factor
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)
+
+    def _iter_batches(self):
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for indices in self.batch_sampler:
+                batch = [self.dataset[i] for i in indices]
+                yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self.num_workers and self.num_workers > 0:
+            return _PrefetchIterator(self._iter_batches(),
+                                     self.prefetch_factor * max(self.num_workers, 1))
+        return self._iter_batches()
+
+
+class _PrefetchIterator:
+    _SENTINEL = object()
+
+    def __init__(self, source, depth):
+        self._queue = queue.Queue(maxsize=depth)
+        self._err = None
+
+        def worker():
+            try:
+                for item in source:
+                    self._queue.put(item)
+            except BaseException as e:  # propagate to consumer
+                self._err = e
+            finally:
+                self._queue.put(self._SENTINEL)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -1,0 +1,79 @@
+"""Eager op dispatch: one generic mechanism for forward + autograd recording.
+
+Replaces the reference's generated per-op pipeline (Python-C wrapper →
+``{op}_ad_func`` → C++ API → kernel dispatch; see SURVEY §3.1 and templates at
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py:210).  Here every
+op is a pure jax function; ``apply_op`` substitutes Tensor arguments, runs the
+function (under ``jax.vjp`` when grads are needed), wraps outputs, and records
+one GradNode.  Under ``jax.jit`` tracing the same path runs with tracers in
+``Tensor._data`` — the tape still records, but jit train steps use the
+functional ``jax.grad`` path instead of the tape.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import mode
+from ..framework.flags import get_flags
+from ..autograd.tape import GradNode
+
+_is_tensor = lambda x: isinstance(x, Tensor)
+
+
+def apply_op(name, fn, args, kwargs):
+    """Run ``fn`` (pure jax) over ``args``/``kwargs`` with Tensors substituted.
+
+    Any ``Tensor`` found anywhere in the (args, kwargs) pytree becomes a
+    differentiable input; everything else is closed over as a static attribute.
+    Returns Tensor-wrapped outputs mirroring the output pytree of ``fn``.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
+    t_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
+    tensors = [leaves[i] for i in t_pos]
+    datas = [t._data for t in tensors]
+    from ..amp import amp_cast_inputs
+    datas = amp_cast_inputs(name, datas)
+
+    def pure(*tdatas):
+        new_leaves = list(leaves)
+        for i, d in zip(t_pos, tdatas):
+            new_leaves[i] = d
+        a, k = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return fn(*a, **k)
+
+    requires_grad = (mode.is_grad_enabled()
+                     and any(not t.stop_gradient for t in tensors))
+
+    if requires_grad:
+        out, vjp_fn = jax.vjp(pure, *datas)
+    else:
+        out = pure(*datas)
+        vjp_fn = None
+
+    out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+    node = None
+    if requires_grad:
+        avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
+        node = GradNode(name, vjp_fn, tensors, avals, out_treedef)
+        if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
+            _check_nan_inf(name, out_leaves)
+
+    wrapped = []
+    for i, o in enumerate(out_leaves):
+        differentiable = requires_grad and jnp.issubdtype(o.dtype, jnp.inexact)
+        t = Tensor(o, stop_gradient=not differentiable)
+        if differentiable:
+            t._node = node
+            t._out_idx = i
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(out_treedef, wrapped)
+
+
+def _check_nan_inf(name, out_leaves):
+    """FLAGS_check_nan_inf parity (paddle/fluid/eager/nan_inf_utils.cc)."""
+    for o in out_leaves:
+        if isinstance(o, jax.core.Tracer):
+            return  # cannot check under trace
+        if jnp.issubdtype(o.dtype, jnp.inexact) and not bool(jnp.isfinite(o).all()):
+            raise FloatingPointError(f"NaN or Inf detected in output of op '{name}'")

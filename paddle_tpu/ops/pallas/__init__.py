@@ -1,0 +1,49 @@
+"""Pallas TPU kernels for the hot ops.
+
+Analog of the reference's hand-fused CUDA kernels
+(paddle/phi/kernels/fusion/, flash_attn at
+paddle/phi/kernels/gpu/flash_attn_kernel.cu).  Selection order:
+Pallas kernel (TPU, flag-gated) → XLA composition fallback (works everywhere,
+still fuses well).  ``FLAGS_use_pallas_kernels`` toggles.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.flags import get_flags
+
+
+def _use_pallas():
+    return (jax.default_backend() == "tpu"
+            and get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"])
+
+
+def _xla_attention(q, k, v, attn_mask=None, is_causal=False):
+    """Reference XLA attention on [B, T, N, H] (paddle flash-attn layout)."""
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=jnp.float32))
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    logits = jnp.einsum("btnh,bsnh->bnts", qf, kf) * scale
+    if is_causal:
+        t, s = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        logits = jnp.where(causal, logits, jnp.finfo(jnp.float32).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bnts,bsnh->btnh", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def flash_attention(q, k, v, attn_mask=None, is_causal=False):
+    """Flash attention on [batch, seq, num_heads, head_dim]."""
+    if _use_pallas() and attn_mask is None:
+        try:
+            from .flash_attention import flash_attention_pallas
+            return flash_attention_pallas(q, k, v, is_causal=is_causal)
+        except Exception:
+            pass
+    return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal)

@@ -1,0 +1,10 @@
+"""Vision model zoo (reference python/paddle/vision/models/)."""
+
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)

@@ -1,0 +1,18 @@
+"""Test config: force an 8-device CPU mesh.
+
+Mirrors the reference's multi-process-on-one-box distributed test strategy
+(test/legacy_test/test_dist_base.py:926) — here the "cluster" is 8 virtual XLA
+host devices, so sharding/collective tests run anywhere.  jax may already be
+imported (TPU site plugins), so the backend is forced via jax.config rather
+than env vars.
+"""
+
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,194 @@
+"""Tape autograd correctness: analytic vs numeric gradients (the
+check_grad discipline of reference eager_op_test.py:377)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = fn(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        fm = fn(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+class TestBackward:
+    def test_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x + x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 7.0])
+
+    def test_matmul_grad_numeric(self):
+        a = np.random.rand(3, 4).astype(np.float64).astype(np.float32)
+        b = np.random.rand(4, 2).astype(np.float32)
+        ta = paddle.to_tensor(a, stop_gradient=False)
+        tb = paddle.to_tensor(b, stop_gradient=False)
+        loss = paddle.matmul(ta, tb).sum()
+        loss.backward()
+        ng = numeric_grad(lambda m: (m @ b).sum(), a.copy())
+        np.testing.assert_allclose(ta.grad.numpy(), ng, rtol=1e-2, atol=1e-2)
+        np.testing.assert_allclose(tb.grad.numpy(),
+                                   numeric_grad(lambda m: (a @ m).sum(), b.copy()),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_grad_accumulation(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        (x * 2).backward()
+        (x * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+    def test_shared_subexpression(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x          # used twice
+        z = (y + y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x
+        s = y.sum()
+        s.backward(retain_graph=True)
+        s.backward(retain_graph=True)
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+        with pytest.raises(RuntimeError):
+            z = x * x
+            w = z.sum()
+            w.backward()
+            w.backward()  # not retained
+
+    def test_stop_gradient(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = paddle.to_tensor([2.0])  # stop_gradient=True
+        (x * y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+        assert y.grad is None
+
+    def test_detach(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = (x * 2).detach()
+        assert y.stop_gradient
+        z = x * 2
+        (z + y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_multi_output_op(self):
+        x = paddle.to_tensor(np.random.rand(4, 6).astype(np.float32),
+                             stop_gradient=False)
+        vals, idx = paddle.topk(x, 2, axis=1)
+        vals.sum().backward()
+        g = x.grad.numpy()
+        assert (g.sum(1) == 2).all()  # two 1s per row
+
+    def test_hook(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_non_scalar_backward_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(RuntimeError):
+            y.backward()
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 0.5]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 1.0])
+
+
+class TestGradAPI:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x * x
+        (gx,) = paddle.grad(y.sum(), [x])
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        assert x.grad is None  # .grad untouched
+
+    def test_grad_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        z = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * 2
+        gs = paddle.grad(y.sum(), [x, z], allow_unused=True)
+        assert gs[1] is None
+
+
+class TestPyLayer:
+    def test_custom_fwd_bwd(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestNanInfCheck:
+    def test_flag_detects(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor([0.0], stop_gradient=False)
+            with pytest.raises(FloatingPointError):
+                y = paddle.log(x) * 0 + paddle.log(x)
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+class TestGradNoSideEffects:
+    def test_grad_does_not_pollute_other_leaves(self):
+        w = paddle.to_tensor([3.0], stop_gradient=False)
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (w * x).sum()
+        (gx,) = paddle.grad(y, [x], retain_graph=True)
+        np.testing.assert_allclose(gx.numpy(), [3.0])
+        assert w.grad is None  # not polluted
+        assert x.grad is None
+
+    def test_grad_wrt_non_leaf(self):
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        x = a * 3          # non-leaf
+        y = (x * x).sum()
+        (gx,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(gx.numpy(), [12.0])  # 2x = 12
+
+    def test_grad_mixed_leaf_and_nonleaf(self):
+        a = paddle.to_tensor([2.0], stop_gradient=False)
+        x = a * 3
+        y = (x * x).sum()
+        ga, gx = paddle.grad(y, [a, x])
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        np.testing.assert_allclose(ga.numpy(), [36.0])  # dy/da = 2*(3a)*3

@@ -1,0 +1,110 @@
+"""io: datasets, samplers, DataLoader; save/load."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import io, nn
+
+
+class RangeDataset(io.Dataset):
+    def __init__(self, n):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.int64(i % 3)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_batching(self):
+        loader = io.DataLoader(RangeDataset(10), batch_size=4)
+        batches = list(loader)
+        assert len(batches) == 3
+        x, y = batches[0]
+        # int64 canonicalizes to int32 (TPU-native integer width)
+        assert x.shape == [4] and y.dtype in (np.int32, np.int64)
+
+    def test_drop_last_shuffle(self):
+        loader = io.DataLoader(RangeDataset(10), batch_size=4, shuffle=True,
+                               drop_last=True)
+        batches = list(loader)
+        assert len(batches) == 2
+        seen = np.concatenate([b[0].numpy() for b in batches])
+        assert len(set(seen.tolist())) == 8
+
+    def test_tensor_dataset(self):
+        xs = paddle.to_tensor(np.arange(12.0).reshape(6, 2).astype(np.float32))
+        ds = io.TensorDataset([xs])
+        assert len(ds) == 6
+        loader = io.DataLoader(ds, batch_size=3)
+        (batch,) = next(iter(loader))
+        assert batch.shape == [3, 2]
+
+    def test_prefetch_worker(self):
+        loader = io.DataLoader(RangeDataset(20), batch_size=5, num_workers=2)
+        assert len(list(loader)) == 4
+
+    def test_distributed_batch_sampler(self):
+        ds = RangeDataset(16)
+        s0 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=0)
+        s1 = io.DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == 4 and not set(i0) & set(i1)
+
+
+class TestSaveLoad:
+    def test_state_dict_roundtrip(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "model.pdparams")
+            paddle.save(model.state_dict(), path)
+            loaded = paddle.load(path)
+        model2 = nn.Sequential(nn.Linear(4, 8), nn.Linear(8, 2))
+        model2.set_state_dict(loaded)
+        np.testing.assert_array_equal(model2[0].weight.numpy(),
+                                      model[0].weight.numpy())
+
+    def test_nested_objects(self):
+        obj = {"a": paddle.ones([2]), "b": [1, 2, {"c": paddle.zeros([1])}],
+               "d": "text"}
+        with tempfile.TemporaryDirectory() as dd:
+            path = os.path.join(dd, "obj.pdt")
+            paddle.save(obj, path)
+            loaded = paddle.load(path)
+        assert loaded["d"] == "text"
+        np.testing.assert_array_equal(loaded["a"].numpy(), [1, 1])
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        import jax.numpy as jnp
+        x = paddle.ones([4, 4])
+        with paddle.amp.auto_cast():
+            out = paddle.matmul(x, x)
+        assert out.dtype == jnp.bfloat16
+        out2 = paddle.matmul(x, x)
+        assert out2.dtype == np.float32
+
+    def test_blacklist_stays_fp32(self):
+        x = paddle.ones([4, 4])
+        with paddle.amp.auto_cast():
+            out = paddle.nn.functional.softmax(x)
+        assert out.dtype == np.float32
+
+    def test_grad_scaler_fp16_flow(self):
+        from paddle_tpu import optimizer
+        model = nn.Linear(2, 2)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = model(paddle.ones([1, 2])).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert np.isfinite(model.weight.numpy()).all()

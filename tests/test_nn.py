@@ -1,0 +1,252 @@
+"""nn.Layer machinery + layer numerics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def rand_t(*shape, sg=True):
+    return paddle.to_tensor(np.random.rand(*shape).astype(np.float32),
+                            stop_gradient=sg)
+
+
+class TestLayerBase:
+    def test_parameters_registration(self):
+        lin = nn.Linear(4, 3)
+        names = dict(lin.named_parameters())
+        assert set(names) == {"weight", "bias"}
+        assert lin.weight.shape == [4, 3]
+        assert not lin.weight.stop_gradient
+
+    def test_sublayers_state_dict(self):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = model.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        sd2 = {k: paddle.to_tensor(v.numpy() * 0) for k, v in sd.items()}
+        model.set_state_dict(sd2)
+        assert model[0].weight.numpy().sum() == 0
+
+    def test_train_eval(self):
+        model = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        model.eval()
+        assert not model[1].training
+        model.train()
+        assert model[1].training
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(
+            lambda layer, inp, out: calls.append(1))
+        lin(rand_t(1, 2))
+        assert calls == [1]
+        h.remove()
+        lin(rand_t(1, 2))
+        assert calls == [1]
+
+    def test_buffers(self):
+        bn = nn.BatchNorm1D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+
+class TestLayers:
+    def test_linear_numerics(self):
+        lin = nn.Linear(3, 2)
+        x = rand_t(5, 3)
+        out = lin(x)
+        expect = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), expect, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        idx = paddle.to_tensor(np.array([[1, 0, 3]]))
+        out = emb(idx)
+        assert out.shape == [1, 3, 4]
+        assert np.abs(out.numpy()[0, 1]).sum() == 0  # padding
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = rand_t(2, 4, 8)
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_batchnorm_updates_stats(self):
+        bn = nn.BatchNorm1D(3)
+        x = paddle.to_tensor(np.random.randn(16, 3).astype(np.float32) * 2 + 5)
+        bn(x)
+        # data mean ~5, momentum 0.9 -> running mean ~0.5 after one step
+        assert abs(bn._mean.numpy().mean()) > 0.2
+
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        x = rand_t(2, 3, 16, 16)
+        assert conv(x).shape == [2, 8, 16, 16]
+        conv_s = nn.Conv2D(3, 8, 3, stride=2)
+        assert conv_s(x).shape == [2, 8, 7, 7]
+
+    def test_conv2d_vs_manual(self):
+        # 1x1 conv == matmul over channels
+        conv = nn.Conv2D(4, 6, 1, bias_attr=False)
+        x = rand_t(1, 4, 5, 5)
+        out = conv(x).numpy()
+        w = conv.weight.numpy().reshape(6, 4)
+        expect = np.einsum("oc,nchw->nohw", w, x.numpy())
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+    def test_pools(self):
+        x = rand_t(1, 2, 8, 8)
+        assert nn.MaxPool2D(2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        np.testing.assert_allclose(nn.AdaptiveAvgPool2D(1)(x).numpy()[0, 0, 0, 0],
+                                   x.numpy()[0, 0].mean(), rtol=1e-5)
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = d(x)
+        assert 0.2 < (out.numpy() == 0).mean() < 0.8
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = rand_t(2, 5, 16)
+        assert mha(x).shape == [2, 5, 16]
+
+    def test_mha_cache(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = rand_t(2, 1, 16)
+        cache = mha.gen_cache(x)
+        out, cache = mha(x, cache=cache)
+        assert cache.k.shape[1] == 1
+        out, cache = mha(rand_t(2, 1, 16), cache=cache)
+        assert cache.k.shape[1] == 2
+
+    def test_transformer_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 2, 32)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = rand_t(2, 6, 16)
+        assert enc(x).shape == [2, 6, 16]
+
+
+class TestFunctional:
+    def test_activations(self):
+        x = np.linspace(-3, 3, 20).astype(np.float32)
+        tx = paddle.to_tensor(x)
+        np.testing.assert_allclose(F.relu(tx).numpy(), np.maximum(x, 0))
+        np.testing.assert_allclose(F.sigmoid(tx).numpy() if hasattr(F, "sigmoid")
+                                   else paddle.sigmoid(tx).numpy(),
+                                   1 / (1 + np.exp(-x)), rtol=1e-5)
+        sm = F.softmax(tx).numpy()
+        np.testing.assert_allclose(sm.sum(), 1.0, rtol=1e-5)
+
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 4, 1])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels))
+        # numpy reference
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        ref = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 4, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels), ignore_index=-100)
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        ref = -np.log(p[[0, 2], [0, 4]]).mean()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_attention_causal(self):
+        q = rand_t(1, 4, 2, 8)
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert out.shape == [1, 4, 2, 8]
+        # first position attends only to itself -> equals v[0]
+        np.testing.assert_allclose(out.numpy()[0, 0], q.numpy()[0, 0],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_loss_grad_flows(self):
+        lin = nn.Linear(4, 3)
+        x = rand_t(8, 4)
+        y = paddle.to_tensor(np.random.randint(0, 3, (8,)))
+        loss = F.cross_entropy(lin(x), y)
+        loss.backward()
+        assert lin.weight.grad is not None
+        assert np.isfinite(lin.weight.grad.numpy()).all()
+
+    def test_pad(self):
+        x = rand_t(1, 2, 3, 3)
+        out = F.pad(x, [1, 1, 2, 2])
+        assert out.shape == [1, 2, 7, 5]
+
+
+class TestInitializers:
+    def test_constant_xavier(self):
+        from paddle_tpu.nn import initializer as I
+        c = I.Constant(2.0)((3, 3), np.float32)
+        assert (np.asarray(c) == 2.0).all()
+        xv = np.asarray(I.XavierUniform()((100, 100), np.float32))
+        limit = np.sqrt(6.0 / 200)
+        assert np.abs(xv).max() <= limit + 1e-6
+        kn = np.asarray(I.KaimingNormal()((50, 50), np.float32))
+        assert 0.1 < kn.std() / np.sqrt(2.0 / 50) < 1.5
+
+
+class TestReviewRegressions:
+    def test_cross_entropy_weighted_mean_with_axis_label(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([[0], [1], [2], [1]])
+        w = np.array([1.0, 2.0, 3.0], np.float32)
+        loss = F.cross_entropy(paddle.to_tensor(logits),
+                               paddle.to_tensor(labels),
+                               weight=paddle.to_tensor(w))
+        e = np.exp(logits - logits.max(1, keepdims=True))
+        p = e / e.sum(1, keepdims=True)
+        lb = labels[:, 0]
+        per = -np.log(p[np.arange(4), lb]) * w[lb]
+        ref = per.sum() / w[lb].sum()
+        np.testing.assert_allclose(loss.numpy(), ref, rtol=1e-5)
+
+    def test_adaptive_max_pool_non_divisible(self):
+        x = rand_t(1, 2, 5, 5)
+        out = nn.AdaptiveMaxPool2D(3)(x)
+        assert out.shape == [1, 2, 3, 3]
+
+    def test_adaptive_avg_pool1d_non_divisible(self):
+        x = rand_t(1, 2, 7)
+        out = nn.AdaptiveAvgPool1D(3)(x)
+        assert out.shape == [1, 2, 3]
+
+    def test_dropout_downscale_in_infer(self):
+        x = paddle.ones([10])
+        out = F.dropout(x, p=0.4, training=False, mode="downscale_in_infer")
+        np.testing.assert_allclose(out.numpy(), 0.6 * np.ones(10), rtol=1e-6)
+
+    def test_maxpool_return_mask(self):
+        x = paddle.to_tensor(np.arange(16.0, dtype=np.float32).reshape(1, 1, 4, 4))
+        out, mask = F.max_pool2d(x, 2, return_mask=True)
+        np.testing.assert_array_equal(out.numpy()[0, 0], [[5, 7], [13, 15]])
+        np.testing.assert_array_equal(mask.numpy()[0, 0], [[5, 7], [13, 15]])
+
+    def test_maxpool_ceil_mode(self):
+        x = rand_t(1, 1, 5, 5)
+        out = F.max_pool2d(x, 2, stride=2, ceil_mode=True)
+        assert out.shape == [1, 1, 3, 3]
+        out = F.max_pool2d(x, 2, stride=2, ceil_mode=False)
+        assert out.shape == [1, 1, 2, 2]
+
+    def test_gumbel_softmax_hard(self):
+        x = rand_t(4, 6)
+        out = F.gumbel_softmax(x, hard=True)
+        np.testing.assert_allclose(out.numpy().sum(-1), np.ones(4), rtol=1e-5)
+        assert ((out.numpy() == out.numpy().max(-1, keepdims=True)).sum(-1) == 1).all()

@@ -1,0 +1,146 @@
+"""Optimizers, LR schedulers, grad clip."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def fit_line(opt_cls, steps=200, **kw):
+    paddle.seed(0)
+    w_true = np.array([[2.0], [-3.0]], dtype=np.float32)
+    x = np.random.rand(64, 2).astype(np.float32)
+    y = x @ w_true
+    lin = nn.Linear(2, 1, bias_attr=False)
+    opt = opt_cls(parameters=lin.parameters(), **kw)
+    for _ in range(steps):
+        loss = ((lin(paddle.to_tensor(x)) - paddle.to_tensor(y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return lin.weight.numpy(), float(loss.numpy())
+
+
+class TestOptimizers:
+    def test_sgd_converges(self):
+        w, loss = fit_line(optimizer.SGD, learning_rate=0.5, steps=300)
+        np.testing.assert_allclose(w, [[2.0], [-3.0]], atol=0.05)
+
+    def test_momentum_converges(self):
+        w, loss = fit_line(optimizer.Momentum, learning_rate=0.1, steps=300)
+        np.testing.assert_allclose(w, [[2.0], [-3.0]], atol=0.05)
+
+    def test_adam_converges(self):
+        w, loss = fit_line(optimizer.Adam, learning_rate=0.1, steps=400)
+        np.testing.assert_allclose(w, [[2.0], [-3.0]], atol=0.05)
+
+    def test_adamw_decay(self):
+        # with huge decay, weights shrink toward zero
+        lin = nn.Linear(2, 2, bias_attr=False)
+        opt = optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                              parameters=lin.parameters())
+        w0 = np.abs(lin.weight.numpy()).mean()
+        for _ in range(50):
+            loss = (lin(paddle.ones([1, 2])) * 0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert np.abs(lin.weight.numpy()).mean() < w0 * 0.2
+
+    def test_adam_matches_reference_formula(self):
+        p0 = np.array([1.0], dtype=np.float32)
+        g = np.array([0.5], dtype=np.float32)
+        param = nn.Parameter(p0)
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[param])
+        param.grad = paddle.to_tensor(g)
+        opt.step()
+        m = 0.1 * g
+        v = 0.001 * g * g
+        mhat = m / 0.1
+        vhat = v / 0.001
+        expect = p0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+        np.testing.assert_allclose(param.numpy(), expect, rtol=1e-5)
+
+    def test_lamb_runs(self):
+        w, loss = fit_line(optimizer.Lamb, learning_rate=0.03, steps=300)
+        assert loss < 0.5
+
+    def test_state_dict_roundtrip(self):
+        lin = nn.Linear(2, 2)
+        opt = optimizer.Adam(learning_rate=0.1, parameters=lin.parameters())
+        loss = lin(paddle.ones([1, 2])).sum()
+        loss.backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=lin.parameters())
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        k = [k for k in sd if str(k).endswith("moment1")]
+        assert k
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sched())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        sched = optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(sched() - 1.0) < 1e-6
+        for _ in range(10):
+            sched.step()
+        assert sched() < 1e-6
+
+    def test_warmup(self):
+        sched = optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                          end_lr=0.1)
+        first = sched()
+        for _ in range(10):
+            sched.step()
+        assert first < 0.011
+        np.testing.assert_allclose(sched(), 0.1, rtol=1e-6)
+
+    def test_noam(self):
+        sched = optimizer.lr.NoamDecay(d_model=512, warmup_steps=100)
+        vals = []
+        for _ in range(200):
+            vals.append(sched())
+            sched.step()
+        peak = int(np.argmax(vals))
+        assert 90 <= peak <= 110
+
+    def test_optimizer_uses_scheduler(self):
+        lin = nn.Linear(2, 2)
+        sched = optimizer.lr.StepDecay(0.5, step_size=1, gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sched, parameters=lin.parameters())
+        assert opt.get_lr() == 0.5
+        sched.step()
+        assert abs(opt.get_lr() - 0.05) < 1e-9
+
+
+class TestGradClip:
+    def test_clip_by_value(self):
+        clip = optimizer.ClipGradByValue(0.1)
+        lin = nn.Linear(2, 2, bias_attr=False)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=lin.parameters(),
+                            grad_clip=clip)
+        (lin(paddle.ones([1, 2]) * 100).sum()).backward()
+        w0 = lin.weight.numpy()
+        opt.step()
+        assert np.abs(lin.weight.numpy() - w0).max() <= 0.1 + 1e-6
+
+    def test_clip_global_norm(self):
+        clip = optimizer.ClipGradByGlobalNorm(1.0)
+        lin = nn.Linear(4, 4, bias_attr=False)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=lin.parameters(),
+                            grad_clip=clip)
+        (lin(paddle.ones([1, 4]) * 50).sum()).backward()
+        w0 = lin.weight.numpy()
+        opt.step()
+        delta = lin.weight.numpy() - w0
+        np.testing.assert_allclose(np.linalg.norm(delta), 1.0, rtol=1e-4)

@@ -1,0 +1,218 @@
+"""Op numerics vs numpy — the OpTest pattern
+(reference test/legacy_test/eager_op_test.py:377) without the program modes:
+eager outputs checked against numpy reference implementations."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=sg)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2], dtype="int32").dtype == np.int32
+        np.testing.assert_allclose(paddle.full([2, 2], 3.5).numpy(), 3.5)
+
+    def test_arange_linspace(self):
+        np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+        np.testing.assert_allclose(paddle.linspace(0, 1, 5).numpy(),
+                                   np.linspace(0, 1, 5), rtol=1e-6)
+
+    def test_like_ops(self):
+        x = t(np.ones((2, 3)))
+        assert paddle.zeros_like(x).shape == [2, 3]
+        assert paddle.full_like(x, 7).numpy()[0, 0] == 7
+
+    def test_eye_diag_tril(self):
+        np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3, dtype=np.float32))
+        x = t(np.arange(9.0).reshape(3, 3))
+        np.testing.assert_array_equal(paddle.tril(x).numpy(),
+                                      np.tril(np.arange(9.0).reshape(3, 3)))
+
+
+class TestMath:
+    def test_binary(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        for name, ref in [("add", np.add), ("subtract", np.subtract),
+                          ("multiply", np.multiply), ("divide", np.divide),
+                          ("maximum", np.maximum), ("minimum", np.minimum)]:
+            out = getattr(paddle, name)(t(a), t(b))
+            np.testing.assert_allclose(out.numpy(), ref(a, b), rtol=1e-6)
+
+    def test_dunders(self):
+        a, b = t([1.0, 2.0]), t([3.0, 4.0])
+        np.testing.assert_allclose((a + b).numpy(), [4, 6])
+        np.testing.assert_allclose((a * 2).numpy(), [2, 4])
+        np.testing.assert_allclose((2 / a).numpy(), [2, 1])
+        np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+        np.testing.assert_allclose((-a).numpy(), [-1, -2])
+        assert (a == a).numpy().all()
+        assert ((a < b).numpy()).all()
+
+    def test_unary(self):
+        x = np.random.rand(10).astype(np.float32) + 0.1
+        for name, ref in [("sqrt", np.sqrt), ("exp", np.exp), ("log", np.log),
+                          ("abs", np.abs), ("tanh", np.tanh), ("floor", np.floor),
+                          ("square", np.square)]:
+            np.testing.assert_allclose(getattr(paddle, name)(t(x)).numpy(),
+                                       ref(x), rtol=1e-5)
+
+    def test_reductions(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.sum(t(x)).numpy(), x.sum(), rtol=1e-5)
+        np.testing.assert_allclose(paddle.mean(t(x), axis=1).numpy(),
+                                   x.mean(1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.max(t(x), axis=-1, keepdim=True).numpy(),
+                                   x.max(-1, keepdims=True))
+        np.testing.assert_allclose(paddle.std(t(x)).numpy(), x.std(ddof=1),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(
+            paddle.logsumexp(t(x), axis=0).numpy(),
+            np.log(np.exp(x).sum(0)), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        x = np.random.randn(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.cumsum(t(x), axis=1).numpy(),
+                                   np.cumsum(x, 1), rtol=1e-5)
+        np.testing.assert_allclose(paddle.clip(t(x), -0.5, 0.5).numpy(),
+                                   np.clip(x, -0.5, 0.5))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = t(np.arange(24.0).reshape(2, 3, 4))
+        assert x.reshape([4, 6]).shape == [4, 6]
+        assert x.transpose([2, 0, 1]).shape == [4, 2, 3]
+        assert x.flatten().shape == [24]
+        assert x.flatten(1, 2).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        np.testing.assert_array_equal(
+            paddle.concat([t(a), t(b)], axis=0).numpy(), np.concatenate([a, b]))
+        parts = paddle.split(t(a), [1, 2], axis=1)
+        assert parts[0].shape == [2, 1] and parts[1].shape == [2, 2]
+        parts = paddle.split(t(a), [1, -1], axis=1)
+        assert parts[1].shape == [2, 2]
+        np.testing.assert_array_equal(paddle.stack([t(a), t(b)]).numpy(),
+                                      np.stack([a, b]))
+
+    def test_squeeze_unsqueeze_expand(self):
+        x = t(np.ones((1, 3, 1)))
+        assert x.squeeze().shape == [3]
+        assert x.squeeze(0).shape == [3, 1]
+        assert x.unsqueeze(0).shape == [1, 1, 3, 1]
+        y = t(np.ones((1, 3)))
+        assert paddle.expand(y, [4, 3]).shape == [4, 3]
+        assert paddle.expand(y, [4, -1]).shape == [4, 3]
+
+    def test_gather_scatter(self):
+        x = np.arange(12.0).reshape(4, 3).astype(np.float32)
+        idx = np.array([0, 2])
+        np.testing.assert_array_equal(paddle.gather(t(x), t(idx)).numpy(),
+                                      x[[0, 2]])
+        upd = np.ones((2, 3), np.float32) * 9
+        out = paddle.scatter(t(x), t(idx), t(upd))
+        expect = x.copy()
+        expect[[0, 2]] = 9
+        np.testing.assert_array_equal(out.numpy(), expect)
+
+    def test_where_masked(self):
+        x = np.random.randn(3, 4).astype(np.float32)
+        cond = x > 0
+        np.testing.assert_array_equal(
+            paddle.where(t(cond), t(x), t(-x)).numpy(), np.where(cond, x, -x))
+        np.testing.assert_array_equal(paddle.masked_select(t(x), t(cond)).numpy(),
+                                      x[cond])
+
+    def test_sort_topk_argmax(self):
+        x = np.random.randn(5, 6).astype(np.float32)
+        np.testing.assert_array_equal(paddle.sort(t(x), axis=1).numpy(),
+                                      np.sort(x, 1))
+        np.testing.assert_array_equal(paddle.argmax(t(x), axis=1).numpy(),
+                                      np.argmax(x, 1))
+        vals, idx = paddle.topk(t(x), 3, axis=1)
+        np.testing.assert_allclose(vals.numpy(), -np.sort(-x, 1)[:, :3],
+                                   rtol=1e-6)
+
+    def test_indexing(self):
+        x = t(np.arange(24.0).reshape(4, 6))
+        np.testing.assert_array_equal(x[1].numpy(), np.arange(6.0) + 6)
+        np.testing.assert_array_equal(x[:, 2:4].shape, [4, 2])
+        x[0] = 0.0
+        assert x.numpy()[0].sum() == 0
+
+    def test_unique_nonzero(self):
+        x = np.array([3, 1, 2, 1, 3])
+        np.testing.assert_array_equal(paddle.unique(t(x)).numpy(), [1, 2, 3])
+        nz = paddle.nonzero(t(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b,
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b,
+            rtol=1e-5)
+
+    def test_norm_det_svd(self):
+        x = np.random.rand(4, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.norm(t(x)).numpy(),
+                                   np.linalg.norm(x), rtol=1e-5)
+        np.testing.assert_allclose(paddle.det(t(x)).numpy(), np.linalg.det(x),
+                                   rtol=1e-3)
+        u, s, vh = paddle.svd(t(x))
+        np.testing.assert_allclose((u.numpy() * s.numpy()) @ vh.numpy(), x,
+                                   atol=1e-4)
+
+    def test_einsum(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32)
+        np.testing.assert_allclose(paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(),
+                                   a @ b, rtol=1e-5)
+
+    def test_solve(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(paddle.solve(t(a), t(b)).numpy(),
+                                   np.linalg.solve(a, b), rtol=1e-3, atol=1e-4)
+
+
+class TestRandomSeed:
+    def test_seed_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([4, 4]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([4, 4]).numpy()
+        np.testing.assert_array_equal(a, b)
+
+    def test_randint_range(self):
+        x = paddle.randint(0, 10, [100]).numpy()
+        assert x.min() >= 0 and x.max() < 10
+
+    def test_randperm(self):
+        p = paddle.randperm(16).numpy()
+        np.testing.assert_array_equal(np.sort(p), np.arange(16))
+
+
+class TestDtype:
+    def test_astype(self):
+        x = t(np.ones((2, 2)))
+        assert x.astype("int32").dtype == np.int32
+        assert x.astype(paddle.bfloat16).dtype == "bfloat16"
+
+    def test_default_dtype(self):
+        assert paddle.get_default_dtype() == np.float32
+        x = paddle.to_tensor([1.5])
+        assert x.dtype == np.float32
