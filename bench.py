@@ -1,0 +1,93 @@
+"""Benchmark: GPT-124M causal-LM training throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline = achieved MFU / 0.45 (the BASELINE.md north-star MFU target) —
+the reference repo publishes no absolute numbers (SURVEY §6), so the target
+ratio is the honest comparison.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip():
+    """bf16 peak for the attached TPU generation; CPU fallback is nominal."""
+    import jax
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 1e12  # CPU: nominal, MFU not meaningful
+
+
+def main():
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models.gpt import gpt_124m, gpt_tiny
+
+    paddle.seed(0)
+    if on_tpu:
+        cfg = dict(batch=8, seq=512)
+        model = gpt_124m(hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
+        steps, warmup = 20, 3
+    else:
+        cfg = dict(batch=4, seq=128)
+        model = gpt_tiny(num_layers=4, hidden_size=128,
+                         max_position_embeddings=128)
+        steps, warmup = 5, 2
+
+    n_params = sum(p.size for p in model.parameters())
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = TrainStep(model, lambda logits, labels: model.loss(logits, labels),
+                     opt)
+
+    rng = np.random.RandomState(0)
+    vocab = model.config.vocab_size
+    ids = paddle.to_tensor(
+        rng.randint(0, vocab, (cfg["batch"], cfg["seq"])).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, vocab, (cfg["batch"], cfg["seq"])).astype(np.int32))
+
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    float(loss.numpy())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    final = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = cfg["batch"] * cfg["seq"]
+    tok_s = tokens_per_step * steps / dt
+    flops_per_token = 6.0 * n_params
+    mfu = tok_s * flops_per_token / peak_flops_per_chip()
+
+    assert np.isfinite(final), "loss diverged during bench"
+    print(json.dumps({
+        "metric": "gpt124m_train_tokens_per_sec_per_chip" if on_tpu
+        else "gpt_tiny_cpu_tokens_per_sec",
+        "value": round(tok_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,12 @@
+"""Model zoo (language models; vision lives in paddle_tpu.vision.models)."""
+
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_tiny,
+    gpt_124m,
+    gpt_350m,
+    gpt_1_3b,
+    gpt_6_7b,
+)

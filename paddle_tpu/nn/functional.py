@@ -892,15 +892,24 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
 
 # ---------------- attention ----------------
 
-@op()
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
-                                 dropout_p=0.0, is_causal=False, training=True):
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True):
     """SDPA on [batch, seq, heads, dim] (paddle layout,
     python/paddle/nn/functional/flash_attention.py:125).  Uses the Pallas
-    flash kernel on TPU when available, else XLA attention."""
+    flash kernel on TPU when available, else XLA attention.  Attention
+    dropout draws from the active key stream."""
     from ..ops import pallas
-    return pallas.flash_attention(query, key, value, attn_mask=attn_mask,
-                                  is_causal=is_causal)
+    use_drop = dropout_p > 0.0 and training
+    drop_key = get_rng_key() if use_drop else None
+
+    @op("scaled_dot_product_attention")
+    def _sdpa(query, key, value, attn_mask):
+        return pallas.flash_attention(
+            query, key, value, attn_mask=attn_mask, is_causal=is_causal,
+            dropout_p=dropout_p if use_drop else 0.0, dropout_key=drop_key)
+
+    return _sdpa(query, key, value, attn_mask)
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,

@@ -14,6 +14,13 @@ def _u(name):
     return OPS[name].user_fn
 
 
+# aliases: same op, second paddle-facing name
+for _alias, _orig in [("unbind", "unstack"), ("remainder", "mod"),
+                      ("inv", "inverse")]:
+    if _orig in OPS and _alias not in OPS:
+        OPS[_alias] = OPS[_orig]
+
+
 _BINARY_DUNDERS = {
     "__add__": "add", "__radd__": "add",
     "__sub__": "subtract",

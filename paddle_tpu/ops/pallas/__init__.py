@@ -18,7 +18,8 @@ def _use_pallas():
             and get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"])
 
 
-def _xla_attention(q, k, v, attn_mask=None, is_causal=False):
+def _xla_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
+                   dropout_key=None):
     """Reference XLA attention on [B, T, N, H] (paddle flash-attn layout)."""
     scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=jnp.float32))
     qf = q.astype(jnp.float32)
@@ -34,16 +35,25 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False):
         else:
             logits = logits + attn_mask.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
     out = jnp.einsum("bnts,bsnh->btnh", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)
 
 
-def flash_attention(q, k, v, attn_mask=None, is_causal=False):
-    """Flash attention on [batch, seq, num_heads, head_dim]."""
-    if _use_pallas() and attn_mask is None:
+def flash_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
+                    dropout_key=None):
+    """Flash attention on [batch, seq, num_heads, head_dim].
+
+    Attention dropout forces the XLA path (the Pallas kernel is
+    dropout-free, like most production flash kernels at inference/bf16
+    pretrain settings)."""
+    if _use_pallas() and attn_mask is None and dropout_p == 0.0:
         try:
             from .flash_attention import flash_attention_pallas
-            return flash_attention_pallas(q, k, v, is_causal=is_causal)
+            return flash_attention_pallas(q, k, v, is_causal)
         except Exception:
             pass
-    return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal)
+    return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
+                          dropout_p=dropout_p, dropout_key=dropout_key)

@@ -1,0 +1,123 @@
+"""SPMD pipeline parallelism: the microbatch loop compiled INTO the program.
+
+The reference drives 1F1B from the host (PipelineParallel at
+meta_parallel/pipeline_parallel.py:188, NCCL P2P per microbatch edge).  On TPU
+the whole schedule lives inside one XLA program: a ``shard_map`` manual only
+over the 'pp' mesh axis (dp/mp stay under GSPMD via ``axis_names``), a
+``lax.scan`` over schedule ticks, and ``lax.ppermute`` moving activations
+stage→stage over ICI.  ``jax.grad`` through the scan yields the reverse
+pipeline automatically — backward scheduling falls out of AD instead of being
+hand-written (the subtle part of the reference's interleaved 1F1B).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.random import key_stream
+
+
+def _layer_scan(block_fn, x, stacked_params, rng_key):
+    """Scan over stacked layers, threading a fresh dropout key per layer."""
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    keys = jax.random.split(rng_key, n_layers) if rng_key is not None else None
+
+    def body(h, xs):
+        if keys is None:
+            return block_fn(xs, h), None
+        lp, k = xs
+        with key_stream(k):
+            return block_fn(lp, h), None
+
+    xs = stacked_params if keys is None else (stacked_params, keys)
+    out, _ = lax.scan(body, x, xs)
+    return out
+
+
+def spmd_pipeline(block_fn, stacked_params, x, *, mesh, n_microbatches,
+                  axis="pp", rng_key=None):
+    """Run ``x`` through pipeline stages inside the current jit trace.
+
+    Args:
+      block_fn: pure ``(layer_params, hidden) -> hidden`` for ONE layer.
+      stacked_params: pytree with leaves ``[num_layers, ...]`` — will be
+        split so each stage owns ``num_layers // pp`` consecutive layers.
+      x: activations ``[batch, ...]`` (a global array; dp/mp shardings stay
+        under GSPMD).
+      n_microbatches: must divide batch.
+    Returns activations after all layers, same shape as x.
+    """
+    pp = mesh.shape[axis]
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if pp == 1:
+        return _layer_scan(block_fn, x, stacked_params, rng_key)
+
+    m = n_microbatches
+    batch = x.shape[0]
+    assert batch % m == 0, f"batch {batch} not divisible by microbatches {m}"
+    assert n_layers % pp == 0, \
+        f"num_layers {n_layers} not divisible by pp degree {pp}"
+
+    other_axes = frozenset(n for n in mesh.axis_names if n != axis)
+
+    def stage_fn(local_params, x_local):
+        # local_params leaves: [layers_per_stage, ...]; x_local: [m, mb, ...]
+        stage = lax.axis_index(axis)
+        # decorrelate dropout across stages and ticks
+        stage_key = (jax.random.fold_in(rng_key, stage)
+                     if rng_key is not None else None)
+
+        def run_stage(h, tick):
+            k = (jax.random.fold_in(stage_key, tick)
+                 if stage_key is not None else None)
+            return _layer_scan(block_fn, h, local_params, k)
+
+        state = jnp.zeros_like(x_local[0])
+        outputs = jnp.zeros_like(x_local)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t while t < m
+            inject = x_local[jnp.clip(t, 0, m - 1)]
+            state = jnp.where((stage == 0) & (t < m), inject, state)
+            out = run_stage(state, t)
+            # last stage emits microbatch (t - pp + 1)
+            mb_idx = t - (pp - 1)
+            valid = (stage == pp - 1) & (mb_idx >= 0) & (mb_idx < m)
+            outputs = jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(
+                    outputs, out, jnp.clip(mb_idx, 0, m - 1), 0),
+                outputs)
+            state = lax.ppermute(out, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                       jnp.arange(m + pp - 1))
+        # replicate the last stage's outputs to every stage
+        outputs = lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
+            axis)
+        return outputs
+
+    mapped = jax.shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                  P()),
+        out_specs=P(),
+        axis_names=frozenset({axis}),
+        check_vma=False)
+
+    x_micro = x.reshape((m, batch // m) + x.shape[1:])
+    if "dp" in mesh.axis_names:
+        x_micro = lax.with_sharding_constraint(
+            x_micro, jax.sharding.NamedSharding(
+                mesh, P(None, "dp", *([None] * (x_micro.ndim - 2)))))
+    out = mapped(stacked_params, x_micro)
+    return out.reshape(x.shape)
