@@ -5,6 +5,18 @@ Patching operator methods onto Tensor mirrors the reference's
 """
 
 from . import creation, linalg, logic, manipulation, math, random  # noqa: F401
+from . import (  # noqa: F401
+    conv_extra,
+    fft_ops,
+    fused_ops,
+    graph_ops,
+    misc_ops,
+    optim_ops,
+    pool_ops,
+    seq_ops,
+    sparse_ops,
+    vision_ops,
+)
 from .dispatch import apply_op
 from .registry import OPS, coverage, op, raw  # noqa: F401
 from ..core.tensor import Tensor

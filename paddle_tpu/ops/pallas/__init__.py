@@ -19,9 +19,10 @@ def _use_pallas():
 
 
 def _xla_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
-                   dropout_key=None):
+                   dropout_key=None, scale=None):
     """Reference XLA attention on [B, T, N, H] (paddle flash-attn layout)."""
-    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=jnp.float32))
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=jnp.float32))
     qf = q.astype(jnp.float32)
     kf = k.astype(jnp.float32)
     logits = jnp.einsum("btnh,bsnh->bnts", qf, kf) * scale
@@ -43,17 +44,24 @@ def _xla_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
 
 
 def flash_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
-                    dropout_key=None):
+                    dropout_key=None, scale=None):
     """Flash attention on [batch, seq, num_heads, head_dim].
 
-    Attention dropout forces the XLA path (the Pallas kernel is
-    dropout-free, like most production flash kernels at inference/bf16
-    pretrain settings)."""
-    if _use_pallas() and attn_mask is None and dropout_p == 0.0:
+    When ``dropout_p > 0`` and no explicit key is given, a key is drawn from
+    the global RNG (paddle.seed-controlled) — attention dropout must not be
+    silently dropped.  Attention dropout forces the XLA path (the Pallas
+    kernel is dropout-free, like most production flash kernels at
+    inference/bf16 pretrain settings)."""
+    if dropout_p > 0.0 and dropout_key is None:
+        from ...framework.random import get_rng_key
+        dropout_key = get_rng_key()
+    if (_use_pallas() and attn_mask is None and dropout_p == 0.0
+            and scale is None):
         try:
             from .flash_attention import flash_attention_pallas
             return flash_attention_pallas(q, k, v, is_causal)
         except Exception:
             pass
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
-                          dropout_p=dropout_p, dropout_key=dropout_key)
+                          dropout_p=dropout_p, dropout_key=dropout_key,
+                          scale=scale)

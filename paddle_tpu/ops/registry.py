@@ -53,6 +53,19 @@ def raw(name):
     return OPS[name].jax_fn
 
 
+def register_external(name, user_fn, jax_fn=None, tags=()):
+    """Register an already-wrapped user-facing function under ``name``.
+
+    For ops whose public entry point lives outside the ``@op`` decorator
+    (creation/random fns returning Tensors directly, collective wrappers,
+    rng-threading wrappers).  Keeps the coverage table honest without
+    forcing everything through ``apply_op``.
+    """
+    if name not in OPS:
+        OPS[name] = OpDef(name, jax_fn, user_fn, tuple(tags))
+    return user_fn
+
+
 def coverage(yaml_names=None):
     """Return (registered, total, pct) against an op-name inventory."""
     if yaml_names is None:

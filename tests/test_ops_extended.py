@@ -1,0 +1,452 @@
+"""Tests for the extended op set (OpTest-style numeric checks vs NumPy,
+mirroring test/legacy_test/eager_op_test.py:377 in the reference)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.registry import OPS
+
+
+def t(x, **kw):
+    return paddle.to_tensor(x, **kw)
+
+
+# ------------------------------------------------------------------ fft
+
+def test_fft_c2c_roundtrip():
+    x = np.random.randn(4, 8).astype(np.complex64)
+    f = OPS["fft_c2c"].user_fn(t(x), axes=[1], normalization="backward",
+                               forward=True)
+    b = OPS["fft_c2c"].user_fn(f, axes=[1], normalization="backward",
+                               forward=False)
+    np.testing.assert_allclose(b.numpy(), x, atol=1e-5)
+
+
+def test_fft_r2c_matches_numpy():
+    x = np.random.randn(6, 10).astype(np.float32)
+    out = OPS["fft_r2c"].user_fn(t(x), axes=[1], normalization="backward",
+                                 forward=True, onesided=True)
+    np.testing.assert_allclose(out.numpy(), np.fft.rfft(x, axis=1),
+                               atol=1e-4)
+
+
+def test_fft_c2r_matches_numpy():
+    x = np.random.randn(4, 9).astype(np.float32)
+    spec = np.fft.rfft(x, axis=1)
+    out = OPS["fft_c2r"].user_fn(t(spec.astype(np.complex64)), axes=[1],
+                                 last_dim_size=9)
+    np.testing.assert_allclose(out.numpy(), x, atol=1e-4)
+
+
+# ------------------------------------------------------------- interp
+
+def test_bilinear_interp_matches_manual():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    out = OPS["bilinear_interp"].user_fn(t(x), size=[8, 8],
+                                         align_corners=True)
+    assert out.shape == [1, 1, 8, 8]
+    # corners preserved under align_corners
+    np.testing.assert_allclose(out.numpy()[0, 0, 0, 0], 0.0, atol=1e-5)
+    np.testing.assert_allclose(out.numpy()[0, 0, -1, -1], 15.0, atol=1e-5)
+
+
+def test_nearest_interp_shape():
+    x = np.random.randn(2, 3, 5, 5).astype(np.float32)
+    out = OPS["nearest_interp"].user_fn(t(x), size=[10, 10],
+                                        align_corners=False)
+    assert out.shape == [2, 3, 10, 10]
+
+
+# -------------------------------------------------------- grid sample
+
+def test_affine_grid_identity():
+    theta = np.asarray([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+    grid = OPS["affine_grid"].user_fn(t(theta), [1, 1, 4, 4],
+                                      align_corners=True)
+    assert grid.shape == [1, 4, 4, 2]
+    np.testing.assert_allclose(grid.numpy()[0, 0, 0], [-1, -1], atol=1e-6)
+    np.testing.assert_allclose(grid.numpy()[0, -1, -1], [1, 1], atol=1e-6)
+
+
+def test_grid_sample_identity():
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    theta = np.asarray([[[1.0, 0, 0], [0, 1.0, 0]]], np.float32)
+    grid = OPS["affine_grid"].user_fn(t(theta), [1, 2, 5, 5],
+                                      align_corners=True)
+    out = OPS["grid_sample"].user_fn(t(x), grid, align_corners=True)
+    np.testing.assert_allclose(out.numpy(), x, atol=1e-4)
+
+
+# ------------------------------------------------------------- roi ops
+
+def test_roi_align_whole_image_mean():
+    x = np.ones((1, 1, 4, 4), np.float32) * 7.0
+    boxes = np.asarray([[0.0, 0.0, 4.0, 4.0]], np.float32)
+    out = OPS["roi_align"].user_fn(t(x), t(boxes), pooled_height=2,
+                                   pooled_width=2, spatial_scale=1.0,
+                                   aligned=False)
+    np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 7.0),
+                               atol=1e-4)
+
+
+# ----------------------------------------------------------------- nms
+
+def test_nms_suppresses_overlap():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 10.5, 10.5],
+                        [20, 20, 30, 30]], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    idx, cnt = OPS["nms"].user_fn(t(boxes), 0.5, t(scores))
+    assert int(cnt.numpy()) == 2
+    kept = set(idx.numpy()[:2].tolist())
+    assert kept == {0, 2}
+
+
+def test_multiclass_nms3_shapes():
+    bboxes = np.random.rand(2, 6, 4).astype(np.float32) * 10
+    scores = np.random.rand(2, 3, 6).astype(np.float32)
+    out, idx, cnt = OPS["multiclass_nms3"].user_fn(
+        t(bboxes), t(scores), keep_top_k=4)
+    assert out.shape == [8, 6]
+    assert cnt.shape == [2]
+
+
+# ---------------------------------------------------------------- pool
+
+def test_pool2d_avg_matches_numpy():
+    x = np.random.randn(1, 1, 4, 4).astype(np.float32)
+    out = OPS["pool2d"].user_fn(t(x), kernel_size=2, strides=2,
+                                pooling_type="avg")
+    ref = x.reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-5)
+
+
+def test_max_pool2d_with_index_and_unpool_roundtrip():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    vals, idx = OPS["max_pool2d_with_index"].user_fn(t(x), kernel_size=2,
+                                                     strides=2)
+    assert vals.shape == [1, 2, 2, 2]
+    up = OPS["unpool"].user_fn(vals, idx, kernel_size=2, strides=2)
+    assert up.shape == [1, 2, 4, 4]
+    # unpooled values at argmax positions match the max values
+    np.testing.assert_allclose(np.sort(up.numpy()[up.numpy() != 0]),
+                               np.sort(vals.numpy().ravel()), atol=1e-6)
+
+
+# ----------------------------------------------------- optimizer kernels
+
+def test_adam_kernel_matches_reference_math():
+    rng = np.random.RandomState(0)
+    p = rng.randn(5).astype(np.float32)
+    g = rng.randn(5).astype(np.float32)
+    m1 = np.zeros(5, np.float32)
+    m2 = np.zeros(5, np.float32)
+    b1p = np.asarray([1.0], np.float32)
+    b2p = np.asarray([1.0], np.float32)
+    outs = OPS["adam_"].user_fn(t(p), t(g), 0.1, t(m1), t(m2), t(b1p),
+                                t(b2p))
+    m1r = 0.1 * g
+    m2r = 0.001 * g * g
+    pr = p - 0.1 * (m1r / (1 - 0.9)) / (np.sqrt(m2r / (1 - 0.999)) + 1e-8)
+    np.testing.assert_allclose(outs[0].numpy(), pr, rtol=1e-5)
+
+
+def test_sgd_kernel():
+    p = np.ones(3, np.float32)
+    g = np.ones(3, np.float32)
+    out, _ = OPS["sgd_"].user_fn(t(p), 0.5, t(g))
+    np.testing.assert_allclose(out.numpy(), 0.5 * np.ones(3), atol=1e-6)
+
+
+def test_check_finite_and_unscale():
+    xs = [np.asarray([2.0, 4.0], np.float32)]
+    outs, found = OPS["check_finite_and_unscale_"].user_fn(
+        [t(xs[0])], t(np.asarray([2.0], np.float32)))
+    np.testing.assert_allclose(outs[0].numpy(), [1.0, 2.0], atol=1e-6)
+    assert not bool(found.numpy()[0])
+    outs, found = OPS["check_finite_and_unscale_"].user_fn(
+        [t(np.asarray([np.inf], np.float32))],
+        t(np.asarray([1.0], np.float32)))
+    assert bool(found.numpy()[0])
+
+
+# -------------------------------------------------------------- seq ops
+
+def test_rnn_lstm_shapes_and_manual_check():
+    T, B, I, H = 3, 2, 4, 5
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, B, I).astype(np.float32)
+    wi = rng.randn(4 * H, I).astype(np.float32) * 0.1
+    wh = rng.randn(4 * H, H).astype(np.float32) * 0.1
+    bi = np.zeros(4 * H, np.float32)
+    bh = np.zeros(4 * H, np.float32)
+    h0 = np.zeros((1, B, H), np.float32)
+    c0 = np.zeros((1, B, H), np.float32)
+    out, (hT, cT) = OPS["rnn"].user_fn(
+        t(x), (t(h0), t(c0)), [t(wi), t(wh), t(bi), t(bh)],
+        hidden_size=H, mode="LSTM")
+    assert out.shape == [T, B, H]
+    assert hT.shape == [1, B, H]
+
+
+def test_warpctc_runs():
+    T, B, C, L = 6, 2, 5, 3
+    logits = np.random.randn(T, B, C).astype(np.float32)
+    labels = np.random.randint(1, C, (B, L)).astype(np.int32)
+    loss = OPS["warpctc"].user_fn(
+        t(logits), t(labels),
+        t(np.full((B,), T, np.int32)), t(np.full((B,), L, np.int32)))
+    assert loss.shape == [B]
+    assert np.all(np.isfinite(loss.numpy()))
+
+
+def test_warprnnt_simple():
+    B, T, U, C = 1, 2, 1, 3
+    logits = np.zeros((B, T, U + 1, C), np.float32)
+    labels = np.asarray([[1]], np.int32)
+    loss = OPS["warprnnt"].user_fn(
+        t(logits), t(labels), t(np.asarray([T], np.int32)),
+        t(np.asarray([U], np.int32)))
+    # uniform logits: prob of each path = (1/3)^3, two paths
+    expected = -np.log(2 * (1 / 3) ** 3)
+    np.testing.assert_allclose(loss.numpy(), [expected], rtol=1e-4)
+
+
+def test_viterbi_decode_simple():
+    # 2 tags; potentials force tag 1 at every step
+    pot = np.asarray([[[0.0, 5.0], [0.0, 5.0], [0.0, 5.0]]], np.float32)
+    trans = np.zeros((2, 2), np.float32)
+    scores, path = OPS["viterbi_decode"].user_fn(
+        t(pot), t(trans), t(np.asarray([3], np.int64)),
+        include_bos_eos_tag=False)
+    np.testing.assert_array_equal(path.numpy()[0], [1, 1, 1])
+    np.testing.assert_allclose(scores.numpy()[0], 15.0, atol=1e-5)
+
+
+def test_edit_distance():
+    hyp = np.asarray([[1, 2, 3, 0]], np.int64)
+    ref = np.asarray([[1, 3, 3, 0]], np.int64)
+    d, n = OPS["edit_distance"].user_fn(t(hyp), t(ref),
+                                        t(np.asarray([3], np.int64)),
+                                        t(np.asarray([3], np.int64)),
+                                        normalized=False)
+    np.testing.assert_allclose(d.numpy(), [[1.0]], atol=1e-6)
+
+
+def test_frame_overlap_add_roundtrip():
+    x = np.random.randn(2, 16).astype(np.float32)
+    fr = OPS["frame"].user_fn(t(x), frame_length=4, hop_length=4)
+    assert fr.shape == [2, 4, 4]
+    back = OPS["overlap_add"].user_fn(fr, hop_length=4)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-5)
+
+
+def test_gather_tree():
+    ids = np.asarray([[[2, 5]], [[3, 6]]], np.int64)      # [T=2, B=1, W=2]
+    parents = np.asarray([[[0, 0]], [[1, 0]]], np.int64)
+    out = OPS["gather_tree"].user_fn(t(ids), t(parents))
+    # beam0 at t=1 came from parent 1 → path [5, 3]
+    np.testing.assert_array_equal(out.numpy()[:, 0, 0], [5, 3])
+
+
+# ------------------------------------------------------------ graph ops
+
+def test_send_u_recv_sum():
+    x = np.asarray([[1.0], [2.0], [3.0]], np.float32)
+    src = np.asarray([0, 1, 2, 0], np.int32)
+    dst = np.asarray([1, 2, 0, 0], np.int32)
+    out = OPS["send_u_recv"].user_fn(t(x), t(src), t(dst), reduce_op="sum")
+    np.testing.assert_allclose(out.numpy(), [[4.0], [1.0], [2.0]],
+                               atol=1e-6)
+
+
+def test_segment_pool_mean():
+    x = np.asarray([[1.0], [3.0], [10.0]], np.float32)
+    seg = np.asarray([0, 0, 1], np.int32)
+    out = OPS["segment_pool"].user_fn(t(x), t(seg), pooltype="MEAN")
+    np.testing.assert_allclose(out.numpy()[:2], [[2.0], [10.0]], atol=1e-6)
+
+
+# ----------------------------------------------------------- vision misc
+
+def test_fold_unfold_roundtrip_ones():
+    x = np.random.randn(1, 2, 4, 4).astype(np.float32)
+    unfolded = paddle.nn.functional.unfold(t(x), kernel_sizes=[2, 2],
+                                           strides=2)
+    folded = OPS["fold"].user_fn(unfolded, output_sizes=[4, 4],
+                                 kernel_sizes=[2, 2], strides=2)
+    np.testing.assert_allclose(folded.numpy(), x, atol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    prior = np.asarray([[0.0, 0.0, 10.0, 10.0]], np.float32)
+    target = np.asarray([[1.0, 1.0, 9.0, 9.0]], np.float32)
+    enc = OPS["box_coder"].user_fn(t(prior), None, t(target),
+                                   code_type="encode_center_size")
+    dec = OPS["box_coder"].user_fn(t(prior), None, enc[:, 0, :][None]
+                                   if False else enc,
+                                   code_type="decode_center_size")
+    np.testing.assert_allclose(dec.numpy().reshape(-1), target.reshape(-1),
+                               atol=1e-3)
+
+
+def test_channel_shuffle():
+    x = np.arange(8, dtype=np.float32).reshape(1, 4, 1, 2)
+    out = OPS["channel_shuffle"].user_fn(t(x), groups=2)
+    assert out.shape == [1, 4, 1, 2]
+    np.testing.assert_allclose(out.numpy()[0, :, 0, 0], [0, 4, 2, 6])
+
+
+def test_deformable_conv_zero_offset_equals_conv():
+    rng = np.random.RandomState(0)
+    x = rng.randn(1, 2, 5, 5).astype(np.float32)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    offset = np.zeros((1, 2 * 9, 3, 3), np.float32)
+    out = OPS["deformable_conv"].user_fn(t(x), t(offset), t(w),
+                                         strides=(1, 1), paddings=(0, 0))
+    ref = paddle.nn.functional.conv2d(t(x), t(w)).numpy()
+    np.testing.assert_allclose(out.numpy(), ref, atol=1e-3)
+
+
+def test_yolo_box_shapes():
+    n, na, cls, h = 1, 2, 3, 4
+    x = np.random.randn(n, na * (5 + cls), h, h).astype(np.float32)
+    img = np.asarray([[128, 128]], np.int32)
+    boxes, scores = OPS["yolo_box"].user_fn(
+        t(x), t(img), anchors=[10, 13, 16, 30], class_num=cls)
+    assert boxes.shape == [n, na * h * h, 4]
+    assert scores.shape == [n, na * h * h, cls]
+
+
+# ----------------------------------------------------------- misc ops
+
+def test_p_norm_and_frobenius():
+    x = np.asarray([[3.0, 4.0]], np.float32)
+    out = OPS["p_norm"].user_fn(t(x), porder=2.0, axis=1)
+    np.testing.assert_allclose(out.numpy(), [5.0], atol=1e-5)
+    fro = OPS["frobenius_norm"].user_fn(t(x))
+    np.testing.assert_allclose(fro.numpy(), 5.0, atol=1e-5)
+
+
+def test_batch_norm_updates_stats():
+    x = np.random.randn(8, 3, 2, 2).astype(np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    out, m_out, v_out, _, _ = OPS["batch_norm_"].user_fn(
+        t(x), t(mean), t(var), momentum=0.9)
+    assert out.shape == [8, 3, 2, 2]
+    np.testing.assert_allclose(m_out.numpy(),
+                               0.1 * x.mean((0, 2, 3)), atol=1e-4)
+    # normalized output has ~zero mean
+    np.testing.assert_allclose(out.numpy().mean((0, 2, 3)),
+                               np.zeros(3), atol=1e-4)
+
+
+def test_cross_entropy_with_softmax():
+    logits = np.asarray([[1.0, 2.0, 3.0]], np.float32)
+    label = np.asarray([[2]], np.int64)
+    sm, loss = OPS["cross_entropy_with_softmax"].user_fn(t(logits), t(label))
+    ref = -np.log(np.exp(3) / np.exp([1, 2, 3]).sum())
+    np.testing.assert_allclose(loss.numpy().reshape(-1), [ref], rtol=1e-5)
+
+
+def test_lu_unpack_reconstructs():
+    import scipy.linalg as sla
+    a = np.random.randn(4, 4).astype(np.float32)
+    lu, piv = sla.lu_factor(a)
+    p, l, u = OPS["lu_unpack"].user_fn(t(lu.astype(np.float32)),
+                                       t((piv + 1).astype(np.int32)))
+    rec = p.numpy() @ l.numpy() @ u.numpy()
+    np.testing.assert_allclose(rec, a, atol=1e-4)
+
+
+def test_multiplex():
+    a = np.asarray([[1.0], [2.0]], np.float32)
+    b = np.asarray([[10.0], [20.0]], np.float32)
+    idx = np.asarray([[1], [0]], np.int32)
+    out = OPS["multiplex"].user_fn([t(a), t(b)], t(idx))
+    np.testing.assert_allclose(out.numpy(), [[10.0], [2.0]], atol=1e-6)
+
+
+def test_shard_index():
+    x = np.asarray([[1], [5], [9]], np.int64)
+    out = OPS["shard_index"].user_fn(t(x), index_num=12, nshards=3,
+                                     shard_id=1)
+    np.testing.assert_array_equal(out.numpy(), [[-1], [1], [-1]])
+
+
+def test_sparse_roundtrip():
+    x = np.zeros((3, 4), np.float32)
+    x[0, 1] = 5.0
+    x[2, 3] = 7.0
+    idx, vals, shape = OPS["to_sparse_coo"].user_fn(t(x))
+    dense = OPS["to_dense"].user_fn(idx, vals, (3, 4))
+    np.testing.assert_allclose(dense.numpy(), x, atol=1e-6)
+
+
+def test_depthwise_conv2d():
+    x = np.random.randn(1, 3, 5, 5).astype(np.float32)
+    w = np.random.randn(3, 1, 3, 3).astype(np.float32)
+    out = OPS["depthwise_conv2d"].user_fn(t(x), t(w))
+    assert out.shape == [1, 3, 3, 3]
+    # each output channel only depends on its input channel
+    ref0 = paddle.nn.functional.conv2d(t(x[:, :1]), t(w[:1])).numpy()
+    np.testing.assert_allclose(out.numpy()[:, :1], ref0, atol=1e-4)
+
+
+def test_conv3d_transpose_shape():
+    x = np.random.randn(1, 2, 3, 3, 3).astype(np.float32)
+    w = np.random.randn(2, 4, 2, 2, 2).astype(np.float32)
+    out = OPS["conv3d_transpose"].user_fn(t(x), t(w), stride=2)
+    assert out.shape == [1, 4, 6, 6, 6]
+
+
+def test_spectral_norm_unit_sigma():
+    rng = np.random.RandomState(7)
+    w = rng.randn(4, 3).astype(np.float32)
+    u = rng.randn(4).astype(np.float32)
+    v = rng.randn(3).astype(np.float32)
+    out = OPS["spectral_norm"].user_fn(t(w), t(u), t(v), power_iters=50)
+    s = np.linalg.svd(out.numpy(), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, atol=1e-3)
+
+
+def test_fused_attention_matches_unfused():
+    rng = np.random.RandomState(0)
+    b_, t_, c, nh = 1, 4, 8, 2
+    hd = c // nh
+    x = rng.randn(b_, t_, c).astype(np.float32)
+    qkvw = rng.randn(3, nh, hd, c).astype(np.float32) * 0.1
+    lw = rng.randn(c, c).astype(np.float32) * 0.1
+    out = OPS["fused_attention"].user_fn(
+        t(x), t(qkvw), None, t(lw), None, num_heads=nh, pre_layer_norm=True,
+        ln_scale=t(np.ones(c, np.float32)), ln_bias=t(np.zeros(c, np.float32)))
+    assert out.shape == [b_, t_, c]
+    assert np.all(np.isfinite(out.numpy()))
+
+
+def test_merge_selected_rows():
+    rows = np.asarray([1, 1, 3], np.int64)
+    vals = np.asarray([[1.0], [2.0], [5.0]], np.float32)
+    uniq, summed = OPS["merge_selected_rows"].user_fn(t(rows), t(vals))
+    got = {int(r): float(v) for r, v in zip(uniq.numpy(), summed.numpy())
+           if r >= 0}
+    assert got[1] == 3.0 and got[3] == 5.0
+
+
+def test_accuracy_op():
+    vals = np.asarray([[0.9], [0.8]], np.float32)
+    indices = np.asarray([[2], [1]], np.int64)
+    label = np.asarray([[2], [0]], np.int64)
+    acc, correct, total = OPS["accuracy"].user_fn(t(vals), t(indices),
+                                                  t(label))
+    np.testing.assert_allclose(acc.numpy(), 0.5, atol=1e-6)
+
+
+def test_grad_flows_through_new_ops():
+    x = t(np.random.randn(2, 3, 8, 8).astype(np.float32),
+          stop_gradient=False)
+    out = OPS["bilinear_interp"].user_fn(x, size=[4, 4], align_corners=False)
+    out.backward(t(np.ones((2, 3, 4, 4), np.float32)))
+    assert x.grad is not None
+    assert x.grad.shape == [2, 3, 8, 8]
