@@ -1,0 +1,112 @@
+// Native flag registry + memory stat counters.
+//
+// Flag registry: parity with the reference's exported-gflags surface
+// (paddle/phi/core/flags.cc — PHI_DEFINE_EXPORTED_*, paddle.set_flags /
+// get_flags); here a mutex-guarded string map seeded from FLAGS_* env vars on
+// first touch, shared by every in-process consumer (Python layer mirrors it).
+//
+// Memory stats: parity with paddle/fluid/memory/stats.cc —
+// Stat{Update,GetCurrent,GetPeak} keyed by (kind, device id) with a
+// lock-free peak update. On TPU, device memory is owned by PjRt/XLA, so these
+// track host-side accounting and whatever the Python layer reports from
+// device allocation stats.
+#include "paddle_native.h"
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_flags_mu;
+std::map<std::string, std::string>& flag_map() {
+  static std::map<std::string, std::string> m;
+  return m;
+}
+
+struct StatSlot {
+  std::atomic<int64_t> current{0};
+  std::atomic<int64_t> peak{0};
+};
+
+std::mutex g_stats_mu;
+std::map<std::string, StatSlot*>& stat_map() {
+  static std::map<std::string, StatSlot*> m;
+  return m;
+}
+
+StatSlot* slot(const char* kind, int dev_id) {
+  std::string key = std::string(kind) + "#" + std::to_string(dev_id);
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  auto& m = stat_map();
+  auto it = m.find(key);
+  if (it == m.end()) it = m.emplace(key, new StatSlot).first;
+  return it->second;
+}
+
+char* dup_cstr(const std::string& s) {
+  char* out = static_cast<char*>(malloc(s.size() + 1));
+  memcpy(out, s.c_str(), s.size() + 1);
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int pd_flags_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  flag_map()[name] = value ? value : "";
+  return 0;
+}
+
+char* pd_flags_get(const char* name) {
+  {
+    std::lock_guard<std::mutex> lk(g_flags_mu);
+    auto& m = flag_map();
+    auto it = m.find(name);
+    if (it != m.end()) return dup_cstr(it->second);
+  }
+  const char* env = getenv(name);
+  if (!env) return nullptr;
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  flag_map()[name] = env;
+  return dup_cstr(env);
+}
+
+char* pd_flags_dump(void) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  std::string out;
+  for (auto& kv : flag_map()) {
+    out += kv.first;
+    out += "=";
+    out += kv.second;
+    out += "\n";
+  }
+  return dup_cstr(out);
+}
+
+void pd_stat_update(const char* kind, int dev_id, int64_t delta) {
+  StatSlot* s = slot(kind, dev_id);
+  int64_t cur = s->current.fetch_add(delta) + delta;
+  int64_t prev = s->peak.load();
+  while (cur > prev && !s->peak.compare_exchange_weak(prev, cur)) {}
+}
+
+int64_t pd_stat_current(const char* kind, int dev_id) {
+  return slot(kind, dev_id)->current.load();
+}
+
+int64_t pd_stat_peak(const char* kind, int dev_id) {
+  return slot(kind, dev_id)->peak.load();
+}
+
+void pd_stat_reset_peak(const char* kind, int dev_id) {
+  StatSlot* s = slot(kind, dev_id);
+  s->peak.store(s->current.load());
+}
+
+}  // extern "C"

@@ -1,0 +1,68 @@
+// paddle_tpu native runtime core — C ABI surface.
+//
+// TPU-native equivalents of the reference's host-side C++ runtime pieces:
+//   * TCPStore rendezvous KV   (ref: paddle/phi/core/distributed/store/tcp_store.h:120)
+//   * exported flag registry   (ref: paddle/phi/core/flags.cc)
+//   * host/device memory stats (ref: paddle/fluid/memory/stats.cc)
+//   * enforce-style error stack (ref: paddle/fluid/platform/enforce.h)
+//
+// Fresh design, not a port: single poll()-driven server thread, length-prefixed
+// binary frames, C ABI only (loaded from Python via ctypes — no pybind11).
+#ifndef PADDLE_NATIVE_H_
+#define PADDLE_NATIVE_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+// ---------------------------------------------------------------- TCPStore --
+// Server: owns the KV map; runs a background poll loop.
+// Returns opaque handle (NULL on failure). port==0 picks a free port.
+void* pd_store_server_start(int port);
+int   pd_store_server_port(void* server);
+void  pd_store_server_stop(void* server);
+
+// Client: blocking connection to host:port.
+// timeout_ms applies to connect and to every wait().
+void* pd_store_client_connect(const char* host, int port, int timeout_ms);
+void  pd_store_client_close(void* client);
+
+// All return 0 on success, negative errno-style codes on failure.
+int pd_store_set(void* client, const char* key, const uint8_t* val, uint64_t len);
+// get: allocates *val via malloc (caller frees with pd_free). -2 == not found.
+int pd_store_get(void* client, const char* key, uint8_t** val, uint64_t* len);
+// add: atomic fetch-add on an int64 counter key; *out receives the new value.
+int pd_store_add(void* client, const char* key, int64_t delta, int64_t* out);
+// wait: block until key exists (server-side parked wait, no polling).
+int pd_store_wait(void* client, const char* key, int timeout_ms);
+int pd_store_del(void* client, const char* key);
+int pd_store_num_keys(void* client, int64_t* out);
+
+void pd_free(void* p);
+
+// ------------------------------------------------------------------- Flags --
+int         pd_flags_set(const char* name, const char* value);
+// returns malloc'd string (pd_free) or NULL if unset.
+char*       pd_flags_get(const char* name);
+// newline-joined "name=value" dump; malloc'd.
+char*       pd_flags_dump(void);
+
+// ------------------------------------------------------------ Memory stats --
+// Mirrors Stat{Update,GetCurrent,GetPeak} keyed by (stat_kind, dev_id).
+void    pd_stat_update(const char* kind, int dev_id, int64_t delta);
+int64_t pd_stat_current(const char* kind, int dev_id);
+int64_t pd_stat_peak(const char* kind, int dev_id);
+void    pd_stat_reset_peak(const char* kind, int dev_id);
+
+// ------------------------------------------------------------------ Errors --
+// Thread-local last-error string for all pd_* calls; malloc'd copy.
+char* pd_last_error(void);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // PADDLE_NATIVE_H_

@@ -1,0 +1,469 @@
+// TCPStore — rendezvous key-value store for multi-host bootstrap.
+//
+// Role parity with the reference's master-hosted KV store
+// (paddle/phi/core/distributed/store/tcp_store.h:120, tcp_utils.cc): rank 0
+// hosts the map; all ranks set/get/add/wait to coordinate mesh bootstrap and
+// barriers over DCN.  The design here is new: one poll(2) loop services all
+// connections with non-blocking sockets and per-connection reassembly
+// buffers, and wait() parks server-side (a deferred-reply list flushed after
+// every mutation) instead of client polling.
+//
+// Wire format (little-endian):
+//   request : u8 opcode | u32 klen | key bytes | payload
+//     SET  payload: u64 vlen | value bytes
+//     ADD  payload: i64 delta
+//     GET/WAIT/DEL/NUMKEYS payload: none
+//   response: u8 status(0 ok, 1 not-found) | u64 vlen | value bytes
+#include "paddle_native.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 1, kGet = 2, kAdd = 3, kWait = 4, kDel = 5, kNumKeys = 6 };
+enum Status : uint8_t { kOk = 0, kNotFound = 1 };
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+struct Conn {
+  int fd;
+  std::string inbuf;   // partially received request bytes
+  std::string outbuf;  // pending response bytes not yet flushed
+  bool parked = false; // blocked in WAIT
+  std::string wait_key;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  int wake_r = -1, wake_w = -1;  // self-pipe to interrupt poll on stop
+  std::thread loop;
+  std::atomic<bool> stopping{false};
+  std::unordered_map<std::string, std::string> kv;
+  std::vector<Conn*> conns;
+};
+
+void append_u32(std::string* s, uint32_t v) { s->append(reinterpret_cast<char*>(&v), 4); }
+void append_u64(std::string* s, uint64_t v) { s->append(reinterpret_cast<char*>(&v), 8); }
+
+void reply_value(Conn* c, uint8_t status, const void* data, uint64_t len) {
+  c->outbuf.push_back(static_cast<char>(status));
+  append_u64(&c->outbuf, len);
+  if (len) c->outbuf.append(reinterpret_cast<const char*>(data), len);
+}
+
+// Flush parked WAITs whose key now exists.
+void flush_waiters(Server* s) {
+  for (Conn* c : s->conns) {
+    if (c->parked && s->kv.count(c->wait_key)) {
+      c->parked = false;
+      reply_value(c, kOk, nullptr, 0);
+    }
+  }
+}
+
+// Try to consume one complete request from c->inbuf. Returns false if more
+// bytes are needed.
+bool handle_one(Server* s, Conn* c) {
+  const std::string& b = c->inbuf;
+  if (b.size() < 5) return false;
+  uint8_t op = static_cast<uint8_t>(b[0]);
+  uint32_t klen;
+  memcpy(&klen, b.data() + 1, 4);
+  size_t need = 5 + klen;
+  uint64_t vlen = 0;
+  if (op == kSet) {
+    if (b.size() < need + 8) return false;
+    memcpy(&vlen, b.data() + need, 8);
+    need += 8 + vlen;
+  } else if (op == kAdd) {
+    need += 8;
+  }
+  if (b.size() < need) return false;
+
+  std::string key(b.data() + 5, klen);
+  switch (op) {
+    case kSet: {
+      s->kv[key].assign(b.data() + 5 + klen + 8, vlen);
+      reply_value(c, kOk, nullptr, 0);
+      flush_waiters(s);
+      break;
+    }
+    case kGet: {
+      auto it = s->kv.find(key);
+      if (it == s->kv.end()) reply_value(c, kNotFound, nullptr, 0);
+      else reply_value(c, kOk, it->second.data(), it->second.size());
+      break;
+    }
+    case kAdd: {
+      int64_t delta;
+      memcpy(&delta, b.data() + 5 + klen, 8);
+      int64_t cur = 0;
+      auto it = s->kv.find(key);
+      if (it != s->kv.end() && it->second.size() == 8)
+        memcpy(&cur, it->second.data(), 8);
+      cur += delta;
+      s->kv[key].assign(reinterpret_cast<char*>(&cur), 8);
+      reply_value(c, kOk, &cur, 8);
+      flush_waiters(s);
+      break;
+    }
+    case kWait: {
+      if (s->kv.count(key)) reply_value(c, kOk, nullptr, 0);
+      else { c->parked = true; c->wait_key = key; }
+      break;
+    }
+    case kDel: {
+      s->kv.erase(key);
+      reply_value(c, kOk, nullptr, 0);
+      break;
+    }
+    case kNumKeys: {
+      int64_t n = static_cast<int64_t>(s->kv.size());
+      reply_value(c, kOk, &n, 8);
+      break;
+    }
+    default:
+      reply_value(c, kNotFound, nullptr, 0);
+  }
+  c->inbuf.erase(0, need);
+  return true;
+}
+
+void set_nonblock(int fd) { fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK); }
+
+void server_loop(Server* s) {
+  char tmp[65536];
+  while (!s->stopping.load()) {
+    std::vector<pollfd> pfds;
+    pfds.push_back({s->listen_fd, POLLIN, 0});
+    pfds.push_back({s->wake_r, POLLIN, 0});
+    for (Conn* c : s->conns) {
+      short ev = POLLIN;
+      if (!c->outbuf.empty()) ev |= POLLOUT;
+      pfds.push_back({c->fd, ev, 0});
+    }
+    if (poll(pfds.data(), pfds.size(), 1000) < 0) {
+      if (errno == EINTR) continue;
+      break;
+    }
+    if (pfds[1].revents & POLLIN) { (void)!read(s->wake_r, tmp, sizeof tmp); }
+    // Service existing connections first; pfds was sized before any accept,
+    // so only the first n_polled conns have a pollfd this round.
+    size_t n_polled = pfds.size() - 2;
+    for (size_t i = 0; i < n_polled; ++i) {
+      Conn* c = s->conns[i];
+      pollfd& p = pfds[2 + i];
+      bool dead = false;
+      if (p.revents & (POLLERR | POLLHUP)) dead = true;
+      if (!dead && (p.revents & POLLIN)) {
+        ssize_t n = recv(c->fd, tmp, sizeof tmp, 0);
+        if (n <= 0) dead = (n == 0 || errno != EAGAIN);
+        else {
+          c->inbuf.append(tmp, n);
+          while (handle_one(s, c)) {}
+        }
+      }
+      if (!dead && (p.revents & POLLOUT) && !c->outbuf.empty()) {
+        ssize_t n = send(c->fd, c->outbuf.data(), c->outbuf.size(), MSG_NOSIGNAL);
+        if (n > 0) c->outbuf.erase(0, n);
+        else if (n < 0 && errno != EAGAIN) dead = true;
+      }
+      if (dead) { close(c->fd); c->fd = -1; }
+    }
+    for (size_t i = 0; i < s->conns.size();) {
+      if (s->conns[i]->fd < 0) { delete s->conns[i]; s->conns.erase(s->conns.begin() + i); }
+      else ++i;
+    }
+    if (pfds[0].revents & POLLIN) {
+      int fd = accept(s->listen_fd, nullptr, nullptr);
+      if (fd >= 0) {
+        set_nonblock(fd);
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+        s->conns.push_back(new Conn{fd});
+      }
+    }
+  }
+}
+
+// --------------------------------------------------------------- client ----
+
+struct Client {
+  int fd = -1;
+  int timeout_ms = 30000;
+};
+
+bool send_all(Client* c, const void* data, size_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len) {
+    ssize_t n = send(c->fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      set_error(std::string("send: ") + strerror(errno));
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+bool recv_all(Client* c, void* data, size_t len, int timeout_ms) {
+  char* p = static_cast<char*>(data);
+  while (len) {
+    pollfd pfd{c->fd, POLLIN, 0};
+    int r = poll(&pfd, 1, timeout_ms);
+    if (r == 0) { set_error("recv timeout"); return false; }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      set_error(std::string("poll: ") + strerror(errno));
+      return false;
+    }
+    ssize_t n = recv(c->fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      set_error("connection closed by store server");
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+// Any failure mid-request leaves the stream desynchronized (e.g. a WAIT
+// timeout whose reply arrives later), so the connection is poisoned: closed
+// immediately, and every later call fails loudly instead of reading stale
+// frames.
+void poison(Client* c) {
+  if (c->fd >= 0) { close(c->fd); c->fd = -1; }
+}
+
+bool request(Client* c, uint8_t op, const char* key, const std::string& payload,
+             uint8_t* status, std::string* value, int timeout_ms) {
+  if (c->fd < 0) {
+    set_error("store connection previously failed; reconnect required");
+    return false;
+  }
+  std::string req;
+  req.push_back(static_cast<char>(op));
+  append_u32(&req, static_cast<uint32_t>(strlen(key)));
+  req.append(key);
+  req.append(payload);
+  if (!send_all(c, req.data(), req.size())) { poison(c); return false; }
+  uint8_t st;
+  if (!recv_all(c, &st, 1, timeout_ms)) { poison(c); return false; }
+  uint64_t vlen;
+  if (!recv_all(c, &vlen, 8, timeout_ms)) { poison(c); return false; }
+  value->resize(vlen);
+  if (vlen && !recv_all(c, &value->front(), vlen, timeout_ms)) {
+    poison(c);
+    return false;
+  }
+  *status = st;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_store_server_start(int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { set_error("socket failed"); return nullptr; }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0 ||
+      listen(fd, 128) < 0) {
+    set_error(std::string("bind/listen: ") + strerror(errno));
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  set_nonblock(fd);
+  auto* s = new Server;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  int pipefd[2];
+  if (pipe(pipefd) == 0) { s->wake_r = pipefd[0]; s->wake_w = pipefd[1]; set_nonblock(s->wake_r); }
+  s->loop = std::thread(server_loop, s);
+  return s;
+}
+
+int pd_store_server_port(void* server) {
+  return server ? static_cast<Server*>(server)->port : -1;
+}
+
+void pd_store_server_stop(void* server) {
+  if (!server) return;
+  auto* s = static_cast<Server*>(server);
+  s->stopping.store(true);
+  if (s->wake_w >= 0) { char b = 1; (void)!write(s->wake_w, &b, 1); }
+  if (s->loop.joinable()) s->loop.join();
+  for (Conn* c : s->conns) { close(c->fd); delete c; }
+  close(s->listen_fd);
+  if (s->wake_r >= 0) close(s->wake_r);
+  if (s->wake_w >= 0) close(s->wake_w);
+  delete s;
+}
+
+void* pd_store_client_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) {
+    set_error(std::string("getaddrinfo failed for ") + host);
+    return nullptr;
+  }
+  // Retry non-blocking connects until timeout — peers may start before the
+  // rank-0 server — with each attempt's poll bounded by the remaining time.
+  int fd = -1;
+  int waited = 0;
+  while (true) {
+    fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) {
+      set_error(std::string("socket: ") + strerror(errno));
+      freeaddrinfo(res);
+      return nullptr;
+    }
+    set_nonblock(fd);
+    int rc = connect(fd, res->ai_addr, res->ai_addrlen);
+    if (rc == 0) break;
+    if (errno == EINPROGRESS) {
+      pollfd pfd{fd, POLLOUT, 0};
+      int remaining = timeout_ms - waited;
+      int attempt_ms = remaining < 1000 ? remaining : 1000;
+      int pr = poll(&pfd, 1, attempt_ms > 0 ? attempt_ms : 0);
+      waited += attempt_ms;
+      int err = 0;
+      socklen_t elen = sizeof err;
+      if (pr > 0 &&
+          getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) == 0 && err == 0)
+        break;
+    }
+    close(fd);
+    fd = -1;
+    if (waited >= timeout_ms) {
+      set_error(std::string("connect timeout to ") + host + ":" + portstr);
+      freeaddrinfo(res);
+      return nullptr;
+    }
+    usleep(200 * 1000);
+    waited += 200;
+  }
+  // back to blocking mode for the request/response path
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) & ~O_NONBLOCK);
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  auto* c = new Client;
+  c->fd = fd;
+  c->timeout_ms = timeout_ms;
+  return c;
+}
+
+void pd_store_client_close(void* client) {
+  if (!client) return;
+  auto* c = static_cast<Client*>(client);
+  close(c->fd);
+  delete c;
+}
+
+int pd_store_set(void* client, const char* key, const uint8_t* val, uint64_t len) {
+  auto* c = static_cast<Client*>(client);
+  std::string payload;
+  append_u64(&payload, len);
+  payload.append(reinterpret_cast<const char*>(val), len);
+  uint8_t st;
+  std::string out;
+  if (!request(c, kSet, key, payload, &st, &out, c->timeout_ms)) return -1;
+  return st == kOk ? 0 : -2;
+}
+
+int pd_store_get(void* client, const char* key, uint8_t** val, uint64_t* len) {
+  auto* c = static_cast<Client*>(client);
+  uint8_t st;
+  std::string out;
+  if (!request(c, kGet, key, "", &st, &out, c->timeout_ms)) return -1;
+  if (st != kOk) return -2;
+  *len = out.size();
+  *val = static_cast<uint8_t*>(malloc(out.size() ? out.size() : 1));
+  memcpy(*val, out.data(), out.size());
+  return 0;
+}
+
+int pd_store_add(void* client, const char* key, int64_t delta, int64_t* out) {
+  auto* c = static_cast<Client*>(client);
+  std::string payload(reinterpret_cast<char*>(&delta), 8);
+  uint8_t st;
+  std::string resp;
+  if (!request(c, kAdd, key, payload, &st, &resp, c->timeout_ms) || resp.size() != 8)
+    return -1;
+  memcpy(out, resp.data(), 8);
+  return 0;
+}
+
+int pd_store_wait(void* client, const char* key, int timeout_ms) {
+  auto* c = static_cast<Client*>(client);
+  uint8_t st;
+  std::string out;
+  int t = timeout_ms > 0 ? timeout_ms : c->timeout_ms;
+  if (!request(c, kWait, key, "", &st, &out, t)) return -1;
+  return st == kOk ? 0 : -2;
+}
+
+int pd_store_del(void* client, const char* key) {
+  auto* c = static_cast<Client*>(client);
+  uint8_t st;
+  std::string out;
+  if (!request(c, kDel, key, "", &st, &out, c->timeout_ms)) return -1;
+  return 0;
+}
+
+int pd_store_num_keys(void* client, int64_t* out) {
+  auto* c = static_cast<Client*>(client);
+  uint8_t st;
+  std::string resp;
+  if (!request(c, kNumKeys, "", "", &st, &resp, c->timeout_ms) || resp.size() != 8)
+    return -1;
+  memcpy(out, resp.data(), 8);
+  return 0;
+}
+
+void pd_free(void* p) { free(p); }
+
+char* pd_last_error(void) {
+  char* out = static_cast<char*>(malloc(g_last_error.size() + 1));
+  memcpy(out, g_last_error.c_str(), g_last_error.size() + 1);
+  return out;
+}
+
+}  // extern "C"

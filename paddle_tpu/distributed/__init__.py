@@ -36,4 +36,5 @@ from .parallel import (  # noqa: F401
     init_parallel_env,
     is_initialized,
 )
+from .store import TCPStore  # noqa: F401
 from . import fleet  # noqa: F401

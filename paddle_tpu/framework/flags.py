@@ -31,6 +31,16 @@ def set_flags(flags):
             _flags[k] = v
         else:
             _flags[k] = _FLAG_DEFS[k][1](v)
+    # Mirror into the native registry (paddle/phi/core/flags.cc parity) so
+    # C++ runtime components observe the same values.  Only when the library
+    # is already loaded — set_flags must never trigger a compile.
+    try:
+        from ..core import native as _native
+        if _native.loaded():
+            for k in flags:
+                _native.flags_set(k, _flags[k])
+    except Exception:
+        pass
 
 
 def get_flags(flags=None):

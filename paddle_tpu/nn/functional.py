@@ -461,6 +461,15 @@ def adaptive_avg_pool1d(x, output_size):
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
+    from ..ops import pallas as _pallas
+    if (len(normalized_shape) == 1 and weight is not None
+            and bias is not None and _pallas._use_pallas()):
+        from ..ops.pallas.layernorm_kernel import layernorm_pallas, supports
+        rows = 1
+        for d in x.shape[:-1]:
+            rows *= d
+        if supports(rows, x.shape[-1]):
+            return layernorm_pallas(x, weight, bias, eps=epsilon)
     axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)

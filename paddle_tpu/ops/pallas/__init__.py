@@ -57,11 +57,13 @@ def flash_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
         dropout_key = get_rng_key()
     if (_use_pallas() and attn_mask is None and dropout_p == 0.0
             and scale is None):
-        try:
-            from .flash_attention import flash_attention_pallas
+        from .attention_kernel import flash_attention_pallas, supports
+        # causal masking in the kernel is top-left aligned; for seq_q !=
+        # seq_k the paddle/XLA semantics are bottom-right aligned, so only
+        # self-attention-shaped causal inputs take the kernel path
+        causal_ok = (not is_causal) or q.shape[1] == k.shape[1]
+        if causal_ok and supports(q.shape[1], k.shape[1], q.shape[3]):
             return flash_attention_pallas(q, k, v, is_causal)
-        except Exception:
-            pass
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           dropout_p=dropout_p, dropout_key=dropout_key,
                           scale=scale)

@@ -1,0 +1,165 @@
+"""Native C++ runtime core: TCPStore, flags, memory stats.
+
+Mirrors the reference's store/flag tests; the multi-client barrier test
+follows the multi-process-on-one-box pattern (SURVEY §4.2) with threads as
+ranks, exercising the real TCP path.
+"""
+
+import struct
+import threading
+
+import pytest
+
+from paddle_tpu.core import native as pd_native
+from paddle_tpu.distributed.store import TCPStore
+
+
+def test_native_builds():
+    assert pd_native.available(), "native lib must compile (g++ is in image)"
+
+
+def _roundtrip(store_ctor):
+    master = store_ctor()
+    master.set("alpha", b"hello")
+    assert master.get("alpha") == b"hello"
+    assert master.get("missing") is None
+    assert master.add("ctr", 5) == 5
+    assert master.add("ctr", -2) == 3
+    master.wait(["alpha"], timeout=2)
+    master.delete_key("alpha")
+    assert master.get("alpha") is None
+    assert master.num_keys() >= 1  # ctr remains
+
+
+def test_tcpstore_native_roundtrip():
+    _roundtrip(lambda: TCPStore("127.0.0.1", 0, is_master=True, world_size=1))
+
+
+def test_tcpstore_python_fallback(monkeypatch):
+    monkeypatch.setattr(pd_native, "load", lambda: None)
+    _roundtrip(lambda: TCPStore("127.0.0.1", 0, is_master=True, world_size=1))
+
+
+def test_tcpstore_wait_blocks_until_set():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    results = []
+
+    def waiter():
+        client = TCPStore("127.0.0.1", master.port, is_master=False,
+                          world_size=1)
+        client.wait(["late-key"], timeout=10)
+        results.append(struct.unpack("<q", client.get("late-key"))[0])
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+    time.sleep(0.3)
+    master.set("late-key", struct.pack("<q", 42))
+    t.join(timeout=10)
+    assert results == [42]
+
+
+def test_tcpstore_barrier_multi_client():
+    world = 4
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world)
+    arrived = []
+    lock = threading.Lock()
+
+    def rank(i):
+        s = (master if i == 0 else
+             TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=world))
+        with lock:
+            arrived.append(i)
+        s.barrier(tag="t0", timeout=15)
+        # after barrier, every rank must have arrived
+        with lock:
+            assert len(arrived) == world
+
+    threads = [threading.Thread(target=rank, args=(i,)) for i in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=20)
+        assert not t.is_alive()
+
+
+def test_tcpstore_wait_timeout_poisons_connection():
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    client = TCPStore("127.0.0.1", master.port, is_master=False, world_size=1)
+    with pytest.raises((TimeoutError, RuntimeError)):
+        client.wait(["never-set"], timeout=0.3)
+    # the stream is desynchronized after a timed-out WAIT: the connection
+    # must be dead, not silently returning stale frames
+    with pytest.raises((TimeoutError, RuntimeError, OSError)):
+        client.get("anything")
+    # the master's own connection is unaffected
+    master.set("alive", b"1")
+    assert master.get("alive") == b"1"
+
+
+def test_tcpstore_barrier_reentrant():
+    world = 2
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=world)
+    client = TCPStore("127.0.0.1", master.port, is_master=False,
+                      world_size=world)
+    rounds_done = []
+
+    def peer():
+        for r in range(3):
+            client.barrier(tag="loop", timeout=15)
+
+    t = threading.Thread(target=peer)
+    t.start()
+    for r in range(3):
+        master.barrier(tag="loop", timeout=15)
+        rounds_done.append(r)
+    t.join(timeout=20)
+    assert not t.is_alive()
+    assert rounds_done == [0, 1, 2]
+
+
+def test_tcpstore_mixed_native_fallback_protocol(monkeypatch):
+    """A fallback (pure-Python) client must interoperate with the native
+    server — both speak the same binary wire protocol."""
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    assert master._lib is not None
+    monkeypatch.setattr(pd_native, "load", lambda: None)
+    client = TCPStore("127.0.0.1", master.port, is_master=False, world_size=1)
+    assert client._lib is None
+    master.set("native-key", b"abc")
+    assert client.get("native-key") == b"abc"
+    client.set("py-key", b"xyz")
+    assert master.get("py-key") == b"xyz"
+    assert client.add("mixed-ctr", 7) == 7
+    assert master.add("mixed-ctr", 1) == 8
+    client.wait(["native-key"], timeout=2)
+    assert client.num_keys() >= 3
+
+
+def test_native_flags_mirror():
+    import paddle_tpu as paddle
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    assert pd_native.flags_get("FLAGS_check_nan_inf") in ("True", "true", "1")
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_native_stats():
+    pd_native.stat_update("TestStat", 0, 100)
+    pd_native.stat_update("TestStat", 0, 50)
+    assert pd_native.stat_current("TestStat", 0) == 150
+    assert pd_native.stat_peak("TestStat", 0) == 150
+    pd_native.stat_update("TestStat", 0, -150)
+    assert pd_native.stat_current("TestStat", 0) == 0
+    assert pd_native.stat_peak("TestStat", 0) == 150
+    pd_native.stat_reset_peak("TestStat", 0)
+    assert pd_native.stat_peak("TestStat", 0) == 0
+
+
+def test_memory_api():
+    from paddle_tpu.framework import memory
+    memory.host_stat_update("Allocated", 4096)
+    assert memory.host_stat_current("Allocated") >= 4096
+    # device-side numbers: just type-check (CPU backend may lack stats)
+    assert isinstance(memory.memory_allocated(), int)
+    assert isinstance(memory.max_memory_allocated(), int)
