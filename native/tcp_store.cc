@@ -84,17 +84,33 @@ void flush_waiters(Server* s) {
 
 // Try to consume one complete request from c->inbuf. Returns false if more
 // bytes are needed.
+constexpr uint32_t kMaxKeyLen = 1u << 16;        // 64 KiB keys
+constexpr uint64_t kMaxValueLen = 1ull << 30;    // 1 GiB values
+
+// Returns true when a full request was consumed.  A frame whose lengths
+// exceed the sanity caps (corrupt stream / port scanner) marks the
+// connection dead instead of letting `need` wrap size_t.
 bool handle_one(Server* s, Conn* c) {
   const std::string& b = c->inbuf;
   if (b.size() < 5) return false;
   uint8_t op = static_cast<uint8_t>(b[0]);
   uint32_t klen;
   memcpy(&klen, b.data() + 1, 4);
+  if (klen > kMaxKeyLen) {
+    close(c->fd);
+    c->fd = -1;
+    return false;
+  }
   size_t need = 5 + klen;
   uint64_t vlen = 0;
   if (op == kSet) {
     if (b.size() < need + 8) return false;
     memcpy(&vlen, b.data() + need, 8);
+    if (vlen > kMaxValueLen) {
+      close(c->fd);
+      c->fd = -1;
+      return false;
+    }
     need += 8 + vlen;
   } else if (op == kAdd) {
     need += 8;

@@ -38,3 +38,4 @@ from .parallel import (  # noqa: F401
 )
 from .store import TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401

@@ -143,8 +143,13 @@ class TCPStore:
                 rc = self._lib.pd_store_wait(self._client, key.encode(),
                                              int(t * 1000))
                 if rc != 0:
-                    raise TimeoutError(
-                        f"TCPStore.wait({key!r}) timed out after {t}s "
+                    err = _native.last_error(self._lib)
+                    if "timeout" in err:
+                        raise TimeoutError(
+                            f"TCPStore.wait({key!r}) timed out after {t}s "
+                            "(connection closed; reconnect required)")
+                    raise RuntimeError(
+                        f"TCPStore.wait({key!r}) failed: {err} "
                         "(connection closed; reconnect required)")
             else:
                 self._py_req(_OP_WAIT, key, timeout_s=t)

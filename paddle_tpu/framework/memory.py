@@ -44,8 +44,6 @@ def memory_reserved(device_id=0):
     stats = _device_stats(device_id)
     if "bytes_reserved" in stats:
         return int(stats["bytes_reserved"])
-    if "bytes_limit" in stats:
-        return int(stats["bytes_limit"])
     return _native.stat_current(_RESERVED, device_id)
 
 

@@ -27,6 +27,16 @@ from ..distributed.fleet.meta_parallel.mp_layers import (
 from ..nn import functional as F
 from ..nn.initializer import Normal
 from ..nn.layer_base import ParamAttr
+from ..ops.registry import op
+
+
+@op("gpt_cp_attention")
+def _cp_attention(q, k, v, mesh=None, axis="sep", mode="ring"):
+    """Context-parallel causal attention as a registered op (so the eager
+    autograd tape differentiates through the shard_map ring)."""
+    from ..distributed.fleet.meta_parallel import context_parallel_attention
+    return context_parallel_attention(q, k, v, mesh, axis=axis, mode=mode,
+                                      is_causal=True)
 
 
 class GPTConfig:
@@ -35,7 +45,7 @@ class GPTConfig:
                  max_position_embeddings=1024, hidden_dropout_prob=0.1,
                  attention_probs_dropout_prob=0.1, initializer_range=0.02,
                  layer_norm_epsilon=1e-5, sequence_parallel=False,
-                 use_flash_attention=True):
+                 use_flash_attention=True, cp_mode=None):
         self.vocab_size = vocab_size
         self.hidden_size = hidden_size
         self.num_layers = num_layers
@@ -48,6 +58,8 @@ class GPTConfig:
         self.layer_norm_epsilon = layer_norm_epsilon
         self.sequence_parallel = sequence_parallel
         self.use_flash_attention = use_flash_attention
+        # context parallelism over the mesh 'sep' axis: None | 'ring' | 'ulysses'
+        self.cp_mode = cp_mode
 
     @property
     def head_dim(self):
@@ -69,15 +81,27 @@ class GPTAttention(nn.Layer):
                                       input_is_parallel=True)
         self.dropout_p = config.attention_probs_dropout_prob
         self.resid_drop = nn.Dropout(config.hidden_dropout_prob)
+        self.cp_mode = config.cp_mode
 
     def forward(self, x):
         b, t, _ = x.shape
         qkv = self.qkv(x)
         qkv = qkv.reshape([b, t, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                             dropout_p=self.dropout_p,
-                                             training=self.training)
+        out = None
+        # attention dropout is inactive in eval, so cp only yields to the
+        # dense path when dropout would actually be applied
+        cp_usable = self.dropout_p == 0.0 or not self.training
+        if self.cp_mode and cp_usable:
+            from ..distributed.fleet.spmd import current_mesh
+            mesh = current_mesh()
+            if mesh is not None and "sep" in mesh.axis_names:
+                out = _cp_attention(q, k, v, mesh=mesh, axis="sep",
+                                    mode=self.cp_mode)
+        if out is None:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                                 dropout_p=self.dropout_p,
+                                                 training=self.training)
         out = out.reshape([b, t, self.num_heads * self.head_dim])
         return self.resid_drop(self.proj(out))
 
@@ -110,8 +134,15 @@ class GPTBlock(nn.Layer):
         self.ln_2 = nn.LayerNorm(config.hidden_size,
                                  epsilon=config.layer_norm_epsilon)
         self.mlp = GPTMLP(config)
+        self.sequence_parallel = config.sequence_parallel
 
     def forward(self, x):
+        if self.sequence_parallel:
+            # Megatron-style SP: the norm/residual segment lives seq-sharded
+            # over the mp group; GSPMD inserts the reduce-scatter/all-gather
+            # pair the reference would hand-write (SURVEY §5.7).
+            from ..distributed.fleet.meta_parallel import mark_sequence_sharded
+            x._data = mark_sequence_sharded(x._data, axis="mp", seq_dim=1)
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return x
