@@ -112,6 +112,11 @@ from . import metric  # noqa: F401, E402
 from . import vision  # noqa: F401, E402
 from .framework_io import load, save  # noqa: F401, E402
 from .ops.registry import coverage as op_coverage  # noqa: F401, E402
+from . import profiler  # noqa: F401, E402
+from . import inference  # noqa: F401, E402
+from . import incubate  # noqa: F401, E402
+from . import hapi  # noqa: F401, E402
+from .hapi import Model, summary  # noqa: F401, E402
 
 
 def disable_static(place=None):

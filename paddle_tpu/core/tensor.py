@@ -22,6 +22,7 @@ from ..framework.dtype import convert_dtype, get_default_dtype
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
                  "_backward_hooks", "name", "persistable", "trainable",
+                 "process_mesh", "placements",  # auto_parallel dist attrs
                  "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient=True, name=None):
@@ -45,6 +46,16 @@ class Tensor:
         self.name = name
         self.persistable = False
         self.trainable = not stop_gradient
+
+    def __reduce__(self):
+        # pickle as host data (autograd state intentionally dropped) — makes
+        # whole Layers picklable for jit.save / paddle.save(Layer).
+        # Subclasses (Parameter) lack __slots__, so extra attributes like
+        # mesh_axes live in __dict__ and round-trip through `extras`.
+        extras = dict(getattr(self, "__dict__", {}) or {})
+        return (_tensor_from_pickle,
+                (type(self), np.asarray(self._data), self.stop_gradient,
+                 self.name, self.persistable, extras))
 
     # ---- metadata ----
     @property
@@ -256,3 +267,12 @@ def _unwrap_index(idx):
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
     """``paddle.to_tensor`` parity."""
     return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _tensor_from_pickle(cls, data, stop_gradient, name, persistable, extras):
+    t = cls.__new__(cls)
+    Tensor.__init__(t, data, stop_gradient=stop_gradient, name=name)
+    for k, v in extras.items():
+        setattr(t, k, v)
+    t.persistable = persistable
+    return t

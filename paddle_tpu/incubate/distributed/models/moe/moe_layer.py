@@ -116,7 +116,7 @@ def global_scatter(x, local_count, global_count, group=None):
     (reference global_scatter_op.cc semantics).  x: [S, H] already ordered
     by destination rank with per-rank counts; implemented as
     lax.all_to_all inside a shard_map over the group's axis."""
-    from ....distributed.group import _ensure_default_group
+    from .....distributed.group import _ensure_default_group
 
     g = group or _ensure_default_group()
     # the tiled all_to_all below exchanges equal-size per-rank chunks; the

@@ -202,10 +202,11 @@ class TrainStep:
         loss = step(batch_x, batch_y)      # Tensors in, loss Tensor out
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True):
+    def __init__(self, model, loss_fn, optimizer, donate=True, remat=False):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        self.remat = remat
         self._params, self._frozen = _split_state(model)
         self._opt_state = optimizer.init_state_pytree(self._params)
         self._step = 0
@@ -230,6 +231,10 @@ class TrainStep:
                     loss = loss_fn(out_t, *label_t)
                 return loss._data if isinstance(loss, Tensor) else loss
 
+            if self.remat:
+                # activation rematerialization: recompute the forward during
+                # the backward pass instead of saving activations
+                loss_f = jax.checkpoint(loss_f)
             loss, grads = jax.value_and_grad(loss_f)(params)
             if grad_clip is not None:
                 grads = grad_clip.clip_pytree(grads)
@@ -269,3 +274,6 @@ class TrainStep:
     def state_dict(self):
         return {"params": self._params, "opt_state": self._opt_state,
                 "step": self._step}
+
+
+from .save_load import TranslatedLayer, load, save  # noqa: E402,F401

@@ -10,6 +10,8 @@ one GradNode.  Under ``jax.jit`` tracing the same path runs with tracers in
 functional ``jax.grad`` path instead of the tape.
 """
 
+import time
+
 import jax
 import jax.numpy as jnp
 
@@ -17,6 +19,7 @@ from ..core.tensor import Tensor
 from ..framework import mode
 from ..framework.flags import get_flags
 from ..autograd.tape import GradNode
+from ..profiler import host_events_active, record_host_event
 
 _is_tensor = lambda x: isinstance(x, Tensor)
 
@@ -45,11 +48,19 @@ def apply_op(name, fn, args, kwargs):
     requires_grad = (mode.is_grad_enabled()
                      and any(not t.stop_gradient for t in tensors))
 
+    # profiler RecordEvent parity: the reference generates a record-event
+    # into every ad_func (eager_gen.py "Dygraph Record Event")
+    timing = host_events_active()
+    t0 = time.perf_counter() if timing else 0.0
+
     if requires_grad:
         out, vjp_fn = jax.vjp(pure, *datas)
     else:
         out = pure(*datas)
         vjp_fn = None
+
+    if timing:
+        record_host_event(name, t0, time.perf_counter() - t0)
 
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
     node = None

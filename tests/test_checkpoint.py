@@ -1,0 +1,89 @@
+"""Distributed checkpoint: sharded save, reshard-on-load, async save.
+
+Reference: auto_parallel Converter re-shards checkpoints across parallel
+configs (static/converter.py); here save under one mesh layout, load under
+another, and verify bit-exact round trips.
+"""
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (
+    Converter,
+    async_save_state_dict,
+    load_state_dict,
+    save_state_dict,
+    wait_async_save,
+)
+
+
+def _mesh(shape, names):
+    return Mesh(np.array(jax.devices()).reshape(shape), names)
+
+
+def test_sharded_save_load_round_trip(tmp_path):
+    mesh = _mesh((8,), ("x",))
+    w = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharded = jax.device_put(w, NamedSharding(mesh, P("x", None)))
+    path = str(tmp_path / "ckpt")
+    save_state_dict({"w": sharded, "b": np.ones(3, np.float32)}, path)
+    assert os.path.exists(os.path.join(path, "meta.json"))
+
+    out = load_state_dict(path)
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    np.testing.assert_array_equal(np.asarray(out["b"]), np.ones(3))
+
+
+def test_reshard_on_load(tmp_path):
+    """Save row-sharded over 8; load column-sharded over 2x4 — Converter
+    parity."""
+    w = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    mesh1 = _mesh((8,), ("x",))
+    sharded = jax.device_put(w, NamedSharding(mesh1, P("x", None)))
+    path = str(tmp_path / "ckpt")
+    save_state_dict({"w": sharded}, path)
+
+    mesh2 = _mesh((2, 4), ("a", "b"))
+    target = NamedSharding(mesh2, P(None, "b"))
+    out = load_state_dict(path, shardings={"w": target})
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    assert out["w"].sharding.spec == P(None, "b")
+
+
+def test_load_into_model_tensors(tmp_path):
+    from paddle_tpu import nn
+
+    paddle.seed(0)
+    m1 = nn.Linear(4, 4)
+    path = str(tmp_path / "ckpt")
+    save_state_dict({k: v for k, v in m1.state_dict().items()}, path)
+
+    paddle.seed(123)
+    m2 = nn.Linear(4, 4)
+    sd2 = m2.state_dict()
+    load_state_dict(path, target_state_dict=sd2)
+    np.testing.assert_array_equal(m2.weight.numpy(), m1.weight.numpy())
+
+
+def test_async_save(tmp_path):
+    w = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    path = str(tmp_path / "async_ckpt")
+    async_save_state_dict({"w": jax.numpy.asarray(w)}, path)
+    wait_async_save()
+    out = load_state_dict(path)
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+
+
+def test_converter_class(tmp_path):
+    mesh = _mesh((8,), ("x",))
+    w = np.random.RandomState(2).randn(8, 4).astype(np.float32)
+    conv = Converter()
+    out = conv.convert({"w": jax.numpy.asarray(w)},
+                       target_shardings={"w": NamedSharding(mesh,
+                                                            P("x", None))})
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+    assert len(out["w"].sharding.device_set) == 8

@@ -1,0 +1,135 @@
+"""hapi Model.fit/evaluate/predict, jit.save/load, inference predictor."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.hapi import EarlyStopping, Model, summary
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.io import TensorDataset
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 1)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+def _data(n=128, seed=0):
+    rs = np.random.RandomState(seed)
+    X = rs.randn(n, 8).astype("float32")
+    Y = (X @ rs.randn(8, 1)).astype("float32")
+    return X, Y
+
+
+def test_hapi_model_fit_evaluate_predict(tmp_path):
+    paddle.seed(0)
+    X, Y = _data()
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+    model = Model(Net())
+    model.prepare(
+        optimizer=optimizer.Adam(learning_rate=1e-2,
+                                 parameters=model.parameters()),
+        loss=lambda out, y: nn.functional.mse_loss(out, y))
+    hist = model.fit(ds, epochs=3, batch_size=32, verbose=0)
+    assert hist["loss"][-1] < hist["loss"][0] * 0.7
+
+    ev = model.evaluate(ds, batch_size=32)
+    assert ev["loss"] < hist["loss"][0]
+
+    preds = model.predict(TensorDataset([paddle.to_tensor(X)]),
+                          batch_size=32, stack_outputs=True)
+    assert preds[0].shape == (128, 1)
+
+    model.save(str(tmp_path / "m"))
+    m2 = Model(Net())
+    m2.load(str(tmp_path / "m"))
+    np.testing.assert_allclose(
+        m2.network.fc1.weight.numpy(), model.network.fc1.weight.numpy())
+
+
+def test_hapi_early_stopping():
+    paddle.seed(0)
+    X, Y = _data(64)
+    ds = TensorDataset([paddle.to_tensor(X), paddle.to_tensor(Y)])
+    model = Model(Net())
+    model.prepare(
+        optimizer=optimizer.SGD(learning_rate=0.0,
+                                parameters=model.parameters()),
+        loss=lambda out, y: nn.functional.mse_loss(out, y))
+    es = EarlyStopping(monitor="loss", patience=0)
+    hist = model.fit(ds, eval_data=ds, epochs=10, batch_size=32, verbose=0,
+                     callbacks=[es])
+    # lr=0 -> no improvement -> stops after ~2 evals, far fewer than 10 epochs
+    n_epochs = len(hist["loss"]) // 2  # 2 batches per epoch
+    assert n_epochs <= 3
+
+
+def test_predict_keeps_partial_batches():
+    paddle.seed(0)
+    X, _ = _data(10)
+    model = Model(Net())
+    model.prepare(loss=None, optimizer=None)
+    preds = model.predict(TensorDataset([paddle.to_tensor(X)]),
+                          batch_size=4, stack_outputs=True)
+    assert preds[0].shape == (10, 1)  # tail batch of 2 not dropped
+
+
+def test_summary_counts():
+    net = Net()
+    info = summary(net)
+    # 8*32 + 32 + 32*1 + 1
+    assert info["total_params"] == 8 * 32 + 32 + 32 + 1
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    paddle.seed(0)
+    net = Net()
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 8)
+                         .astype("float32"))
+    want = net(x).numpy()
+    prefix = str(tmp_path / "jit_model")
+    paddle.jit.save(net, prefix)
+    loaded = paddle.jit.load(prefix)
+    got = loaded(x).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_inference_predictor(tmp_path):
+    paddle.seed(0)
+    net = Net()
+    x = np.random.RandomState(2).randn(4, 8).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+
+    prefix = str(tmp_path / "inf_model")
+    paddle.jit.save(net, prefix)
+
+    config = Config(prefix)
+    predictor = create_predictor(config)
+    # positional style
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+    # handle style
+    h = predictor.get_input_handle(predictor.get_input_names()[0])
+    h.copy_from_cpu(x)
+    predictor.run()
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    np.testing.assert_allclose(out_h.copy_to_cpu(), want, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_inference_predictor_in_process_model():
+    paddle.seed(0)
+    net = Net()
+    x = np.random.RandomState(3).randn(2, 8).astype("float32")
+    want = net(paddle.to_tensor(x)).numpy()
+    config = Config()
+    config.set_model_obj(net)
+    predictor = create_predictor(config)
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)

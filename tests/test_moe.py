@@ -138,6 +138,26 @@ def test_moe_expert_parallel_sharded():
     assert len(moe.w1._data.sharding.device_set) == 8
 
 
+def test_global_scatter_gather_roundtrip():
+    """Uniform-count all-to-all over the default 8-device group."""
+    from paddle_tpu.incubate.distributed.models.moe import (
+        global_gather,
+        global_scatter,
+    )
+
+    n = 8
+    x = paddle.to_tensor(
+        np.arange(n * n * 4, dtype=np.float32).reshape(n * n, 4))
+    counts = np.full(n, n, dtype=np.int64)
+    scattered = global_scatter(x, counts, counts)
+    back = global_gather(scattered, counts, counts)
+    np.testing.assert_array_equal(back.numpy(), x.numpy())
+    with pytest.raises(NotImplementedError, match="uniform"):
+        bad = counts.copy()
+        bad[0] += 1
+        global_scatter(x, bad, counts)
+
+
 def test_switch_gate_jitter_only_in_training():
     paddle.seed(0)
     moe = MoELayer(d_model=8, d_hidden=16, num_experts=4, gate="switch")
