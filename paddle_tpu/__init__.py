@@ -117,6 +117,13 @@ from . import inference  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
 from . import hapi  # noqa: F401, E402
 from .hapi import Model, summary  # noqa: F401, E402
+from . import fft  # noqa: F401, E402
+from . import signal  # noqa: F401, E402
+from . import sparse  # noqa: F401, E402
+from . import distribution  # noqa: F401, E402
+from . import quantization  # noqa: F401, E402
+from . import geometric  # noqa: F401, E402
+from . import static  # noqa: F401, E402
 
 
 def disable_static(place=None):

@@ -39,3 +39,7 @@ from .parallel import (  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
+from .spawn import spawn  # noqa: F401

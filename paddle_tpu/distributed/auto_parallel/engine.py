@@ -99,6 +99,7 @@ class Engine:
 
     def evaluate(self, valid_data, batch_size=1, steps=None, verbose=1):
         loader = self._loader(valid_data, batch_size)
+        was_training = self._model.training
         self._model.eval()
         losses = []
         for m in self._metrics:
@@ -116,7 +117,8 @@ class Engine:
                     for m in self._metrics:
                         m.update(*m.compute(out, labels))
         finally:
-            self._model.train()
+            if was_training:
+                self._model.train()
         result = {}
         if losses:
             result["loss"] = float(np.mean(losses))
@@ -127,6 +129,7 @@ class Engine:
 
     def predict(self, test_data, batch_size=1, steps=None, verbose=1):
         loader = self._loader(test_data, batch_size)
+        was_training = self._model.training
         self._model.eval()
         outs = []
         try:
@@ -141,7 +144,8 @@ class Engine:
                     outs.append(np.asarray(out._data if isinstance(out, Tensor)
                                            else out))
         finally:
-            self._model.train()
+            if was_training:
+                self._model.train()
         return outs
 
     def save(self, path, training=True):

@@ -96,8 +96,9 @@ def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
             return out_d
 
         if isinstance(out, (tuple, list)):
-            return type(out)(constrain(t, s) for t, s in
-                             zip(out, out_shard_specs))
+            specs = list(out_shard_specs) + [None] * (len(out)
+                                                      - len(out_shard_specs))
+            return type(out)(constrain(t, s) for t, s in zip(out, specs))
         return constrain(out, out_shard_specs[0])
 
     return wrapped

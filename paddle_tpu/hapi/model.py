@@ -50,6 +50,7 @@ class Model:
         return [float(loss)]
 
     def eval_batch(self, inputs, labels=None):
+        was_training = self.network.training
         self.network.eval()
         try:
             out = self.network(*_tuplize(inputs))
@@ -60,9 +61,11 @@ class Model:
                 metrics.append(m.accumulate())
             return ([float(loss)] if loss is not None else []), metrics
         finally:
-            self.network.train()
+            if was_training:
+                self.network.train()
 
     def predict_batch(self, inputs):
+        was_training = self.network.training
         self.network.eval()
         try:
             out = self.network(*_tuplize(inputs))
@@ -70,7 +73,8 @@ class Model:
             return [np.asarray(o._data if isinstance(o, Tensor) else o)
                     for o in outs]
         finally:
-            self.network.train()
+            if was_training:
+                self.network.train()
 
     # -------------------------------------------------------------- loops --
     def _loader(self, data, batch_size, shuffle=False, drop_last=False):

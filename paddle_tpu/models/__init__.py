@@ -10,3 +10,13 @@ from .gpt import (  # noqa: F401
     gpt_1_3b,
     gpt_6_7b,
 )
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_base_config,
+    bert_tiny,
+    bert_tiny_config,
+)

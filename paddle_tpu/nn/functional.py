@@ -329,8 +329,12 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
             [(0, 0)] + pd_pairs + [(0, 0)]
     dims = (1, 1) + ks if data_format == "NCHW" else (1,) + ks + (1,)
     strides = (1, 1) + st if data_format == "NCHW" else (1,) + st + (1,)
-    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.inexact) else \
-        jnp.iinfo(x.dtype).min
+    if jnp.issubdtype(x.dtype, jnp.inexact):
+        # -inf (not finfo.min): lax.reduce_window's max VJP only linearizes
+        # with the identity element as the init value
+        neg = -jnp.inf
+    else:
+        neg = jnp.iinfo(x.dtype).min
     out = lax.reduce_window(x, neg, lax.max, dims, strides, pad)
     if not return_mask:
         return out

@@ -1,0 +1,280 @@
+"""API long tail: fft/signal, sparse, distribution, quantization, geometric,
+static — numerics vs numpy/scipy-style references (OpTest pattern)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# ------------------------------------------------------------------- fft ---
+
+def test_fft_round_trip_and_grad():
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 32).astype("float32"))
+    spec = paddle.fft.fft(x)
+    back = paddle.fft.ifft(spec)
+    np.testing.assert_allclose(np.asarray(back._data).real, x.numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(spec._data),
+                               np.fft.fft(x.numpy()), rtol=1e-3, atol=1e-3)
+
+
+def test_rfft_matches_numpy():
+    x = np.random.RandomState(1).randn(8, 64).astype("float32")
+    got = paddle.fft.rfft(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got._data), np.fft.rfft(x),
+                               rtol=1e-3, atol=1e-3)
+    back = paddle.fft.irfft(got)
+    np.testing.assert_allclose(np.asarray(back._data), x, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fft2_fftshift():
+    x = np.random.RandomState(2).randn(4, 8, 8).astype("float32")
+    got = paddle.fft.fft2(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got._data), np.fft.fft2(x),
+                               rtol=1e-3, atol=1e-3)
+    sh = paddle.fft.fftshift(paddle.to_tensor(x))
+    np.testing.assert_allclose(sh.numpy(), np.fft.fftshift(x))
+
+
+def test_stft_istft_round_trip():
+    x = paddle.to_tensor(
+        np.random.RandomState(3).randn(2, 512).astype("float32"))
+    win = jnp.asarray(np.hanning(128).astype("float32"))
+    spec = paddle.signal.stft(x, n_fft=128, hop_length=32, window=win)
+    assert spec.shape[-2] == 65  # onesided bins
+    back = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=win,
+                               length=512)
+    np.testing.assert_allclose(back.numpy(), x.numpy(), rtol=1e-3, atol=1e-3)
+
+
+# ---------------------------------------------------------------- sparse ---
+
+def test_sparse_coo_round_trip():
+    dense = np.zeros((4, 5), np.float32)
+    dense[0, 1] = 2.0
+    dense[3, 4] = -1.5
+    indices = [[0, 3], [1, 4]]
+    values = [2.0, -1.5]
+    sp = paddle.sparse.sparse_coo_tensor(indices, values, (4, 5))
+    assert sp.nnz == 2
+    np.testing.assert_array_equal(sp.to_dense().numpy(), dense)
+    np.testing.assert_array_equal(sp.indices().numpy(),
+                                  np.asarray(indices))
+
+
+def test_sparse_matmul_and_relu():
+    rs = np.random.RandomState(0)
+    dense = (rs.rand(6, 6) > 0.7) * rs.randn(6, 6)
+    dense = dense.astype("float32")
+    idx = np.nonzero(dense)
+    sp = paddle.sparse.sparse_coo_tensor(np.stack(idx), dense[idx],
+                                         dense.shape)
+    b = rs.randn(6, 3).astype("float32")
+    got = paddle.sparse.matmul(sp, paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), dense @ b, rtol=1e-5, atol=1e-5)
+    r = paddle.sparse.relu(sp)
+    np.testing.assert_allclose(r.to_dense().numpy(), np.maximum(dense, 0),
+                               rtol=1e-6)
+
+
+def test_sparse_csr():
+    sp = paddle.sparse.sparse_csr_tensor(
+        crows=[0, 1, 1, 3], cols=[2, 0, 1], values=[5.0, 1.0, 2.0],
+        shape=(3, 3))
+    dense = np.zeros((3, 3), np.float32)
+    dense[0, 2] = 5.0
+    dense[2, 0] = 1.0
+    dense[2, 1] = 2.0
+    np.testing.assert_array_equal(sp.to_dense().numpy(), dense)
+    assert sp.is_sparse_csr()
+
+
+# ----------------------------------------------------------- distribution --
+
+def test_normal_distribution():
+    paddle.seed(0)
+    d = paddle.distribution.Normal(0.0, 1.0)
+    s = d.sample((10000,))
+    assert abs(float(np.mean(s.numpy()))) < 0.05
+    assert abs(float(np.std(s.numpy())) - 1.0) < 0.05
+    lp = d.log_prob(paddle.to_tensor(0.0))
+    np.testing.assert_allclose(float(lp), -0.5 * np.log(2 * np.pi),
+                               rtol=1e-5)
+    d2 = paddle.distribution.Normal(1.0, 2.0)
+    kl = paddle.distribution.kl_divergence(d, d2)
+    want = np.log(2.0) + (1 + 1) / 8 - 0.5
+    np.testing.assert_allclose(float(kl), want, rtol=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    paddle.seed(0)
+    c = paddle.distribution.Categorical(
+        paddle.to_tensor([0.0, 0.0, 10.0]))
+    s = c.sample((100,))
+    assert (s.numpy() == 2).mean() > 0.95
+    ent = c.entropy()
+    assert float(ent) < 0.1
+
+    b = paddle.distribution.Bernoulli(probs=paddle.to_tensor(0.8))
+    lp = b.log_prob(paddle.to_tensor(1.0))
+    np.testing.assert_allclose(float(lp), np.log(0.8), rtol=1e-5)
+
+
+@pytest.mark.parametrize("cls,kw", [
+    ("Uniform", dict(low=0.0, high=2.0)),
+    ("Exponential", dict(rate=2.0)),
+    ("Laplace", dict(loc=0.0, scale=1.0)),
+    ("Gamma", dict(concentration=2.0, rate=1.0)),
+    ("Beta", dict(alpha=2.0, beta=3.0)),
+    ("LogNormal", dict(loc=0.0, scale=0.5)),
+    ("Dirichlet", dict(concentration=[1.0, 2.0, 3.0])),
+])
+def test_distribution_sample_logprob(cls, kw):
+    paddle.seed(0)
+    d = getattr(paddle.distribution, cls)(**kw)
+    s = d.sample((16,))
+    lp = d.log_prob(s)
+    assert np.isfinite(np.asarray(lp._data)).all()
+
+
+def test_distribution_gradients_flow():
+    """log_prob/kl_divergence through live Tensors must backprop (VAE/RL)."""
+    from paddle_tpu import optimizer
+
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    opt = optimizer.Adam(learning_rate=5e-2, parameters=net.parameters())
+    x = paddle.to_tensor(np.random.RandomState(0).randn(32, 4)
+                         .astype("float32"))
+    prior = paddle.distribution.Normal(0.0, 1.0)
+    kl0 = None
+    for _ in range(10):
+        h = net(x)
+        from paddle_tpu.ops.math import exp
+        q = paddle.distribution.Normal(h[:, :1], exp(h[:, 1:]))
+        kl = paddle.distribution.kl_divergence(q, prior).mean()
+        kl.backward()
+        opt.step()
+        opt.clear_grad()
+        if kl0 is None:
+            kl0 = float(kl)
+    assert float(kl) < kl0 * 0.9, (kl0, float(kl))
+
+
+def test_signal_frame_axis0():
+    x = np.arange(20, dtype=np.float32)
+    f = paddle.signal.frame(paddle.to_tensor(x), 4, 2, axis=0)
+    assert f.shape == [9, 4]  # [num_frames, frame_length]
+    np.testing.assert_array_equal(f.numpy()[0], x[:4])
+    np.testing.assert_array_equal(f.numpy()[1], x[2:6])
+    back = paddle.signal.overlap_add(
+        paddle.to_tensor(f.numpy()), 4, axis=0)
+    # hop == frame_length -> perfect reconstruction of covered span
+    f2 = paddle.signal.frame(paddle.to_tensor(x), 4, 4, axis=0)
+    rec = paddle.signal.overlap_add(f2, 4, axis=0)
+    np.testing.assert_array_equal(rec.numpy(), x)
+
+
+# ------------------------------------------------------------ quantization --
+
+def test_qat_fake_quant_runs_and_trains():
+    from paddle_tpu import optimizer
+    from paddle_tpu.quantization import (
+        FakeQuanterWithAbsMaxObserver,
+        QAT,
+        QuantConfig,
+    )
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                      weight=FakeQuanterWithAbsMaxObserver)
+    qnet = QAT(cfg).quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(16, 8)
+                         .astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randn(16, 1)
+                         .astype("float32"))
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    l0 = None
+    for _ in range(10):
+        loss = nn.functional.mse_loss(qnet(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if l0 is None:
+            l0 = float(loss)
+    assert float(loss) < l0
+
+
+def test_ptq_observe_and_convert():
+    from paddle_tpu.quantization import AbsmaxObserver, PTQ, QuantConfig
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 4))
+    cfg = QuantConfig(activation=AbsmaxObserver, weight=AbsmaxObserver)
+    ptq = PTQ(cfg)
+    qnet = ptq.quantize(net)
+    x = paddle.to_tensor(np.random.RandomState(2).randn(8, 4)
+                         .astype("float32"))
+    before = qnet(x).numpy()
+    ptq.convert(qnet)
+    after = qnet(x).numpy()
+    # int8 rounding error small but nonzero
+    assert np.abs(after - before).max() < 0.1
+
+
+# --------------------------------------------------------------- geometric --
+
+def test_geometric_send_u_recv():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], dtype=np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], dtype=np.int32))
+    out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    want = np.zeros((4, 3), np.float32)
+    for s, d in zip([0, 1, 2, 0], [1, 2, 1, 0]):
+        want[d] += x.numpy()[s]
+    np.testing.assert_allclose(out.numpy(), want)
+
+
+def test_geometric_segments():
+    data = paddle.to_tensor(np.array([[1., 2], [3, 4], [5, 6]], np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    s = paddle.geometric.segment_sum(data, seg)
+    np.testing.assert_allclose(s.numpy(), [[4, 6], [5, 6]])
+    m = paddle.geometric.segment_mean(data, seg)
+    np.testing.assert_allclose(m.numpy(), [[2, 3], [5, 6]])
+
+
+# ------------------------------------------------------------------ static --
+
+def test_static_save_load_inference_model(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(4, 2)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(3, 4)
+                         .astype("float32"))
+    want = net(x).numpy()
+    prefix = str(tmp_path / "static_model")
+    paddle.static.save_inference_model(prefix, [], net)
+    prog, feeds, fetches = paddle.static.load_inference_model(prefix)
+    exe = paddle.static.Executor()
+    outs = exe.run(prog, feed={"x": x.numpy()})
+    np.testing.assert_allclose(outs[0], want, rtol=1e-5, atol=1e-6)
+
+
+def test_static_program_guard_raises():
+    with pytest.raises(NotImplementedError, match="to_static"):
+        paddle.static.program_guard()
+
+
+def test_input_spec():
+    spec = paddle.static.InputSpec([None, 8], "float32", name="x")
+    assert spec.shape == [None, 8]
+    t = paddle.to_tensor(np.zeros((2, 3), np.float32))
+    s2 = paddle.static.InputSpec.from_tensor(t)
+    assert s2.shape == [2, 3]

@@ -1,0 +1,95 @@
+"""Model zoo: VGG / MobileNetV2 / ViT / BERT forward + training numerics."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def test_vgg_forward_backward():
+    paddle.seed(0)
+    from paddle_tpu.vision.models.vgg import vgg11
+
+    m = vgg11(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 224, 224).astype("float32"))
+    out = m(x)
+    assert out.shape == [1, 10]
+    loss = out.sum()
+    loss.backward()
+    assert m.features[0].weight.grad is not None
+
+
+def test_mobilenetv2_forward():
+    paddle.seed(0)
+    from paddle_tpu.vision.models.mobilenetv2 import mobilenet_v2
+
+    m = mobilenet_v2(num_classes=10)
+    m.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 96, 96).astype("float32"))
+    assert m(x).shape == [1, 10]
+
+
+def test_vit_trains():
+    paddle.seed(0)
+    from paddle_tpu.vision.models.vit import vit_tiny
+
+    m = vit_tiny()
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 3, 32, 32).astype("float32"))
+    y = paddle.to_tensor(np.random.RandomState(1).randint(0, 10, (4,))
+                         .astype("int32"))
+    losses = []
+    for _ in range(5):
+        loss = nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_classification_trains():
+    paddle.seed(0)
+    from paddle_tpu.models.bert import (
+        BertForSequenceClassification,
+        bert_tiny_config,
+    )
+
+    model = BertForSequenceClassification(bert_tiny_config())
+    opt = optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 256, (4, 32)).astype("int32"))
+    mask = paddle.to_tensor(np.ones((4, 32), dtype="float32"))
+    y = paddle.to_tensor(rs.randint(0, 2, (4,)).astype("int32"))
+    losses = []
+    for _ in range(5):
+        logits = model(ids, attention_mask=mask)
+        loss = nn.functional.cross_entropy(logits, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_pretraining_loss():
+    paddle.seed(0)
+    from paddle_tpu.models.bert import BertForPretraining, bert_tiny_config
+
+    model = BertForPretraining(bert_tiny_config())
+    rs = np.random.RandomState(0)
+    ids = paddle.to_tensor(rs.randint(0, 256, (2, 16)).astype("int32"))
+    mlm_labels = rs.randint(0, 256, (2, 16))
+    mlm_labels[:, ::2] = -100  # unmasked positions ignored
+    mlm_labels = paddle.to_tensor(mlm_labels.astype("int32"))
+    nsp = paddle.to_tensor(rs.randint(0, 2, (2,)).astype("int32"))
+    mlm_logits, nsp_logits = model(ids)
+    assert mlm_logits.shape == [2, 16, 256]
+    loss = model.loss(mlm_logits, nsp_logits, mlm_labels, nsp)
+    assert np.isfinite(float(loss))
+    loss.backward()
