@@ -1,0 +1,197 @@
+// Memory sparse table — the parameter-server embedding store.
+//
+// Role parity with the reference PS sparse tables
+// (paddle/fluid/distributed/ps/table/memory_sparse_table.cc — pull/push
+// with in-table optimizer accessors, save/load).  Design here is new:
+// sharded open hash maps guarded by per-shard mutexes, rows initialized
+// deterministically from the key (splitmix64 -> uniform), and the optimizer
+// (SGD / Adagrad) applied inside the push so the host owns optimizer state
+// for 100B-feature-scale embeddings while the TPU only sees dense pulled
+// rows.
+#include "paddle_native.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kNumShards = 16;
+
+struct Row {
+  std::vector<float> w;    // embedding weights [dim]
+  std::vector<float> g2;   // adagrad accumulator [dim] (lazily allocated)
+};
+
+struct Table {
+  int dim;
+  uint64_t seed;
+  float init_range;
+  std::unordered_map<int64_t, Row> shards[kNumShards];
+  std::mutex locks[kNumShards];
+};
+
+inline int shard_of(int64_t key) {
+  return static_cast<uint64_t>(key) % kNumShards;
+}
+
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+// deterministic per-(key, slot) uniform init in [-range, range]
+void init_row(Table* t, int64_t key, Row* row) {
+  row->w.resize(t->dim);
+  uint64_t state = splitmix64(static_cast<uint64_t>(key) ^ t->seed);
+  for (int i = 0; i < t->dim; ++i) {
+    state = splitmix64(state);
+    double u = (state >> 11) * (1.0 / 9007199254740992.0);  // [0,1)
+    row->w[i] = static_cast<float>((2.0 * u - 1.0) * t->init_range);
+  }
+}
+
+Row* find_or_create(Table* t, int64_t key) {
+  int s = shard_of(key);
+  auto& m = t->shards[s];
+  auto it = m.find(key);
+  if (it == m.end()) {
+    it = m.emplace(key, Row{}).first;
+    init_row(t, key, &it->second);
+  }
+  return &it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_table_create(int dim, float init_range, uint64_t seed) {
+  auto* t = new Table;
+  t->dim = dim;
+  t->init_range = init_range;
+  t->seed = seed;
+  return t;
+}
+
+void pd_table_destroy(void* table) { delete static_cast<Table*>(table); }
+
+int pd_table_dim(void* table) { return static_cast<Table*>(table)->dim; }
+
+int64_t pd_table_size(void* table) {
+  auto* t = static_cast<Table*>(table);
+  int64_t n = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    n += static_cast<int64_t>(t->shards[s].size());
+  }
+  return n;
+}
+
+// out: [n, dim] row-major
+void pd_table_pull(void* table, const int64_t* keys, int64_t n, float* out) {
+  auto* t = static_cast<Table*>(table);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(keys[i]);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    Row* r = find_or_create(t, keys[i]);
+    memcpy(out + i * t->dim, r->w.data(), t->dim * sizeof(float));
+  }
+}
+
+// grads: [n, dim]; duplicate keys accumulate sequentially (reference
+// accessor semantics)
+void pd_table_push_sgd(void* table, const int64_t* keys, const float* grads,
+                       int64_t n, float lr) {
+  auto* t = static_cast<Table*>(table);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(keys[i]);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    Row* r = find_or_create(t, keys[i]);
+    const float* g = grads + i * t->dim;
+    for (int d = 0; d < t->dim; ++d) r->w[d] -= lr * g[d];
+  }
+}
+
+void pd_table_push_adagrad(void* table, const int64_t* keys,
+                           const float* grads, int64_t n, float lr,
+                           float eps) {
+  auto* t = static_cast<Table*>(table);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(keys[i]);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    Row* r = find_or_create(t, keys[i]);
+    if (r->g2.empty()) r->g2.assign(t->dim, 0.0f);
+    const float* g = grads + i * t->dim;
+    for (int d = 0; d < t->dim; ++d) {
+      r->g2[d] += g[d] * g[d];
+      r->w[d] -= lr * g[d] / (sqrtf(r->g2[d]) + eps);
+    }
+  }
+}
+
+// Binary format: i32 dim | i64 count | repeated (i64 key | f32*dim w |
+// u8 has_g2 | [f32*dim g2])
+int pd_table_save(void* table, const char* path) {
+  auto* t = static_cast<Table*>(table);
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  int64_t count = pd_table_size(table);
+  fwrite(&t->dim, sizeof(int), 1, f);
+  fwrite(&count, sizeof(int64_t), 1, f);
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    for (auto& kv : t->shards[s]) {
+      fwrite(&kv.first, sizeof(int64_t), 1, f);
+      fwrite(kv.second.w.data(), sizeof(float), t->dim, f);
+      uint8_t has_g2 = kv.second.g2.empty() ? 0 : 1;
+      fwrite(&has_g2, 1, 1, f);
+      if (has_g2)
+        fwrite(kv.second.g2.data(), sizeof(float), t->dim, f);
+    }
+  }
+  fclose(f);
+  return 0;
+}
+
+int pd_table_load(void* table, const char* path) {
+  auto* t = static_cast<Table*>(table);
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  int dim = 0;
+  int64_t count = 0;
+  if (fread(&dim, sizeof(int), 1, f) != 1 || dim != t->dim ||
+      fread(&count, sizeof(int64_t), 1, f) != 1) {
+    fclose(f);
+    return -2;
+  }
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t key;
+    if (fread(&key, sizeof(int64_t), 1, f) != 1) { fclose(f); return -3; }
+    Row row;
+    row.w.resize(dim);
+    if (fread(row.w.data(), sizeof(float), dim, f)
+        != static_cast<size_t>(dim)) { fclose(f); return -3; }
+    uint8_t has_g2 = 0;
+    if (fread(&has_g2, 1, 1, f) != 1) { fclose(f); return -3; }
+    if (has_g2) {
+      row.g2.resize(dim);
+      if (fread(row.g2.data(), sizeof(float), dim, f)
+          != static_cast<size_t>(dim)) { fclose(f); return -3; }
+    }
+    int s = shard_of(key);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    t->shards[s][key] = std::move(row);
+  }
+  fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,173 @@
+"""Parameter-server stack — host-resident sparse embedding tables.
+
+Reference: the PS stack at paddle/fluid/distributed/ps/ (brpc client/server,
+memory_sparse_table.cc with in-table optimizer accessors) + Python
+the_one_ps.py (SURVEY §2.7).
+
+TPU redesign: embeddings at 100B-feature scale never fit in HBM — they live
+in host RAM in the native C++ sparse table (native/sparse_table.cc), on the
+TPU-VM CPUs.  The device only sees the dense pulled rows for the current
+batch; gradients for those rows are pushed back and the table applies its
+own optimizer (SGD/Adagrad) host-side.  Multi-host sharding keys by
+``key % num_shards`` with one table per host over DCN (the rendezvous/DCN
+plumbing reuses TCPStore); single-host runs fully in-process via ctypes.
+"""
+
+import ctypes
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...autograd.py_layer import PyLayer
+from ...core import native as _native
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+
+
+def _lib():
+    lib = _native.load()
+    if lib is None:
+        raise RuntimeError("native library unavailable; the PS sparse table "
+                           "requires the C++ runtime (g++)")
+    if not hasattr(lib.pd_table_create, "_bound"):
+        lib.pd_table_create.restype = ctypes.c_void_p
+        lib.pd_table_create.argtypes = [ctypes.c_int, ctypes.c_float,
+                                        ctypes.c_uint64]
+        lib.pd_table_destroy.argtypes = [ctypes.c_void_p]
+        lib.pd_table_dim.restype = ctypes.c_int
+        lib.pd_table_dim.argtypes = [ctypes.c_void_p]
+        lib.pd_table_size.restype = ctypes.c_int64
+        lib.pd_table_size.argtypes = [ctypes.c_void_p]
+        lib.pd_table_pull.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.pd_table_push_sgd.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float]
+        lib.pd_table_push_adagrad.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+            ctypes.c_float]
+        lib.pd_table_save.restype = ctypes.c_int
+        lib.pd_table_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_table_load.restype = ctypes.c_int
+        lib.pd_table_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_table_create._bound = True
+    return lib
+
+
+def _i64p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _f32p(arr):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class SparseTable:
+    """Host-side embedding table (memory_sparse_table.cc parity).
+
+    >>> t = SparseTable(dim=8, optimizer="adagrad", learning_rate=0.05)
+    >>> rows = t.pull(np.array([3, 17, 3]))       # [3, 8]; missing keys init
+    >>> t.push(np.array([3, 17]), grads)          # in-table optimizer step
+    """
+
+    def __init__(self, dim, optimizer="adagrad", learning_rate=0.05,
+                 init_range=0.01, epsilon=1e-8, seed=2023):
+        self._lib = _lib()
+        self._h = self._lib.pd_table_create(int(dim), float(init_range),
+                                            int(seed))
+        self.dim = int(dim)
+        self.optimizer = optimizer
+        self.learning_rate = float(learning_rate)
+        self.epsilon = float(epsilon)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.pd_table_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def __len__(self):
+        return int(self._lib.pd_table_size(self._h))
+
+    def pull(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty((len(keys), self.dim), dtype=np.float32)
+        self._lib.pd_table_pull(self._h, _i64p(keys), len(keys), _f32p(out))
+        return out
+
+    def push(self, keys, grads, learning_rate=None):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        grads = np.ascontiguousarray(np.asarray(grads, dtype=np.float32)
+                                     .reshape(len(keys), self.dim))
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        if self.optimizer == "sgd":
+            self._lib.pd_table_push_sgd(self._h, _i64p(keys), _f32p(grads),
+                                        len(keys), lr)
+        elif self.optimizer == "adagrad":
+            self._lib.pd_table_push_adagrad(self._h, _i64p(keys),
+                                            _f32p(grads), len(keys), lr,
+                                            self.epsilon)
+        else:
+            raise ValueError(f"unknown table optimizer {self.optimizer!r}")
+
+    def save(self, path):
+        rc = self._lib.pd_table_save(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"table save failed rc={rc}")
+
+    def load(self, path):
+        rc = self._lib.pd_table_load(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"table load failed rc={rc}")
+
+
+class _EmbeddingPull(PyLayer):
+    @staticmethod
+    def forward(ctx, ids, anchor, table):
+        ids_np = np.asarray(ids._data if isinstance(ids, Tensor) else ids)
+        rows = table.pull(ids_np)
+        ctx.table = table
+        ctx.ids = ids_np.reshape(-1)
+        ctx.out_shape = ids_np.shape + (table.dim,)
+        # depend on the trainable anchor so backward reaches this node
+        out = jnp.asarray(rows).reshape(ctx.out_shape)
+        return Tensor(out + 0.0 * anchor._data)
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        g = np.asarray(grad_out._data if isinstance(grad_out, Tensor)
+                       else grad_out)
+        ctx.table.push(ctx.ids, g.reshape(len(ctx.ids), ctx.table.dim))
+        anchor_grad = Tensor(jnp.zeros((1,), jnp.float32))
+        return None, anchor_grad
+
+
+class DistributedEmbedding(Layer):
+    """Embedding lookup backed by the host PS table.
+
+    Forward pulls rows for the batch's ids; backward pushes the row
+    gradients, where the table's own optimizer updates them (the device
+    optimizer never sees these parameters — reference PS semantics).
+    """
+
+    def __init__(self, dim, optimizer="adagrad", learning_rate=0.05,
+                 init_range=0.01, table=None, name=None):
+        super().__init__()
+        self.table = table if table is not None else SparseTable(
+            dim, optimizer=optimizer, learning_rate=learning_rate,
+            init_range=init_range)
+        self.dim = self.table.dim
+        # trainable anchor: routes autograd through the PyLayer
+        from ...nn.initializer import Constant
+        self._anchor = self.create_parameter(
+            (1,), default_initializer=Constant(0.0))
+
+    def forward(self, ids):
+        return _EmbeddingPull.apply(ids, self._anchor, self.table)

@@ -10,6 +10,8 @@ from .gpt import (  # noqa: F401
     gpt_1_3b,
     gpt_6_7b,
 )
+from .wide_deep import WideDeep  # noqa: F401
+from .deepspeech import DeepSpeech2, deepspeech2_tiny  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig,
     BertForPretraining,

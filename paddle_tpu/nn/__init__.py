@@ -28,6 +28,7 @@ from .container import (  # noqa: F401
     Sequential,
 )
 from .conv import Conv1D, Conv2D, Conv2DTranspose, Conv3D  # noqa: F401
+from .rnn import GRU, LSTM, SimpleRNN  # noqa: F401
 from .pooling import (  # noqa: F401
     AdaptiveAvgPool1D,
     AdaptiveAvgPool2D,

@@ -903,6 +903,23 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
     loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
     return _reduce(loss, reduction)
 
+def ctc_loss(log_probs, labels, input_lengths=None, label_lengths=None,
+             blank=0, reduction="mean", norm_by_times=False):
+    """CTC loss (reference paddle.nn.functional.ctc_loss over the warpctc
+    kernel).  log_probs: [T, B, C] time-major logits."""
+    from ..ops.seq_ops import warpctc
+
+    loss = warpctc(log_probs, labels, logits_length=input_lengths,
+                   labels_length=label_lengths, blank=blank,
+                   norm_by_times=norm_by_times)
+    # loss is a Tensor (warpctc is a registered op): reduce at Tensor level
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
 # ---------------- attention ----------------
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

@@ -77,6 +77,55 @@ def test_bert_classification_trains():
     assert losses[-1] < losses[0]
 
 
+def test_rnn_layers_forward_shapes():
+    paddle.seed(0)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 10, 8).astype("float32"))
+    lstm = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+    out, (h, c) = lstm(x)
+    assert out.shape == [2, 10, 32]
+    assert h.shape == [4, 2, 16] and c.shape == [4, 2, 16]
+    gru = nn.GRU(8, 16)
+    out, h = gru(x)
+    assert out.shape == [2, 10, 16]
+    srnn = nn.SimpleRNN(8, 16)
+    out, h = srnn(x)
+    assert out.shape == [2, 10, 16]
+
+
+def test_lstm_gradient_flows():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 8)
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(2, 6, 4).astype("float32"))
+    out, _ = lstm(x)
+    out.sum().backward()
+    assert lstm.weight_ih_l0.grad is not None
+    assert not np.allclose(lstm.weight_ih_l0.grad.numpy(), 0)
+
+
+def test_deepspeech2_ctc_trains():
+    paddle.seed(0)
+    from paddle_tpu.models.deepspeech import deepspeech2_tiny
+
+    model = deepspeech2_tiny()
+    opt = optimizer.Adam(learning_rate=2e-3, parameters=model.parameters())
+    rs = np.random.RandomState(0)
+    feats = paddle.to_tensor(rs.randn(2, 32, 16).astype("float32"))
+    labels = paddle.to_tensor(rs.randint(1, 12, (2, 5)).astype("int32"))
+    lab_len = paddle.to_tensor(np.array([5, 4], np.int32))
+    losses = []
+    for _ in range(8):
+        logits = model(feats)
+        loss = model.loss(logits, labels, label_lengths=lab_len)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+
+
 def test_bert_pretraining_loss():
     paddle.seed(0)
     from paddle_tpu.models.bert import BertForPretraining, bert_tiny_config
