@@ -56,9 +56,16 @@ def _bwd_kernel(x_ref, g_ref, mu_ref, rstd_ref, dy_ref, dx_ref, dg_ref,
     c1 = jnp.mean(wdy, axis=1, keepdims=True)
     c2 = jnp.mean(wdy * xhat, axis=1, keepdims=True)
     dx_ref[...] = ((wdy - c1 - xhat * c2) * rstd).astype(dx_ref.dtype)
-    # per-row-block partial reductions; summed over blocks by the caller
-    dg_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
-    db_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+    # dg/db accumulate across the (sequential) TPU grid into one [1, C]
+    # block — a [nb, C] partials array would need a block whose leading dim
+    # is 1, which the TPU lowering rejects for nb not divisible by 8.
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        dg_ref[...] = jnp.zeros_like(dg_ref)
+        db_ref[...] = jnp.zeros_like(db_ref)
+
+    dg_ref[...] += jnp.sum(dy * xhat, axis=0, keepdims=True)
+    db_ref[...] += jnp.sum(dy, axis=0, keepdims=True)
 
 
 def _ln_fwd(x2d, g, b, eps, block_rows, interpret):
@@ -102,17 +109,17 @@ def _ln_bwd(x2d, g, mu, rstd, dy, block_rows, interpret):
         ],
         out_specs=[
             pl.BlockSpec((block_rows, c), lambda i: (i, 0)),
-            pl.BlockSpec((1, c), lambda i: (i, 0)),
-            pl.BlockSpec((1, c), lambda i: (i, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
+            pl.BlockSpec((1, c), lambda i: (0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, c), x2d.dtype),
-            jax.ShapeDtypeStruct((nb, c), jnp.float32),
-            jax.ShapeDtypeStruct((nb, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
+            jax.ShapeDtypeStruct((1, c), jnp.float32),
         ],
         interpret=interpret,
     )(x2d, g.reshape(1, c), mu, rstd, dy)
-    return dx, jnp.sum(dgp, axis=0), jnp.sum(dbp, axis=0)
+    return dx, dgp[0], dbp[0]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
