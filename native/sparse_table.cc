@@ -143,8 +143,13 @@ int pd_table_save(void* table, const char* path) {
   auto* t = static_cast<Table*>(table);
   FILE* f = fopen(path, "wb");
   if (!f) return -1;
-  int64_t count = pd_table_size(table);
+  // The row count cannot be snapshotted up front: a concurrent push may
+  // insert keys while shards are written one lock at a time, making the
+  // header disagree with the body (truncated/misaligned load).  Write a
+  // placeholder, count rows actually written, then seek back and patch.
+  int64_t count = 0;
   fwrite(&t->dim, sizeof(int), 1, f);
+  long count_pos = ftell(f);
   fwrite(&count, sizeof(int64_t), 1, f);
   for (int s = 0; s < kNumShards; ++s) {
     std::lock_guard<std::mutex> lk(t->locks[s]);
@@ -155,8 +160,11 @@ int pd_table_save(void* table, const char* path) {
       fwrite(&has_g2, 1, 1, f);
       if (has_g2)
         fwrite(kv.second.g2.data(), sizeof(float), t->dim, f);
+      ++count;
     }
   }
+  if (fseek(f, count_pos, SEEK_SET) != 0) { fclose(f); return -4; }
+  fwrite(&count, sizeof(int64_t), 1, f);
   fclose(f);
   return 0;
 }

@@ -67,11 +67,20 @@ def _serialize_shards(host_items):
     return meta, blobs
 
 
-def _write_checkpoint(path, host_items):
+def _write_checkpoint(path, host_items, rank=None):
+    """Write this process's shards as per-rank files.
+
+    Every rank owns distinct addressable shards in a multi-host job; fixed
+    file names would make ranks clobber each other, so both the metadata and
+    the blob archive carry the process index (reference DistributedSaver
+    writes per-rank files the same way).
+    """
+    if rank is None:
+        rank = jax.process_index()
     os.makedirs(path, exist_ok=True)
     meta, blobs = _serialize_shards(host_items)
-    np.savez(os.path.join(path, "data.npz"), **blobs)
-    with open(os.path.join(path, "meta.json"), "w") as f:
+    np.savez(os.path.join(path, f"data_rank{rank}.npz"), **blobs)
+    with open(os.path.join(path, f"meta_rank{rank}.json"), "w") as f:
         json.dump(meta, f)
 
 
@@ -79,6 +88,38 @@ def save_state_dict(state_dict, path, process_group=None, coordinator=None):
     """Save a (possibly sharded) state dict as shard files + metadata."""
     _write_checkpoint(path, {key: _to_host_shards(val)
                              for key, val in state_dict.items()})
+
+
+def _read_all_ranks(path):
+    """Merge every rank's metadata into key -> (shape, dtype, entries) with
+    per-entry blob lookups; accepts the legacy single-file layout too."""
+    import glob
+
+    metas = []
+    for mf in sorted(glob.glob(os.path.join(path, "meta_rank*.json"))):
+        rank_tag = os.path.basename(mf)[len("meta_rank"):-len(".json")]
+        with open(mf) as f:
+            metas.append((json.load(f),
+                          np.load(os.path.join(path,
+                                               f"data_rank{rank_tag}.npz"))))
+    legacy = os.path.join(path, "meta.json")
+    if not metas and os.path.exists(legacy):
+        with open(legacy) as f:
+            metas.append((json.load(f),
+                          np.load(os.path.join(path, "data.npz"))))
+    if not metas:
+        raise FileNotFoundError(f"no checkpoint metadata under {path}")
+    merged = {}
+    for meta, blobs in metas:
+        for key, desc in meta.items():
+            slot = merged.setdefault(
+                key, {"shape": desc["shape"], "dtype": desc["dtype"],
+                      "entries": {}})
+            for entry in desc["shards"]:
+                idx = tuple(tuple(p) for p in entry["offsets"])
+                if idx not in slot["entries"]:  # replicated across ranks
+                    slot["entries"][idx] = blobs[entry["file"]]
+    return merged
 
 
 def load_state_dict(path, target_state_dict=None, shardings=None):
@@ -89,15 +130,20 @@ def load_state_dict(path, target_state_dict=None, shardings=None):
       Tensors are given, and also returned.
     - shardings: optional dict name -> jax Sharding overriding the target.
     """
-    with open(os.path.join(path, "meta.json")) as f:
-        meta = json.load(f)
-    blobs = np.load(os.path.join(path, "data.npz"))
+    merged = _read_all_ranks(path)
     out = {}
-    for key, desc in meta.items():
-        full = np.zeros(desc["shape"], dtype=desc["dtype"])
-        for entry in desc["shards"]:
-            sl = tuple(slice(a, b) for a, b in entry["offsets"])
-            full[sl] = blobs[entry["file"]]
+    for key, desc in merged.items():
+        full = np.empty(desc["shape"], dtype=desc["dtype"])
+        covered = 0
+        for idx, data in desc["entries"].items():
+            sl = tuple(slice(a, b) for a, b in idx)
+            full[sl] = data
+            covered += int(np.prod([b - a for a, b in idx]))
+        total = int(np.prod(desc["shape"])) if desc["shape"] else 1
+        if covered < total:
+            raise ValueError(
+                f"checkpoint for '{key}' covers {covered}/{total} elements "
+                f"— a rank's shard files are missing from {path}")
         target = None
         if shardings and key in shardings:
             target = shardings[key]

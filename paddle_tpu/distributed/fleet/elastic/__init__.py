@@ -64,7 +64,7 @@ class ElasticManager:
         now = time.time()
         dead = []
         for nid in node_ids:
-            raw = self._store.get(f"/elastic/beat/{nid}")
+            raw = self._store.get_nowait(f"/elastic/beat/{nid}")
             if raw is None or len(raw) != 8:
                 dead.append(nid)
                 continue

@@ -62,6 +62,14 @@ def launch(argv=None):
 
     store = None
     if args.master is None:
+        if args.nnodes > 1:
+            # A self-hosted 127.0.0.1 endpoint is unreachable from other
+            # nodes — the job would hang at bootstrap instead of failing
+            # fast.  Multi-node requires an explicit routable master.
+            raise SystemExit(
+                "--master is required when --nnodes > 1 (the self-hosted "
+                "rendezvous binds 127.0.0.1, which remote nodes cannot "
+                "reach). Pass --master <node0_ip>:<port>.")
         # self-host the rendezvous KV on a free port (node 0 semantics)
         from ..store import TCPStore
         store = TCPStore("127.0.0.1", 0, is_master=True,

@@ -106,7 +106,23 @@ class TCPStore:
             self._py_req(_OP_SET, key,
                          struct.pack("<Q", len(value)) + value)
 
-    def get(self, key):
+    def get(self, key, timeout=None):
+        """Blocking get: waits until ``key`` exists, then returns its value.
+
+        Matches reference TCPStore::get semantics (tcp_store.cc get() calls
+        wait() first) so bootstrap code can rely on rank 0 publishing a key
+        strictly before other ranks read it.  Raises TimeoutError if the key
+        never appears.  Use :meth:`get_nowait` for a non-blocking probe.
+        """
+        self.wait([key], timeout=timeout)
+        value = self.get_nowait(key)
+        if value is None:
+            # deleted between wait and get — treat like a missing key
+            raise KeyError(f"TCPStore key {key!r} vanished after wait")
+        return value
+
+    def get_nowait(self, key):
+        """Non-blocking probe: value bytes, or None if the key is absent."""
         if self._lib is not None:
             out = ctypes.c_void_p()
             length = ctypes.c_uint64()

@@ -39,8 +39,19 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
         self._scale = None
 
     def __call__(self, x):
-        absmax = float(jnp.max(jnp.abs(
-            x._data if isinstance(x, Tensor) else x)))
+        import jax
+
+        data = x._data if isinstance(x, Tensor) else x
+        absmax_t = jnp.max(jnp.abs(data))
+        if isinstance(absmax_t, jax.core.Tracer):
+            # Under a jit/to_static trace the scale must stay a traced array
+            # (float() would raise ConcretizationTypeError) and the Python
+            # moving-average state must not capture tracers: quantize with
+            # the current batch's abs-max and leave the eager-side moving
+            # average untouched.
+            scale = jnp.maximum(absmax_t.astype(jnp.float32), 1e-9)
+            return _fake_quant(x, scale, bits=self.bits)
+        absmax = float(absmax_t)
         if self._scale is None:
             self._scale = absmax
         else:
@@ -62,8 +73,13 @@ class AbsmaxObserver(BaseQuanter):
         self._max = 0.0
 
     def __call__(self, x):
-        self._max = max(self._max, float(jnp.max(jnp.abs(
-            x._data if isinstance(x, Tensor) else x))))
+        import jax
+
+        data = x._data if isinstance(x, Tensor) else x
+        absmax_t = jnp.max(jnp.abs(data))
+        if isinstance(absmax_t, jax.core.Tracer):
+            return x  # PTQ calibration is an eager pass; no-op under trace
+        self._max = max(self._max, float(absmax_t))
         return x
 
     def scales(self):

@@ -31,7 +31,7 @@ def test_sharded_save_load_round_trip(tmp_path):
     sharded = jax.device_put(w, NamedSharding(mesh, P("x", None)))
     path = str(tmp_path / "ckpt")
     save_state_dict({"w": sharded, "b": np.ones(3, np.float32)}, path)
-    assert os.path.exists(os.path.join(path, "meta.json"))
+    assert os.path.exists(os.path.join(path, "meta_rank0.json"))
 
     out = load_state_dict(path)
     np.testing.assert_array_equal(np.asarray(out["w"]), w)

@@ -22,12 +22,12 @@ def _roundtrip(store_ctor):
     master = store_ctor()
     master.set("alpha", b"hello")
     assert master.get("alpha") == b"hello"
-    assert master.get("missing") is None
+    assert master.get_nowait("missing") is None  # blocking get() would wait
     assert master.add("ctr", 5) == 5
     assert master.add("ctr", -2) == 3
     master.wait(["alpha"], timeout=2)
     master.delete_key("alpha")
-    assert master.get("alpha") is None
+    assert master.get_nowait("alpha") is None
     assert master.num_keys() >= 1  # ctr remains
 
 
