@@ -164,8 +164,11 @@ int pd_table_save(void* table, const char* path) {
     }
   }
   if (fseek(f, count_pos, SEEK_SET) != 0) { fclose(f); return -4; }
-  fwrite(&count, sizeof(int64_t), 1, f);
-  fclose(f);
+  if (fwrite(&count, sizeof(int64_t), 1, f) != 1) { fclose(f); return -4; }
+  // fclose flushes buffered writes; a failure here (disk full) means the
+  // header patch may not have landed — report it rather than return a
+  // valid-looking file whose header still says 0 rows.
+  if (fclose(f) != 0) return -5;
   return 0;
 }
 

@@ -75,10 +75,35 @@ def _write_checkpoint(path, host_items, rank=None):
     the blob archive carry the process index (reference DistributedSaver
     writes per-rank files the same way).
     """
+    explicit_rank = rank is not None
     if rank is None:
         rank = jax.process_index()
+    world = jax.process_count()
     os.makedirs(path, exist_ok=True)
+    # Explicit rank= means the caller is emulating a multi-rank layout from
+    # one process (tests, offline reshard tools): jax.process_count() says
+    # nothing about their intended world size, so neither stamp it nor
+    # delete sibling rank files the caller may have just written.
+    if not explicit_rank and rank == 0:
+        # Remove stale files from ranks that no longer exist (a previous
+        # save with a larger world size); merging them at load would
+        # silently resurrect old parameter values.
+        import glob
+        import re
+        for mf in glob.glob(os.path.join(path, "meta_rank*.json")):
+            m = re.match(r"meta_rank(\d+)\.json$", os.path.basename(mf))
+            if m and int(m.group(1)) >= world:
+                os.remove(mf)
+                stale = os.path.join(path, f"data_rank{m.group(1)}.npz")
+                if os.path.exists(stale):
+                    os.remove(stale)
+        for legacy in ("meta.json", "data.npz"):
+            lf = os.path.join(path, legacy)
+            if os.path.exists(lf):
+                os.remove(lf)
     meta, blobs = _serialize_shards(host_items)
+    if not explicit_rank:
+        meta["__world_size__"] = world
     np.savez(os.path.join(path, f"data_rank{rank}.npz"), **blobs)
     with open(os.path.join(path, f"meta_rank{rank}.json"), "w") as f:
         json.dump(meta, f)
@@ -109,9 +134,18 @@ def _read_all_ranks(path):
                           np.load(os.path.join(path, "data.npz"))))
     if not metas:
         raise FileNotFoundError(f"no checkpoint metadata under {path}")
+    worlds = {m.get("__world_size__") for m, _ in metas}
+    declared = next((w for w in worlds if w is not None), None)
+    if len(worlds) > 1 or (declared is not None and declared != len(metas)):
+        raise ValueError(
+            f"inconsistent checkpoint under {path}: found {len(metas)} rank "
+            f"files but metadata declares world size(s) {sorted(worlds, key=str)} "
+            "— files from different save epochs are mixed")
     merged = {}
     for meta, blobs in metas:
         for key, desc in meta.items():
+            if key == "__world_size__":
+                continue
             slot = merged.setdefault(
                 key, {"shape": desc["shape"], "dtype": desc["dtype"],
                       "entries": {}})

@@ -98,7 +98,7 @@ class TCPStore:
             value = value.encode()
         value = bytes(value)
         if self._lib is not None:
-            rc = self._lib.pd_store_set(self._client, key.encode(), value,
+            rc = self._lib.pd_store_set(self._require_client(), key.encode(), value,
                                         len(value))
             if rc != 0:
                 raise RuntimeError(f"TCPStore.set({key!r}) failed rc={rc}")
@@ -126,7 +126,7 @@ class TCPStore:
         if self._lib is not None:
             out = ctypes.c_void_p()
             length = ctypes.c_uint64()
-            rc = self._lib.pd_store_get(self._client, key.encode(),
+            rc = self._lib.pd_store_get(self._require_client(), key.encode(),
                                         ctypes.byref(out), ctypes.byref(length))
             if rc == -2:
                 return None
@@ -142,7 +142,7 @@ class TCPStore:
     def add(self, key, delta=1):
         if self._lib is not None:
             out = ctypes.c_int64()
-            rc = self._lib.pd_store_add(self._client, key.encode(), int(delta),
+            rc = self._lib.pd_store_add(self._require_client(), key.encode(), int(delta),
                                         ctypes.byref(out))
             if rc != 0:
                 raise RuntimeError(f"TCPStore.add({key!r}) failed rc={rc}")
@@ -151,35 +151,85 @@ class TCPStore:
         return struct.unpack("<q", value)[0]
 
     def wait(self, keys, timeout=None):
+        """Block until every key exists.
+
+        A timed-out WAIT desynchronizes the request stream (the server may
+        still send the reply later), so the connection is dropped — but a
+        fresh one is transparently established before raising, keeping this
+        store object usable for subsequent operations.
+        """
         if isinstance(keys, str):
             keys = [keys]
         t = timeout if timeout is not None else self.timeout
         for key in keys:
             if self._lib is not None:
-                rc = self._lib.pd_store_wait(self._client, key.encode(),
+                rc = self._lib.pd_store_wait(self._require_client(), key.encode(),
                                              int(t * 1000))
                 if rc != 0:
                     err = _native.last_error(self._lib)
+                    self._reconnect()
                     if "timeout" in err:
                         raise TimeoutError(
-                            f"TCPStore.wait({key!r}) timed out after {t}s "
-                            "(connection closed; reconnect required)")
+                            f"TCPStore.wait({key!r}) timed out after {t}s")
                     raise RuntimeError(
-                        f"TCPStore.wait({key!r}) failed: {err} "
-                        "(connection closed; reconnect required)")
+                        f"TCPStore.wait({key!r}) failed: {err}")
             else:
-                self._py_req(_OP_WAIT, key, timeout_s=t)
+                try:
+                    self._py_req(_OP_WAIT, key, timeout_s=t)
+                except (TimeoutError, OSError):
+                    self._reconnect()
+                    raise
+
+    def _reconnect(self):
+        """Replace a poisoned/closed connection with a fresh one.
+
+        Bounded by a short timeout — this runs inside failure paths (a
+        timed-out WAIT) where stalling the caller for the full store
+        timeout would delay the original error by up to 30s.  On failure
+        _client is None; subsequent ops raise via :meth:`_require_client`.
+        """
+        short = min(self.timeout, 2.0)
+        if self._lib is not None:
+            if getattr(self, "_client", None):
+                try:
+                    self._lib.pd_store_client_close(self._client)
+                except Exception:
+                    pass
+            self._client = self._lib.pd_store_client_connect(
+                self.host.encode(), self.port, int(short * 1000)) or None
+        else:
+            if getattr(self, "_client", None) is not None:
+                try:
+                    self._client.close()
+                except OSError:
+                    pass
+            try:
+                s = socket.create_connection((self.host, self.port),
+                                             timeout=short)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                s.settimeout(None)
+                self._client = s
+            except OSError:
+                self._client = None
+
+    def _require_client(self):
+        """Native client handle, or a catchable error if reconnect failed
+        (passing NULL into the C API would SIGSEGV the rank)."""
+        if self._client is None:
+            raise RuntimeError(
+                "store connection previously failed; reconnect required")
+        return self._client
 
     def delete_key(self, key):
         if self._lib is not None:
-            self._lib.pd_store_del(self._client, key.encode())
+            self._lib.pd_store_del(self._require_client(), key.encode())
         else:
             self._py_req(_OP_DEL, key)
 
     def num_keys(self):
         if self._lib is not None:
             out = ctypes.c_int64()
-            self._lib.pd_store_num_keys(self._client, ctypes.byref(out))
+            self._lib.pd_store_num_keys(self._require_client(), ctypes.byref(out))
             return out.value
         _, value = self._py_req(_OP_NUMKEYS, "")
         return struct.unpack("<q", value)[0]

@@ -40,7 +40,7 @@ def _layer_scan(block_fn, x, stacked_params, rng_key):
 
 
 def spmd_pipeline(block_fn, stacked_params, x, *, mesh, n_microbatches,
-                  axis="pp", rng_key=None):
+                  axis="pp", rng_key=None, activation_spec=None):
     """Run ``x`` through pipeline stages inside the current jit trace.
 
     Args:
@@ -115,7 +115,15 @@ def spmd_pipeline(block_fn, stacked_params, x, *, mesh, n_microbatches,
         check_vma=False)
 
     x_micro = x.reshape((m, batch // m) + x.shape[1:])
-    if "dp" in mesh.axis_names:
+    if activation_spec is not None:
+        # Keep the caller's activation sharding (e.g. dp on batch, mp on
+        # seq) on the microbatched layout instead of clobbering it — a
+        # mismatched constraint here cannot be transposed by XLA in the
+        # backward pass and triggers involuntary full rematerialization.
+        micro_spec = P(None, *activation_spec)
+        x_micro = lax.with_sharding_constraint(
+            x_micro, jax.sharding.NamedSharding(mesh, micro_spec))
+    elif "dp" in mesh.axis_names:
         x_micro = lax.with_sharding_constraint(
             x_micro, jax.sharding.NamedSharding(
                 mesh, P(None, "dp", *([None] * (x_micro.ndim - 2)))))

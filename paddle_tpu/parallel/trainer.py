@@ -135,9 +135,10 @@ class SpmdTrainStep:
                 h = jax.lax.with_sharding_constraint(
                     h, NamedSharding(mesh, seq_spec))
                 h = spmd_pipeline(blk, params["blocks"], h, mesh=mesh,
-                                  n_microbatches=n_micro, rng_key=pipe_key)
+                                  n_microbatches=n_micro, rng_key=pipe_key,
+                                  activation_spec=seq_spec)
                 h = jax.lax.with_sharding_constraint(
-                    h, NamedSharding(mesh, P("dp", None, None)))
+                    h, NamedSharding(mesh, seq_spec))
                 logits = head_fn(params["head"], h, params["embed"])
                 return loss_fn(logits, labels)
 

@@ -84,15 +84,15 @@ def test_tcpstore_barrier_multi_client():
         assert not t.is_alive()
 
 
-def test_tcpstore_wait_timeout_poisons_connection():
+def test_tcpstore_wait_timeout_recovers():
     master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
     client = TCPStore("127.0.0.1", master.port, is_master=False, world_size=1)
     with pytest.raises((TimeoutError, RuntimeError)):
         client.wait(["never-set"], timeout=0.3)
-    # the stream is desynchronized after a timed-out WAIT: the connection
-    # must be dead, not silently returning stale frames
-    with pytest.raises((TimeoutError, RuntimeError, OSError)):
-        client.get("anything")
+    # a timed-out WAIT desynchronizes the stream; the store must reconnect
+    # transparently so the object stays usable (no stale frames, no brick)
+    client.set("recovered", b"1")
+    assert client.get("recovered", timeout=2) == b"1"
     # the master's own connection is unaffected
     master.set("alive", b"1")
     assert master.get("alive") == b"1"
