@@ -4,14 +4,76 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 vs_baseline = achieved MFU / 0.45 (the BASELINE.md north-star MFU target) —
 the reference repo publishes no absolute numbers (SURVEY §6), so the target
 ratio is the honest comparison.
+
+Structure: the parent process NEVER imports jax.  A wedged TPU tunnel makes
+``import jax`` hang outright (site hooks capture env at interpreter startup
+— observed live in round 2), so the measurement runs in a worker subprocess
+under a hard timeout; on failure it retries, then falls back to a CPU worker
+with the TPU plugin env scrubbed, and always emits exactly one JSON line.
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 import traceback
 
 import numpy as np
+
+_REPO_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _emit(obj):
+    print(json.dumps(obj))
+
+
+def _run_worker(timeout, cpu=False):
+    """Run this file with --worker in a subprocess; returns (json_line, err)."""
+    env = dict(os.environ)
+    if cpu:
+        for var in ("PALLAS_AXON_POOL_IPS", "AXON_POOL_SVC_OVERRIDE",
+                    "AXON_LOOPBACK_RELAY"):
+            env.pop(var, None)
+        env["JAX_PLATFORMS"] = "cpu"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, cwd=_REPO_DIR, timeout=timeout,
+            capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, f"worker timed out after {timeout}s (cpu={cpu})"
+    for line in reversed(proc.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                json.loads(line)
+                return line, None
+            except ValueError:
+                continue
+    tail = (proc.stderr or proc.stdout or "").strip()[-400:]
+    return None, f"worker rc={proc.returncode} (cpu={cpu}): {tail}"
+
+
+def orchestrate():
+    errs = []
+    for attempt, timeout in enumerate((900, 600)):
+        line, err = _run_worker(timeout)
+        if line is not None:
+            print(line)
+            return
+        errs.append(err)
+        time.sleep(10)
+    line, err = _run_worker(600, cpu=True)
+    if line is not None:
+        obj = json.loads(line)
+        obj["error"] = "; ".join(errs)
+        _emit(obj)
+        return
+    errs.append(err)
+    _emit({"metric": "gpt124m_train_tokens_per_sec_per_chip",
+           "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+           "error": "; ".join(errs)})
 
 
 def _init_backend(retries=3, backoff=(5, 15, 30)):
@@ -136,13 +198,16 @@ def main():
 
 
 if __name__ == "__main__":
-    try:
-        main()
-    except Exception:
-        # Always emit exactly one parseable JSON line, even on failure.
-        print(json.dumps({
-            "metric": "gpt124m_train_tokens_per_sec_per_chip",
-            "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
-            "error": traceback.format_exc(limit=3).replace("\n", " | "),
-        }))
-        sys.exit(0)
+    if "--worker" in sys.argv:
+        try:
+            main()
+        except Exception:
+            # Always emit exactly one parseable JSON line, even on failure.
+            print(json.dumps({
+                "metric": "gpt124m_train_tokens_per_sec_per_chip",
+                "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
+                "error": traceback.format_exc(limit=3).replace("\n", " | "),
+            }))
+            sys.exit(0)
+    else:
+        orchestrate()
