@@ -224,7 +224,10 @@ def where(condition, x=None, y=None):
 
 @op()
 def select_scatter(x, values, axis, index):
-    return x.at[(slice(None),) * axis + (index,)].set(values)
+    import builtins
+    # builtins.slice: the module-global ``slice`` is the op wrapper below
+    ax = axis % x.ndim  # negative axis must index from the back, not axis 0
+    return x.at[(builtins.slice(None),) * ax + (index,)].set(values)
 
 @op()
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
@@ -347,16 +350,16 @@ def repeat_interleave(x, repeats, axis=None):
 
 @op()
 def slice(x, axes, starts, ends):
-    idx = [slice(None)] * x.ndim
     import builtins
+    idx = [builtins.slice(None)] * x.ndim
     for ax, st, en in zip(axes, starts, ends):
         idx[ax] = builtins.slice(int(st), int(en))
     return x[tuple(idx)]
 
 @op()
 def strided_slice(x, axes, starts, ends, strides):
-    idx = [slice(None)] * x.ndim
     import builtins
+    idx = [builtins.slice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
         idx[ax] = builtins.slice(int(st), int(en), int(sd))
     return x[tuple(idx)]

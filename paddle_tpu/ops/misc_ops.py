@@ -366,11 +366,17 @@ def thresholded_relu(x, threshold=1.0):
 
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
     if not training:
-        return OPS["scale"].user_fn(x, scale=(lower + upper) / 2.0) \
-            if hasattr(x, "_data") else x * ((lower + upper) / 2.0)
+        # eval mode: fixed slope on the NEGATIVE part only (reference
+        # rrelu_kernel.cc — leaky-relu with slope (lower+upper)/2)
+        mid = (lower + upper) / 2.0
+        @op("rrelu_eval")
+        def _rrelu_eval(x):
+            return jnp.where(x >= 0, x, (x.astype(jnp.float32) * mid)
+                             .astype(x.dtype))
+        return _rrelu_eval(x)
     key = get_rng_key()
 
-    @op("rrelu")
+    @op("rrelu_train")
     def _rrelu(x):
         a = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
         return jnp.where(x >= 0, x, (a * x.astype(jnp.float32))

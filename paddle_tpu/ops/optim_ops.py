@@ -67,11 +67,15 @@ def adam_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
          else param).astype(f32)
     g = grad.astype(f32)
     lr = jnp.asarray(learning_rate, f32)
-    b1p = jnp.asarray(beta1_pow, f32) * beta1
-    b2p = jnp.asarray(beta2_pow, f32) * beta2
+    b1p_in = jnp.asarray(beta1_pow, f32)
+    b2p_in = jnp.asarray(beta2_pow, f32)
+    # reference adam_functors.h: bias correction uses the INPUT pows
+    # (caller initializes them to beta); outputs advance them by one step
     p_new, m1n, m2n = _adam_core(p, g, moment1.astype(f32),
-                                 moment2.astype(f32), b1p, b2p, lr,
+                                 moment2.astype(f32), b1p_in, b2p_in, lr,
                                  beta1, beta2, epsilon)
+    b1p = b1p_in * beta1
+    b2p = b2p_in * beta2
     if skip_update is not None:
         skip = jnp.asarray(skip_update).reshape(())
         p_new = jnp.where(skip, p, p_new)
@@ -101,11 +105,15 @@ def adamw_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
     lr = jnp.asarray(learning_rate, f32) * lr_ratio
     if with_decay:
         p = p * (1.0 - lr * coeff)
-    b1p = jnp.asarray(beta1_pow, f32) * beta1
-    b2p = jnp.asarray(beta2_pow, f32) * beta2
+    b1p_in = jnp.asarray(beta1_pow, f32)
+    b2p_in = jnp.asarray(beta2_pow, f32)
+    # reference adam_functors.h: bias correction uses the INPUT pows
+    # (caller initializes them to beta); outputs advance them by one step
     p_new, m1n, m2n = _adam_core(p, g, moment1.astype(f32),
-                                 moment2.astype(f32), b1p, b2p, lr,
+                                 moment2.astype(f32), b1p_in, b2p_in, lr,
                                  beta1, beta2, epsilon)
+    b1p = b1p_in * beta1
+    b2p = b2p_in * beta2
     if skip_update is not None:
         skip = jnp.asarray(skip_update).reshape(())
         p0 = (master_param if multi_precision and master_param is not None
@@ -200,10 +208,12 @@ def lamb_(param, grad, learning_rate, moment1, moment2, beta1_pow, beta2_pow,
     lr = jnp.asarray(learning_rate, f32)
     m1n = beta1 * moment1.astype(f32) + (1 - beta1) * g
     m2n = beta2 * moment2.astype(f32) + (1 - beta2) * g * g
-    b1p = jnp.asarray(beta1_pow, f32) * beta1
-    b2p = jnp.asarray(beta2_pow, f32) * beta2
-    m_hat = m1n / (1 - b1p)
-    v_hat = m2n / (1 - b2p)
+    b1p_in = jnp.asarray(beta1_pow, f32)
+    b2p_in = jnp.asarray(beta2_pow, f32)
+    m_hat = m1n / (1 - b1p_in)
+    v_hat = m2n / (1 - b2p_in)
+    b1p = b1p_in * beta1
+    b2p = b2p_in * beta2
     r = m_hat / (jnp.sqrt(v_hat) + epsilon) + weight_decay * p
     w_norm = jnp.linalg.norm(p)
     r_norm = jnp.linalg.norm(r)

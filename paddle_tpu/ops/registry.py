@@ -42,7 +42,12 @@ def op(opname=None, tags=()):
             kwargs.pop("name", None)
             return apply_op(name, jfn, args, kwargs)
 
-        OPS[name] = OpDef(name, jfn, user_fn, tuple(tags))
+        # First registration wins: several public ops register a
+        # closure-capturing inner @op on every call (dropout, rrelu, …);
+        # letting those clobber the import-time entry would leave OPS[name]
+        # pointing at a narrowed signature.
+        if name not in OPS:
+            OPS[name] = OpDef(name, jfn, user_fn, tuple(tags))
         return user_fn
 
     return deco

@@ -1,0 +1,176 @@
+"""OpTest-style sweep harness.
+
+Mirrors the reference OpTest discipline (test/legacy_test/eager_op_test.py:377
+— ``check_output`` against a NumPy reference and ``check_grad`` against
+numeric finite differences) for every op in the registry inventory.
+
+Each op gets a spec:
+  make(rng) -> (args, kwargs)      inputs; numpy arrays become Tensors
+  ref(*np_args, **kwargs)          optional numpy forward reference
+  grad=(i, ...)                    positional-arg indices to grad-check
+  out(result)                      optional: select comparable array(s)
+  check(result, args, kwargs)      optional custom validator (random ops,
+                                   structural checks)
+  rtol/atol                        forward tolerances
+Ops with no spec must appear in SKIPS with an honest reason; the sweep test
+asserts the partition is exact.
+"""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS
+
+SPECS = {}
+SKIPS = {}
+
+
+def spec(name, make, ref=None, grad=(), out=None, check=None,
+         rtol=1e-5, atol=1e-6, grad_rtol=5e-2, grad_atol=5e-3, eps=1e-2):
+    assert name not in SPECS, f"duplicate spec {name}"
+    SPECS[name] = dict(make=make, ref=ref, grad=tuple(grad), out=out,
+                       check=check, rtol=rtol, atol=atol,
+                       grad_rtol=grad_rtol, grad_atol=grad_atol, eps=eps)
+
+
+def skip(name, reason):
+    assert name not in SKIPS, f"duplicate skip {name}"
+    SKIPS[name] = reason
+
+
+def _to_tensor(x, sg=True):
+    return paddle.to_tensor(np.asarray(x), stop_gradient=sg)
+
+
+def _wrap(args, grad_idx):
+    out = []
+    for i, a in enumerate(args):
+        if isinstance(a, np.ndarray):
+            out.append(_to_tensor(a, sg=i not in grad_idx))
+        else:
+            out.append(a)
+    return out
+
+
+def _arrays(result):
+    """Flatten op output into a list of numpy arrays."""
+    if isinstance(result, Tensor):
+        return [np.asarray(result.numpy())]
+    if isinstance(result, (list, tuple)):
+        flat = []
+        for r in result:
+            if isinstance(r, (Tensor, np.ndarray)) or hasattr(r, "dtype"):
+                flat.extend(_arrays(r))
+        return flat
+    if hasattr(result, "dtype"):
+        return [np.asarray(result)]
+    return []
+
+
+def _scalarize(result, weights=None):
+    """Deterministic scalar from the float outputs (for grad checks)."""
+    arrs = []
+    if isinstance(result, Tensor):
+        result = [result]
+    for r in result if isinstance(result, (list, tuple)) else [result]:
+        if isinstance(r, Tensor) and np.issubdtype(
+                np.asarray(r.numpy()).dtype, np.floating):
+            arrs.append(r)
+    total = None
+    for j, r in enumerate(arrs):
+        w = weights[j] if weights is not None else None
+        contrib = paddle.sum(r * _to_tensor(w)) if w is not None \
+            else paddle.sum(r)
+        total = contrib if total is None else total + contrib
+    return total, len(arrs)
+
+
+def _make_weights(result, rng):
+    ws = []
+    rs = result if isinstance(result, (list, tuple)) else [result]
+    for r in rs:
+        if isinstance(r, Tensor) and np.issubdtype(
+                np.asarray(r.numpy()).dtype, np.floating):
+            ws.append(rng.uniform(0.5, 1.5,
+                                  np.asarray(r.numpy()).shape)
+                      .astype(np.asarray(r.numpy()).dtype))
+    return ws
+
+
+def check_forward(name, s, rng):
+    args, kwargs = s["make"](rng)
+    fn = OPS[name].user_fn
+    targs = _wrap(args, set())
+    result = fn(*targs, **kwargs)
+    if s["check"] is not None:
+        s["check"](result, args, kwargs)
+        return
+    if s["out"] is not None:
+        result = s["out"](result)
+    if s["ref"] is None:
+        # no reference: at minimum the op must run and return finite values
+        for a in _arrays(result):
+            if np.issubdtype(a.dtype, np.floating):
+                assert np.isfinite(a).all(), f"{name}: non-finite output"
+        return
+    np_args = [a for a in args if isinstance(a, np.ndarray)]
+    expect = s["ref"](*np_args, **kwargs)
+    got = _arrays(result)
+    want = _arrays(expect) if isinstance(expect, (list, tuple)) \
+        else [np.asarray(expect)]
+    assert len(got) >= len(want), \
+        f"{name}: {len(got)} outputs vs {len(want)} expected"
+    for g, w in zip(got, want):
+        if np.issubdtype(np.asarray(w).dtype, np.floating) or \
+                np.issubdtype(np.asarray(w).dtype, np.complexfloating):
+            np.testing.assert_allclose(g, w, rtol=s["rtol"], atol=s["atol"],
+                                       err_msg=name)
+        else:
+            np.testing.assert_array_equal(g, w, err_msg=name)
+
+
+def check_grad(name, s, rng):
+    """Tape gradient vs central finite difference (OpTest check_grad)."""
+    if not s["grad"]:
+        return
+    args, kwargs = s["make"](rng)
+    grad_idx = set(s["grad"])
+    fn = OPS[name].user_fn
+
+    # weights fix the scalarization so numeric/analytic losses match
+    probe = fn(*_wrap(args, set()), **kwargs)
+    weights = _make_weights(probe, rng)
+
+    targs = _wrap(args, grad_idx)
+    result = fn(*targs, **kwargs)
+    loss, _ = _scalarize(result, weights)
+    assert loss is not None, f"{name}: no float output to grad-check"
+    loss.backward()
+
+    def numeric_loss(np_args):
+        r = fn(*_wrap(np_args, set()), **kwargs)
+        l, _ = _scalarize(r, weights)
+        return float(l.numpy())
+
+    eps = s["eps"]
+    for i in sorted(grad_idx):
+        analytic = np.asarray(targs[i].grad.numpy())
+        x = args[i]
+        flat = x.reshape(-1)
+        num = np.zeros_like(flat, dtype=np.float64)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            f_plus = numeric_loss(args)
+            flat[j] = orig - eps
+            f_minus = numeric_loss(args)
+            flat[j] = orig
+            num[j] = (f_plus - f_minus) / (2 * eps)
+        num = num.reshape(x.shape)
+        # OpTest-style relative error on the max-abs scale
+        scale = max(np.abs(num).max(), np.abs(analytic).max(), 1e-3)
+        err = np.abs(num - analytic).max() / scale
+        assert err < s["grad_rtol"], \
+            (f"{name}: grad mismatch on arg {i}: rel err {err:.4f}\n"
+             f"numeric={num}\nanalytic={analytic}")

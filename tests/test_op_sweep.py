@@ -1,0 +1,42 @@
+"""The OpTest sweep: every inventory op either numerically verified or
+skip-listed with a reason (reference eager_op_test.py:377 discipline)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.inventory import OP_INVENTORY
+
+import op_specs  # noqa: F401  (populates SPECS/SKIPS)
+from op_sweep_harness import SKIPS, SPECS, check_forward, check_grad
+
+
+def _seed(name):
+    import zlib
+    return (zlib.crc32(name.encode()) & 0x7FFFFFFF) or 1
+
+
+@pytest.mark.parametrize("name", sorted(OP_INVENTORY))
+def test_op_forward(name):
+    if name in SKIPS:
+        pytest.skip(SKIPS[name])
+    if name not in SPECS:
+        pytest.fail(f"{name}: no spec and no skip reason — add one")
+    check_forward(name, SPECS[name], np.random.RandomState(_seed(name)))
+
+
+@pytest.mark.parametrize(
+    "name", sorted(n for n, s in SPECS.items()
+                   if s["grad"] and n in OP_INVENTORY))
+def test_op_grad(name):
+    check_grad(name, SPECS[name], np.random.RandomState(_seed(name) ^ 0xA5))
+
+
+def test_partition_is_exact():
+    """Every inventory name is spec'd xor skip-listed."""
+    inv = set(OP_INVENTORY)
+    both = set(SPECS) & set(SKIPS)
+    assert not both, f"ops both spec'd and skipped: {sorted(both)}"
+    uncovered = inv - set(SPECS) - set(SKIPS)
+    assert not uncovered, (
+        f"{len(uncovered)} inventory ops have neither spec nor skip reason: "
+        f"{sorted(uncovered)[:40]}...")

@@ -141,14 +141,17 @@ def test_adam_kernel_matches_reference_math():
     g = rng.randn(5).astype(np.float32)
     m1 = np.zeros(5, np.float32)
     m2 = np.zeros(5, np.float32)
-    b1p = np.asarray([1.0], np.float32)
-    b2p = np.asarray([1.0], np.float32)
+    # reference convention (adam_functors.h): beta pows are initialized to
+    # beta and used pre-update; the kernel emits pow*beta for the next step
+    b1p = np.asarray([0.9], np.float32)
+    b2p = np.asarray([0.999], np.float32)
     outs = OPS["adam_"].user_fn(t(p), t(g), 0.1, t(m1), t(m2), t(b1p),
                                 t(b2p))
     m1r = 0.1 * g
     m2r = 0.001 * g * g
     pr = p - 0.1 * (m1r / (1 - 0.9)) / (np.sqrt(m2r / (1 - 0.999)) + 1e-8)
     np.testing.assert_allclose(outs[0].numpy(), pr, rtol=1e-5)
+    np.testing.assert_allclose(outs[3].numpy(), [0.81], rtol=1e-6)
 
 
 def test_sgd_kernel():
