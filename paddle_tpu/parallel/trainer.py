@@ -61,19 +61,35 @@ class SpmdTrainStep:
     """
 
     def __init__(self, model, optimizer, mesh, n_microbatches=1,
-                 sequence_parallel=False, remat=False, zero_stage=1):
+                 sequence_parallel=False, remat=False, zero_stage=1,
+                 virtual_pp=1):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
         self.n_microbatches = n_microbatches
         self.sequence_parallel = sequence_parallel
         self.remat = remat
+        self.virtual_pp = virtual_pp
 
         d = model.functional_decompose()
         self.fns = d["fns"]
         self.num_layers = d["num_layers"]
         params = d["params"]
         specs = d["specs"]
+
+        # Interleaved pipeline: permute the stacked layer dim ONCE here so
+        # each stage's round-robin chunks land contiguously under the P('pp')
+        # sharding — doing it inside the jitted step would re-gather half the
+        # block weights across stages every step.
+        self._layer_perm = None
+        pp_deg = mesh.shape.get("pp", 1)
+        if virtual_pp > 1 and pp_deg > 1:
+            from .pipeline import interleave_permutation
+            self._layer_perm = interleave_permutation(
+                self.num_layers, pp_deg, virtual_pp)
+            params = dict(params)
+            params["blocks"] = jax.tree_util.tree_map(
+                lambda leaf: leaf[self._layer_perm], params["blocks"])
 
         # build NamedShardings per leaf
         def shardings_for(p_tree, s_tree):
@@ -136,7 +152,9 @@ class SpmdTrainStep:
                     h, NamedSharding(mesh, seq_spec))
                 h = spmd_pipeline(blk, params["blocks"], h, mesh=mesh,
                                   n_microbatches=n_micro, rng_key=pipe_key,
-                                  activation_spec=seq_spec)
+                                  activation_spec=seq_spec,
+                                  virtual_pp=self.virtual_pp,
+                                  prepermuted=True)
                 h = jax.lax.with_sharding_constraint(
                     h, NamedSharding(mesh, seq_spec))
                 logits = head_fn(params["head"], h, params["embed"])
@@ -171,9 +189,21 @@ class SpmdTrainStep:
 
     __call__ = step
 
+    def _canonical_params(self):
+        """Params with the stacked-layer dim in model order (the interleave
+        permutation undone) — the layout checkpoints and the model use."""
+        if self._layer_perm is None:
+            return self.params
+        inv = np.argsort(self._layer_perm)
+        out = dict(self.params)
+        out["blocks"] = jax.tree_util.tree_map(
+            lambda leaf: leaf[inv], self.params["blocks"])
+        return out
+
     def sync_to_model(self):
-        self.model.load_stacked(self.params)
+        self.model.load_stacked(self._canonical_params())
 
     def state_dict(self):
-        return {"params": self.params, "opt_state": self.opt_state,
+        return {"params": self._canonical_params(),
+                "opt_state": self.opt_state,
                 "step": self._step_count}
