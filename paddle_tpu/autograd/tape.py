@@ -26,23 +26,36 @@ class GradNode:
     to build zero cotangents for outputs that received none.
     """
 
-    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_treedef", "n_outputs")
+    __slots__ = ("name", "vjp_fn", "inputs", "out_avals", "out_treedef",
+                 "n_outputs", "primal_fn", "in_dtypes")
 
-    def __init__(self, name, vjp_fn, inputs, out_avals, out_treedef):
+    def __init__(self, name, vjp_fn, inputs, out_avals, out_treedef,
+                 primal_fn=None, in_dtypes=None):
         self.name = name
         self.vjp_fn = vjp_fn
         self.inputs = inputs
         self.out_avals = out_avals  # list of ShapeDtypeStruct, flattened outputs
         self.out_treedef = out_treedef
         self.n_outputs = len(out_avals)
+        # pure function of the tensor inputs; kept so create_graph=True can
+        # re-record the pullback as differentiable ops (vjp-of-vjp).
+        # in_dtypes are the dtypes the forward actually ran with (post AMP
+        # autocast) — the re-recorded pullback must cast the same way or the
+        # recomputed primal won't accept the recorded cotangent dtypes.
+        self.primal_fn = primal_fn
+        self.in_dtypes = in_dtypes
 
     def release(self):
         self.vjp_fn = None
         self.inputs = None
+        self.primal_fn = None
 
 
 def _is_float0(x):
-    return getattr(x, "dtype", None) == jax.dtypes.float0
+    d = getattr(x, "dtype", None)
+    if d is None and hasattr(x, "_data"):
+        d = getattr(x._data, "dtype", None)
+    return d == jax.dtypes.float0
 
 
 def _topo_order(root_nodes):
@@ -70,7 +83,8 @@ def _topo_order(root_nodes):
     return order
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
+def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None,
+             create_graph=False):
     """Run reverse accumulation from ``tensors``.
 
     Default mode writes into leaf ``.grad`` slots (parity: ``egr::Backward``
@@ -78,6 +92,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
     ``id(tensor) -> [tensor, cotangent-or-None]``), cotangents accumulate
     ONLY into the sinks — leaf ``.grad`` is untouched and non-leaf sinks
     receive their gradient too (the ``paddle.grad``/GeneralGrad mode).
+
+    ``create_graph=True`` re-records every pullback as a dispatched op over
+    the node's ORIGINAL input tensors (vjp-of-vjp through ``jax.vjp`` of the
+    primal), so the returned gradients are themselves differentiable —
+    including terms flowing through the primals (reference double-grad
+    nodes, paddle/fluid/eager/api/manual/).
     """
     from ..core.tensor import Tensor
 
@@ -88,28 +108,39 @@ def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
 
+    if create_graph:
+        retain_graph = True  # the new grad graph references the old nodes
+
     # pending cotangents: id(node) -> {out_idx: cotangent}
     pending = {}
     roots = []
 
     def _apply_hooks(t, g):
         for hook in t._backward_hooks:
-            out = hook(Tensor(g, stop_gradient=True))
+            gt = g if (create_graph and isinstance(g, Tensor)) else \
+                Tensor(g, stop_gradient=True)
+            out = hook(gt)
             if out is not None:
-                g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+                g = out if create_graph and isinstance(out, Tensor) else (
+                    out._data if isinstance(out, Tensor) else jnp.asarray(out))
         return g
+
+    def _acc(a, b):
+        if a is None:
+            return b
+        return a + b
 
     def _deposit(t, g):
         """Route one cotangent arriving at tensor ``t``."""
         if sinks is not None and id(t) in sinks:
             g = _apply_hooks(t, g)
             slot = sinks[id(t)]
-            slot[1] = g if slot[1] is None else slot[1] + g
+            slot[1] = _acc(slot[1], g)
             # keep flowing upstream: other sinks may sit above this one
             prod = t._node
             if prod is not None:
                 s = pending.setdefault(id(prod), {})
-                s[t._out_idx] = s.get(t._out_idx, 0) + g
+                s[t._out_idx] = _acc(s.get(t._out_idx), g)
             return
         if t.stop_gradient:
             return
@@ -117,10 +148,12 @@ def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
         if prod is not None:
             g = _apply_hooks(t, g)
             s = pending.setdefault(id(prod), {})
-            s[t._out_idx] = s.get(t._out_idx, 0) + g
+            s[t._out_idx] = _acc(s.get(t._out_idx), g)
         elif sinks is None:
             g = _apply_hooks(t, g)
-            if t.grad is None:
+            if create_graph and isinstance(g, Tensor):
+                t.grad = g if t.grad is None else t.grad + g
+            elif t.grad is None:
                 t.grad = Tensor(g, stop_gradient=True)
             else:
                 t.grad = Tensor(t.grad._data + g, stop_gradient=True)
@@ -134,6 +167,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
                     f"grad can be implicitly created only for scalar outputs, "
                     f"got shape {t.shape}")
             g = jnp.ones_like(t._data)
+            if create_graph:
+                g = Tensor(g, stop_gradient=True)
+        elif create_graph:
+            g = g if isinstance(g, Tensor) else Tensor(jnp.asarray(g),
+                                                       stop_gradient=True)
         else:
             g = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         if t._node is not None:
@@ -159,9 +197,37 @@ def backward(tensors, grad_tensors=None, retain_graph=False, sinks=None):
             if i in slot:
                 cots.append(slot[i])
             else:
-                cots.append(jnp.zeros(aval.shape, aval.dtype))
+                z = jnp.zeros(aval.shape, aval.dtype)
+                cots.append(Tensor(z, stop_gradient=True) if create_graph
+                            else z)
         cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
-        in_cots = node.vjp_fn(cot_tree)
+        if create_graph and node.primal_fn is not None:
+            # Re-record the pullback as a dispatched op over the ORIGINAL
+            # inputs: jax.vjp of the primal runs inside the op, so autograd
+            # sees d(grad)/d(primal) as well as d(grad)/d(cotangent).
+            from ..ops.dispatch import apply_op
+            primal_fn = node.primal_fn
+            in_dtypes = node.in_dtypes
+
+            def pull(cot, *primals):
+                if in_dtypes is not None:  # replay the forward's AMP casts
+                    primals = tuple(p.astype(d)
+                                    for p, d in zip(primals, in_dtypes))
+                _, vjp = jax.vjp(primal_fn, *primals)
+                return vjp(cot)
+
+            in_cots = apply_op("grad::" + node.name, pull,
+                               (cot_tree,) + tuple(node.inputs), {})
+        elif create_graph:
+            raise NotImplementedError(
+                f"create_graph=True through node '{node.name}' is not "
+                "supported: it has no re-recordable primal (PyLayer-style "
+                "custom backward). Higher-order gradients through custom "
+                "PyLayers require the PyLayer backward itself to be built "
+                "from differentiable ops — or use "
+                "paddle_tpu.incubate.autograd over a pure function.")
+        else:
+            in_cots = node.vjp_fn(cot_tree)
         for t, g in zip(node.inputs, in_cots):
             if t is None or _is_float0(g):
                 continue
@@ -176,15 +242,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     Computes grads of ``outputs`` wrt ``inputs`` without touching ``.grad``.
     Implemented by running the tape with temporary accumulation targets.
-    ``create_graph`` (higher-order eager grad) is not yet supported — use the
-    functional ``jax.grad`` path for higher-order derivatives.
+    With ``create_graph=True`` the returned gradients carry their own grad
+    graph (pullbacks re-recorded as dispatched vjp-of-vjp ops), enabling
+    arbitrary-order eager differentiation.
     """
     from ..core.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True in eager mode is not supported yet; "
-            "use paddle_tpu.incubate.autograd (jax.grad) for higher-order.")
     single_out = isinstance(outputs, Tensor)
     if single_out:
         outputs = [outputs]
@@ -194,7 +257,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
 
     sinks = {id(t): [t, None] for t in inputs}
     backward(outputs, grad_tensors=grad_outputs,
-             retain_graph=bool(retain_graph), sinks=sinks)
+             retain_graph=bool(retain_graph) or create_graph, sinks=sinks,
+             create_graph=create_graph)
     results = []
     for t in inputs:
         g = sinks[id(t)][1]
@@ -204,6 +268,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                     "One of the differentiated tensors appears unused; "
                     "pass allow_unused=True to return None for it.")
             results.append(None)
+        elif create_graph and isinstance(g, Tensor):
+            results.append(g)  # keeps its grad graph for higher-order
         else:
             results.append(Tensor(g, stop_gradient=True))
     return results[0] if single_in else results

@@ -120,6 +120,19 @@ class Tensor:
     def __bool__(self):
         if self.size != 1:
             raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        import jax as _jax
+        if isinstance(self._data, _jax.core.Tracer):
+            # Data-dependent Python control flow inside to_static/jit: the
+            # branch condition is a traced value, so `if`/`while` on it
+            # would bake one branch at trace time.  The reference rewrites
+            # these via dy2static AST transforms (python/paddle/jit/
+            # dy2static/); here the contract is explicit.
+            raise TypeError(
+                "Tensor used as a Python bool inside a to_static/jit trace. "
+                "Data-dependent control flow cannot be traced: replace "
+                "`if`/`while` on tensor values with paddle_tpu.where / "
+                "lax.cond-style ops, or move the branch outside the "
+                "compiled function.")
         return bool(self.item())
 
     def __len__(self):
@@ -133,9 +146,11 @@ class Tensor:
                 f"       {np.array2string(np.asarray(jax.device_get(self._data)), prefix='       ')})")
 
     # ---- autograd ----
-    def backward(self, grad_tensor=None, retain_graph=False):
+    def backward(self, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
         from ..autograd.tape import backward as _backward
-        _backward([self], [grad_tensor], retain_graph=retain_graph)
+        _backward([self], [grad_tensor], retain_graph=retain_graph,
+                  create_graph=create_graph)
 
     def register_hook(self, hook):
         self._backward_hooks.append(hook)

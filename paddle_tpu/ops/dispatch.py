@@ -66,7 +66,9 @@ def apply_op(name, fn, args, kwargs):
     node = None
     if requires_grad:
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]
-        node = GradNode(name, vjp_fn, tensors, avals, out_treedef)
+        node = GradNode(name, vjp_fn, tensors, avals, out_treedef,
+                        primal_fn=pure,
+                        in_dtypes=tuple(d.dtype for d in datas))
         if get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"]:
             _check_nan_inf(name, out_leaves)
 

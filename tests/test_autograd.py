@@ -192,3 +192,78 @@ class TestGradNoSideEffects:
         ga, gx = paddle.grad(y, [a, x])
         np.testing.assert_allclose(gx.numpy(), [12.0])
         np.testing.assert_allclose(ga.numpy(), [36.0])  # dy/da = 2*(3a)*3
+
+
+class TestCreateGraph:
+    """Higher-order eager grad (reference double-grad nodes,
+    paddle/fluid/eager/api/manual/)."""
+
+    def test_double_grad(self):
+        x = paddle.to_tensor(np.array([2.0, 3.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        gx = paddle.grad(paddle.sum(y), x, create_graph=True)
+        np.testing.assert_allclose(gx.numpy(), 3 * np.array([4.0, 9.0]),
+                                   rtol=1e-6)
+        ggx = paddle.grad(paddle.sum(gx), x)
+        np.testing.assert_allclose(ggx.numpy(), 6 * np.array([2.0, 3.0]),
+                                   rtol=1e-6)
+
+    def test_triple_grad(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = x ** 4
+        g1 = paddle.grad(y, x, create_graph=True)
+        g2 = paddle.grad(g1, x, create_graph=True)
+        g3 = paddle.grad(g2, x)
+        np.testing.assert_allclose(g3.numpy(), [48.0], rtol=1e-6)
+
+    def test_backward_create_graph_populates_differentiable_grad(self):
+        x = paddle.to_tensor(np.array([1.5], np.float32),
+                             stop_gradient=False)
+        y = paddle.sum(x * x)
+        y.backward(create_graph=True)
+        np.testing.assert_allclose(x.grad.numpy(), [3.0], rtol=1e-6)
+        assert x.grad._node is not None  # grad carries its own graph
+
+    def test_mixed_second_order_through_two_inputs(self):
+        # f = x^2 * y; d2f/dxdy = 2x
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.array([5.0], np.float32),
+                             stop_gradient=False)
+        f = x * x * y
+        gx = paddle.grad(f, x, create_graph=True)   # 2xy
+        gxy = paddle.grad(gx, y)
+        np.testing.assert_allclose(gxy.numpy(), [6.0], rtol=1e-6)
+
+
+class TestIncubateAutograd:
+    def test_functional_surface(self):
+        from paddle_tpu.incubate import autograd as ag
+
+        def f(t):
+            return paddle.sum(t * t * t)
+
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        _, tan = ag.jvp(f, x)
+        np.testing.assert_allclose(float(tan.numpy()), 15.0, rtol=1e-6)
+        _, g = ag.vjp(f, x)
+        np.testing.assert_allclose(g.numpy(), [3.0, 12.0], rtol=1e-6)
+        H = ag.Hessian(f, x)
+        np.testing.assert_allclose(H[:].numpy(),
+                                   np.diag([6.0, 12.0]), atol=1e-5)
+
+
+class TestToStaticControlFlowGuard:
+    def test_tensor_bool_under_trace_raises_clearly(self):
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            if paddle.sum(x) > 0:
+                return x * 2
+            return x * 3
+
+        with pytest.raises(TypeError, match="Data-dependent control flow"):
+            f(paddle.to_tensor(np.ones(3, np.float32)))
