@@ -12,6 +12,7 @@ Reference lists: python/paddle/amp/amp_lists.py.
 import contextlib
 import threading
 
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -188,11 +189,77 @@ class GradScaler:
         return self._enable
 
     def get_scale(self):
+        st = getattr(self, "_compiled_state", None)
+        if st is not None:  # live state owned by a compiled TrainStep
+            return float(st["scale"])
         return self._scale
 
     def state_dict(self):
+        st = getattr(self, "_compiled_state", None)
+        if st is not None:
+            return {"scale": float(st["scale"]),
+                    "good_steps": int(st["good"]),
+                    "bad_steps": int(st["bad"])}
         return {"scale": self._scale, "good_steps": self._good_steps,
                 "bad_steps": self._bad_steps}
 
     def set_state_dict(self, sd):
         self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", self._good_steps)
+        self._bad_steps = sd.get("bad_steps", self._bad_steps)
+        if getattr(self, "_compiled_state", None) is not None:
+            # write through: an attached compiled TrainStep reads this dict
+            # as its live scaler state on the next step
+            self._compiled_state = scaler_init_state(self)
+
+
+# ---- compiled-path loss scaling (update_loss_scaling_ parity) ----
+
+def scaler_init_state(scaler):
+    """Device-array scaler state threaded through a compiled train step."""
+    return {"scale": jnp.float32(scaler._scale),
+            "good": jnp.int32(scaler._good_steps),
+            "bad": jnp.int32(scaler._bad_steps)}
+
+
+def scaler_apply(scaler, state, grads):
+    """Pure: unscale grads, detect non-finite, run the dynamic-scale update.
+
+    The in-jit form of GradScaler.unscale_/update (reference
+    update_loss_scaling_ kernel + fleet distributed_scaler, fleet/scaler.py:28).
+    Returns (unscaled_grads, found_inf, new_state).
+    """
+    inv = 1.0 / state["scale"]
+    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+    leaves = jax.tree_util.tree_leaves(grads)
+    finite = jnp.all(jnp.stack([jnp.isfinite(l).all() for l in leaves]))
+    found = jnp.logical_not(finite)
+    if not scaler._dynamic:
+        return grads, found, state
+    bad1 = jnp.where(found, state["bad"] + 1, 0)
+    good1 = jnp.where(found, 0, state["good"] + 1)
+    dec = found & (bad1 >= scaler._decr_every)
+    inc = (~found) & (good1 >= scaler._incr_every)
+    scale1 = jnp.where(
+        dec, jnp.maximum(state["scale"] * scaler._decr_ratio, 1.0),
+        jnp.where(inc, state["scale"] * scaler._incr_ratio, state["scale"]))
+    return grads, found, {"scale": scale1,
+                          "good": jnp.where(inc, 0, good1),
+                          "bad": jnp.where(dec, 0, bad1)}
+
+
+def scaler_guarded_update(scaler, scaler_state, grads, grad_clip, optimizer,
+                          params, opt_state, step, lr):
+    """Shared compiled-step epilogue: unscale, clip, update, and keep the
+    old params/opt-state when non-finite gradients were found."""
+    grads, found_inf, new_sstate = scaler_apply(scaler, scaler_state, grads)
+    if grad_clip is not None:
+        grads = grad_clip.clip_pytree(grads)
+    cand_params, cand_opt = optimizer.apply_gradients_pytree(
+        params, grads, opt_state, step, lr=lr)
+
+    def merge(old, new):
+        return jax.tree_util.tree_map(
+            lambda o, n: jnp.where(found_inf, o, n), old, new)
+
+    return merge(params, cand_params), merge(opt_state, cand_opt), new_sstate

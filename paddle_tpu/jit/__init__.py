@@ -202,7 +202,8 @@ class TrainStep:
         loss = step(batch_x, batch_y)      # Tensors in, loss Tensor out
     """
 
-    def __init__(self, model, loss_fn, optimizer, donate=True, remat=False):
+    def __init__(self, model, loss_fn, optimizer, donate=True, remat=False,
+                 scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -212,6 +213,16 @@ class TrainStep:
         self._step = 0
         self._compiled = None
         self._donate = donate
+        # loss scaling composed INTO the compiled step (reference
+        # fleet/scaler.py distributed_scaler + update_loss_scaling_ kernel)
+        self.scaler = scaler if (scaler is not None and scaler.is_enable()) \
+            else None
+        if self.scaler is not None:
+            from ..amp import scaler_init_state
+            self._scaler_state = scaler_init_state(self.scaler)
+            self.scaler._compiled_state = self._scaler_state
+        else:
+            self._scaler_state = None
 
     def _build(self):
         model = self.model
@@ -219,7 +230,7 @@ class TrainStep:
         optimizer = self.optimizer
         grad_clip = optimizer._grad_clip
 
-        def step_fn(params, frozen, opt_state, step, lr, key, inputs, labels):
+        def make_loss_f(frozen, key, inputs, labels):
             def loss_f(p):
                 with key_stream(key):
                     out = functional_call(model, {**p, **frozen}, *inputs)
@@ -235,6 +246,10 @@ class TrainStep:
                 # activation rematerialization: recompute the forward during
                 # the backward pass instead of saving activations
                 loss_f = jax.checkpoint(loss_f)
+            return loss_f
+
+        def step_fn(params, frozen, opt_state, step, lr, key, inputs, labels):
+            loss_f = make_loss_f(frozen, key, inputs, labels)
             loss, grads = jax.value_and_grad(loss_f)(params)
             if grad_clip is not None:
                 grads = grad_clip.clip_pytree(grads)
@@ -242,8 +257,28 @@ class TrainStep:
                 params, grads, opt_state, step, lr=lr)
             return loss, new_params, new_opt
 
+        scaler = self.scaler
+
+        def step_fn_scaled(params, frozen, opt_state, step, lr, key, inputs,
+                           labels, scaler_state):
+            from ..amp import scaler_guarded_update
+            loss_f = make_loss_f(frozen, key, inputs, labels)
+
+            def scaled_f(p):
+                l = loss_f(p)
+                return l * scaler_state["scale"].astype(l.dtype), l
+
+            (_, loss), grads = jax.value_and_grad(
+                scaled_f, has_aux=True)(params)
+            new_params, new_opt, new_sstate = scaler_guarded_update(
+                scaler, scaler_state, grads, grad_clip, optimizer,
+                params, opt_state, step, lr)
+            return loss, new_params, new_opt, new_sstate
+
         donate = (0, 2) if self._donate else ()
-        self._compiled = jax.jit(step_fn, donate_argnums=donate)
+        self._compiled = jax.jit(
+            step_fn_scaled if scaler is not None else step_fn,
+            donate_argnums=donate)
 
     def __call__(self, inputs, labels=()):
         """inputs: Tensor or tuple for the model; labels: Tensor or tuple for
@@ -259,9 +294,18 @@ class TrainStep:
             labels = (labels,)
         in_data = tuple(t._data if isinstance(t, Tensor) else t for t in inputs)
         lb_data = tuple(t._data if isinstance(t, Tensor) else t for t in labels)
-        loss, self._params, self._opt_state = self._compiled(
-            self._params, self._frozen, self._opt_state,
-            jnp.int32(self._step), lr, key, in_data, lb_data)
+        if self.scaler is not None:
+            # the scaler object owns the live state (set_state_dict can
+            # replace it between steps)
+            loss, self._params, self._opt_state, new_sstate = \
+                self._compiled(self._params, self._frozen, self._opt_state,
+                               jnp.int32(self._step), lr, key, in_data,
+                               lb_data, self.scaler._compiled_state)
+            self.scaler._compiled_state = new_sstate
+        else:
+            loss, self._params, self._opt_state = self._compiled(
+                self._params, self._frozen, self._opt_state,
+                jnp.int32(self._step), lr, key, in_data, lb_data)
         self.sync_to_model()
         return Tensor(loss)
 

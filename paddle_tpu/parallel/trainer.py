@@ -62,7 +62,7 @@ class SpmdTrainStep:
 
     def __init__(self, model, optimizer, mesh, n_microbatches=1,
                  sequence_parallel=False, remat=False, zero_stage=1,
-                 virtual_pp=1):
+                 virtual_pp=1, scaler=None):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -70,6 +70,17 @@ class SpmdTrainStep:
         self.sequence_parallel = sequence_parallel
         self.remat = remat
         self.virtual_pp = virtual_pp
+        # loss scaling composed into the compiled hybrid step (the fleet
+        # distributed_scaler role, fleet/scaler.py:28 — found-inf detection
+        # is global automatically: grads are global arrays under GSPMD)
+        self.scaler = scaler if (scaler is not None and scaler.is_enable()) \
+            else None
+        if self.scaler is not None:
+            from ..amp import scaler_init_state
+            self._scaler_state = scaler_init_state(self.scaler)
+            self.scaler._compiled_state = self._scaler_state
+        else:
+            self._scaler_state = None
 
         d = model.functional_decompose()
         self.fns = d["fns"]
@@ -169,7 +180,26 @@ class SpmdTrainStep:
                 params, grads, opt_state, step, lr=lr)
             return loss, new_params, new_opt
 
-        self._compiled = jax.jit(step_fn, donate_argnums=(0, 1))
+        scaler = self.scaler
+
+        def step_fn_scaled(params, opt_state, step, lr, key, input_ids,
+                           labels, scaler_state):
+            from ..amp import scaler_guarded_update
+
+            def scaled(params, input_ids, labels, key):
+                l = forward(params, input_ids, labels, key)
+                return l * scaler_state["scale"].astype(l.dtype), l
+
+            (_, loss), grads = jax.value_and_grad(scaled, has_aux=True)(
+                params, input_ids, labels, key)
+            new_params, new_opt, new_sstate = scaler_guarded_update(
+                scaler, scaler_state, grads, grad_clip, optimizer,
+                params, opt_state, step, lr)
+            return loss, new_params, new_opt, new_sstate
+
+        self._compiled = jax.jit(
+            step_fn_scaled if scaler is not None else step_fn,
+            donate_argnums=(0, 1))
 
     def step(self, input_ids, labels):
         if self._compiled is None:
@@ -182,9 +212,16 @@ class SpmdTrainStep:
         lr = jnp.float32(self.optimizer.get_lr())
         key = get_rng_key()
         with self.mesh:
-            loss, self.params, self.opt_state = self._compiled(
-                self.params, self.opt_state, jnp.int32(self._step_count),
-                lr, key, ids, lbl)
+            if self.scaler is not None:
+                loss, self.params, self.opt_state, new_sstate = \
+                    self._compiled(self.params, self.opt_state,
+                                   jnp.int32(self._step_count), lr, key,
+                                   ids, lbl, self.scaler._compiled_state)
+                self.scaler._compiled_state = new_sstate
+            else:
+                loss, self.params, self.opt_state = self._compiled(
+                    self.params, self.opt_state, jnp.int32(self._step_count),
+                    lr, key, ids, lbl)
         return Tensor(loss)
 
     __call__ = step

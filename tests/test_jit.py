@@ -115,3 +115,93 @@ class TestTrainStep:
         l1 = float(step(x, lbl).numpy())
         l2 = float(step(x, lbl).numpy())
         assert l1 != l2  # rng threaded per step, not baked
+
+
+class TestCompiledGradScaler:
+    """Loss scaling composed into the compiled step (reference
+    fleet/scaler.py:28 distributed_scaler + update_loss_scaling_)."""
+
+    def _build(self, scaler):
+        paddle.seed(0)
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.l = nn.Linear(8, 1)
+
+            def forward(self, x):
+                return self.l(x)
+
+        m = M()
+        opt = optimizer.SGD(learning_rate=0.1, parameters=m.parameters())
+        step = TrainStep(m, lambda o, y: F.mse_loss(o, y), opt,
+                         scaler=scaler)
+        return m, step
+
+    def test_scaled_training_converges_and_scale_grows(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       incr_every_n_steps=2)
+        m, step = self._build(scaler)
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.rand(16, 8).astype(np.float32))
+        Y = paddle.to_tensor(rng.rand(16, 1).astype(np.float32))
+        losses = [float(step(X, Y).numpy()) for _ in range(6)]
+        assert losses[-1] < losses[0]
+        assert scaler.get_scale() == 1024.0 * 2 ** 3  # 6 good steps / 2
+
+    def test_found_inf_skips_update_and_decreases_scale(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                       decr_ratio=0.5)
+        m, step = self._build(scaler)
+        rng = np.random.RandomState(0)
+        X = paddle.to_tensor(rng.rand(16, 8).astype(np.float32))
+        Y = paddle.to_tensor(rng.rand(16, 1).astype(np.float32))
+        step(X, Y)
+        w_before = m.l.weight.numpy().copy()
+        step(paddle.to_tensor(np.full((16, 8), np.inf, np.float32)), Y)
+        assert scaler.get_scale() == 512.0
+        np.testing.assert_array_equal(w_before, m.l.weight.numpy())
+
+
+class TestRecomputeAPI:
+    def test_recompute_grad_parity(self):
+        from paddle_tpu.distributed.fleet import recompute
+
+        paddle.seed(0)
+        lin = nn.Linear(8, 8)
+
+        def f(t):
+            return F.relu(lin(t))
+
+        xa = np.random.RandomState(0).rand(4, 8).astype(np.float32)
+        x1 = paddle.to_tensor(xa, stop_gradient=False)
+        paddle.sum(recompute(f, x1)).backward()
+        w_grad = lin.weight.grad
+        assert w_grad is not None  # closed-over layer params must train
+        lin.weight.clear_grad() if hasattr(lin.weight, "clear_grad") else None
+        g_rec = (x1.grad.numpy().copy(), w_grad.numpy().copy())
+        lin.weight.grad = None
+        lin.bias.grad = None
+        x2 = paddle.to_tensor(xa, stop_gradient=False)
+        paddle.sum(f(x2)).backward()
+        np.testing.assert_allclose(g_rec[0], x2.grad.numpy(), rtol=1e-6)
+        np.testing.assert_allclose(g_rec[1], lin.weight.grad.numpy(),
+                                   rtol=1e-6)
+
+    def test_recompute_sequential_segments(self):
+        from paddle_tpu.distributed.fleet import recompute_sequential
+
+        paddle.seed(1)
+        seq = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 4))
+        x = paddle.to_tensor(np.ones((2, 8), np.float32))
+        out = recompute_sequential({"segments": 2}, seq, x)
+        np.testing.assert_allclose(out.numpy(), seq(x).numpy(), rtol=1e-6)
+
+    def test_recompute_policy_knob(self):
+        from paddle_tpu.distributed.fleet import recompute
+
+        x = paddle.to_tensor(np.ones((2, 4), np.float32), stop_gradient=False)
+        out = recompute(lambda t: t * t, x, policy="dots")
+        paddle.sum(out).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 4)),
+                                   rtol=1e-6)
