@@ -1,11 +1,21 @@
-"""DataLoader (reference python/paddle/io/reader.py:218).
+"""DataLoader (reference python/paddle/io/reader.py:218 and the
+multiprocess iterator at python/paddle/io/dataloader/dataloader_iter.py).
 
-Single-process iterator with numpy collation; batches become device Tensors
-lazily (jax moves data async on first use).  ``num_workers`` is accepted for
-parity; a thread-pool prefetcher covers the common TPU-VM case where host
-CPUs outrun one chip's consumption.
+Three feeding modes:
+- ``num_workers=0``: synchronous single-process iteration.
+- ``num_workers=0`` with ``use_buffer_reader``: thread prefetch (the TPU-VM
+  common case — host CPUs decode while the chip computes).
+- ``num_workers>0``: forked worker PROCESSES pulling index batches from a
+  task queue and returning numpy-collated batches over a result queue,
+  reordered to preserve determinism — the reference's multiprocess design
+  with the queue depth ``prefetch_factor * num_workers``.  Workers never
+  touch jax (fork safety): collation to device Tensors happens in the
+  parent.
 """
 
+import itertools
+import multiprocessing as mp
+import os
 import queue
 import threading
 
@@ -15,23 +25,53 @@ from ..core.tensor import Tensor
 from .dataset import IterableDataset
 from .sampler import BatchSampler
 
+_worker_info = None
 
-def default_collate_fn(batch):
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    """Inside a worker process: (id, num_workers, dataset); else None.
+    Reference: python/paddle/io/dataloader/worker.py get_worker_info."""
+    return _worker_info
+
+
+def _collate_numpy(batch):
+    """Worker-side collation: numpy only (no jax in forked children)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+        return np.stack([np.asarray(s._data) for s in batch])
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
-        return Tensor(np.asarray(batch, dtype=np.int64))
+        return np.asarray(batch, dtype=np.int64)
     if isinstance(sample, (float, np.floating)):
-        return Tensor(np.asarray(batch, dtype=np.float32))
+        return np.asarray(batch, dtype=np.float32)
     if isinstance(sample, (list, tuple)):
         transposed = zip(*batch)
-        return type(sample)(default_collate_fn(list(s)) for s in transposed)
+        return type(sample)(_collate_numpy(list(s)) for s in transposed)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
+        return {k: _collate_numpy([d[k] for d in batch]) for k in sample}
     raise TypeError(f"cannot collate type {type(sample)}")
+
+
+def _to_tensors(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_tensors(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _to_tensors(v) for k, v in obj.items()}
+    return obj
+
+
+def default_collate_fn(batch):
+    return _to_tensors(_collate_numpy(batch))
 
 
 class DataLoader:
@@ -42,14 +82,21 @@ class DataLoader:
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
                  persistent_workers=False):
         self.dataset = dataset
-        self.collate_fn = collate_fn or default_collate_fn
-        self.num_workers = num_workers
+        self.collate_fn = collate_fn
+        self.num_workers = int(num_workers or 0)
         self.prefetch_factor = prefetch_factor
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
+        self.use_buffer_reader = use_buffer_reader
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last
+            if self.num_workers > 0:
+                # reference behavior: every worker sees the whole
+                # IterableDataset unless it shards via get_worker_info()
+                pass
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
         else:
@@ -62,29 +109,37 @@ class DataLoader:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
 
+    # ---------------------------------------------------- single process --
     def _iter_batches(self):
+        collate = self.collate_fn or default_collate_fn
         if self._iterable_mode:
             batch = []
             for sample in self.dataset:
                 batch.append(sample)
                 if len(batch) == self.batch_size:
-                    yield self.collate_fn(batch)
+                    yield collate(batch)
                     batch = []
             if batch and not self.drop_last:
-                yield self.collate_fn(batch)
+                yield collate(batch)
         else:
             for indices in self.batch_sampler:
                 batch = [self.dataset[i] for i in indices]
-                yield self.collate_fn(batch)
+                yield collate(batch)
 
     def __iter__(self):
-        if self.num_workers and self.num_workers > 0:
+        if self.num_workers > 0 and not self._iterable_mode:
+            return _MultiprocessIterator(self)
+        if self.num_workers > 0 and self._iterable_mode:
+            return _MultiprocessIterableIterator(self)
+        if self.use_buffer_reader:
             return _PrefetchIterator(self._iter_batches(),
-                                     self.prefetch_factor * max(self.num_workers, 1))
+                                     max(2, self.prefetch_factor))
         return self._iter_batches()
 
 
 class _PrefetchIterator:
+    """Thread prefetch: overlaps host-side batch assembly with device work."""
+
     _SENTINEL = object()
 
     def __init__(self, source, depth):
@@ -113,3 +168,189 @@ class _PrefetchIterator:
                 raise self._err
             raise StopIteration
         return item
+
+
+def _map_worker_loop(dataset, collate_fn, task_q, result_q, wid, n_workers,
+                     init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(wid, n_workers, dataset)
+    if init_fn is not None:
+        init_fn(wid)
+    collate = collate_fn or _collate_numpy
+    while True:
+        task = task_q.get()
+        if task is None:
+            return
+        seq, indices = task
+        try:
+            batch = collate([dataset[i] for i in indices])
+            result_q.put((seq, batch, None))
+        except BaseException as e:
+            result_q.put((seq, None, repr(e)))
+
+
+def _iterable_worker_loop(dataset, collate_fn, batch_size, drop_last,
+                          result_q, wid, n_workers, init_fn):
+    global _worker_info
+    _worker_info = WorkerInfo(wid, n_workers, dataset)
+    if init_fn is not None:
+        init_fn(wid)
+    collate = collate_fn or _collate_numpy
+    try:
+        batch = []
+        for sample in dataset:
+            batch.append(sample)
+            if len(batch) == batch_size:
+                result_q.put(("data", collate(batch), None))
+                batch = []
+        if batch and not drop_last:
+            result_q.put(("data", collate(batch), None))
+        result_q.put(("done", None, None))
+    except BaseException as e:
+        result_q.put(("error", None, repr(e)))
+
+
+class _MultiprocessIterator:
+    """Ordered multiprocess map-dataset iterator.
+
+    Index batches go to a shared task queue; results come back tagged with
+    their sequence number and are reordered so output order matches the
+    sampler regardless of worker timing (reference _DataLoaderIterMultiProcess
+    reordering via _rcvd_idx)."""
+
+    def __init__(self, loader):
+        self._loader = loader
+        ctx = mp.get_context("fork")
+        n = loader.num_workers
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._indices = list(loader.batch_sampler)
+        self._n_batches = len(self._indices)
+        self._next_submit = 0
+        self._next_yield = 0
+        self._buffer = {}
+        self._timeout = loader.timeout or 300
+        self._workers = [
+            ctx.Process(
+                target=_map_worker_loop,
+                args=(loader.dataset, loader.collate_fn, self._task_q,
+                      self._result_q, i, n, loader.worker_init_fn),
+                daemon=True)
+            for i in range(n)
+        ]
+        for w in self._workers:
+            w.start()
+        # keep prefetch_factor batches in flight per worker
+        for _ in range(min(self._n_batches,
+                           loader.prefetch_factor * n)):
+            self._submit()
+
+    def _submit(self):
+        if self._next_submit < self._n_batches:
+            self._task_q.put((self._next_submit,
+                              self._indices[self._next_submit]))
+            self._next_submit += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next_yield >= self._n_batches:
+            self._shutdown()
+            raise StopIteration
+        while self._next_yield not in self._buffer:
+            try:
+                seq, batch, err = self._result_q.get(timeout=self._timeout)
+            except queue.Empty:
+                dead = [i for i, w in enumerate(self._workers)
+                        if not w.is_alive()]
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timeout after {self._timeout}s"
+                    + (f"; dead workers: {dead}" if dead else ""))
+            if err is not None:
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed: {err}")
+            self._buffer[seq] = batch
+        batch = self._buffer.pop(self._next_yield)
+        self._next_yield += 1
+        self._submit()
+        return _to_tensors(batch)
+
+    def _shutdown(self):
+        for _ in self._workers:
+            try:
+                self._task_q.put_nowait(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+        self._workers = []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
+
+
+class _MultiprocessIterableIterator:
+    """IterableDataset over workers: each worker iterates the dataset
+    (sharding is the dataset's job via get_worker_info, as in the
+    reference); first-come delivery."""
+
+    def __init__(self, loader):
+        ctx = mp.get_context("fork")
+        n = loader.num_workers
+        self._result_q = ctx.Queue(maxsize=max(2, loader.prefetch_factor * n))
+        self._timeout = loader.timeout or 300
+        self._done = 0
+        self._n = n
+        self._workers = [
+            ctx.Process(
+                target=_iterable_worker_loop,
+                args=(loader.dataset, loader.collate_fn, loader.batch_size,
+                      loader.drop_last, self._result_q, i, n,
+                      loader.worker_init_fn),
+                daemon=True)
+            for i in range(n)
+        ]
+        for w in self._workers:
+            w.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        while True:
+            if self._done >= self._n:
+                self._shutdown()
+                raise StopIteration
+            try:
+                kind, batch, err = self._result_q.get(timeout=self._timeout)
+            except queue.Empty:
+                self._shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timeout after {self._timeout}s")
+            if kind == "error":
+                self._shutdown()
+                raise RuntimeError(f"DataLoader worker failed: {err}")
+            if kind == "done":
+                self._done += 1
+                continue
+            return _to_tensors(batch)
+
+    def _shutdown(self):
+        for w in self._workers:
+            w.join(timeout=1)
+            if w.is_alive():
+                w.terminate()
+        self._workers = []
+
+    def __del__(self):
+        try:
+            self._shutdown()
+        except Exception:
+            pass
