@@ -13,9 +13,7 @@ Three feeding modes:
   parent.
 """
 
-import itertools
 import multiprocessing as mp
-import os
 import queue
 import threading
 
@@ -41,6 +39,10 @@ def get_worker_info():
     return _worker_info
 
 
+def _is_namedtuple(obj):
+    return isinstance(obj, tuple) and hasattr(obj, "_fields")
+
+
 def _collate_numpy(batch):
     """Worker-side collation: numpy only (no jax in forked children)."""
     sample = batch[0]
@@ -52,6 +54,8 @@ def _collate_numpy(batch):
         return np.asarray(batch, dtype=np.int64)
     if isinstance(sample, (float, np.floating)):
         return np.asarray(batch, dtype=np.float32)
+    if _is_namedtuple(sample):
+        return type(sample)(*(_collate_numpy(list(s)) for s in zip(*batch)))
     if isinstance(sample, (list, tuple)):
         transposed = zip(*batch)
         return type(sample)(_collate_numpy(list(s)) for s in transposed)
@@ -63,6 +67,8 @@ def _collate_numpy(batch):
 def _to_tensors(obj):
     if isinstance(obj, np.ndarray):
         return Tensor(obj)
+    if _is_namedtuple(obj):
+        return type(obj)(*(_to_tensors(v) for v in obj))
     if isinstance(obj, (list, tuple)):
         return type(obj)(_to_tensors(v) for v in obj)
     if isinstance(obj, dict):
@@ -74,13 +80,48 @@ def default_collate_fn(batch):
     return _to_tensors(_collate_numpy(batch))
 
 
+class _PackedTensor:
+    """Transport marker: a Tensor produced by a user collate_fn inside a
+    worker, detensorized to numpy for the queue and re-wrapped in the
+    parent — so batch types do not depend on num_workers."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
+def _pack_for_transport(obj):
+    if isinstance(obj, Tensor):
+        return _PackedTensor(np.asarray(obj._data))
+    if _is_namedtuple(obj):
+        return type(obj)(*(_pack_for_transport(v) for v in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack_for_transport(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _pack_for_transport(v) for k, v in obj.items()}
+    return obj
+
+
+def _unpack_from_transport(obj):
+    if isinstance(obj, _PackedTensor):
+        return Tensor(obj.array)
+    if _is_namedtuple(obj):
+        return type(obj)(*(_unpack_from_transport(v) for v in obj))
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack_from_transport(v) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _unpack_from_transport(v) for k, v in obj.items()}
+    return obj
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, multiprocessing_context=None):
         self.dataset = dataset
         self.collate_fn = collate_fn
         self.num_workers = int(num_workers or 0)
@@ -88,6 +129,11 @@ class DataLoader:
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
         self.use_buffer_reader = use_buffer_reader
+        # "fork" keeps locally-defined datasets working (reference/Linux
+        # default) but inherits jax's threads — if the parent has a live
+        # device backend and workers hang, pass "spawn"/"forkserver" (the
+        # dataset must then be picklable).
+        self.multiprocessing_context = multiprocessing_context
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -108,6 +154,12 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no len()")
         return len(self.batch_sampler)
+
+    def _mp_context(self):
+        ctx = self.multiprocessing_context
+        if ctx is None or isinstance(ctx, str):
+            return mp.get_context(ctx or "fork")
+        return ctx
 
     # ---------------------------------------------------- single process --
     def _iter_batches(self):
@@ -170,12 +222,54 @@ class _PrefetchIterator:
         return item
 
 
+def _liveness_get(result_q, workers, timeout, shutdown, expect_exit=False):
+    """Pull one result, honoring the user timeout if set (timeout>0), else
+    waiting indefinitely while the workers are alive (timeout=0 is the
+    reference's documented "no timeout").  Raises on dead workers or
+    user-timeout expiry.
+
+    ``expect_exit=True`` (iterable path): workers exit normally after their
+    final message, so death is fatal only when ALL are gone and the queue
+    has drained.  ``expect_exit=False`` (map path): workers live until
+    shutdown, so ANY death means an in-flight task may be lost and the
+    ordered reorder buffer would stall forever — raise after a short grace
+    (the dead worker's last result may still be in the feeder pipe)."""
+    import time as _time
+
+    deadline = (_time.monotonic() + timeout) if timeout else None
+    death_grace = 2  # extra 5s polls after a partial death before raising
+    while True:
+        step = 5.0
+        if deadline is not None:
+            step = min(step, max(0.0, deadline - _time.monotonic()))
+        try:
+            return result_q.get(timeout=max(0.05, step))
+        except queue.Empty:
+            dead = [i for i, w in enumerate(workers) if not w.is_alive()]
+            if deadline is not None and _time.monotonic() >= deadline:
+                shutdown()
+                raise RuntimeError(
+                    f"DataLoader worker timeout after {timeout}s"
+                    + (f"; dead workers: {dead}" if dead else ""))
+            if not dead:
+                continue
+            if expect_exit and len(dead) < len(workers):
+                continue
+            if death_grace > 0:
+                death_grace -= 1
+                continue
+            shutdown()
+            raise RuntimeError(
+                f"DataLoader workers died unexpectedly: {dead}")
+
+
 def _map_worker_loop(dataset, collate_fn, task_q, result_q, wid, n_workers,
                      init_fn):
     global _worker_info
     _worker_info = WorkerInfo(wid, n_workers, dataset)
     if init_fn is not None:
         init_fn(wid)
+    user_collate = collate_fn is not None
     collate = collate_fn or _collate_numpy
     while True:
         task = task_q.get()
@@ -184,6 +278,8 @@ def _map_worker_loop(dataset, collate_fn, task_q, result_q, wid, n_workers,
         seq, indices = task
         try:
             batch = collate([dataset[i] for i in indices])
+            if user_collate:
+                batch = _pack_for_transport(batch)
             result_q.put((seq, batch, None))
         except BaseException as e:
             result_q.put((seq, None, repr(e)))
@@ -195,16 +291,24 @@ def _iterable_worker_loop(dataset, collate_fn, batch_size, drop_last,
     _worker_info = WorkerInfo(wid, n_workers, dataset)
     if init_fn is not None:
         init_fn(wid)
+    user_collate = collate_fn is not None
     collate = collate_fn or _collate_numpy
+
+    def _ship(b):
+        b = collate(b)
+        if user_collate:
+            b = _pack_for_transport(b)
+        result_q.put(("data", b, None))
+
     try:
         batch = []
         for sample in dataset:
             batch.append(sample)
             if len(batch) == batch_size:
-                result_q.put(("data", collate(batch), None))
+                _ship(batch)
                 batch = []
         if batch and not drop_last:
-            result_q.put(("data", collate(batch), None))
+            _ship(batch)
         result_q.put(("done", None, None))
     except BaseException as e:
         result_q.put(("error", None, repr(e)))
@@ -220,7 +324,7 @@ class _MultiprocessIterator:
 
     def __init__(self, loader):
         self._loader = loader
-        ctx = mp.get_context("fork")
+        ctx = loader._mp_context()
         n = loader.num_workers
         self._task_q = ctx.Queue()
         self._result_q = ctx.Queue()
@@ -229,7 +333,7 @@ class _MultiprocessIterator:
         self._next_submit = 0
         self._next_yield = 0
         self._buffer = {}
-        self._timeout = loader.timeout or 300
+        self._timeout = loader.timeout or None  # 0 = no timeout (reference)
         self._workers = [
             ctx.Process(
                 target=_map_worker_loop,
@@ -259,15 +363,8 @@ class _MultiprocessIterator:
             self._shutdown()
             raise StopIteration
         while self._next_yield not in self._buffer:
-            try:
-                seq, batch, err = self._result_q.get(timeout=self._timeout)
-            except queue.Empty:
-                dead = [i for i, w in enumerate(self._workers)
-                        if not w.is_alive()]
-                self._shutdown()
-                raise RuntimeError(
-                    f"DataLoader worker timeout after {self._timeout}s"
-                    + (f"; dead workers: {dead}" if dead else ""))
+            seq, batch, err = _liveness_get(
+                self._result_q, self._workers, self._timeout, self._shutdown)
             if err is not None:
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
@@ -275,6 +372,8 @@ class _MultiprocessIterator:
         batch = self._buffer.pop(self._next_yield)
         self._next_yield += 1
         self._submit()
+        if self._loader.collate_fn is not None:
+            return _unpack_from_transport(batch)
         return _to_tensors(batch)
 
     def _shutdown(self):
@@ -302,10 +401,11 @@ class _MultiprocessIterableIterator:
     reference); first-come delivery."""
 
     def __init__(self, loader):
-        ctx = mp.get_context("fork")
+        self._loader = loader
+        ctx = loader._mp_context()
         n = loader.num_workers
         self._result_q = ctx.Queue(maxsize=max(2, loader.prefetch_factor * n))
-        self._timeout = loader.timeout or 300
+        self._timeout = loader.timeout or None  # 0 = no timeout (reference)
         self._done = 0
         self._n = n
         self._workers = [
@@ -328,18 +428,17 @@ class _MultiprocessIterableIterator:
             if self._done >= self._n:
                 self._shutdown()
                 raise StopIteration
-            try:
-                kind, batch, err = self._result_q.get(timeout=self._timeout)
-            except queue.Empty:
-                self._shutdown()
-                raise RuntimeError(
-                    f"DataLoader worker timeout after {self._timeout}s")
+            kind, batch, err = _liveness_get(
+                self._result_q, self._workers, self._timeout, self._shutdown,
+                expect_exit=True)
             if kind == "error":
                 self._shutdown()
                 raise RuntimeError(f"DataLoader worker failed: {err}")
             if kind == "done":
                 self._done += 1
                 continue
+            if self._loader.collate_fn is not None:
+                return _unpack_from_transport(batch)
             return _to_tensors(batch)
 
     def _shutdown(self):

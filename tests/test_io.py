@@ -133,13 +133,51 @@ class TestMultiprocessDataLoader:
                 time.sleep(0.05)
                 return np.float32(i)
 
-        # serial cost ~= 12*0.05 = 0.6s; 4 workers should cut wall time
-        loader = io.DataLoader(Slow(), batch_size=2, num_workers=4)
+        # serial cost is >= 12*0.05 = 0.6s of sleep by construction; with 4
+        # workers the sleeps overlap.  Compare against the measured serial
+        # time (not an absolute threshold) so suite-wide load can't flake it,
+        # and allow one retry for worker-startup jitter.
         t0 = time.perf_counter()
-        n = sum(1 for _ in loader)
-        dt = time.perf_counter() - t0
-        assert n == 6
-        assert dt < 0.45, f"no parallel speedup: {dt:.2f}s"
+        n_serial = sum(1 for _ in io.DataLoader(Slow(), batch_size=2))
+        dt_serial = time.perf_counter() - t0
+        assert n_serial == 6
+
+        best = float("inf")
+        for _ in range(2):
+            loader = io.DataLoader(Slow(), batch_size=2, num_workers=4)
+            t0 = time.perf_counter()
+            n = sum(1 for _ in loader)
+            best = min(best, time.perf_counter() - t0)
+            assert n == 6
+            if best < 0.8 * dt_serial:
+                break
+        assert best < 0.8 * dt_serial, (
+            f"no parallel speedup: {best:.2f}s vs serial {dt_serial:.2f}s")
+
+    def test_user_collate_type_consistent_across_num_workers(self):
+        """Batch types must not depend on num_workers (Tensor round-trips
+        through the worker queue via the transport packer)."""
+
+        def my_collate(batch):
+            import paddle_tpu as pd
+            return {"x": pd.to_tensor(np.stack(batch)),
+                    "n": len(batch),
+                    "raw": np.stack(batch)}
+
+        class Small(io.Dataset):
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        b0 = next(iter(io.DataLoader(Small(), batch_size=4,
+                                     collate_fn=my_collate)))
+        b2 = next(iter(io.DataLoader(Small(), batch_size=4,
+                                     collate_fn=my_collate, num_workers=2)))
+        assert type(b0["x"]) is type(b2["x"])
+        assert isinstance(b2["raw"], np.ndarray) and b2["n"] == 4
+        np.testing.assert_allclose(b0["x"].numpy(), b2["x"].numpy())
 
     def test_worker_error_propagates(self):
         class Bad(io.Dataset):
