@@ -1,0 +1,5 @@
+"""Autograd package: tape backward, paddle.grad, no_grad, PyLayer."""
+
+from ..framework.mode import no_grad, set_grad_enabled, is_grad_enabled  # noqa: F401
+from .tape import GradNode, backward, grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401

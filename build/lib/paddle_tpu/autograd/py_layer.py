@@ -1,0 +1,89 @@
+"""PyLayer: user-defined forward/backward (paddle.autograd.PyLayer parity).
+
+Reference: paddle/fluid/eager/pylayer/ + paddle/fluid/pybind/eager_py_layer.cc.
+The user's static ``forward``/``backward`` run eagerly; recording hooks the
+user backward into the tape as a GradNode whose vjp calls ``backward``.
+"""
+
+import jax
+
+from ..core.tensor import Tensor
+from ..framework import mode
+from .tape import GradNode
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        inputs = [a for a in jax.tree_util.tree_leaves((args, kwargs),
+                                                       is_leaf=lambda x: isinstance(x, Tensor))
+                  if isinstance(a, Tensor)]
+        requires_grad = (mode.is_grad_enabled()
+                         and any(not t.stop_gradient for t in inputs))
+
+        with mode.grad_enabled(False):
+            out = cls.forward(ctx, *args, **kwargs)
+
+        single = isinstance(out, Tensor)
+        outs = [out] if single else list(out)
+
+        if requires_grad:
+            out_avals = [jax.ShapeDtypeStruct(tuple(t.shape), t.dtype) for t in outs]
+            treedef = jax.tree_util.tree_structure([0] * len(outs))
+
+            def vjp_fn(cotangents):
+                gts = [Tensor(c, stop_gradient=True) for c in cotangents]
+                with mode.grad_enabled(False):
+                    gin = cls.backward(ctx, *gts)
+                if isinstance(gin, Tensor) or gin is None:
+                    gin = (gin,)
+                datas = []
+                for g in gin:
+                    datas.append(None if g is None else
+                                 (g._data if isinstance(g, Tensor) else g))
+                # align with recorded inputs; missing grads -> zeros skipped by tape
+                out_cots = []
+                for t, g in zip(inputs, datas):
+                    if g is None:
+                        import jax.numpy as jnp
+                        g = jnp.zeros(tuple(t.shape), t.dtype)
+                    out_cots.append(g)
+                return tuple(out_cots)
+
+            node = GradNode(cls.__name__, vjp_fn, inputs, out_avals, treedef)
+            for i, t in enumerate(outs):
+                if not jax.numpy.issubdtype(t.dtype, jax.numpy.inexact):
+                    continue
+                t.stop_gradient = False
+                t._node = node
+                t._out_idx = i
+        return out if single else type(out)(outs) if isinstance(out, (list, tuple)) else outs

@@ -1,0 +1,1 @@
+from .tensor import Tensor, to_tensor  # noqa: F401

@@ -1,0 +1,293 @@
+"""Eager Tensor: a jax.Array handle with autograd metadata.
+
+Redesign of the reference's pybind eager Tensor
+(paddle/fluid/pybind/eager_method.cc + ``phi::DenseTensor`` at
+paddle/phi/core/dense_tensor.h:38).  There is no separate allocator/DeviceContext:
+storage, placement and async execution belong to jax/PjRt.  Autograd metadata
+(``stop_gradient``, producer GradNode, hooks) mirrors ``egr::AutogradMeta``.
+
+Most operator methods are patched onto this class by ``paddle_tpu.ops``
+(analog of the reference's math-op monkey patch in
+python/paddle/fluid/dygraph/math_op_patch.py).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype, get_default_dtype
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx",
+                 "_backward_hooks", "name", "persistable", "trainable",
+                 "process_mesh", "placements",  # auto_parallel dist attrs
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            if dtype is None and isinstance(data, (bool, int, float, list, tuple)):
+                arr = np.asarray(data)
+                if arr.dtype == np.float64:
+                    dtype = get_default_dtype()
+                data = arr
+            data = jnp.asarray(data, dtype=convert_dtype(dtype))
+        elif dtype is not None and data.dtype != convert_dtype(dtype):
+            data = data.astype(convert_dtype(dtype))
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        self._backward_hooks = []
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+
+    def __reduce__(self):
+        # pickle as host data (autograd state intentionally dropped) — makes
+        # whole Layers picklable for jit.save / paddle.save(Layer).
+        # Subclasses (Parameter) lack __slots__, so extra attributes like
+        # mesh_axes live in __dict__ and round-trip through `extras`.
+        extras = dict(getattr(self, "__dict__", {}) or {})
+        return (_tensor_from_pickle,
+                (type(self), np.asarray(self._data), self.stop_gradient,
+                 self.name, self.persistable, extras))
+
+    # ---- metadata ----
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        from ..framework.device import CPUPlace, TPUPlace
+        try:
+            dev = next(iter(self._data.devices()))
+        except Exception:
+            return CPUPlace()
+        if dev.platform == "cpu":
+            return CPUPlace()
+        return TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # ---- conversion ----
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        if args:
+            return self.numpy().item(*args)
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __array__(self, dtype=None):
+        arr = self.numpy()
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        import jax as _jax
+        if isinstance(self._data, _jax.core.Tracer):
+            # Data-dependent Python control flow inside to_static/jit: the
+            # branch condition is a traced value, so `if`/`while` on it
+            # would bake one branch at trace time.  The reference rewrites
+            # these via dy2static AST transforms (python/paddle/jit/
+            # dy2static/); here the contract is explicit.
+            raise TypeError(
+                "Tensor used as a Python bool inside a to_static/jit trace. "
+                "Data-dependent control flow cannot be traced: replace "
+                "`if`/`while` on tensor values with paddle_tpu.where / "
+                "lax.cond-style ops, or move the branch outside the "
+                "compiled function.")
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_txt = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype}{grad_txt},\n"
+                f"       {np.array2string(np.asarray(jax.device_get(self._data)), prefix='       ')})")
+
+    # ---- autograd ----
+    def backward(self, grad_tensor=None, retain_graph=False,
+                 create_graph=False):
+        from ..autograd.tape import backward as _backward
+        _backward([self], [grad_tensor], retain_graph=retain_graph,
+                  create_graph=create_graph)
+
+    def register_hook(self, hook):
+        self._backward_hooks.append(hook)
+
+        class _Handle:
+            def remove(h):
+                try:
+                    self._backward_hooks.remove(hook)
+                except ValueError:
+                    pass
+        return _Handle()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True)
+        t.name = self.name
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        from ..ops.dispatch import apply_op
+        return apply_op("clone", lambda x: jnp.array(x, copy=True), (self,), {})
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data), stop_gradient=True)
+        else:
+            self.grad = None
+
+    @property
+    def gradient(self):
+        return None if self.grad is None else self.grad.numpy()
+
+    # ---- in-place (functional rebind; bumps nothing — document the caveat) ----
+    def set_value(self, value):
+        value = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        self._data = value.astype(self._data.dtype) if value.dtype != self._data.dtype else value
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def _rebind(self, data):
+        """Internal: in-place update used by optimizers (param.step)."""
+        self._data = data
+        return self
+
+    # ---- placement / dtype ----
+    def astype(self, dtype):
+        from ..ops.dispatch import apply_op
+        d = convert_dtype(dtype)
+        return apply_op("cast", lambda x: x.astype(d), (self,), {})
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def to(self, *args, **kwargs):
+        # accepts dtype and/or device strings; device moves via device_put
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, str) and (a.startswith(("cpu", "gpu", "tpu", "cuda"))):
+                devs = jax.devices("cpu" if a.startswith("cpu") else None)
+                out = Tensor(jax.device_put(out._data, devs[0]),
+                             stop_gradient=out.stop_gradient)
+            elif a is not None:
+                out = out.astype(a)
+        return out
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def cuda(self, *_):
+        return Tensor(jax.device_put(self._data, jax.devices()[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self):
+        return self
+
+    # ---- indexing ----
+    def __getitem__(self, idx):
+        from ..ops.dispatch import apply_op
+        idx = _unwrap_index(idx)
+        return apply_op("getitem", lambda x: x[idx], (self,), {})
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        value = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ---- misc parity helpers ----
+    @property
+    def T(self):
+        from ..ops.dispatch import apply_op
+        return apply_op("t", lambda x: x.T, (self,), {})
+
+    def __hash__(self):
+        return id(self)
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list) and any(isinstance(i, Tensor) for i in idx):
+        return [_unwrap_index(i) for i in idx]
+    return idx
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """``paddle.to_tensor`` parity."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _tensor_from_pickle(cls, data, stop_gradient, name, persistable, extras):
+    t = cls.__new__(cls)
+    Tensor.__init__(t, data, stop_gradient=stop_gradient, name=name)
+    for k, v in extras.items():
+        setattr(t, k, v)
+    t.persistable = persistable
+    return t

@@ -1,0 +1,46 @@
+"""paddle_tpu.distributed (reference python/paddle/distributed/).
+
+Collectives are XLA HLOs over device meshes (SURVEY §5.8); groups are mesh
+slices; hybrid parallelism lives in ``fleet``; the SPMD planner in
+``auto_parallel``.
+"""
+
+from .communication import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    p2p_permute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .group import (  # noqa: F401
+    Group,
+    destroy_process_group,
+    get_group,
+    new_group,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+)
+from .store import TCPStore  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
+from . import ps  # noqa: F401
+from .spawn import spawn  # noqa: F401

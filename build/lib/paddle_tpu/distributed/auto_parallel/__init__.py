@@ -1,0 +1,23 @@
+"""auto_parallel — semi-automatic SPMD (reference
+python/paddle/distributed/auto_parallel/, 38.5k LoC; SURVEY §2.7).
+
+The reference pipeline is Completer (propagate dist attrs, completion.py:107)
+→ Partitioner (split program per rank, partitioner.py:40) → Resharder
+(insert comm, reshard.py:1010).  On TPU all three collapse into GSPMD:
+the user marks seed shardings (``shard_tensor``/``shard_op``), XLA's sharding
+propagation completes them, and the partitioner/resharder ARE the compiler.
+What remains here is the user API (ProcessMesh, placements, markers), the
+Strategy config surface, and the Engine train/eval/predict driver.
+"""
+
+from .process_mesh import ProcessMesh  # noqa: F401
+from .placement import Partial, Replicate, Shard  # noqa: F401
+from .interface import (  # noqa: F401
+    dtensor_from_fn,
+    reshard,
+    shard_layer,
+    shard_op,
+    shard_tensor,
+)
+from .strategy import Strategy  # noqa: F401
+from .engine import Engine  # noqa: F401

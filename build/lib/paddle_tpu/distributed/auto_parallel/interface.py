@@ -1,0 +1,125 @@
+"""shard_tensor / shard_op / shard_layer / reshard markers.
+
+Reference: auto_parallel/interface.py:28,117 (shard_tensor records a
+TensorDistAttr that the Completer propagates).  On TPU the marker IS the
+mechanism: it places the array with a NamedSharding and XLA propagates.
+"""
+
+import jax
+
+from ...core.tensor import Tensor
+from .placement import (
+    Placement,
+    Replicate,
+    Shard,
+    placements_to_spec,
+    shard_spec_to_spec,
+)
+from .process_mesh import ProcessMesh
+
+
+def _resolve_spec(mesh, placements_or_spec, ndim):
+    if placements_or_spec is None:
+        from jax.sharding import PartitionSpec as P
+        return P()
+    entries = list(placements_or_spec)
+    if entries and isinstance(entries[0], Placement):
+        return placements_to_spec(entries, mesh.dim_names, ndim)
+    return shard_spec_to_spec(entries, ndim)
+
+
+def shard_tensor(x, process_mesh=None, placements=None, shard_spec=None,
+                 mesh=None, stop_gradient=None):
+    """Place ``x`` on the mesh with the given placements.
+
+    Accepts both the placements API (``[Shard(0), Replicate()]`` — one entry
+    per MESH dim) and the 2.5 shard_spec API (one mesh-dim name or None per
+    TENSOR dim).
+    """
+    pm = process_mesh if process_mesh is not None else mesh
+    if not isinstance(pm, ProcessMesh):
+        pm = ProcessMesh(pm)
+    data = x._data if isinstance(x, Tensor) else x
+    spec = _resolve_spec(pm, placements if placements is not None
+                         else shard_spec, data.ndim)
+    jmesh = pm.jax_mesh()
+    sharded = jax.device_put(data, jax.sharding.NamedSharding(jmesh, spec))
+    if isinstance(x, Tensor):
+        x._data = sharded
+        x.process_mesh = pm
+        x.placements = placements
+        return x
+    t = Tensor(sharded)
+    t.process_mesh = pm
+    t.placements = placements
+    return t
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    """Create a distributed tensor by sharding fn's output."""
+    return shard_tensor(fn(*args, **kwargs), process_mesh=mesh,
+                        placements=placements)
+
+
+def reshard(x, mesh, placements):
+    """Move a tensor to a (new) mesh/placements — the Resharder
+    (reference static/reshard.py:1010) is one device_put on TPU."""
+    return shard_tensor(x, process_mesh=mesh, placements=placements)
+
+
+def shard_op(op_fn, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Wrap a callable so its outputs get sharding constraints.
+
+    Reference interface.py:117 records dist attrs on the op; here output
+    constraints steer XLA's propagation.
+    """
+    pm = process_mesh if isinstance(process_mesh, ProcessMesh) else (
+        ProcessMesh(process_mesh) if process_mesh is not None else None)
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        if pm is None or out_shard_specs is None:
+            return out
+        jmesh = pm.jax_mesh()
+
+        def constrain(t, spec_entry):
+            if spec_entry is None:
+                return t
+            data = t._data if isinstance(t, Tensor) else t
+            spec = shard_spec_to_spec(spec_entry, data.ndim)
+            out_d = jax.lax.with_sharding_constraint(
+                data, jax.sharding.NamedSharding(jmesh, spec))
+            if isinstance(t, Tensor):
+                t._data = out_d
+                return t
+            return out_d
+
+        if isinstance(out, (tuple, list)):
+            specs = list(out_shard_specs) + [None] * (len(out)
+                                                      - len(out_shard_specs))
+            return type(out)(constrain(t, s) for t, s in zip(out, specs))
+        return constrain(out, out_shard_specs[0])
+
+    return wrapped
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """Shard a Layer's parameters over a mesh (reference api shard_layer).
+
+    ``shard_fn(name, layer, mesh)`` may place params; the default replicates
+    (XLA propagation then decides from activations).
+    """
+    pm = process_mesh if isinstance(process_mesh, ProcessMesh) else \
+        ProcessMesh(process_mesh)
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, pm)
+    else:
+        jmesh = pm.jax_mesh()
+        from jax.sharding import PartitionSpec as P
+        for p in layer.parameters():
+            p._data = jax.device_put(
+                p._data, jax.sharding.NamedSharding(jmesh, P()))
+    return layer

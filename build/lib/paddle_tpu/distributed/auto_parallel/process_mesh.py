@@ -1,0 +1,74 @@
+"""ProcessMesh (reference auto_parallel/process_mesh.py; C++
+paddle/phi/core/distributed/auto_parallel/process_mesh.h:32).
+
+A named cartesian process topology; materializes directly as a
+jax.sharding.Mesh.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names=None, process_ids=None, shape=None):
+        arr = np.asarray(mesh)
+        if process_ids is not None and shape is not None:
+            arr = np.asarray(process_ids).reshape(shape)
+        self._shape = list(arr.shape)
+        self._process_ids = arr.flatten().tolist()
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    # reference alias
+    processes = process_ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def mesh(self):
+        return np.asarray(self._process_ids).reshape(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def jax_mesh(self):
+        """Materialize as a jax Mesh over the local device list."""
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            picked = np.array([devs[pid % len(devs)]
+                               for pid in self._process_ids])
+            self._jax_mesh = Mesh(picked.reshape(self._shape),
+                                  tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids),
+                     tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")

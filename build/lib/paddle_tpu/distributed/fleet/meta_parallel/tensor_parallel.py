@@ -1,0 +1,26 @@
+"""TensorParallel wrapper (reference meta_parallel/tensor_parallel.py).
+
+The reference broadcasts mp params at init; here wrapping physically places
+parameters per their mesh_axes over the hybrid mesh, so the wrapped model's
+jit steps run partitioned.
+"""
+
+from ....nn.layer_base import Layer
+from ..spmd import shard_parameters
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        shard_parameters(layers, hcg.mesh)
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)

@@ -1,0 +1,164 @@
+"""Activation recompute as a user API.
+
+Reference: python/paddle/distributed/fleet/recompute/recompute.py:332
+(``recompute``), ``recompute_sequential`` (:456).  TPU-native design: the
+function is wrapped in ``jax.checkpoint`` — its VJP recomputes the forward
+from the inputs instead of saving intermediates.  That one primitive covers
+both the eager tape (the recorded pullback holds only the inputs) and the
+compiled paths (XLA rematerializes inside jit), replacing the reference's
+hand-rolled RecomputeFunction/PyLayer machinery.
+
+Policy knobs map to ``jax.checkpoint_policies``: ``checkpoint="full"``
+saves nothing (default), ``"dots"`` saves matmul results
+(dots_saveable), ``"nothing_saveable"``/``"everything_saveable"`` pass
+through to jax.
+"""
+
+import functools
+
+import jax
+
+from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
+
+_POLICIES = {
+    None: None,
+    "full": None,  # save nothing; recompute everything
+    "dots": "dots_saveable",
+    "dots_saveable": "dots_saveable",
+    "dots_with_no_batch_dims": "dots_with_no_batch_dims_saveable",
+    "nothing_saveable": "nothing_saveable",
+    "everything_saveable": "everything_saveable",
+}
+
+
+def _resolve_policy(name):
+    key = _POLICIES.get(name, name)
+    if key is None:
+        return None
+    pol = getattr(jax.checkpoint_policies, key, None)
+    if pol is None:
+        raise ValueError(
+            f"unknown recompute policy {name!r}; use one of "
+            f"{sorted(k for k in _POLICIES if isinstance(k, str))}")
+    return pol
+
+
+def _collect_param_tensors(function):
+    """Trainable Tensors the function closes over (its Layer's parameters,
+    bound-method self, closure cells).  These must become explicit
+    differentiable inputs of the recorded recompute op — apply_op only
+    differentiates Tensors it can SEE in args, so closed-over layer weights
+    would otherwise silently stop training."""
+    from ...nn.layer_base import Layer
+
+    found, seen = [], set()
+
+    def add(t):
+        if isinstance(t, Tensor) and not t.stop_gradient and \
+                id(t) not in seen:
+            seen.add(id(t))
+            found.append(t)
+
+    def visit(obj, depth=0):
+        if isinstance(obj, Layer):
+            for p in obj.parameters():
+                add(p)
+        elif isinstance(obj, Tensor):
+            add(obj)
+        elif depth == 0 and isinstance(obj, (list, tuple)):
+            for o in obj:
+                visit(o, depth + 1)
+
+    visit(function)
+    self_obj = getattr(function, "__self__", None)
+    if self_obj is not None:
+        visit(self_obj)
+    raw_fn = getattr(function, "__func__", function)
+    for cell in getattr(raw_fn, "__closure__", None) or ():
+        try:
+            visit(cell.cell_contents)
+        except ValueError:  # empty cell
+            pass
+    # globals referenced by name in the code object (a module-level layer
+    # used inside the function is not a closure cell)
+    code = getattr(raw_fn, "__code__", None)
+    fglobals = getattr(raw_fn, "__globals__", None)
+    if code is not None and fglobals is not None:
+        for name in code.co_names:
+            if name in fglobals:
+                visit(fglobals[name])
+    return found
+
+
+def recompute(function, *args, use_reentrant=True, preserve_rng_state=True,
+              policy=None, **kwargs):
+    """Run ``function(*args, **kwargs)`` with activation rematerialization.
+
+    The backward pass recomputes the forward instead of reading saved
+    activations — the memory/computation trade the reference implements with
+    RecomputeFunction (recompute.py:332).  ``use_reentrant`` and
+    ``preserve_rng_state`` are accepted for API parity; rng state is always
+    preserved (the dispatch key stream threads keys functionally, so replay
+    is deterministic by construction).
+    """
+    params = _collect_param_tensors(function)
+    return apply_op("recompute", _RecomputeFn(function, policy, params),
+                    (tuple(args), kwargs, params), {})
+
+
+class _RecomputeFn:
+    """Pure callable so apply_op records one checkpointed node."""
+
+    def __init__(self, function, policy, param_tensors):
+        self._fn = function
+        self._params = param_tensors
+        self._ckpt = jax.checkpoint(self._call, policy=_resolve_policy(policy))
+
+    def _call(self, args, kwargs, param_vals):
+        # apply_op substituted raw arrays where Tensors were; hand the user
+        # function Tensors again so arbitrary layer code works inside
+        wrap = lambda a: Tensor(a) if isinstance(a, jax.Array) else a
+        args = jax.tree_util.tree_map(wrap, args)
+        kwargs = jax.tree_util.tree_map(wrap, kwargs)
+        # bind traced values into the closed-over parameter Tensors for the
+        # duration of the call (restored after; same pattern as QuantedLayer)
+        from ...framework import mode
+        originals = [p._data for p in self._params]
+        try:
+            for p, val in zip(self._params, param_vals):
+                p._data = val._data if isinstance(val, Tensor) else val
+            # grads flow through the enclosing jax trace, not the eager
+            # tape — skip per-op vjp recording inside the checkpointed body
+            with mode.grad_enabled(False):
+                out = self._fn(*args, **kwargs)
+        finally:
+            for p, orig in zip(self._params, originals):
+                p._data = orig
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda v: isinstance(v, Tensor))
+
+    def __call__(self, args, kwargs, param_vals):
+        return self._ckpt(args, kwargs, param_vals)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Recompute a paddle.nn.Sequential in segments (reference
+    recompute_sequential:456).  ``ctx`` carries {'segments': N}."""
+    segments = (ctx or {}).get("segments", 1)
+    layers = list(functions)
+    seg_size = max(1, len(layers) // max(1, segments))
+    out = args[0] if len(args) == 1 else args
+    i = 0
+    while i < len(layers):
+        chunk = layers[i:i + seg_size]
+
+        def seg_fn(x, _chunk=tuple(chunk)):
+            for layer in _chunk:
+                x = layer(x)
+            return x
+
+        out = recompute(seg_fn, out, **kwargs)
+        i += seg_size
+    return out

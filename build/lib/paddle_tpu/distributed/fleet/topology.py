@@ -1,0 +1,216 @@
+"""Hybrid-parallel topology over a jax device Mesh.
+
+Redesign of ``CommunicateTopology``/``HybridCommunicateGroup``
+(reference python/paddle/distributed/fleet/base/topology.py:54,140).  The
+reference carves one NCCL comm per parallel axis per rank; here the same
+4-D topology ["dp", "pp", "sharding", "mp"(, "sep")] materializes as ONE
+jax.sharding.Mesh whose axis names are consumed by NamedSharding /
+shard_map — XLA derives every communicator from shardings.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+from ..group import Group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = int(np.prod(self._dims))
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return self._world
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._parallel_names]
+        rank = 0
+        for c, d in zip(coords, self._dims):
+            rank = rank * d + c
+        return rank
+
+    def get_coord(self, rank):
+        coords = []
+        for d in reversed(self._dims):
+            coords.append(rank % d)
+            rank //= d
+        return tuple(reversed(coords))
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        return [r for r in range(self._world)
+                if self.get_coord(r)[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        axis = self._parallel_names.index(axis_name)
+        other_sizes = [d for i, d in enumerate(self._dims) if i != axis]
+        lists = []
+        for flat in range(int(np.prod(other_sizes)) if other_sizes else 1):
+            coords_other = []
+            f = flat
+            for d in reversed(other_sizes):
+                coords_other.append(f % d)
+                f //= d
+            coords_other = list(reversed(coords_other))
+            comm = []
+            for k in range(self._dims[axis]):
+                coord = list(coords_other)
+                coord.insert(axis, k)
+                comm.append(self.get_rank(**dict(zip(self._parallel_names,
+                                                     coord))))
+            lists.append(comm)
+        return lists
+
+
+# canonical mesh axis names (paddle name -> mesh axis)
+AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding", "model": "mp",
+            "sep": "sep"}
+
+
+def build_mesh(dp=1, pp=1, sharding=1, mp=1, sep=1, devices=None):
+    """Build the hybrid Mesh.  Axis order follows the reference topology
+    order (data, pipe, sharding, model) so rank layout matches
+    fleet's (distributed_strategy.proto:68-71 degrees)."""
+    devices = devices if devices is not None else jax.devices()
+    need = dp * pp * sharding * mp * sep
+    if need > len(devices):
+        raise ValueError(f"topology requires {need} devices, have {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, pp, sharding, mp, sep)
+    axes = ("dp", "pp", "sharding", "mp", "sep")
+    # drop singleton sep axis unless used, keep canonical 4D otherwise
+    if sep == 1:
+        arr = arr.reshape(dp, pp, sharding, mp)
+        axes = ("dp", "pp", "sharding", "mp")
+    return Mesh(arr, axes)
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:140.  Exposes the same rank/degree accessors and
+    per-axis Groups; additionally owns the jax Mesh used by SPMD training."""
+
+    def __init__(self, topology):
+        self._topo = topology
+        self.global_rank = jax.process_index()
+        self.nranks = topology.world_size()
+        names = topology.get_hybrid_group_names()
+        dims = {n: topology.get_dim(n) for n in names}
+        self._dp_degree = dims.get("data", 1)
+        self._pp_degree = dims.get("pipe", 1)
+        self._sharding_degree = dims.get("sharding", 1)
+        self._mp_degree = dims.get("model", 1)
+        self._sep_degree = dims.get("sep", 1)
+        self.mesh = build_mesh(self._dp_degree, self._pp_degree,
+                               self._sharding_degree, self._mp_degree,
+                               self._sep_degree)
+        coord = self._topo.get_coord(self.global_rank)
+        self._coord = dict(zip(names, coord))
+        self._groups = {}
+
+    # --- degrees ---
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # --- ranks within axis ---
+    def get_data_parallel_rank(self):
+        return self._coord.get("data", 0)
+
+    def get_model_parallel_rank(self):
+        return self._coord.get("model", 0)
+
+    def get_stage_id(self):
+        return self._coord.get("pipe", 0)
+
+    def get_sharding_parallel_rank(self):
+        return self._coord.get("sharding", 0)
+
+    # --- groups (device-mesh slices) ---
+    def _axis_group(self, paddle_axis):
+        if paddle_axis not in self._groups:
+            ranks = self._current_axis_ranks(paddle_axis)
+            devs = jax.devices()
+            g = Group(ranks, [devs[r] for r in ranks if r < len(devs)],
+                      gid=100 + len(self._groups), name=paddle_axis)
+            self._groups[paddle_axis] = g
+        return self._groups[paddle_axis]
+
+    def _current_axis_ranks(self, axis_name):
+        names = self._topo.get_hybrid_group_names()
+        axis = names.index(axis_name)
+        comm_lists = self._topo.get_comm_list(axis_name)
+        for comm in comm_lists:
+            if self.global_rank in comm:
+                return comm
+        return comm_lists[0]
+
+    def get_data_parallel_group(self):
+        return self._axis_group("data")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("model")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_check_parallel_group(self, *a):
+        return self._axis_group("data")
+
+    def get_data_parallel_group_src_rank(self):
+        return self.get_data_parallel_group().ranks[0]
+
+    def get_model_parallel_group_src_rank(self):
+        return self.get_model_parallel_group().ranks[0]
+
+    # --- pipeline helpers ---
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        if self._mp_degree > 1 or self._pp_degree > 1 or \
+                self._sharding_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL if self._mp_degree > 1 \
+                else ParallelMode.PIPELINE_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+
+class ParallelMode:
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3

@@ -1,0 +1,99 @@
+"""Process groups over jax device meshes.
+
+Redesign of the reference ProcessGroup
+(paddle/fluid/distributed/collective/process_group.h:53).  There is no runtime
+communicator to manage: a Group is a named 1-D jax Mesh (a slice of devices);
+collectives over it become XLA collective HLOs — inside a jit/shard_map trace
+they are ``lax.psum``-family calls on the group's axis name, and eager calls
+wrap a tiny cached shard_map program.  One NCCL comm per (group, device)
+(process_group_nccl.cc) dissolves into compiler-scheduled ICI collectives.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+_AXIS = "_pg"  # axis name used by every 1-D group mesh
+
+
+class Group:
+    def __init__(self, ranks, devices, gid=0, name=None):
+        self.ranks = list(ranks)
+        self.id = gid
+        self.name = name or f"group_{gid}"
+        self._devices = list(devices)
+        self._mesh = Mesh(np.array(self._devices), (_AXIS,)) \
+            if self._devices else None
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def axis(self):
+        return _AXIS
+
+    @property
+    def rank(self):
+        # single-controller: the "current rank" notion maps to process index
+        pid = jax.process_index()
+        return self.ranks.index(pid) if pid in self.ranks else 0
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, ranks={self.ranks})"
+
+
+_default_group = None
+_groups = {}
+_next_gid = 1
+
+
+def _ensure_default_group():
+    global _default_group
+    if _default_group is None:
+        devs = jax.devices()
+        _default_group = Group(list(range(len(devs))), devs, gid=0,
+                               name="default")
+        _groups[0] = _default_group
+    return _default_group
+
+
+def get_group(gid=0):
+    if gid == 0:
+        return _ensure_default_group()
+    return _groups[gid]
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    """Create a group over a subset of devices (reference
+    python/paddle/distributed/collective.py:175)."""
+    global _next_gid
+    devs = jax.devices()
+    if ranks is None:
+        ranks = list(range(len(devs)))
+    group_devs = [devs[r] for r in ranks if r < len(devs)]
+    g = Group(list(ranks), group_devs, gid=_next_gid)
+    _groups[_next_gid] = g
+    _next_gid += 1
+    return g
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None:
+        _groups.clear()
+        _default_group = None
+    else:
+        _groups.pop(group.id, None)

@@ -1,0 +1,140 @@
+"""python -m paddle_tpu.distributed.launch — multi-process job launcher.
+
+Reference: python/paddle/distributed/launch/main.py:18 (controllers build
+per-rank env, master KV rendezvous, log dirs per rank).  TPU redesign: on a
+TPU pod each *host* runs ONE process (single-controller per host, jax
+multi-host runtime); the launcher's job is rank env + rendezvous via the
+native TCPStore (rank 0 hosts) + log aggregation.  ``--nproc_per_node`` > 1
+is supported for CPU testing (the reference's multi-process-per-box test
+pattern, SURVEY §4.2).
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a distributed training job")
+    p.add_argument("--master", default=None,
+                   help="rendezvous endpoint host:port (default: self-host)")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids, comma separated")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _rank_env(args, local_rank, world_size, master):
+    env = dict(os.environ)
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_NNODES": str(args.nnodes),
+        "PADDLE_MASTER": master,
+        "PADDLE_JOB_ID": args.job_id,
+        # jax multi-host bootstrap mirrors the same endpoint
+        "JAX_COORDINATOR_ADDRESS": master,
+    })
+    if args.devices is not None:
+        env["CUDA_VISIBLE_DEVICES"] = args.devices
+        env["TPU_VISIBLE_DEVICES"] = args.devices
+    return env
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    world_size = args.nnodes * args.nproc_per_node
+
+    store = None
+    if args.master is None:
+        if args.nnodes > 1:
+            # A self-hosted 127.0.0.1 endpoint is unreachable from other
+            # nodes — the job would hang at bootstrap instead of failing
+            # fast.  Multi-node requires an explicit routable master.
+            raise SystemExit(
+                "--master is required when --nnodes > 1 (the self-hosted "
+                "rendezvous binds 127.0.0.1, which remote nodes cannot "
+                "reach). Pass --master <node0_ip>:<port>.")
+        # self-host the rendezvous KV on a free port (node 0 semantics)
+        from ..store import TCPStore
+        store = TCPStore("127.0.0.1", 0, is_master=True,
+                         world_size=world_size)
+        master = f"127.0.0.1:{store.port}"
+    else:
+        master = args.master
+
+    os.makedirs(args.log_dir, exist_ok=True)
+    procs = []
+    logs = []
+    log_files = []
+    for local_rank in range(args.nproc_per_node):
+        rank = args.node_rank * args.nproc_per_node + local_rank
+        log_path = os.path.join(args.log_dir,
+                                f"workerlog.{rank}")
+        logf = open(log_path, "w")
+        log_files.append(logf)
+        cmd = [sys.executable, args.training_script] + \
+            args.training_script_args
+        proc = subprocess.Popen(
+            cmd, env=_rank_env(args, local_rank, world_size, master),
+            stdout=logf, stderr=subprocess.STDOUT)
+        procs.append(proc)
+        logs.append(log_path)
+
+    def _terminate(*_):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGTERM, _terminate)
+    rc = 0
+    try:
+        while any(p.poll() is None for p in procs):
+            for p in procs:
+                code = p.poll()
+                if code is not None and code != 0:
+                    # one rank failed: tear down the rest (reference
+                    # controller restart/abort policy)
+                    _terminate()
+                    rc = code
+            time.sleep(0.2)
+        for p in procs:
+            rc = rc or (p.returncode or 0)
+    except KeyboardInterrupt:
+        _terminate()
+        rc = 130
+    finally:
+        for f in log_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+    if rc != 0:
+        sys.stderr.write(
+            f"[launch] job failed (exit {rc}); logs: {', '.join(logs)}\n")
+        tail = logs[0]
+        try:
+            with open(tail) as f:
+                sys.stderr.write("".join(f.readlines()[-20:]))
+        except OSError:
+            pass
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

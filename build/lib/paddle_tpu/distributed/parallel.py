@@ -1,0 +1,118 @@
+"""Parallel environment bootstrap + dygraph DataParallel.
+
+Reference: python/paddle/distributed/parallel.py (init_parallel_env :915,
+DataParallel :186).  TPU redesign: there is no TCPStore/NCCL bootstrap to do in
+single-controller mode — ``init_parallel_env`` initializes jax.distributed when
+multi-host env vars are present and builds the default device mesh.  Gradient
+sync needs no EagerReducer bucketing (reducer.cc): under SPMD the gradient
+pmean is one fused XLA all-reduce scheduled by the compiler.
+"""
+
+import os
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .group import _ensure_default_group
+
+
+class ParallelEnv:
+    """Reference: python/paddle/fluid/dygraph/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def local_rank(self):
+        return jax.process_index()
+
+    @property
+    def world_size(self):
+        return jax.process_count()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return jax.process_count()
+
+    @property
+    def dev_id(self):
+        return 0
+
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Bootstrap multi-host jax if configured; build the default group."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
+    if coord and nnodes > 1 and jax.process_count() == 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=nnodes,
+            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+    _ensure_default_group()
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    return jax.device_count()
+
+
+def is_initialized():
+    return _initialized
+
+
+class DataParallel(Layer):
+    """paddle.DataParallel parity.
+
+    On TPU the wrapped model's training runs SPMD: batch sharded over the data
+    axis, gradients pmean'd by XLA.  Wrapping keeps API parity (state_dict
+    passthrough, no_sync) and marks the model for dp sharding when used with
+    jit.TrainStep/ShardedTrainStep.  There is no bucketed EagerReducer —
+    see reference paddle/fluid/distributed/collective/reducer.cc:89 for what
+    this replaces.
+    """
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def no_sync(self):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss

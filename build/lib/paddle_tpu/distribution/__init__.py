@@ -1,0 +1,372 @@
+"""paddle.distribution parity (reference python/paddle/distribution/).
+
+Distributions are thin classes over jax.scipy/jax.random; sampling draws
+from the global seeded key stream (paddle.seed-controlled).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework.random import get_rng_key
+from ..ops.dispatch import apply_op
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    """Keep Tensors (autograd flows); lift plain values to float32 Tensors."""
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, jnp.float32))
+
+
+def _elemwise(name, fn, *args):
+    """Run pure-jax ``fn`` through the op dispatcher so the eager tape
+    records it (distribution parameters may be live Tensors)."""
+    return apply_op(name, fn, args, {})
+
+
+def _shape(sample_shape, base):
+    return tuple(sample_shape) + tuple(base)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_d(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    """Differentiable: loc/scale may be live Tensors — log_prob, entropy,
+    kl_divergence and rsample record on the eager tape."""
+
+    def __init__(self, loc, scale, name=None):
+        self._loc = _t(loc)
+        self._scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(tuple(self._loc.shape),
+                                              tuple(self._scale.shape)))
+
+    @property
+    def loc(self):
+        return self._loc._data
+
+    @property
+    def scale(self):
+        return self._scale._data
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        eps = jax.random.normal(k, _shape(shape, self.batch_shape))
+        return _elemwise("normal_rsample",
+                         lambda loc, scale: loc + scale * eps,
+                         self._loc, self._scale)
+
+    def log_prob(self, value):
+        const = 0.5 * math.log(2 * math.pi)
+        return _elemwise(
+            "normal_log_prob",
+            lambda v, loc, scale: (-((v - loc) ** 2) / (2 * scale ** 2)
+                                   - jnp.log(scale) - const),
+            value if isinstance(value, Tensor) else _t(value),
+            self._loc, self._scale)
+
+    def entropy(self):
+        shape = self.batch_shape
+        return _elemwise(
+            "normal_entropy",
+            lambda scale: (0.5 + 0.5 * math.log(2 * math.pi)
+                           + jnp.log(scale) + jnp.zeros(shape)),
+            self._scale)
+
+    def kl_divergence(self, other):
+        return _elemwise(
+            "normal_kl",
+            lambda la, sa, lb, sb: (jnp.log(sb / sa)
+                                    + (sa ** 2 + (la - lb) ** 2)
+                                    / (2 * sb ** 2) - 0.5),
+            self._loc, self._scale, other._loc, other._scale)
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _d(low).astype(jnp.float32)
+        self.high = _d(high).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        u = jax.random.uniform(k, _shape(shape, self.batch_shape))
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _d(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low)
+                      + jnp.zeros(self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self._probs = _t(probs)
+        else:
+            self._probs = _elemwise("sigmoid", jax.nn.sigmoid, _t(logits))
+        super().__init__(tuple(self._probs.shape))
+
+    @property
+    def probs(self):
+        return self._probs._data
+
+    @property
+    def logits(self):
+        p = self._probs._data
+        return jnp.log(p) - jnp.log1p(-p)
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(jax.random.bernoulli(
+            k, self.probs, _shape(shape, self.batch_shape))
+            .astype(jnp.float32))
+
+    def log_prob(self, value):
+        return _elemwise(
+            "bernoulli_log_prob",
+            lambda v, p: (v * jnp.log(jnp.clip(p, 1e-12))
+                          + (1 - v) * jnp.log(jnp.clip(1 - p, 1e-12))),
+            value if isinstance(value, Tensor) else _t(value), self._probs)
+
+    def entropy(self):
+        return _elemwise(
+            "bernoulli_entropy",
+            lambda p: -(p * jnp.log(jnp.clip(p, 1e-12))
+                        + (1 - p) * jnp.log(jnp.clip(1 - p, 1e-12))),
+            self._probs)
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self._logits = _t(logits)
+        super().__init__(tuple(self._logits.shape)[:-1])
+
+    @property
+    def logits(self):
+        return self._logits._data
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self._logits._data, axis=-1)
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(jax.random.categorical(
+            k, self.logits, shape=_shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        v = _d(value).astype(jnp.int32)
+        return _elemwise(
+            "categorical_log_prob",
+            lambda logits: jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1), v[..., None],
+                axis=-1)[..., 0],
+            self._logits)
+
+    def entropy(self):
+        return _elemwise(
+            "categorical_entropy",
+            lambda logits: -jnp.sum(
+                jax.nn.softmax(logits, -1)
+                * jax.nn.log_softmax(logits, -1), axis=-1),
+            self._logits)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _d(rate).astype(jnp.float32)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(jax.random.exponential(
+            k, _shape(shape, self.batch_shape)) / self.rate)
+
+    def log_prob(self, value):
+        v = _d(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _d(loc).astype(jnp.float32)
+        self.scale = _d(scale).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            k, _shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        v = _d(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1.0 + jnp.log(2 * self.scale))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _d(concentration).astype(jnp.float32)
+        self.rate = _d(rate).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(jax.random.gamma(
+            k, self.concentration, _shape(shape, self.batch_shape))
+            / self.rate)
+
+    def log_prob(self, value):
+        v = _d(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _d(alpha).astype(jnp.float32)
+        self.beta = _d(beta).astype(jnp.float32)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(jax.random.beta(k, self.alpha, self.beta,
+                                      _shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        v = _d(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(_d(self._normal.sample(shape))))
+
+    def log_prob(self, value):
+        v = _d(value)
+        return Tensor(_d(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _d(probs).astype(jnp.float32)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        n = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            k, jnp.log(self.probs),
+            shape=_shape(shape, self.batch_shape) + (self.total_count,))
+        return Tensor(jax.nn.one_hot(draws, n).sum(axis=-2))
+
+    def log_prob(self, value):
+        v = _d(value)
+        logp = jnp.log(jnp.clip(self.probs, 1e-12))
+        coef = (jax.scipy.special.gammaln(jnp.asarray(self.total_count + 1.0))
+                - jnp.sum(jax.scipy.special.gammaln(v + 1.0), axis=-1))
+        return Tensor(coef + jnp.sum(v * logp, axis=-1))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _d(concentration).astype(jnp.float32)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        k = get_rng_key()
+        return Tensor(jax.random.dirichlet(
+            k, self.concentration, _shape(shape, self.batch_shape)))
+
+    def log_prob(self, value):
+        v = _d(value)
+        a = self.concentration
+        norm = (jnp.sum(jax.scipy.special.gammaln(a), axis=-1)
+                - jax.scipy.special.gammaln(jnp.sum(a, axis=-1)))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), axis=-1) - norm)
+
+
+def kl_divergence(p, q):
+    """Registered closed forms (differentiable); falls back to
+    p.kl_divergence(q)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        return _elemwise(
+            "categorical_kl",
+            lambda a, b: jnp.sum(
+                jax.nn.softmax(a, -1)
+                * (jax.nn.log_softmax(a, -1) - jax.nn.log_softmax(b, -1)),
+                axis=-1),
+            p._logits, q._logits)
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        def _kl(pa, qa):
+            pa = jnp.clip(pa, 1e-12, 1 - 1e-12)
+            qa = jnp.clip(qa, 1e-12, 1 - 1e-12)
+            return (pa * (jnp.log(pa) - jnp.log(qa))
+                    + (1 - pa) * (jnp.log1p(-pa) - jnp.log1p(-qa)))
+        return _elemwise("bernoulli_kl", _kl, p._probs, q._probs)
+    if hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"kl_divergence not registered for {type(p).__name__}/"
+        f"{type(q).__name__}")

@@ -1,0 +1,46 @@
+"""Framework core: dtypes, device management, RNG, flags, execution modes.
+
+TPU-native analog of the reference's ``paddle/phi/common/`` scalar types
+(``DataType``/``Place`` — paddle/phi/common/place.h) and the global state held by
+``egr::Controller`` (paddle/fluid/eager/api/utils/global_utils.h:45).  Instead of a
+DeviceContextPool over CUDA streams, device state is JAX's: devices come from
+``jax.devices()`` and placement is expressed with shardings.
+"""
+
+from .dtype import (  # noqa: F401
+    DTYPE_MAP,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .random import get_rng_key, seed, split_key  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .mode import (  # noqa: F401
+    grad_enabled,
+    in_dynamic_mode,
+    is_grad_enabled,
+    no_grad,
+    set_grad_enabled,
+)

@@ -1,0 +1,82 @@
+"""Device/place management.
+
+The reference models devices as ``phi::Place`` + a DeviceContextPool
+(paddle/fluid/platform/device_context.h).  Here a "place" is a thin label over
+JAX's device list; actual placement happens through shardings and
+``jax.device_put``.  ``CUDAPlace`` is accepted for API compatibility and maps to
+the default accelerator.
+"""
+
+import jax
+
+
+class Place:
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.device_id == other.device_id
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.device_id))
+
+
+class CPUPlace(Place):
+    def __init__(self):
+        super().__init__(0)
+
+
+class TPUPlace(Place):
+    pass
+
+
+class CUDAPlace(Place):
+    """Accepted for source compatibility; maps to the default accelerator."""
+
+
+_current_device = None
+
+
+def _default_device_str():
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return "tpu:0"
+    if backend == "gpu":
+        return "gpu:0"
+    return "cpu"
+
+
+def get_device():
+    return _current_device or _default_device_str()
+
+
+def set_device(device):
+    """Accepts "cpu", "tpu", "tpu:0", "gpu:0" etc.  Returns the place."""
+    global _current_device
+    name = device.split(":")[0]
+    idx = int(device.split(":")[1]) if ":" in device else 0
+    if name == "cpu":
+        place = CPUPlace()
+    elif name in ("tpu", "xpu"):
+        place = TPUPlace(idx)
+    elif name in ("gpu", "cuda"):
+        place = CUDAPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    _current_device = device
+    return place
+
+
+def device_count():
+    return jax.device_count()
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_tpu():
+    return jax.default_backend() == "tpu"

@@ -1,0 +1,75 @@
+"""Dtype registry.
+
+Mirrors the reference's ``phi::DataType`` (paddle/phi/common/data_type.h) but the
+canonical representation is a ``jax.numpy`` dtype.  Paddle dtype strings
+("float32", "bfloat16", ...) are accepted everywhere a dtype is.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+DTYPE_MAP = {
+    "bool": jnp.bool_,
+    "uint8": jnp.uint8,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+_default_dtype = jnp.float32
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype-ish value (str, np.dtype, jnp dtype) to a numpy dtype.
+
+    64-bit integer types canonicalize to 32-bit unless jax x64 is enabled —
+    the TPU-native integer width (and jax's default canonicalization).
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in DTYPE_MAP:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+        d = np.dtype(DTYPE_MAP[dtype])
+    else:
+        d = np.dtype(dtype)
+    import jax
+    if not jax.config.jax_enable_x64:
+        if d == np.int64:
+            return np.dtype(np.int32)
+        if d == np.uint64:
+            return np.dtype(np.uint32)
+    return d
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if d not in (np.dtype(jnp.float32), np.dtype(jnp.float64), np.dtype(jnp.float16),
+                 np.dtype(jnp.bfloat16)):
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return np.dtype(_default_dtype)

@@ -1,0 +1,72 @@
+"""Device/host memory statistics.
+
+Reference: paddle/fluid/memory/stats.cc (Stat{Update,GetCurrent,GetPeak})
+exposed as paddle.device.cuda.max_memory_allocated etc.  On TPU the device
+heap belongs to PjRt/XLA, so device numbers come from
+``jax.Device.memory_stats()`` and host-side accounting rides the native C++
+stat counters (native/flags_stats.cc).
+"""
+
+import jax
+
+from ..core import native as _native
+
+_ALLOCATED = "Allocated"
+_RESERVED = "Reserved"
+
+
+def _device_stats(device_id=0):
+    devs = jax.devices()
+    if device_id >= len(devs):
+        return {}
+    try:
+        return devs[device_id].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device_id=0):
+    """Bytes currently allocated on the device."""
+    stats = _device_stats(device_id)
+    if "bytes_in_use" in stats:
+        return int(stats["bytes_in_use"])
+    return _native.stat_current(_ALLOCATED, device_id)
+
+
+def max_memory_allocated(device_id=0):
+    stats = _device_stats(device_id)
+    if "peak_bytes_in_use" in stats:
+        return int(stats["peak_bytes_in_use"])
+    return _native.stat_peak(_ALLOCATED, device_id)
+
+
+def memory_reserved(device_id=0):
+    stats = _device_stats(device_id)
+    if "bytes_reserved" in stats:
+        return int(stats["bytes_reserved"])
+    return _native.stat_current(_RESERVED, device_id)
+
+
+def max_memory_reserved(device_id=0):
+    stats = _device_stats(device_id)
+    if "peak_bytes_reserved" in stats:
+        return int(stats["peak_bytes_reserved"])
+    return _native.stat_peak(_RESERVED, device_id)
+
+
+def reset_peak_memory_stats(device_id=0):
+    _native.stat_reset_peak(_ALLOCATED, device_id)
+    _native.stat_reset_peak(_RESERVED, device_id)
+
+
+def host_stat_update(kind, delta, device_id=0):
+    """Host-side accounting hook (DataLoader pinned buffers etc.)."""
+    _native.stat_update(kind, device_id, delta)
+
+
+def host_stat_current(kind, device_id=0):
+    return _native.stat_current(kind, device_id)
+
+
+def host_stat_peak(kind, device_id=0):
+    return _native.stat_peak(kind, device_id)

@@ -1,0 +1,63 @@
+"""Execution / grad modes.
+
+Grad-mode global mirrors ``egr::Controller::HasGrad``
+(paddle/fluid/eager/api/utils/global_utils.h:45); ``no_grad`` mirrors
+``paddle.no_grad``.  ``in_dynamic_mode`` is always True at the user API level —
+the static path here is tracing under jit, not a separate program builder.
+"""
+
+import contextlib
+import threading
+
+
+class _Mode(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_mode = _Mode()
+
+
+def is_grad_enabled():
+    return _mode.grad_enabled
+
+
+def set_grad_enabled(enabled):
+    _mode.grad_enabled = bool(enabled)
+
+
+@contextlib.contextmanager
+def grad_enabled(enabled):
+    prev = _mode.grad_enabled
+    _mode.grad_enabled = bool(enabled)
+    try:
+        yield
+    finally:
+        _mode.grad_enabled = prev
+
+
+class no_grad:
+    """Context manager & decorator disabling autograd recording."""
+
+    def __enter__(self):
+        self._prev = _mode.grad_enabled
+        _mode.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _mode.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+def in_dynamic_mode():
+    return True

@@ -1,0 +1,65 @@
+"""Global RNG state.
+
+Paddle exposes a global generator seeded by ``paddle.seed``
+(python/paddle/framework/random.py in the reference).  JAX is functional, so we
+keep one root key and split it per request.  Code running under ``jax.jit``
+should thread keys explicitly (the train-step helpers do); the global key is for
+eager convenience and parameter init.
+"""
+
+import contextlib
+
+import jax
+
+
+class _GlobalRNG:
+    def __init__(self, seed_val=0):
+        self._key = jax.random.PRNGKey(seed_val)
+        self.initial_seed = seed_val
+
+    def split(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+_rng = _GlobalRNG(0)
+
+
+def seed(seed_val):
+    """Reset the global RNG (paddle.seed parity)."""
+    global _rng
+    _rng = _GlobalRNG(int(seed_val))
+    return _rng
+
+
+_key_stream = None
+
+
+@contextlib.contextmanager
+def key_stream(key):
+    """Route get_rng_key() through an explicit (possibly traced) key.
+
+    Used by jit paths so dropout etc. get fresh randomness per compiled step
+    instead of a baked-in constant key.
+    """
+    global _key_stream
+    prev = _key_stream
+    _key_stream = [key]
+    try:
+        yield
+    finally:
+        _key_stream = prev
+
+
+def get_rng_key():
+    """Split the global key (or the active key stream) and return a subkey."""
+    global _key_stream
+    if _key_stream is not None:
+        k, sub = jax.random.split(_key_stream[0])
+        _key_stream[0] = k
+        return sub
+    return _rng.split()
+
+
+def split_key(n):
+    return jax.random.split(_rng.split(), n)

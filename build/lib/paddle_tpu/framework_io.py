@@ -1,0 +1,49 @@
+"""paddle.save / paddle.load parity (reference python/paddle/framework/io.py:646,888).
+
+State dicts serialize as pickled numpy payloads; sharded global arrays gather
+to host first.  The async sharded checkpoint path (orbax) lives in
+paddle_tpu.incubate.checkpoint (SURVEY §5.4 equivalence).
+"""
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _to_serializable(obj):
+    from .core.tensor import Tensor
+    if isinstance(obj, Tensor):
+        return ("__tensor__", np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _to_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_serializable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_serializable(obj):
+    from .core.tensor import Tensor
+    if isinstance(obj, tuple) and len(obj) == 2 and obj[0] == "__tensor__":
+        return Tensor(obj[1])
+    if isinstance(obj, dict):
+        return {k: _from_serializable(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_serializable(v) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_from_serializable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_serializable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _from_serializable(pickle.load(f))

@@ -1,0 +1,8 @@
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model, summary  # noqa: F401

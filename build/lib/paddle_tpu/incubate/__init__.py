@@ -1,0 +1,3 @@
+"""paddle.incubate parity namespace (reference python/paddle/incubate/)."""
+
+from . import autograd, distributed  # noqa: F401

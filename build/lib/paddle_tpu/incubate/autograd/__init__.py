@@ -1,0 +1,206 @@
+"""paddle.incubate.autograd parity — functional higher-order autodiff.
+
+Reference: python/paddle/incubate/autograd/ (primapi.py ``forward_grad``/
+``grad``, functional.py ``jvp``/``vjp``/``Jacobian``/``Hessian``).  The
+reference lowers to primitive-op rules so its static compiler can
+differentiate; on TPU jax IS the primitive system, so these are thin
+functional wrappers: Tensors at the boundary, jax transforms inside.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "grad", "forward_grad",
+           "enable_prim", "disable_prim", "prim_enabled"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_wrap(v) for v in x)
+    return Tensor(x, stop_gradient=True)
+
+
+def _functional(func):
+    """Adapt a Tensor-in/Tensor-out function to raw jax arrays."""
+
+    def fn(*arrays):
+        args = tuple(Tensor(a, stop_gradient=True) for a in arrays)
+        out = func(*args)
+        return jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t),
+            out, is_leaf=lambda v: isinstance(v, Tensor))
+
+    return fn
+
+
+def _as_tuple(xs):
+    if isinstance(xs, (list, tuple)):
+        return tuple(xs), True
+    return (xs,), False
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v).  v defaults to ones."""
+    xs_t, _ = _as_tuple(xs)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    if v is None:
+        tangents = tuple(jnp.ones_like(p) for p in primals)
+    else:
+        v_t, _ = _as_tuple(v)
+        tangents = tuple(_unwrap(t) for t in v_t)
+    out, tan = jax.jvp(_functional(func), primals, tangents)
+    return _wrap(out), _wrap(tan)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: returns (func(xs), v^T @ J).  v defaults to ones."""
+    xs_t, multi = _as_tuple(xs)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    out, pull = jax.vjp(_functional(func), *primals)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = jax.tree_util.tree_map(
+            lambda t: _unwrap(t), v,
+            is_leaf=lambda x: isinstance(x, Tensor))
+    grads = pull(cot)
+    grads = _wrap(list(grads)) if multi else _wrap(grads[0])
+    return _wrap(out), grads
+
+
+def grad(outputs=None, inputs=None, grad_outputs=None, func=None, xs=None):
+    """Functional gradient.  Two call forms:
+
+    - ``grad(func, xs)`` (primapi.py:grad): returns d func / d xs.
+    - ``grad(outputs, inputs, grad_outputs)``: eager-tape form, delegates to
+      ``paddle_tpu.autograd.grad`` with create_graph=True.
+    """
+    if callable(outputs):
+        func, xs = outputs, inputs
+        xs_t, multi = _as_tuple(xs)
+        primals = tuple(_unwrap(x) for x in xs_t)
+
+        def scalar_fn(*arrays):
+            out = _functional(func)(*arrays)
+            leaves = jax.tree_util.tree_leaves(out)
+            return sum(jnp.sum(l) for l in leaves)
+
+        gs = jax.grad(scalar_fn, argnums=tuple(range(len(primals))))(*primals)
+        return _wrap(list(gs)) if multi else _wrap(gs[0])
+    from ...autograd.tape import grad as tape_grad
+    return tape_grad(outputs, inputs, grad_outputs=grad_outputs,
+                     create_graph=True)
+
+
+def forward_grad(func, xs, v=None):
+    """primapi.forward_grad parity: forward-mode derivative of func at xs."""
+    return jvp(func, xs, v)[1]
+
+
+class Jacobian:
+    """Lazy Jacobian (reference functional.py Jacobian): index or
+    materialize with ``[:]``."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_t, self._multi = _as_tuple(xs)
+        self._primals = tuple(_unwrap(x) for x in xs_t)
+        self._fn = _functional(func)
+        self._batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            jac = jax.jacrev(self._fn, argnums=tuple(
+                range(len(self._primals))))(*self._primals)
+            if not self._multi:
+                jac = jac[0] if isinstance(jac, tuple) else jac
+            out_leaves = jax.tree_util.tree_leaves(jac)
+            self._mat = out_leaves[0] if len(out_leaves) == 1 else jac
+        return self._mat
+
+    def __getitem__(self, idx):
+        m = self._materialize()
+        if isinstance(m, (tuple, list)):
+            return _wrap([jnp.asarray(x)[idx] for x in m])
+        arr = jnp.asarray(m)
+        if not self._batched and arr.ndim >= 2:
+            arr = arr.reshape(int(np.prod(arr.shape[:arr.ndim // 2])), -1)
+        return Tensor(arr[idx], stop_gradient=True)
+
+    @property
+    def shape(self):
+        m = self._materialize()
+        arr = jnp.asarray(m if not isinstance(m, (tuple, list)) else m[0])
+        return list(arr.shape)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar function (reference functional.py)."""
+
+    def __init__(self, func, xs, is_batched=False):
+        xs_t, self._multi = _as_tuple(xs)
+        self._primals = tuple(_unwrap(x) for x in xs_t)
+        fn = _functional(func)
+
+        def scalar_fn(*arrays):
+            out = fn(*arrays)
+            leaves = jax.tree_util.tree_leaves(out)
+            tot = sum(jnp.sum(l) for l in leaves)
+            return tot
+
+        self._scalar_fn = scalar_fn
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            n_in = len(self._primals)
+            argnums = tuple(range(n_in))
+            # argnums as a tuple makes jax return nested tuples h[i][j] even
+            # for a single input — uniform block assembly below
+            h = jax.hessian(self._scalar_fn, argnums=argnums)(*self._primals)
+            sizes = [int(np.prod(p.shape)) for p in self._primals]
+            # full block Hessian over concatenated flattened inputs
+            # (reference functional.Hessian semantics)
+            self._mat = jnp.concatenate(
+                [jnp.concatenate(
+                    [jnp.asarray(h[i][j]).reshape(sizes[i], sizes[j])
+                     for j in range(n_in)], axis=1)
+                 for i in range(n_in)], axis=0)
+        return self._mat
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx], stop_gradient=True)
+
+    @property
+    def shape(self):
+        return list(self._materialize().shape)
+
+
+# prim-mode toggles: jax is always "primitive mode" (every op differentiates
+# through its jax definition), so these are no-ops kept for API parity with
+# python/paddle/incubate/autograd/primx.py.
+_PRIM = {"enabled": False}
+
+
+def enable_prim():
+    _PRIM["enabled"] = True
+
+
+def disable_prim():
+    _PRIM["enabled"] = False
+
+
+def prim_enabled():
+    return _PRIM["enabled"]

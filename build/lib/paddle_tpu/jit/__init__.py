@@ -1,0 +1,323 @@
+"""paddle_tpu.jit: the dygraph→compiled bridge.
+
+Replaces the reference's dy2static stack (``paddle.jit.to_static`` at
+python/paddle/jit/api.py:233: AST transformers → ProgramDesc →
+PartialProgramLayer → InterpreterCore).  On TPU there is no program IR of our
+own: ``to_static`` traces the eager code with jax tracers flowing through the
+same op implementations and compiles via XLA.  ConcreteProgram analog = the
+jaxpr cached inside jax.jit; StandaloneExecutor analog = PjRt executable cache.
+
+Key pieces:
+- ``functional_call(layer, values, *args)`` — run a Layer with its
+  parameters/buffers substituted from a pytree (torch.func-style), the
+  functionalization primitive everything else builds on.
+- ``to_static(fn_or_layer)`` — compile forward.
+- ``TrainStep(model, loss_fn, opt)`` — whole training step (fwd+bwd+optimizer)
+  as ONE compiled XLA program: the performance path matching the reference's
+  "everything under jit" north star.
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import mode
+from ..framework.random import get_rng_key, key_stream
+from ..nn.layer_base import Layer
+
+_is_tensor = lambda x: isinstance(x, Tensor)
+
+
+def _bind(layer, values):
+    """Swap state_dict tensors' storage to ``values``; return restore list."""
+    sd = layer.state_dict()
+    saved = []
+    for name, arr in values.items():
+        t = sd[name]
+        saved.append((t, t._data))
+        t._data = arr
+    return saved, sd
+
+
+def _restore(saved):
+    for t, data in saved:
+        t._data = data
+
+
+def functional_call(layer, values, *args, return_buffers=False,
+                    forward_fn=None, **kwargs):
+    """Run ``layer(*args, **kwargs)`` with parameters/buffers from ``values``
+    (dict name -> jax array).  Inputs may be Tensors or jax arrays.  Returns
+    output (jax-array pytree); with ``return_buffers=True`` also returns the
+    possibly-updated buffer values (BatchNorm running stats etc.).
+    ``forward_fn`` overrides the callable (used by to_static to avoid
+    re-entering its own compiled forward)."""
+    saved, sd = _bind(layer, values)
+    call = forward_fn if forward_fn is not None else layer
+    try:
+        targs = [Tensor(a) if not isinstance(a, Tensor) and
+                 isinstance(a, (jax.Array, np.ndarray)) else a for a in args]
+        with mode.grad_enabled(False):
+            out = call(*targs, **kwargs)
+        out_data = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else t, out,
+            is_leaf=_is_tensor)
+        if return_buffers:
+            buf = {name: t._data for name, t in sd.items() if name in values}
+            return out_data, buf
+        return out_data
+    finally:
+        _restore(saved)
+
+
+def _split_state(layer):
+    """Trainable params vs frozen state (non-trainable params + buffers)."""
+    params, others = {}, {}
+    for name, t in layer.state_dict().items():
+        if isinstance(t, Tensor) and not t.stop_gradient:
+            params[name] = t._data
+        else:
+            others[name] = t._data
+    return params, others
+
+
+class StaticFunction:
+    """Compiled forward wrapper (ConcreteProgram/PartialProgramLayer analog,
+    reference python/paddle/jit/dy2static/program_translator.py)."""
+
+    def __init__(self, function, layer=None):
+        self._function = function
+        self._layer = layer
+        self._cache = {}
+
+    def __call__(self, *args, **kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
+                                                     is_leaf=_is_tensor)
+        t_pos = tuple(i for i, l in enumerate(leaves) if isinstance(l, Tensor))
+        datas = [leaves[i]._data for i in t_pos]
+        static_leaves = tuple(
+            None if i in t_pos else _hashable(leaves[i])
+            for i in range(len(leaves)))
+        training = self._layer.training if self._layer is not None else None
+        cache_key = (treedef, t_pos, static_leaves, training)
+
+        if cache_key not in self._cache:
+            layer = self._layer
+            function = self._function
+            raw_leaves = list(leaves)
+
+            if layer is not None:
+                params, others = _split_state(layer)
+
+                @jax.jit
+                def compiled(params, others, key, *datas):
+                    new_leaves = list(raw_leaves)
+                    for i, d in zip(t_pos, datas):
+                        new_leaves[i] = Tensor(d)
+                    a, k = jax.tree_util.tree_unflatten(treedef, new_leaves)
+                    with key_stream(key):
+                        out, buf = functional_call(layer, {**params, **others},
+                                                   *a, return_buffers=True,
+                                                   forward_fn=function, **k)
+                    return out, buf
+
+                self._cache[cache_key] = ("layer", compiled)
+            else:
+                @jax.jit
+                def compiled(key, *datas):
+                    new_leaves = list(raw_leaves)
+                    for i, d in zip(t_pos, datas):
+                        new_leaves[i] = Tensor(d)
+                    a, k = jax.tree_util.tree_unflatten(treedef, new_leaves)
+                    with key_stream(key), mode.grad_enabled(False):
+                        out = function(*a, **k)
+                    return jax.tree_util.tree_map(
+                        lambda t: t._data if isinstance(t, Tensor) else t, out,
+                        is_leaf=_is_tensor)
+
+                self._cache[cache_key] = ("fn", compiled)
+
+        kind, compiled = self._cache[cache_key]
+        key = get_rng_key()
+        if kind == "layer":
+            params, others = _split_state(self._layer)
+            out, buf = compiled(params, others, key, *datas)
+            sd = self._layer.state_dict()
+            for name, val in buf.items():
+                if name in sd and sd[name].stop_gradient and \
+                        not isinstance(val, jax.core.Tracer):
+                    sd[name]._data = val
+        else:
+            out = compiled(key, *datas)
+        return jax.tree_util.tree_map(
+            lambda d: Tensor(d) if isinstance(d, jax.Array) else d, out)
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._function)
+
+
+def _hashable(x):
+    if isinstance(x, (list,)):
+        return tuple(_hashable(i) for i in x)
+    if isinstance(x, dict):
+        return tuple(sorted((k, _hashable(v)) for k, v in x.items()))
+    if isinstance(x, np.ndarray):
+        return (x.shape, str(x.dtype), x.tobytes())
+    return x
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Compile a function or a Layer's forward (paddle.jit.to_static parity)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            static = StaticFunction(fn.forward, layer=fn)
+            fn.forward = static
+            return fn
+        return StaticFunction(fn)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+class TrainStep:
+    """One whole training step compiled to a single XLA program.
+
+    fwd + bwd (jax.grad over the functionalized model) + grad clip + optimizer
+    update all fuse into one executable; parameters/optimizer state live on
+    device across steps.  This is the TPU answer to the reference's fused
+    optimizer kernels + CUDA-graph capture
+    (paddle/phi/backends/gpu/cuda/cuda_graph.cc).
+
+    Usage::
+        step = TrainStep(model, loss_fn, opt)
+        loss = step(batch_x, batch_y)      # Tensors in, loss Tensor out
+    """
+
+    def __init__(self, model, loss_fn, optimizer, donate=True, remat=False,
+                 scaler=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.remat = remat
+        self._params, self._frozen = _split_state(model)
+        self._opt_state = optimizer.init_state_pytree(self._params)
+        self._step = 0
+        self._compiled = None
+        self._donate = donate
+        # loss scaling composed INTO the compiled step (reference
+        # fleet/scaler.py distributed_scaler + update_loss_scaling_ kernel)
+        self.scaler = scaler if (scaler is not None and scaler.is_enable()) \
+            else None
+        if self.scaler is not None:
+            from ..amp import scaler_init_state
+            self._scaler_state = scaler_init_state(self.scaler)
+            self.scaler._compiled_state = self._scaler_state
+        else:
+            self._scaler_state = None
+
+    def _build(self):
+        model = self.model
+        loss_fn = self.loss_fn
+        optimizer = self.optimizer
+        grad_clip = optimizer._grad_clip
+
+        def make_loss_f(frozen, key, inputs, labels):
+            def loss_f(p):
+                with key_stream(key):
+                    out = functional_call(model, {**p, **frozen}, *inputs)
+                out_t = jax.tree_util.tree_map(
+                    lambda d: Tensor(d) if isinstance(d, jax.Array) else d, out)
+                label_t = tuple(Tensor(l) if isinstance(l, jax.Array) else l
+                                for l in labels)
+                with mode.grad_enabled(False):
+                    loss = loss_fn(out_t, *label_t)
+                return loss._data if isinstance(loss, Tensor) else loss
+
+            if self.remat:
+                # activation rematerialization: recompute the forward during
+                # the backward pass instead of saving activations
+                loss_f = jax.checkpoint(loss_f)
+            return loss_f
+
+        def step_fn(params, frozen, opt_state, step, lr, key, inputs, labels):
+            loss_f = make_loss_f(frozen, key, inputs, labels)
+            loss, grads = jax.value_and_grad(loss_f)(params)
+            if grad_clip is not None:
+                grads = grad_clip.clip_pytree(grads)
+            new_params, new_opt = optimizer.apply_gradients_pytree(
+                params, grads, opt_state, step, lr=lr)
+            return loss, new_params, new_opt
+
+        scaler = self.scaler
+
+        def step_fn_scaled(params, frozen, opt_state, step, lr, key, inputs,
+                           labels, scaler_state):
+            from ..amp import scaler_guarded_update
+            loss_f = make_loss_f(frozen, key, inputs, labels)
+
+            def scaled_f(p):
+                l = loss_f(p)
+                return l * scaler_state["scale"].astype(l.dtype), l
+
+            (_, loss), grads = jax.value_and_grad(
+                scaled_f, has_aux=True)(params)
+            new_params, new_opt, new_sstate = scaler_guarded_update(
+                scaler, scaler_state, grads, grad_clip, optimizer,
+                params, opt_state, step, lr)
+            return loss, new_params, new_opt, new_sstate
+
+        donate = (0, 2) if self._donate else ()
+        self._compiled = jax.jit(
+            step_fn_scaled if scaler is not None else step_fn,
+            donate_argnums=donate)
+
+    def __call__(self, inputs, labels=()):
+        """inputs: Tensor or tuple for the model; labels: Tensor or tuple for
+        loss_fn(output, *labels)."""
+        if self._compiled is None:
+            self._build()
+        self._step += 1
+        lr = jnp.float32(self.optimizer.get_lr())
+        key = get_rng_key()
+        if isinstance(inputs, Tensor):
+            inputs = (inputs,)
+        if isinstance(labels, Tensor):
+            labels = (labels,)
+        in_data = tuple(t._data if isinstance(t, Tensor) else t for t in inputs)
+        lb_data = tuple(t._data if isinstance(t, Tensor) else t for t in labels)
+        if self.scaler is not None:
+            # the scaler object owns the live state (set_state_dict can
+            # replace it between steps)
+            loss, self._params, self._opt_state, new_sstate = \
+                self._compiled(self._params, self._frozen, self._opt_state,
+                               jnp.int32(self._step), lr, key, in_data,
+                               lb_data, self.scaler._compiled_state)
+            self.scaler._compiled_state = new_sstate
+        else:
+            loss, self._params, self._opt_state = self._compiled(
+                self._params, self._frozen, self._opt_state,
+                jnp.int32(self._step), lr, key, in_data, lb_data)
+        self.sync_to_model()
+        return Tensor(loss)
+
+    def sync_to_model(self):
+        """Rebind updated device arrays into the model's Parameters."""
+        sd = self.model.state_dict()
+        for name, arr in self._params.items():
+            sd[name]._data = arr
+
+    def state_dict(self):
+        return {"params": self._params, "opt_state": self._opt_state,
+                "step": self._step}
+
+
+from .save_load import TranslatedLayer, load, save  # noqa: E402,F401

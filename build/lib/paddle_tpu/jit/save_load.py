@@ -1,0 +1,84 @@
+"""paddle.jit.save / paddle.jit.load — TranslatedLayer parity.
+
+Reference: jit.save serializes the traced program + params via
+paddle/fluid/jit/serializer.cc and load returns a TranslatedLayer executing
+it.  Here the Layer object (pure Python, Tensors pickle as host arrays) is
+the program: save writes ``<prefix>.pdmodel`` (pickled structure) +
+``<prefix>.pdiparams`` (state dict); load reconstructs the Layer and wraps
+its forward in ``to_static`` so it executes as one compiled XLA program —
+the same compiled-artifact semantics the reference gets from its serialized
+ProgramDesc.
+"""
+
+import pickle
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+
+
+class TranslatedLayer(Layer):
+    """A loaded inference/training layer (reference
+    python/paddle/jit/translated_layer.py)."""
+
+    def __init__(self, inner):
+        super().__init__()
+        self._inner = inner
+        from . import to_static
+        self._compiled = to_static(inner.forward)
+
+    def forward(self, *args, **kwargs):
+        return self._compiled(*args, **kwargs)
+
+    def state_dict(self, *a, **k):
+        return self._inner.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._inner.set_state_dict(sd, *a, **k)
+
+    def parameters(self, *a, **k):
+        return self._inner.parameters(*a, **k)
+
+    def train(self):
+        self._inner.train()
+        return super().train()
+
+    def eval(self):
+        self._inner.eval()
+        return super().eval()
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Save a Layer (or StaticFunction-decorated Layer) to ``path`` prefix."""
+    from . import StaticFunction
+
+    fwd = layer.forward
+    restore = None
+    if isinstance(fwd, StaticFunction):
+        # unwrap the jit cache before pickling; re-wrapped on load
+        restore = fwd
+        layer.forward = fwd._function if hasattr(fwd, "_function") else \
+            fwd.__wrapped__
+    try:
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(layer, f)
+    finally:
+        if restore is not None:
+            layer.forward = restore
+    state = {k: np.asarray(v._data)
+             for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+
+def load(path, **configs):
+    """Load a jit-saved model; returns a TranslatedLayer."""
+    with open(path + ".pdmodel", "rb") as f:
+        inner = pickle.load(f)
+    try:
+        with open(path + ".pdiparams", "rb") as f:
+            state = pickle.load(f)
+        inner.set_state_dict(state)
+    except FileNotFoundError:
+        pass
+    return TranslatedLayer(inner)

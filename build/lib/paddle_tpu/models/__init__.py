@@ -1,0 +1,24 @@
+"""Model zoo (language models; vision lives in paddle_tpu.vision.models)."""
+
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    gpt_tiny,
+    gpt_124m,
+    gpt_350m,
+    gpt_1_3b,
+    gpt_6_7b,
+)
+from .wide_deep import WideDeep  # noqa: F401
+from .deepspeech import DeepSpeech2, deepspeech2_tiny  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    bert_base,
+    bert_base_config,
+    bert_tiny,
+    bert_tiny_config,
+)

@@ -1,0 +1,76 @@
+"""Extra convolution variants: depthwise + 3-D transpose.
+
+Reference: paddle/phi/kernels/*/depthwise_conv*, conv3d_transpose kernels.
+Depthwise conv on TPU is just grouped convolution — XLA lowers
+feature_group_count==channels efficiently; there is no separate kernel.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op, raw
+
+
+@op()
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     groups=None, data_format="NCHW"):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    g = groups or x.shape[ch_axis]
+    return raw("conv2d")(x, weight, bias=bias, stride=stride,
+                         padding=padding, dilation=dilation, groups=g,
+                         data_format=data_format)
+
+
+@op()
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1, groups=None,
+                               data_format="NCHW", output_size=None):
+    g = groups or x.shape[1]
+    return raw("conv2d_transpose")(x, weight, bias=bias, stride=stride,
+                                   padding=padding,
+                                   output_padding=output_padding,
+                                   dilation=dilation, groups=g,
+                                   data_format=data_format,
+                                   output_size=output_size)
+
+
+def _triple(v):
+    return (v, v, v) if isinstance(v, int) else tuple(v)
+
+
+@op()
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW", output_size=None):
+    stride = _triple(stride)
+    dilation = _triple(dilation)
+    opad = _triple(output_padding)
+    if isinstance(padding, str):
+        pad_pairs = [(0, 0)] * 3 if padding.upper() == "VALID" else None
+    elif isinstance(padding, int):
+        pad_pairs = [(padding, padding)] * 3
+    else:
+        p = list(padding)
+        pad_pairs = ([(pi, pi) for pi in p] if len(p) == 3
+                     else [(p[2 * i], p[2 * i + 1]) for i in range(3)])
+    ks = [(weight.shape[2 + i] - 1) * dilation[i] + 1 for i in range(3)]
+    if pad_pairs is None:  # SAME
+        pad_pairs = [(k // 2, k // 2) for k in ks]
+    pads = [(ks[i] - 1 - pad_pairs[i][0],
+             ks[i] - 1 - pad_pairs[i][1] + opad[i]) for i in range(3)]
+    w = jnp.flip(weight, axis=(2, 3, 4))
+    if groups > 1:
+        ic, ocg = w.shape[0], w.shape[1]
+        w = w.reshape(groups, ic // groups, ocg, *w.shape[2:])
+        w = jnp.swapaxes(w, 1, 2).reshape(groups * ocg, ic // groups,
+                                          *w.shape[3:])
+    else:
+        w = jnp.swapaxes(w, 0, 1)
+    out = lax.conv_general_dilated(
+        x, w, window_strides=(1, 1, 1), padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilation,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape((1, -1, 1, 1, 1))
+    return out

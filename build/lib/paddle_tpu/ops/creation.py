@@ -1,0 +1,185 @@
+"""Tensor creation ops (paddle.tensor.creation parity)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, to_tensor  # noqa: F401
+from ..framework.dtype import convert_dtype, get_default_dtype
+from .registry import op
+
+
+def _dt(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else get_default_dtype()
+    return convert_dtype(dtype)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(tuple(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = np.asarray(fill_value).dtype
+        if dtype == np.float64:
+            dtype = get_default_dtype()
+    return Tensor(jnp.full(tuple(shape), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(tuple(shape), dtype=_dt(dtype)))
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange takes python scalars")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = "int64"
+        else:
+            dtype = get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base,
+                               dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+@op()
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+@op()
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=convert_dtype(dtype))
+
+
+@op()
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
+
+
+@op()
+def empty_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+@op()
+def diag(x, offset=0, padding_value=0):
+    if x.ndim == 1 and padding_value != 0:
+        out = jnp.diag(x, k=offset)
+        mask = jnp.diag(jnp.ones_like(x, dtype=bool), k=offset)
+        return jnp.where(mask, out, jnp.asarray(padding_value, dtype=out.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@op()
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+@op()
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    r = jnp.arange(x.shape[-1])
+    rows = r - offset if offset < 0 else r
+    cols = r + offset if offset > 0 else r
+    out = base.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [a for a in range(nd) if a not in (d1, d2)]
+        perm.insert(d1, nd - 2)
+        inv = list(range(nd))
+        src = [a for a in range(nd - 2)]
+        # move the last two axes into positions (d1, d2)
+        order = []
+        rest = iter(range(nd - 2))
+        for a in range(nd):
+            if a == d1:
+                order.append(nd - 2)
+            elif a == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(rest))
+        out = jnp.transpose(out, order)
+    return out
+
+
+@op()
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+@op()
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    if col is None:
+        col = row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r, c]).astype(convert_dtype(dtype)))
+
+
+@op()
+def meshgrid(*args):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return tuple(jnp.meshgrid(*args, indexing="ij"))
+
+
+@op()
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+@op()
+def complex(real, imag):
+    from jax import lax
+    return lax.complex(real, imag)
+
+
+@op()
+def polar(abs, angle):
+    from jax import lax
+    return lax.complex(abs * jnp.cos(angle), abs * jnp.sin(angle))
+
+
+@op()
+def clone(x):
+    return jnp.array(x, copy=True)
+
+
+@op()
+def numel(x):
+    return jnp.asarray(np.prod(x.shape) if x.shape else 1, dtype=jnp.int64)

@@ -1,0 +1,73 @@
+"""FFT kernels (phi fft ops).
+
+Reference: `paddle/phi/kernels/*/fft*` (pocketfft/cuFFT backends, SURVEY
+§2.9 `paddle.fft`).  On TPU the FFT lowers to XLA's FFT HLO via ``jnp.fft``;
+there is no backend zoo to manage.  These three ops are the primitive layer
+the ``paddle_tpu.fft`` module (user API) builds on, mirroring the
+`fft_c2c` / `fft_r2c` / `fft_c2r` kernel split in
+paddle/phi/api/yaml/ops.yaml.
+"""
+
+import jax.numpy as jnp
+
+from .registry import op
+
+_NORM = {"backward": "backward", "forward": "forward", "ortho": "ortho"}
+
+
+def _norm(normalization):
+    if normalization in (None, ""):
+        return "backward"
+    if normalization not in _NORM:
+        raise ValueError(f"unsupported fft normalization: {normalization}")
+    return normalization
+
+
+@op()
+def fft_c2c(x, axes, normalization="backward", forward=True):
+    axes = tuple(axes)
+    norm = _norm(normalization)
+    if forward:
+        return jnp.fft.fftn(x, axes=axes, norm=norm)
+    return jnp.fft.ifftn(x, axes=axes, norm=norm)
+
+
+@op()
+def fft_r2c(x, axes, normalization="backward", forward=True, onesided=True):
+    axes = tuple(axes)
+    norm = _norm(normalization)
+    if not forward:
+        # ihfft semantics (numpy parity): conj(rfft(x)) with the *inverse*
+        # transform's normalization — backward: 1/n, ortho: 1/sqrt(n),
+        # forward: 1.
+        n = 1
+        for a in axes:
+            n *= x.shape[a]
+        base = jnp.conj(jnp.fft.rfftn(x, axes=axes, norm="backward")
+                        if onesided else
+                        jnp.fft.fftn(x.astype(_complex_of(x.dtype)),
+                                     axes=axes, norm="backward"))
+        if norm == "backward":
+            return base / n
+        if norm == "ortho":
+            return base / jnp.sqrt(jnp.asarray(n, jnp.float32))
+        return base
+    if onesided:
+        return jnp.fft.rfftn(x, axes=axes, norm=norm)
+    return jnp.fft.fftn(x.astype(_complex_of(x.dtype)), axes=axes, norm=norm)
+
+
+@op()
+def fft_c2r(x, axes, normalization="backward", forward=False,
+            last_dim_size=0):
+    axes = tuple(axes)
+    norm = _norm(normalization)
+    s = None
+    if last_dim_size:
+        s = [x.shape[a] for a in axes]
+        s[-1] = last_dim_size
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def _complex_of(dtype):
+    return jnp.complex64 if jnp.dtype(dtype).itemsize <= 4 else jnp.complex128

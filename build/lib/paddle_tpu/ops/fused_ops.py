@@ -1,0 +1,308 @@
+"""Fused ops: attention/FFN/transformer fusions + the XPU-fused op set.
+
+Reference: paddle/phi/kernels/fusion/{gpu,cutlass,onednn,xpu},
+paddle/fluid/operators/fused/ (fused_attention_op.cu,
+fused_feedforward_op.cu, fused_multi_transformer_op.cu), flash-attn loader
+at paddle/phi/backends/dynload/flashattn.h.
+
+TPU design: "fused" is mostly a no-op concept under XLA — these compositions
+compile to fused kernels anyway; the ops exist for API/registry parity and
+to route the attention core through the Pallas flash kernel
+(ops/pallas) where it matters.  The `*_xpu` names mirror the reference's
+per-backend fused op list (paddle/phi/backends/xpu/xpu2_op_list.cc
+precedent) and map to the same compositions here.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .registry import op
+from .pallas import flash_attention as _attention_impl
+
+
+def _ln(x, scale, bias, eps):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    if scale is not None:
+        out = out * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@op()
+def flash_attn(q, k, v, fixed_seed_offset=None, attn_mask=None,
+               dropout=0.0, causal=False, return_softmax=False,
+               is_test=True, rng_name=""):
+    """FlashAttention layout parity: q/k/v [B, T, N, H] → out [B, T, N, H].
+
+    Routes to the Pallas TPU kernel when enabled (ops/pallas), XLA attention
+    otherwise.  Reference python surface:
+    python/paddle/nn/functional/flash_attention.py:125.
+    """
+    out = _attention_impl(q, k, v, attn_mask=attn_mask, is_causal=causal,
+                          dropout_p=0.0 if is_test else dropout)
+    if return_softmax:
+        return out, None
+    return out
+
+
+@op()
+def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
+                        max_seqlen_k, scale=None, dropout=0.0, causal=False,
+                        return_softmax=False, is_test=True):
+    """Varlen flash-attn: q [total_q, N, H] with cumulative seqlens.
+
+    TPU keeps static shapes: segments are re-packed to a padded batch,
+    attended with a mask, and scattered back.
+    """
+    nq = cu_seqlens_q.shape[0] - 1
+    tq, n, h = q.shape
+    mq, mk = int(max_seqlen_q), int(max_seqlen_k)
+
+    def gather_pad(x, cu, m):
+        def per(i):
+            s = cu[i]
+            ln = cu[i + 1] - s
+            idx = s + jnp.arange(m)
+            valid = jnp.arange(m) < ln
+            xi = x[jnp.clip(idx, 0, x.shape[0] - 1)]
+            return jnp.where(valid[:, None, None], xi, 0), valid
+        return jax.vmap(per)(jnp.arange(nq))
+
+    qp, qv = gather_pad(q, cu_seqlens_q, mq)
+    kp, kv = gather_pad(k, cu_seqlens_k, mk)
+    vp, _ = gather_pad(v, cu_seqlens_k, mk)
+    mask = qv[:, :, None] & kv[:, None, :]  # [B, mq, mk]
+    out = _attention_impl(qp, kp, vp, attn_mask=mask[:, None, :, :],
+                          is_causal=causal,
+                          dropout_p=0.0 if is_test else dropout,
+                          scale=scale)
+
+    def scatter_back(o, cu):
+        res = jnp.zeros((tq, n, h), o.dtype)
+
+        def body(i, res):
+            s = cu[i]
+            ln = cu[i + 1] - s
+            idx = s + jnp.arange(mq)
+            valid = jnp.arange(mq) < ln
+            upd = jnp.where(valid[:, None, None], o[i], 0)
+            return res.at[jnp.clip(idx, 0, tq - 1)].add(
+                jnp.where(valid[:, None, None], upd, 0))
+        return jax.lax.fori_loop(0, nq, body, res)
+
+    res = scatter_back(out, cu_seqlens_q)
+    if return_softmax:
+        return res, None
+    return res
+
+
+@op()
+def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
+                               cu_seqlens_k=None, causal_diagonal=None,
+                               seqlen_k=None, max_seqlen_q=None,
+                               max_seqlen_k=None, causal=False, dropout_p=0.0,
+                               scale=None, is_test=True):
+    """Reference: python/paddle/incubate/nn/memory_efficient_attention.py
+    (cutlass kernels).  On TPU this is the same flash path."""
+    return _attention_impl(query, key, value, attn_mask=bias,
+                           is_causal=causal,
+                           dropout_p=0.0 if is_test else dropout_p,
+                           scale=scale)
+
+
+@op()
+def fused_attention(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+                    ln_scale=None, ln_bias=None, ln2_scale=None,
+                    ln2_bias=None, num_heads=1, pre_layer_norm=False,
+                    epsilon=1e-5, attn_dropout_rate=0.0, dropout_rate=0.0,
+                    is_test=True, attn_mask=None, ring_id=-1):
+    """fused_attention op parity (paddle/fluid/operators/fused/
+    fused_attention_op.cu): [LN] → QKV → MHA → out-proj → residual [→ LN]."""
+    b, t, c = x.shape
+    h = c // num_heads
+    residual = x
+    inp = _ln(x, ln_scale, ln_bias, epsilon) if pre_layer_norm else x
+    # qkv_weight [3, num_heads, head_dim, C]
+    qkv = jnp.einsum("btc,khdc->btkhd",
+                     inp.astype(jnp.float32),
+                     qkv_weight.astype(jnp.float32))
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.astype(jnp.float32)[None, None]
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]  # [B,T,N,H]
+    ctx = _attention_impl(q.astype(x.dtype), k.astype(x.dtype),
+                          v.astype(x.dtype), attn_mask=attn_mask,
+                          dropout_p=0.0 if is_test else attn_dropout_rate)
+    ctx = ctx.reshape(b, t, c)
+    out = ctx.astype(jnp.float32) @ linear_weight.astype(jnp.float32)
+    if linear_bias is not None:
+        out = out + linear_bias.astype(jnp.float32)
+    out = residual.astype(jnp.float32) + out
+    if not pre_layer_norm:
+        out = _ln(out.astype(x.dtype), ln2_scale, ln2_bias, epsilon) \
+            .astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@op()
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
+                      linear2_bias, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, pre_layer_norm=False,
+                      epsilon1=1e-5, epsilon2=1e-5, act_method="gelu",
+                      dropout1_rate=0.0, dropout2_rate=0.0, is_test=True,
+                      ring_id=-1):
+    residual = x
+    inp = _ln(x, ln1_scale, ln1_bias, epsilon1) if pre_layer_norm else x
+    h = inp.astype(jnp.float32) @ linear1_weight.astype(jnp.float32)
+    if linear1_bias is not None:
+        h = h + linear1_bias.astype(jnp.float32)
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_method]
+    h = act(h)
+    out = h @ linear2_weight.astype(jnp.float32)
+    if linear2_bias is not None:
+        out = out + linear2_bias.astype(jnp.float32)
+    out = residual.astype(jnp.float32) + out
+    if not pre_layer_norm:
+        out = _ln(out.astype(x.dtype), ln2_scale, ln2_bias, epsilon2) \
+            .astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@op()
+def fused_dropout_add(x, y, p=0.5, is_test=True, mode="upscale_in_train",
+                      seed=0, fix_seed=False):
+    if is_test or p == 0.0:
+        return x + y
+    if fix_seed:
+        key = jax.random.PRNGKey(seed)
+    else:
+        from ..framework.random import get_rng_key
+        key = get_rng_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0) + y
+    return jnp.where(keep, x, 0.0) + y
+
+
+@op()
+def fused_linear_param_grad_add(x, dout, dweight=None, dbias=None,
+                                multi_precision=True, has_bias=True):
+    """Grad-accumulation fusion for linear layers (main-grad path)."""
+    acc_t = jnp.float32 if multi_precision else x.dtype
+    dw = jnp.einsum("...i,...o->io", x.astype(acc_t), dout.astype(acc_t))
+    if dweight is not None:
+        dw = dweight.astype(acc_t) + dw
+    outs = [dw]
+    if has_bias:
+        db = dout.astype(acc_t).reshape(-1, dout.shape[-1]).sum(0)
+        if dbias is not None:
+            db = dbias.astype(acc_t) + db
+        outs.append(db)
+    else:
+        outs.append(None)
+    return tuple(outs)
+
+
+# ---------------------------------------------------------- xpu-fused set
+# The reference ships backend-specific fused ops for its Kunlun backend;
+# the TPU build keeps the registry names and lowers each to the XLA
+# composition (which fuses at compile time).
+
+@op()
+def add_act_xpu(x, y, act_type="relu"):
+    acts = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "linear": lambda v: v}
+    return acts[act_type](x + y)
+
+
+@op()
+def fc_xpu(x, w, bias=None, act_type="linear"):
+    out = x.astype(jnp.float32) @ w.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    acts = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "linear": lambda v: v}
+    return acts[act_type](out).astype(x.dtype)
+
+
+@op()
+def conv2d_xpu(x, filter, bias=None, scale_max=None, out_max_in=None,
+               strides=(1, 1), paddings=(0, 0), dilations=(1, 1), groups=1,
+               act_type="linear"):
+    from .registry import raw
+    out = raw("conv2d")(x, filter, bias=None, stride=list(strides),
+                        padding=list(paddings), dilation=list(dilations),
+                        groups=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    acts = {"relu": jax.nn.relu, "gelu": jax.nn.gelu,
+            "sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "linear": lambda v: v}
+    return acts[act_type](out)
+
+
+@op()
+def embedding_with_eltwise_add_xpu(ids_list, tables_list):
+    out = None
+    for ids, table in zip(ids_list, tables_list):
+        e = table[jnp.asarray(ids, jnp.int32)]
+        out = e if out is None else out + e
+    return out
+
+
+@op()
+def multi_encoder_xpu(x, qkv_weights, qkv_biases, out_weights, out_biases,
+                      ffn1_weights, ffn1_biases, ffn2_weights, ffn2_biases,
+                      ln1_scales, ln1_biases, ln2_scales, ln2_biases,
+                      num_heads=1, attn_mask=None):
+    """Stacked transformer encoder (the reference fuses the whole stack for
+    XPU inference; here one composition, compiled once)."""
+    h = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        h = fused_attention.__wrapped__(
+            h, qkv_weights[i], qkv_biases[i], out_weights[i], out_biases[i],
+            ln_scale=ln1_scales[i], ln_bias=ln1_biases[i],
+            num_heads=num_heads, pre_layer_norm=True, attn_mask=attn_mask)
+        h = fused_feedforward.__wrapped__(
+            h, ffn1_weights[i], ffn1_biases[i], ffn2_weights[i],
+            ffn2_biases[i], ln1_scale=ln2_scales[i], ln1_bias=ln2_biases[i],
+            pre_layer_norm=True)
+    return h
+
+
+@op()
+def fused_multi_transformer_xpu(x, qkv_weights, qkv_biases, out_weights,
+                                out_biases, ffn1_weights, ffn1_biases,
+                                ffn2_weights, ffn2_biases, ln_scales,
+                                ln_biases, ffn_ln_scales, ffn_ln_biases,
+                                num_heads=1, attn_mask=None):
+    return multi_encoder_xpu.__wrapped__(
+        x, qkv_weights, qkv_biases, out_weights, out_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, ln_scales, ln_biases,
+        ffn_ln_scales, ffn_ln_biases, num_heads=num_heads,
+        attn_mask=attn_mask)
+
+
+@op()
+def generate_sequence_xpu(x, axis=-1, dtype=None):
+    n = x.shape[axis]
+    seq = jnp.arange(n, dtype=dtype or jnp.int64)
+    shape = [1] * x.ndim
+    shape[axis] = n
+    return jnp.broadcast_to(seq.reshape(shape), x.shape)
+
+
+@op()
+def yolo_box_xpu(x, img_size, anchors, class_num, conf_thresh=0.01,
+                 downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    from .vision_ops import yolo_box
+    return yolo_box.__wrapped__(x, img_size, anchors, class_num,
+                                conf_thresh=conf_thresh,
+                                downsample_ratio=downsample_ratio,
+                                clip_bbox=clip_bbox, scale_x_y=scale_x_y)

@@ -1,0 +1,150 @@
+"""Graph-NN message-passing ops (paddle.geometric backing kernels).
+
+Reference: paddle/phi/kernels/*/graph_send_recv_*, graph_send_ue_recv,
+segment_pool (paddle/phi/kernels/*/segment_pool_*), graph_reindex,
+weighted_sample_neighbors (SURVEY §2.9 `paddle.geometric`).
+
+TPU design: everything is a segment reduction (`jax.ops.segment_*`) —
+XLA lowers these to sorted scatters that vectorize well.  Sampling /
+reindex ops have inherently dynamic output shapes, so they are host ops
+(numpy) feeding the input pipeline, like the reference's CPU kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op, register_external
+
+_REDUCERS = {
+    "sum": jax.ops.segment_sum,
+    "add": jax.ops.segment_sum,
+    "mean": None,  # handled below
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment_reduce(msg, dst, num_segments, reduce_op):
+    reduce_op = reduce_op.lower()
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(msg, dst, num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones((msg.shape[0],), msg.dtype), dst,
+                                  num_segments)
+        shape = (-1,) + (1,) * (msg.ndim - 1)
+        return s / jnp.maximum(cnt.reshape(shape), 1)
+    out = _REDUCERS[reduce_op](msg, dst, num_segments)
+    if reduce_op in ("max", "min"):
+        # empty segments produce +-inf; zero them like the reference
+        out = jnp.where(jnp.isfinite(out), out, 0)
+    return out
+
+
+@op()
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    n = int(out_size) if out_size else x.shape[0]
+    msg = x[jnp.asarray(src_index, jnp.int32)]
+    return _segment_reduce(msg, jnp.asarray(dst_index, jnp.int32), n,
+                           reduce_op)
+
+
+def _combine(xe, ye, message_op):
+    message_op = message_op.lower()
+    if message_op in ("add",):
+        return xe + ye
+    if message_op in ("sub",):
+        return xe - ye
+    if message_op in ("mul",):
+        return xe * ye
+    if message_op in ("div",):
+        return xe / ye
+    raise ValueError(f"unknown message_op {message_op}")
+
+
+@op()
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """x node features, y edge features; message = x[src] (op) y."""
+    n = int(out_size) if out_size else x.shape[0]
+    xe = x[jnp.asarray(src_index, jnp.int32)]
+    msg = _combine(xe, y, message_op)
+    return _segment_reduce(msg, jnp.asarray(dst_index, jnp.int32), n,
+                           reduce_op)
+
+
+@op()
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    """Per-edge message from both endpoint features (no reduce)."""
+    xe = x[jnp.asarray(src_index, jnp.int32)]
+    ye = y[jnp.asarray(dst_index, jnp.int32)]
+    return _combine(xe, ye, message_op)
+
+
+@op()
+def segment_pool(x, segment_ids, pooltype="SUM"):
+    seg = jnp.asarray(segment_ids, jnp.int32)
+    num = int(jnp.max(seg)) + 1 if not isinstance(seg, jax.core.Tracer) \
+        else x.shape[0]
+    return _segment_reduce(x, seg, num, pooltype.lower())
+
+
+# ---- host-side (dynamic-output) graph sampling ops ----
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    """Compact node ids: x = center nodes, neighbors = flat neighbor list.
+
+    Returns (reindexed_src, reindexed_dst, out_nodes); host op.
+    """
+    x_np = np.asarray(x).reshape(-1)
+    nbr = np.asarray(neighbors).reshape(-1)
+    cnt = np.asarray(count).reshape(-1)
+    out_nodes = list(x_np)
+    mapping = {int(v): i for i, v in enumerate(x_np)}
+    for v in nbr:
+        vi = int(v)
+        if vi not in mapping:
+            mapping[vi] = len(out_nodes)
+            out_nodes.append(vi)
+    reindex_src = np.asarray([mapping[int(v)] for v in nbr], np.int64)
+    dst = np.repeat(np.arange(len(cnt), dtype=np.int64), cnt)
+    from ..core.tensor import Tensor
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int64))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, return_eids=False):
+    """Weighted neighbor sampling on a CSC graph; host op (dynamic shape)."""
+    row_np = np.asarray(row).reshape(-1)
+    colptr_np = np.asarray(colptr).reshape(-1)
+    w_np = np.asarray(edge_weight).reshape(-1)
+    nodes = np.asarray(input_nodes).reshape(-1)
+    # seed from the paddle.seed-controlled global RNG so sampling varies
+    # per call but stays reproducible
+    from ..framework.random import get_rng_key
+    seed = int(np.asarray(
+        jax.random.randint(get_rng_key(), (), 0, np.iinfo(np.int32).max)))
+    rng = np.random.RandomState(seed)
+    out_nbr, out_cnt, out_eid = [], [], []
+    for v in nodes:
+        s, e = int(colptr_np[v]), int(colptr_np[v + 1])
+        deg = e - s
+        if sample_size < 0 or deg <= sample_size:
+            sel = np.arange(s, e)
+        else:
+            p = w_np[s:e].astype(np.float64)
+            p = p / p.sum() if p.sum() > 0 else None
+            sel = s + rng.choice(deg, size=sample_size, replace=False, p=p)
+        out_nbr.extend(row_np[sel])
+        out_eid.extend(sel)
+        out_cnt.append(len(sel))
+    from ..core.tensor import Tensor
+    outs = (Tensor(jnp.asarray(np.asarray(out_nbr, np.int64))),
+            Tensor(jnp.asarray(np.asarray(out_cnt, np.int64))))
+    if return_eids:
+        return outs + (Tensor(jnp.asarray(np.asarray(out_eid, np.int64))),)
+    return outs
+
+
+register_external("reindex_graph", reindex_graph)
+register_external("weighted_sample_neighbors", weighted_sample_neighbors)

@@ -1,0 +1,269 @@
+"""Linear algebra ops (paddle.tensor.linalg parity).
+
+matmul is the MXU hot path: shapes stay static, bf16 inputs hit the systolic
+array directly (reference counterpart: phi::MatmulKernel at
+paddle/phi/kernels/gpu/matmul_kernel.cu:22 → cuBLAS; here → XLA dot_general).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op()
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@op()
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+@op()
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+@op()
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+@op()
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+@op()
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+@op()
+def multi_dot(x):
+    return jnp.linalg.multi_dot(x)
+
+
+@op()
+def norm(x, p="fro", axis=None, keepdim=False):
+    if axis is None:
+        flat = x.reshape(-1)
+        if p in ("fro", 2):
+            return jnp.linalg.norm(flat, ord=2, keepdims=False)
+        if p == jnp.inf or p == float("inf"):
+            return jnp.max(jnp.abs(flat))
+        if p == -jnp.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(flat))
+        if p == 0:
+            return jnp.sum(flat != 0).astype(x.dtype)
+        return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+    if isinstance(axis, (list, tuple)) and len(axis) == 2:
+        ord_ = "fro" if p == "fro" else p
+        return jnp.linalg.norm(x, ord=ord_, axis=tuple(axis), keepdims=keepdim)
+    if p == "fro":
+        p = 2
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@op()
+def dist(x, y, p=2.0):
+    d = (x - y).reshape(-1)
+    if p == 0:
+        return jnp.sum(d != 0).astype(d.dtype)
+    if p == float("inf"):
+        return jnp.max(jnp.abs(d))
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(d))
+    return jnp.sum(jnp.abs(d) ** p) ** (1.0 / p)
+
+
+@op()
+def cross(x, y, axis=9):
+    axis = -1 if axis == 9 else axis
+    return jnp.cross(x, y, axis=axis)
+
+
+@op()
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(L, -1, -2).conj() if upper else L
+
+
+@op()
+def cholesky_solve(x, y, upper=False):
+    # solve A z = x given cholesky factor y of A
+    fac = y if not upper else jnp.swapaxes(y, -1, -2).conj()
+    z = jax.scipy.linalg.solve_triangular(fac, x, lower=True)
+    return jax.scipy.linalg.solve_triangular(
+        jnp.swapaxes(fac, -1, -2).conj(), z, lower=False)
+
+
+@op()
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+@op()
+def det(x):
+    return jnp.linalg.det(x)
+
+
+@op()
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@op()
+def svd(x, full_matrices=False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+@op()
+def svdvals(x):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@op()
+def qr(x, mode="reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+@op()
+def eig(x):
+    # XLA supports eig on CPU only; same restriction as reference GPU fallback
+    return jnp.linalg.eig(x)
+
+
+@op()
+def eigh(x, UPLO="L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+@op()
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+@op()
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+@op()
+def pinv(x, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@op()
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+@op()
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@op()
+def lu(x, pivot=True):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+
+
+@op()
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@op()
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@op()
+def matrix_rank(x, tol=None, hermitian=False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@op()
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+@op()
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return jnp.einsum(equation, *operands)
+
+
+@op()
+def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
+    if min == 0 and max == 0:
+        range_ = None
+    else:
+        range_ = (min, max)
+    hist, _ = jnp.histogram(x.reshape(-1), bins=bins, range=range_,
+                            weights=None if weight is None else weight.reshape(-1),
+                            density=density)
+    return hist if density or weight is not None else hist.astype(jnp.int64)
+
+
+@op()
+def bincount(x, weights=None, minlength=0):
+    length = int(max(minlength, int(jnp.max(x)) + 1 if x.size else minlength))
+    return jnp.bincount(x, weights=weights, length=max(length, 1))
+
+
+@op()
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@op()
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@op()
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@op()
+def matrix_transpose(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@op()
+def householder_product(x, tau):
+    *batch, m, n = x.shape
+
+    def single(a, t):
+        q = jnp.eye(m, dtype=x.dtype)
+        def body(i, q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[:, i])
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=x.dtype) - t[i] * jnp.outer(v, v)
+            return q @ h
+        q = lax.fori_loop(0, n, body, q)
+        return q[:, :n]
+
+    if batch:
+        flat_x = x.reshape((-1, m, n))
+        flat_t = tau.reshape((-1, tau.shape[-1]))
+        out = jax.vmap(single)(flat_x, flat_t)
+        return out.reshape(tuple(batch) + (m, n))
+    return single(x, tau)

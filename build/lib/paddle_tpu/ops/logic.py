@@ -1,0 +1,74 @@
+"""Comparison / logical / bitwise ops (paddle.tensor.logic parity)."""
+
+import jax.numpy as jnp
+
+from .registry import op
+
+
+@op()
+def equal(x, y):
+    return jnp.equal(x, y)
+
+@op()
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+@op()
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+@op()
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+@op()
+def less_than(x, y):
+    return jnp.less(x, y)
+
+@op()
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+@op()
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+@op()
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+@op()
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+@op()
+def logical_not(x):
+    return jnp.logical_not(x)
+
+@op()
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+@op()
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+@op()
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+@op()
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+@op()
+def bitwise_left_shift(x, y):
+    return jnp.left_shift(x, y)
+
+@op()
+def bitwise_right_shift(x, y):
+    return jnp.right_shift(x, y)
+
+@op()
+def is_empty(x):
+    return jnp.asarray(x.size == 0)

@@ -1,0 +1,429 @@
+"""Shape/layout/indexing manipulation ops (paddle.tensor.manipulation parity).
+
+Ops with data-dependent output shapes (unique, nonzero, masked_select) work in
+eager mode but cannot be traced under jit — same restriction as jax; the
+reference runs them host-side too.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+@op()
+def reshape(x, shape):
+    return jnp.reshape(x, tuple(int(s) for s in shape))
+
+@op()
+def transpose(x, perm):
+    return jnp.transpose(x, axes=perm)
+
+@op()
+def t(x):
+    return x.T
+
+@op()
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+@op()
+def concat(x, axis=0):
+    return jnp.concatenate(x, axis=int(axis))
+
+@op()
+def stack(x, axis=0):
+    return jnp.stack(x, axis=axis)
+
+@op()
+def unstack(x, axis=0, num=None):
+    n = num if num is not None else x.shape[axis]
+    return tuple(jnp.squeeze(s, axis=axis)
+                 for s in jnp.split(x, n, axis=axis))
+
+unbind = unstack
+
+@op()
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    # paddle allows one -1 section meaning "the rest"
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = x.shape[axis] - known
+    offsets = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        offsets.append(acc)
+    return tuple(jnp.split(x, offsets, axis=axis))
+
+@op()
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+@op()
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+@op()
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        return jnp.expand_dims(x, axis=tuple(axis))
+    return jnp.expand_dims(x, axis=axis)
+
+@op()
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+    return x.reshape(shape)
+
+@op()
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+@op()
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+@op()
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+@op()
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+@op()
+def expand(x, shape):
+    shape = list(shape)
+    # paddle: -1 keeps the original dim
+    offset = len(shape) - x.ndim
+    for i in range(len(shape)):
+        if shape[i] == -1:
+            shape[i] = x.shape[i - offset]
+    return jnp.broadcast_to(x, tuple(shape))
+
+@op()
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+@op()
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+@op()
+def broadcast_tensors(inputs):
+    return tuple(jnp.broadcast_arrays(*inputs))
+
+@op()
+def gather(x, index, axis=0):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+@op()
+def gather_nd(x, index):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+@op()
+def scatter(x, index, updates, overwrite=True):
+    """Row scatter (paddle.scatter: index over dim 0)."""
+    index = index.reshape(-1)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle accumulate mode: zero out target rows then add
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+@op()
+def scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+@op()
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(tuple(shape), dtype=updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+@op()
+def index_select(x, index, axis=0):
+    return jnp.take(x, index.reshape(-1), axis=axis)
+
+@op()
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index.reshape(-1)].add(jnp.moveaxis(value, axis, 0))
+    return jnp.moveaxis(out, 0, axis)
+
+@op()
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(i for i in indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+@op()
+def index_sample(x, index):
+    rows = jnp.arange(x.shape[0])[:, None]
+    return x[rows, index]
+
+@op()
+def take_along_axis(arr, indices, axis, broadcast=True):
+    if broadcast:
+        shape = list(arr.shape)
+        shape[axis] = indices.shape[axis]
+        indices = jnp.broadcast_to(indices, tuple(shape))
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+@op()
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    values = jnp.broadcast_to(values, indices.shape) if jnp.ndim(values) else \
+        jnp.full(indices.shape, values, dtype=arr.dtype)
+    if reduce == "assign":
+        return jnp.put_along_axis(arr, indices, values, axis=axis, inplace=False)
+    moved_i = jnp.moveaxis(indices, axis, 0)
+    moved_a = jnp.moveaxis(arr, axis, 0)
+    moved_v = jnp.moveaxis(values, axis, 0)
+    grid = jnp.indices(moved_i.shape)
+    idx = (moved_i,) + tuple(grid[1:])
+    if reduce == "add":
+        out = moved_a.at[idx].add(moved_v)
+    elif reduce == "multiply" or reduce == "mul":
+        out = moved_a.at[idx].multiply(moved_v)
+    else:
+        raise ValueError(f"unsupported reduce {reduce!r}")
+    return jnp.moveaxis(out, 0, axis)
+
+@op()
+def masked_select(x, mask):
+    return x[mask]
+
+@op()
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+@op()
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+@op()
+def select_scatter(x, values, axis, index):
+    import builtins
+    # builtins.slice: the module-global ``slice`` is the op wrapper below
+    ax = axis % x.ndim  # negative axis must index from the back, not axis 0
+    return x.at[(builtins.slice(None),) * ax + (index,)].set(values)
+
+@op()
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    res = jnp.unique(x, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return res
+
+@op()
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    import numpy as np
+    xs = np.asarray(x)
+    if axis is None:
+        xs = xs.reshape(-1)
+        keep = np.concatenate([[True], xs[1:] != xs[:-1]])
+    else:
+        moved = np.moveaxis(xs, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate([[True], np.any(flat[1:] != flat[:-1], axis=1)])
+        out = np.moveaxis(np.moveaxis(xs, axis, 0)[keep], 0, axis)
+        return jnp.asarray(out)
+    out = [jnp.asarray(xs[keep])]
+    if return_inverse:
+        out.append(jnp.asarray(np.cumsum(keep) - 1))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        out.append(jnp.asarray(np.diff(np.append(idx, xs.size))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+@op()
+def sort(x, axis=-1, descending=False, stable=False):
+    out = jnp.sort(x, axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+@op()
+def argsort(x, axis=-1, descending=False, stable=False):
+    out = jnp.argsort(x, axis=axis, stable=stable)
+    return jnp.flip(out, axis=axis) if descending else out
+
+@op()
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = lax.top_k(moved, k)
+    else:
+        vals, idx = lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx, -1, axis)
+
+@op()
+def kthvalue(x, k, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i
+
+@op("mode")
+def mode_(x, axis=-1, keepdim=False):
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    srt = jnp.sort(moved, axis=-1)
+    n = srt.shape[-1]
+    runs = jnp.concatenate(
+        [jnp.ones(srt.shape[:-1] + (1,), bool), srt[..., 1:] != srt[..., :-1]], -1)
+    run_id = jnp.cumsum(runs, axis=-1)
+    counts = jax.vmap(lambda rid: jnp.bincount(rid, length=n + 1))(
+        run_id.reshape(-1, n)).reshape(run_id.shape[:-1] + (n + 1,))
+    cnt_per_elem = jnp.take_along_axis(counts, run_id, axis=-1)
+    best = jnp.argmax(cnt_per_elem, axis=-1)
+    mode_vals = jnp.take_along_axis(srt, best[..., None], axis=-1)[..., 0]
+    eq = moved == mode_vals[..., None]
+    first_idx = jnp.argmax(eq, axis=-1)
+    if keepdim:
+        mode_vals = jnp.expand_dims(mode_vals, axis)
+        first_idx = jnp.expand_dims(first_idx, axis)
+    return mode_vals, first_idx
+
+@op()
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out
+
+@op()
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+
+@op()
+def nonzero(x, as_tuple=False):
+    res = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(r[:, None] for r in res)
+    return jnp.stack(res, axis=1)
+
+@op()
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32) if out_int32 else out
+
+@op()
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, x, side=side)
+    return out.astype(jnp.int32) if out_int32 else out
+
+@op()
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+@op()
+def slice(x, axes, starts, ends):
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(int(st), int(en))
+    return x[tuple(idx)]
+
+@op()
+def strided_slice(x, axes, starts, ends, strides):
+    import builtins
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(st), int(en), int(sd))
+    return x[tuple(idx)]
+
+@op()
+def crop(x, shape, offsets=None):
+    if offsets is None:
+        offsets = [0] * x.ndim
+    import builtins
+    idx = tuple(builtins.slice(int(o), int(o) + int(s)) for o, s in zip(offsets, shape))
+    return x[idx]
+
+@op()
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+@op()
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+@op()
+def view_as(x, other):
+    return x.reshape(other.shape)
+
+@op()
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+@op()
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    d = jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+    nd = x.ndim
+    ax1, ax2 = axis1 % nd, axis2 % nd
+    perm = [a for a in range(nd) if a not in (ax1, ax2)] + [ax1, ax2]
+    xt = jnp.transpose(x, perm)
+    r = jnp.arange(d.shape[-1])
+    rows = r - offset if offset < 0 else r
+    cols = r + offset if offset > 0 else r
+    xt = xt.at[..., rows, cols].set(jnp.asarray(y))
+    inv = [0] * nd
+    for i2, p in enumerate(perm):
+        inv[p] = i2
+    return jnp.transpose(xt, inv)
+
+@op()
+def fill_diagonal(x, value, offset=0, wrap=False):
+    n = min(x.shape[-2], x.shape[-1])
+    r = jnp.arange(n - abs(offset) if offset else n)
+    rows = r - offset if offset < 0 else r
+    cols = r + offset if offset > 0 else r
+    return x.at[..., rows, cols].set(value)
+
+@op()
+def tensordot(x, y, axes=2):
+    return jnp.tensordot(x, y, axes=axes)
+
+@op()
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+@op()
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+@op()
+def atleast_3d(x):
+    return jnp.atleast_3d(x)

@@ -1,0 +1,469 @@
+"""Elementwise & reduction math ops (paddle.tensor.math parity).
+
+Reference surface: python/paddle/tensor/math.py (reference) dispatching to PHI
+kernels; here each op is its jnp/lax composition — XLA fuses elementwise chains
+into single kernels on TPU, so there is no hand-fused variant zoo.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+# ---- binary elementwise ----
+
+@op()
+def add(x, y):
+    return jnp.add(x, y)
+
+@op()
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+@op()
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+@op()
+def divide(x, y):
+    return jnp.divide(x, y)
+
+@op()
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+@op()
+def mod(x, y):
+    return jnp.mod(x, y)
+
+remainder = mod
+
+@op()
+def pow(x, y):
+    return jnp.power(x, y)
+
+@op()
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+@op()
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+@op()
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+@op()
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+@op()
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+@op()
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+@op()
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+@op()
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+@op()
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+@op()
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+@op()
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+@op()
+def inner(x, y):
+    return jnp.inner(x, y)
+
+@op()
+def outer(x, y):
+    return jnp.outer(x, y)
+
+@op()
+def kron(x, y):
+    return jnp.kron(x, y)
+
+# ---- unary elementwise ----
+
+@op()
+def sqrt(x):
+    return jnp.sqrt(x)
+
+@op()
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+@op()
+def exp(x):
+    return jnp.exp(x)
+
+@op()
+def expm1(x):
+    return jnp.expm1(x)
+
+@op()
+def log(x):
+    return jnp.log(x)
+
+@op()
+def log2(x):
+    return jnp.log2(x)
+
+@op()
+def log10(x):
+    return jnp.log10(x)
+
+@op()
+def log1p(x):
+    return jnp.log1p(x)
+
+@op("abs")
+def abs_(x):
+    return jnp.abs(x)
+
+@op()
+def neg(x):
+    return jnp.negative(x)
+
+@op()
+def sign(x):
+    return jnp.sign(x)
+
+@op()
+def floor(x):
+    return jnp.floor(x)
+
+@op()
+def ceil(x):
+    return jnp.ceil(x)
+
+@op("round")
+def round_(x):
+    return jnp.round(x)
+
+@op()
+def trunc(x):
+    return jnp.trunc(x)
+
+@op()
+def frac(x):
+    return x - jnp.trunc(x)
+
+@op()
+def sin(x):
+    return jnp.sin(x)
+
+@op()
+def cos(x):
+    return jnp.cos(x)
+
+@op()
+def tan(x):
+    return jnp.tan(x)
+
+@op()
+def asin(x):
+    return jnp.arcsin(x)
+
+@op()
+def acos(x):
+    return jnp.arccos(x)
+
+@op()
+def atan(x):
+    return jnp.arctan(x)
+
+@op()
+def sinh(x):
+    return jnp.sinh(x)
+
+@op()
+def cosh(x):
+    return jnp.cosh(x)
+
+@op()
+def tanh(x):
+    return jnp.tanh(x)
+
+@op()
+def asinh(x):
+    return jnp.arcsinh(x)
+
+@op()
+def acosh(x):
+    return jnp.arccosh(x)
+
+@op()
+def atanh(x):
+    return jnp.arctanh(x)
+
+@op()
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+@op()
+def square(x):
+    return jnp.square(x)
+
+@op()
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+@op()
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+@op()
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+@op()
+def lgamma(x):
+    return jax.scipy.special.gammaln(x)
+
+@op()
+def polygamma(x, n):
+    return jax.scipy.special.polygamma(n, x)
+
+@op()
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+@op()
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+@op()
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+@op()
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+@op()
+def angle(x):
+    return jnp.angle(x)
+
+@op()
+def conj(x):
+    return jnp.conj(x)
+
+@op()
+def real(x):
+    return jnp.real(x)
+
+@op()
+def imag(x):
+    return jnp.imag(x)
+
+@op()
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+@op()
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+@op()
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+@op()
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+@op()
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act == "relu":
+        out = jax.nn.relu(out)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return out
+
+@op()
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+@op()
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+@op()
+def increment(x, value=1.0):
+    return x + value
+
+# ---- reductions ----
+
+@op("sum")
+def sum_(x, axis=None, dtype=None, keepdim=False):
+    if dtype is None and jnp.issubdtype(x.dtype, jnp.bool_):
+        dtype = jnp.int64
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+@op()
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+@op()
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+@op()
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+@op()
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+@op("max")
+def max_(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+@op("min")
+def min_(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+@op()
+def amax(x, axis=None, keepdim=False):
+    return jnp.amax(x, axis=axis, keepdims=keepdim)
+
+@op()
+def amin(x, axis=None, keepdim=False):
+    return jnp.amin(x, axis=axis, keepdims=keepdim)
+
+@op()
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+@op()
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+@op()
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+@op()
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+@op()
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+@op()
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+@op()
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+@op()
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+@op()
+def cummax(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+@op()
+def cummin(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.associative_scan(jnp.minimum, x, axis=axis)
+
+@op()
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return lax.cumlogsumexp(x, axis=axis)
+
+@op()
+def diff(x, n=1, axis=-1):
+    return jnp.diff(x, n=n, axis=axis)
+
+@op()
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if dx is None and x is None:
+        dx = 1.0
+    return jnp.trapezoid(y, x=x, dx=dx if dx is not None else 1.0, axis=axis)
+
+# ---- comparison-reductions / checks ----
+
+@op("all")
+def all_(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+@op("any")
+def any_(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+@op()
+def isnan(x):
+    return jnp.isnan(x)
+
+@op()
+def isinf(x):
+    return jnp.isinf(x)
+
+@op()
+def isfinite(x):
+    return jnp.isfinite(x)
+
+@op()
+def isneginf(x):
+    return jnp.isneginf(x)
+
+@op()
+def isposinf(x):
+    return jnp.isposinf(x)
+
+@op()
+def isreal(x):
+    return jnp.isreal(x)
+
+@op()
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+@op()
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+@op()
+def equal_all(x, y):
+    return jnp.array_equal(x, y)

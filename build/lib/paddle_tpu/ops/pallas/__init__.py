@@ -1,0 +1,81 @@
+"""Pallas TPU kernels for the hot ops.
+
+Analog of the reference's hand-fused CUDA kernels
+(paddle/phi/kernels/fusion/, flash_attn at
+paddle/phi/kernels/gpu/flash_attn_kernel.cu).  Selection order:
+Pallas kernel (TPU, flag-gated) → XLA composition fallback (works everywhere,
+still fuses well).  ``FLAGS_use_pallas_kernels`` toggles.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.flags import get_flags
+
+
+def _use_pallas():
+    return (jax.default_backend() == "tpu"
+            and get_flags("FLAGS_use_pallas_kernels")["FLAGS_use_pallas_kernels"])
+
+
+def _xla_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
+                   dropout_key=None, scale=None):
+    """Reference XLA attention on [B, T, N, H] (paddle flash-attn layout).
+
+    Matmuls stay in the input dtype (bf16 on the MXU) with f32 accumulation
+    via ``preferred_element_type``; only the softmax runs in f32.  Upcasting
+    the operands themselves would push the score/context matmuls onto the
+    4x-slower f32 MXU path — measured as the dominant per-step cost on v5e.
+    """
+    if scale is None:
+        scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], dtype=jnp.float32))
+    logits = jnp.einsum("btnh,bsnh->bnts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        t, s = logits.shape[-2], logits.shape[-1]
+        causal = jnp.tril(jnp.ones((t, s), dtype=bool), k=s - t)
+        logits = jnp.where(causal, logits, jnp.finfo(jnp.float32).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.finfo(jnp.float32).min)
+        else:
+            logits = logits + attn_mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0)
+    out = jnp.einsum("bnts,bsnh->btnh", probs.astype(q.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def flash_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
+                    dropout_key=None, scale=None):
+    """Flash attention on [batch, seq, num_heads, head_dim].
+
+    When ``dropout_p > 0`` and no explicit key is given, a key is drawn from
+    the global RNG (paddle.seed-controlled) — attention dropout must not be
+    silently dropped.  Attention dropout forces the XLA path (the Pallas
+    kernel is dropout-free, like most production flash kernels at
+    inference/bf16 pretrain settings)."""
+    if dropout_p > 0.0 and dropout_key is None:
+        from ...framework.random import get_rng_key
+        dropout_key = get_rng_key()
+    if (_use_pallas() and attn_mask is None and dropout_p == 0.0
+            and scale is None):
+        from .attention_kernel import flash_attention_pallas, supports
+        # causal masking in the kernel is top-left aligned; for seq_q !=
+        # seq_k the paddle/XLA semantics are bottom-right aligned, so only
+        # self-attention-shaped causal inputs take the kernel path
+        causal_ok = (not is_causal) or q.shape[1] == k.shape[1]
+        # Below this sequence length the fused XLA attention is faster on
+        # TPU (profiled on v5e: the kernel's small per-program blocks and
+        # lane-padded head_dim lose to the MXU-saturating einsum); flash
+        # pays off once the [T, S] score matrix dominates HBM.
+        min_seq = get_flags("FLAGS_flash_min_seqlen")["FLAGS_flash_min_seqlen"]
+        if (causal_ok and q.shape[1] >= int(min_seq)
+                and supports(q.shape[1], k.shape[1], q.shape[3])):
+            return flash_attention_pallas(q, k, v, is_causal)
+    return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
+                          dropout_p=dropout_p, dropout_key=dropout_key,
+                          scale=scale)

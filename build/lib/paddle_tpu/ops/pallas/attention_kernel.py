@@ -1,0 +1,297 @@
+"""Flash attention as a Pallas TPU kernel (forward + backward).
+
+The reference ships FlashAttention as a dyn-loaded CUDA library
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu, loader
+paddle/phi/backends/dynload/flashattn.h).  Here the kernel is written
+TPU-native in Pallas: online-softmax over key blocks (never materializes the
+[T, T] score matrix), fp32 accumulation feeding the MXU, and a
+recompute-based backward (dq and dk/dv as separate kernels), wired up as a
+jax.custom_vjp.
+
+Layouts: paddle's flash-attn API is [batch, seq, num_heads, head_dim]
+(python/paddle/nn/functional/flash_attention.py:125); kernels run on
+[batch*heads, seq, head_dim].
+
+Constraints (else the caller falls back to the XLA composition): seq divisible
+by the block size, head_dim <= 128.  Attention dropout and additive masks use
+the fallback path.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _pick_block(seq, preferred):
+    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
+        if b <= preferred and seq % b == 0:
+            return b
+    return None
+
+
+def supports(seq_q, seq_k, head_dim):
+    return (head_dim <= 128
+            and _pick_block(seq_q, DEFAULT_BLOCK_Q) is not None
+            and _pick_block(seq_k, DEFAULT_BLOCK_K) is not None)
+
+
+# ---------------------------------------------------------------- forward --
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_k, causal,
+                scale):
+    """One (batch*head, q-block) program: online softmax over key blocks."""
+    q = q_ref[0].astype(jnp.float32) * scale          # [Bq, H]
+    block_q = q.shape[0]
+    seq_k = k_ref.shape[1]
+    num_kb = seq_k // block_k
+    qi = pl.program_id(1)
+
+    def body(j, carry):
+        o_acc, m, l = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)  # [Bq,Bk]
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        o_new = o_acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return o_new, m_new, l_new
+
+    o0 = jnp.zeros((block_q, q.shape[1]), jnp.float32)
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    if causal:
+        # key blocks beyond this q block's diagonal are fully masked
+        upper = (qi + 1) * block_q
+        num_active = (upper + block_k - 1) // block_k
+        o_acc, m, l = jax.lax.fori_loop(0, num_active, body, (o0, m0, l0))
+    else:
+        o_acc, m, l = jax.lax.fori_loop(0, num_kb, body, (o0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (o_acc / l).astype(o_ref.dtype)
+    # lse is [bn, seq, 1]: a (1, block_q, 1) block per program satisfies the
+    # Mosaic tile constraint (trailing dim equals the full array dim).
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    bn, seq_q, head = q.shape
+    seq_k = k.shape[1]
+    grid = (bn, seq_q // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, head), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, head), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, head), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, head), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bn, seq_q, head), q.dtype),
+            jax.ShapeDtypeStruct((bn, seq_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# --------------------------------------------------------------- backward --
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, block_k, causal, scale):
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    block_q = q.shape[0]
+    seq_k = k_ref.shape[1]
+    qi = pl.program_id(1)
+    lse = lse_ref[0]                                           # [Bq, 1]
+    delta = delta_ref[0]
+
+    def body(j, dq_acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)                                   # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        num_active = ((qi + 1) * block_q + block_k - 1) // block_k
+    else:
+        num_active = seq_k // block_k
+    dq = jax.lax.fori_loop(0, num_active, body,
+                           jnp.zeros_like(q, dtype=jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, block_q, causal, scale):
+    k = k_ref[0].astype(jnp.float32)                           # [Bk, H]
+    v = v_ref[0].astype(jnp.float32)
+    block_k = k.shape[0]
+    seq_q = q_ref.shape[1]
+    ki = pl.program_id(1)
+    num_qb = seq_q // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_new = dv_acc + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_new = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    zeros = jnp.zeros_like(k, dtype=jnp.float32)
+    if causal:
+        # q blocks before this k block's diagonal contribute nothing
+        start = (ki * block_k) // block_q
+        dk, dv = jax.lax.fori_loop(start, num_qb, body, (zeros, zeros))
+    else:
+        dk, dv = jax.lax.fori_loop(0, num_qb, body, (zeros, zeros))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
+               interpret):
+    bn, seq_q, head = q.shape
+    seq_k = k.shape[1]
+    # delta = rowsum(dO * O) — cheap elementwise, leave to XLA fusion
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, block_k=block_k, causal=causal,
+                          scale=scale),
+        grid=(bn, seq_q // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, head), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_k, head), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_k, head), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, head), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, head), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, block_q=block_q, causal=causal,
+                          scale=scale),
+        grid=(bn, seq_k // block_k),
+        in_specs=[
+            pl.BlockSpec((1, seq_q, head), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, head), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, seq_q, head), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, seq_q, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, head), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, head), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------- public API --
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_attention_bnsh(q, k, v, causal, scale, interpret):
+    out, _ = _fwd_rule(q, k, v, causal, scale, interpret)
+    return out
+
+
+def _fwd_rule(q, k, v, causal, scale, interpret):
+    block_q = _pick_block(q.shape[1], DEFAULT_BLOCK_Q)
+    block_k = _pick_block(k.shape[1], DEFAULT_BLOCK_K)
+    out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(causal, scale, interpret, res, do):
+    q, k, v, out, lse = res
+    block_q = _pick_block(q.shape[1], DEFAULT_BLOCK_Q)
+    block_k = _pick_block(k.shape[1], DEFAULT_BLOCK_K)
+    return _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
+                      interpret)
+
+
+_flash_attention_bnsh.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention_pallas(q, k, v, is_causal=False, scale=None,
+                           interpret=False):
+    """q, k, v: [batch, seq, num_heads, head_dim] (paddle flash-attn layout).
+
+    Returns [batch, seq, num_heads, head_dim]; differentiable.
+    """
+    b, sq, n, h = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (h ** 0.5)
+    qt = q.transpose(0, 2, 1, 3).reshape(b * n, sq, h)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * n, sk, h)
+    out = _flash_attention_bnsh(qt, kt, vt, bool(is_causal), float(scale),
+                                interpret)
+    return out.reshape(b, n, sq, h).transpose(0, 2, 1, 3)

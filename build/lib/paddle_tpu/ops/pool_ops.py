@@ -1,0 +1,258 @@
+"""Generic pooling kernels (phi `pool2d`/`pool3d`/`max_pool*_with_index`/
+`unpool`).
+
+Reference: paddle/phi/kernels/funcs/pooling.* + pool kernels.  Built on
+``lax.reduce_window`` which XLA maps directly to the TPU vector unit; the
+with-index variants reduce over (value, linear-index) pairs so the argmax
+comes out of one fused reduce_window.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+
+def _tup(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+def _window_dims(ksize, strides, paddings, nd, channel_last):
+    if channel_last:
+        return ((1,) + ksize + (1,), (1,) + strides + (1,),
+                ((0, 0),) + paddings + ((0, 0),))
+    return ((1, 1) + ksize, (1, 1) + strides, ((0, 0), (0, 0)) + paddings)
+
+
+def _pool_nd(x, ksize, strides, paddings, pooling_type, exclusive,
+             adaptive, ceil_mode, data_format, nd):
+    channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+    spatial = (list(range(1, nd + 1)) if channel_last
+               else list(range(2, nd + 2)))
+    if adaptive:
+        # adaptive: output size = ksize; use mean/max over computed bins
+        out_sizes = _tup(ksize, nd)
+        out = x
+        for ax, osz in zip(spatial, out_sizes):
+            isz = out.shape[ax]
+            starts = (jnp.arange(osz) * isz) // osz
+            ends = ((jnp.arange(osz) + 1) * isz + osz - 1) // osz
+            segs = []
+            for i in range(osz):
+                s, e = int(starts[i]), int(ends[i])
+                sl = [slice(None)] * out.ndim
+                sl[ax] = slice(s, max(e, s + 1))
+                seg = out[tuple(sl)]
+                red = (jnp.max if pooling_type == "max" else jnp.mean)
+                segs.append(red(seg, axis=ax, keepdims=True))
+            out = jnp.concatenate(segs, axis=ax)
+        return out
+    ksize = _tup(ksize, nd)
+    strides = _tup(strides, nd)
+    pads = _tup(paddings, nd)
+    pads = tuple((p, p) if isinstance(p, int) else tuple(p) for p in pads)
+    if ceil_mode:
+        new_pads = []
+        for i, ax in enumerate(spatial):
+            isz = x.shape[ax]
+            p_lo, p_hi = pads[i]
+            span = isz + p_lo + p_hi - ksize[i]
+            extra = (-span) % strides[i] if span % strides[i] else 0
+            new_pads.append((p_lo, p_hi + extra))
+        pads = tuple(new_pads)
+    wdims, wstrides, wpads = _window_dims(ksize, strides, pads, nd,
+                                          channel_last)
+    if pooling_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, jnp.asarray(init, x.dtype), lax.max,
+                                 wdims, wstrides, wpads)
+    xs = lax.reduce_window(x.astype(jnp.float32), 0.0, lax.add, wdims,
+                           wstrides, wpads)
+    if exclusive:
+        ones = jnp.ones_like(x, jnp.float32)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, wdims, wstrides, wpads)
+        return (xs / jnp.maximum(cnt, 1.0)).astype(x.dtype)
+    denom = 1.0
+    for k in ksize:
+        denom *= k
+    return (xs / denom).astype(x.dtype)
+
+
+@op()
+def pool2d(x, kernel_size, strides=1, paddings=0, ceil_mode=False,
+           exclusive=True, data_format="NCHW", pooling_type="max",
+           global_pooling=False, adaptive=False, padding_algorithm="EXPLICIT"):
+    if global_pooling:
+        spatial = (1, 2) if data_format == "NHWC" else (2, 3)
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return red(x, axis=spatial, keepdims=True)
+    return _pool_nd(x, kernel_size, strides, paddings, pooling_type,
+                    exclusive, adaptive, ceil_mode, data_format, 2)
+
+
+@op()
+def pool3d(x, kernel_size, strides=1, paddings=0, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", pooling_type="max",
+           global_pooling=False, adaptive=False, padding_algorithm="EXPLICIT"):
+    if global_pooling:
+        spatial = (1, 2, 3) if data_format == "NDHWC" else (2, 3, 4)
+        red = jnp.max if pooling_type == "max" else jnp.mean
+        return red(x, axis=spatial, keepdims=True)
+    return _pool_nd(x, kernel_size, strides, paddings, pooling_type,
+                    exclusive, adaptive, ceil_mode, data_format, 3)
+
+
+def _max_pool_with_index(x, ksize, strides, paddings, nd, adaptive):
+    """Reduce over (value, flat-spatial-index) pairs in one reduce_window."""
+    spatial_shape = x.shape[2:]
+    flat = 1
+    for s in spatial_shape:
+        flat *= s
+    idx = jnp.arange(flat).reshape(spatial_shape)
+    idx = jnp.broadcast_to(idx, x.shape)
+    if adaptive:
+        return _adaptive_max_with_index(x, _tup(ksize, nd), nd)
+    ksize = _tup(ksize, nd)
+    strides = _tup(strides, nd)
+    pads = _tup(paddings, nd)
+    pads = tuple((p, p) if isinstance(p, int) else tuple(p) for p in pads)
+    wdims, wstrides, wpads = _window_dims(ksize, strides, pads, nd, False)
+
+    def reducer(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return (jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai))
+
+    init = (jnp.asarray(-jnp.inf, jnp.float32),
+            jnp.asarray(flat, jnp.int32))
+    vals, idxs = lax.reduce_window(
+        (x.astype(jnp.float32), idx.astype(jnp.int32)), init, reducer,
+        wdims, wstrides, wpads)
+    return vals.astype(x.dtype), idxs
+
+
+def _adaptive_max_with_index(x, out_sizes, nd):
+    """Per-bin max + flat spatial argmax for adaptive pooling."""
+    spatial_shape = x.shape[2:]
+    strides = [1] * nd
+    for i in range(nd - 2, -1, -1):
+        strides[i] = strides[i + 1] * spatial_shape[i + 1]
+
+    def bins(isz, osz):
+        return [((i * isz) // osz, max(((i + 1) * isz + osz - 1) // osz,
+                                       (i * isz) // osz + 1))
+                for i in range(osz)]
+
+    all_bins = [bins(spatial_shape[i], out_sizes[i]) for i in range(nd)]
+    vals_rows, idx_rows = [], []
+    import itertools
+    out_spatial = tuple(out_sizes)
+    vals = jnp.zeros(x.shape[:2] + out_spatial, jnp.float32)
+    idxs = jnp.zeros(x.shape[:2] + out_spatial, jnp.int32)
+    for pos in itertools.product(*[range(s) for s in out_spatial]):
+        sl = [slice(None), slice(None)]
+        offs = 0
+        for d, p in enumerate(pos):
+            s, e = all_bins[d][p]
+            sl.append(slice(s, e))
+            offs += s * strides[d]
+        seg = x[tuple(sl)].astype(jnp.float32)
+        segf = seg.reshape(seg.shape[:2] + (-1,))
+        am = jnp.argmax(segf, axis=-1)
+        # unflatten local argmax to global flat index
+        loc_shape = seg.shape[2:]
+        loc_strides = [1] * nd
+        for i in range(nd - 2, -1, -1):
+            loc_strides[i] = loc_strides[i + 1] * loc_shape[i + 1]
+        gidx = jnp.zeros_like(am)
+        rem = am
+        for d in range(nd):
+            q = rem // loc_strides[d]
+            rem = rem % loc_strides[d]
+            gidx = gidx + q * strides[d]
+        gidx = gidx + offs
+        vals = vals.at[(slice(None), slice(None)) + pos].set(
+            jnp.max(segf, axis=-1))
+        idxs = idxs.at[(slice(None), slice(None)) + pos].set(
+            gidx.astype(jnp.int32))
+    return vals.astype(x.dtype), idxs
+
+
+@op()
+def max_pool2d_with_index(x, kernel_size, strides=None, paddings=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    if strides is None:
+        strides = kernel_size
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        strides = kernel_size
+        paddings = 0
+    return _max_pool_with_index(x, kernel_size, strides, paddings, 2,
+                                adaptive)
+
+
+@op()
+def max_pool3d_with_index(x, kernel_size, strides=None, paddings=0,
+                          global_pooling=False, adaptive=False,
+                          ceil_mode=False):
+    if strides is None:
+        strides = kernel_size
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        strides = kernel_size
+        paddings = 0
+    return _max_pool_with_index(x, kernel_size, strides, paddings, 3,
+                                adaptive)
+
+
+maxpool = op("maxpool")(lambda x, kernel_size, strides=1, paddings=0:
+                        _pool_nd(x, kernel_size, strides, paddings, "max",
+                                 True, False, False, "NCHW", 2))
+
+
+@op()
+def unpool(x, indices, kernel_size=2, strides=2, paddings=0,
+           output_size=None, data_format="NCHW"):
+    """Max-unpooling: scatter values back to argmax positions."""
+    n, c, h, w = x.shape
+    if output_size is None:
+        ks = _tup(kernel_size, 2)
+        st = _tup(strides, 2)
+        pd = _tup(paddings, 2)
+        oh = (h - 1) * st[0] - 2 * pd[0] + ks[0]
+        ow = (w - 1) * st[1] - 2 * pd[1] + ks[1]
+    else:
+        oh, ow = int(output_size[-2]), int(output_size[-1])
+    out = jnp.zeros((n, c, oh * ow), x.dtype)
+    flat_idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, flat_idx, vals)
+    return out.reshape(n, c, oh, ow)
+
+
+@op()
+def unpool3d(x, indices, kernel_size=2, strides=2, paddings=0,
+             output_size=None, data_format="NCDHW"):
+    n, c, d, h, w = x.shape
+    if output_size is None:
+        ks = _tup(kernel_size, 3)
+        st = _tup(strides, 3)
+        pd = _tup(paddings, 3)
+        od = (d - 1) * st[0] - 2 * pd[0] + ks[0]
+        oh = (h - 1) * st[1] - 2 * pd[1] + ks[1]
+        ow = (w - 1) * st[2] - 2 * pd[2] + ks[2]
+    else:
+        od, oh, ow = (int(s) for s in output_size[-3:])
+    out = jnp.zeros((n, c, od * oh * ow), x.dtype)
+    flat_idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(lambda o, i, v: o.at[i].set(v)))(
+        out, flat_idx, vals)
+    return out.reshape(n, c, od, oh, ow)

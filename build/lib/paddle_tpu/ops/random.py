@@ -1,0 +1,108 @@
+"""Random ops over the global RNG (paddle.tensor.random parity).
+
+Eager calls split the global key (framework/random.py).  Under jit these would
+bake a constant key — jit training paths must thread keys explicitly (the
+nn.functional dropout and train-step helpers accept a key).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..framework.random import get_rng_key
+from .registry import op
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return tuple(int(s) for s in shape)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    key = jax.random.PRNGKey(seed) if seed else get_rng_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=dtype,
+                                     minval=min, maxval=max))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        out_shape = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(m + s * jax.random.normal(get_rng_key(), out_shape,
+                                                dtype=get_default_dtype()))
+    return Tensor(mean + std * jax.random.normal(get_rng_key(), _shape(shape or []),
+                                                 dtype=get_default_dtype()))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    dtype = convert_dtype(dtype) if dtype else get_default_dtype()
+    return Tensor(jax.random.normal(get_rng_key(), _shape(shape), dtype=dtype))
+
+
+def randn(shape, dtype=None, name=None):
+    return standard_normal(shape, dtype=dtype)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(get_rng_key(), _shape(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dtype = convert_dtype(dtype) if dtype else x.dtype
+    return Tensor(jax.random.randint(get_rng_key(), tuple(x.shape), low, high,
+                                     dtype=dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(get_rng_key(), n).astype(convert_dtype(dtype)))
+
+
+@op()
+def bernoulli(x):
+    return jax.random.bernoulli(get_rng_key(), x).astype(x.dtype)
+
+
+@op()
+def multinomial(x, num_samples=1, replacement=False):
+    key = get_rng_key()
+    logits = jnp.log(jnp.maximum(x, 1e-38))
+    if x.ndim == 1:
+        if replacement:
+            return jax.random.categorical(key, logits, shape=(num_samples,)).astype(jnp.int64)
+        return jax.random.choice(key, x.shape[0], shape=(num_samples,),
+                                 replace=False, p=x / jnp.sum(x)).astype(jnp.int64)
+    keys = jax.random.split(key, x.shape[0])
+    if replacement:
+        return jax.vmap(lambda k, lg: jax.random.categorical(k, lg, shape=(num_samples,)))(
+            keys, logits).astype(jnp.int64)
+    return jax.vmap(lambda k, p: jax.random.choice(k, x.shape[1], shape=(num_samples,),
+                                                   replace=False, p=p / jnp.sum(p)))(
+        keys, x).astype(jnp.int64)
+
+
+@op()
+def poisson(x):
+    return jax.random.poisson(get_rng_key(), x).astype(x.dtype)
+
+
+def rand_like(x, dtype=None):
+    dtype = convert_dtype(dtype) if dtype else x.dtype
+    return Tensor(jax.random.uniform(get_rng_key(), tuple(x.shape), dtype=dtype))
+
+
+def normal_like(x, mean=0.0, std=1.0):
+    return Tensor(mean + std * jax.random.normal(get_rng_key(), tuple(x.shape),
+                                                 dtype=x.dtype))

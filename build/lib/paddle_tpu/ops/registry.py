@@ -1,0 +1,80 @@
+"""Op registry.
+
+The single-source op registry is the one piece of the reference architecture
+kept conceptually (YAML op defs at paddle/phi/api/yaml/ops.yaml fanning out to
+API/autograd/bindings; SURVEY §1 "cross-cutting codegen").  Here registration is
+a decorator over a pure-jax implementation; autograd comes for free from
+``jax.vjp`` in dispatch, and the registry doubles as the coverage table
+(analog of the XPU supported-op list precedent,
+paddle/phi/backends/xpu/xpu2_op_list.cc).
+"""
+
+import functools
+
+from .dispatch import apply_op
+
+OPS = {}
+
+
+class OpDef:
+    __slots__ = ("name", "jax_fn", "user_fn", "tags")
+
+    def __init__(self, name, jax_fn, user_fn, tags):
+        self.name = name
+        self.jax_fn = jax_fn
+        self.user_fn = user_fn
+        self.tags = tags
+
+
+def op(opname=None, tags=()):
+    """Register a pure-jax function as an eager op.
+
+    The decorated function must be pure jax (operates on jax arrays / pytrees,
+    no Tensor objects).  The returned user-facing function accepts Tensors
+    anywhere in args/kwargs and records autograd.
+    """
+
+    def deco(jfn):
+        name = opname or jfn.__name__
+
+        @functools.wraps(jfn)
+        def user_fn(*args, **kwargs):
+            kwargs.pop("name", None)
+            return apply_op(name, jfn, args, kwargs)
+
+        # First registration wins: several public ops register a
+        # closure-capturing inner @op on every call (dropout, rrelu, …);
+        # letting those clobber the import-time entry would leave OPS[name]
+        # pointing at a narrowed signature.
+        if name not in OPS:
+            OPS[name] = OpDef(name, jfn, user_fn, tuple(tags))
+        return user_fn
+
+    return deco
+
+
+def raw(name):
+    """Get the pure-jax implementation of a registered op (for jit paths)."""
+    return OPS[name].jax_fn
+
+
+def register_external(name, user_fn, jax_fn=None, tags=()):
+    """Register an already-wrapped user-facing function under ``name``.
+
+    For ops whose public entry point lives outside the ``@op`` decorator
+    (creation/random fns returning Tensors directly, collective wrappers,
+    rng-threading wrappers).  Keeps the coverage table honest without
+    forcing everything through ``apply_op``.
+    """
+    if name not in OPS:
+        OPS[name] = OpDef(name, jax_fn, user_fn, tuple(tags))
+    return user_fn
+
+
+def coverage(yaml_names=None):
+    """Return (registered, total, pct) against an op-name inventory."""
+    if yaml_names is None:
+        from .inventory import OP_INVENTORY
+        yaml_names = OP_INVENTORY
+    have = sum(1 for n in yaml_names if n in OPS)
+    return have, len(yaml_names), 100.0 * have / max(1, len(yaml_names))

@@ -1,0 +1,356 @@
+"""Sequence ops: RNN family, CTC/RNN-T losses, decoding, framing.
+
+Reference kernels: paddle/phi/kernels/*/rnn_kernel.* (cuDNN RNN),
+warpctc (dyn-loaded, paddle/phi/backends/dynload/warpctc.h),
+warprnnt, viterbi_decode (paddle/phi/kernels/cpu/viterbi_decode_kernel.cc),
+gather_tree, frame/overlap_add (paddle/phi/kernels/*/frame_*).
+
+TPU design: all recurrences are ``lax.scan`` — XLA compiles the scan body
+once and the MXU runs the per-step matmuls; CTC uses optax's TPU-tested
+implementation; RNN-T is a log-space DP over anti-diagonal wavefronts.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import op
+
+# ------------------------------------------------------------------- RNN
+
+def _lstm_cell(x, h, c, wi, wh, bi, bh):
+    g = x @ wi.T + h @ wh.T + bi + bh
+    i, f, gg, o = jnp.split(g, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c_new = f * c + i * jnp.tanh(gg)
+    return jnp.tanh(c_new) * o, c_new
+
+
+def _gru_cell(x, h, wi, wh, bi, bh):
+    gi = x @ wi.T + bi
+    gh = h @ wh.T + bh
+    ri, zi, ni = jnp.split(gi, 3, axis=-1)
+    rh, zh, nh = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ri + rh)
+    z = jax.nn.sigmoid(zi + zh)
+    n = jnp.tanh(ni + r * nh)
+    return (1 - z) * n + z * h
+
+
+def _simple_cell(x, h, wi, wh, bi, bh, act):
+    return act(x @ wi.T + h @ wh.T + bi + bh)
+
+
+def _run_layer(x, h0, c0, weights, mode, reverse=False):
+    """x [T,B,I]; returns (out [T,B,H], h_T, c_T)."""
+    wi, wh, bi, bh = weights
+    if reverse:
+        x = jnp.flip(x, 0)
+
+    if mode == "LSTM":
+        def step(carry, xt):
+            h, c = carry
+            h2, c2 = _lstm_cell(xt, h, c, wi, wh, bi, bh)
+            return (h2, c2), h2
+        (hT, cT), out = lax.scan(step, (h0, c0), x)
+    elif mode == "GRU":
+        def step(h, xt):
+            h2 = _gru_cell(xt, h, wi, wh, bi, bh)
+            return h2, h2
+        hT, out = lax.scan(step, h0, x)
+        cT = c0
+    else:
+        act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+        def step(h, xt):
+            h2 = _simple_cell(xt, h, wi, wh, bi, bh, act)
+            return h2, h2
+        hT, out = lax.scan(step, h0, x)
+        cT = c0
+    if reverse:
+        out = jnp.flip(out, 0)
+    return out, hT, cT
+
+
+@op()
+def rnn(x, pre_state, weight_list, sequence_length=None, dropout_prob=0.0,
+        is_bidirec=False, input_size=0, hidden_size=0, num_layers=1,
+        mode="LSTM", seed=0, is_test=False):
+    """Multi-layer (bi)directional RNN; x [T,B,I] (time-major).
+
+    weight_list layout per layer per direction: [w_ih, w_hh, b_ih, b_hh]
+    (cuDNN flat-weight layout in the reference; explicit list here).
+    """
+    num_dir = 2 if is_bidirec else 1
+    h0_all = pre_state[0]  # [L*D, B, H]
+    c0_all = pre_state[1] if mode == "LSTM" and len(pre_state) > 1 else \
+        jnp.zeros_like(h0_all)
+    out = x
+    h_finals, c_finals = [], []
+    wptr = 0
+    for layer in range(num_layers):
+        outs_dir = []
+        for d in range(num_dir):
+            idx = layer * num_dir + d
+            w = tuple(weight_list[wptr:wptr + 4])
+            wptr += 4
+            o, hT, cT = _run_layer(out, h0_all[idx], c0_all[idx], w, mode,
+                                   reverse=(d == 1))
+            outs_dir.append(o)
+            h_finals.append(hT)
+            c_finals.append(cT)
+        out = (jnp.concatenate(outs_dir, axis=-1) if num_dir == 2
+               else outs_dir[0])
+    h_out = jnp.stack(h_finals)
+    c_out = jnp.stack(c_finals)
+    if sequence_length is not None:
+        t = out.shape[0]
+        mask = (jnp.arange(t)[:, None] <
+                jnp.asarray(sequence_length)[None, :])
+        out = out * mask[..., None].astype(out.dtype)
+    if mode == "LSTM":
+        return out, (h_out, c_out)
+    return out, (h_out,)
+
+
+# ------------------------------------------------------------------- CTC
+
+@op()
+def warpctc(logits, label, logits_length=None, labels_length=None,
+            blank=0, norm_by_times=False):
+    """CTC loss. logits [T,B,C] (paddle warpctc layout) or [B,T,C] w/
+    lengths; label [B,L]."""
+    import optax
+    if logits.ndim != 3:
+        raise ValueError("warpctc expects rank-3 logits")
+    t, b, c = logits.shape
+    lg = jnp.transpose(logits, (1, 0, 2)).astype(jnp.float32)  # [B,T,C]
+    if logits_length is None:
+        logits_length = jnp.full((b,), t, jnp.int32)
+    lab = jnp.asarray(label, jnp.int32)
+    if labels_length is None:
+        labels_length = (lab != blank).sum(-1).astype(jnp.int32)
+    tpad = (jnp.arange(t)[None, :] >=
+            jnp.asarray(logits_length)[:, None]).astype(jnp.float32)
+    lpad = (jnp.arange(lab.shape[1])[None, :] >=
+            jnp.asarray(labels_length)[:, None]).astype(jnp.float32)
+    loss = optax.ctc_loss(lg, tpad, lab, lpad, blank_id=blank)
+    if norm_by_times:
+        loss = loss / jnp.maximum(jnp.asarray(logits_length, jnp.float32), 1)
+    return loss
+
+
+# ----------------------------------------------------------------- RNN-T
+
+@op()
+def warprnnt(logits, label, input_lengths, label_lengths, blank=0,
+             fastemit_lambda=0.0):
+    """RNN-T loss, log-space DP.  logits [B, T, U+1, C]; label [B, U]."""
+    b, t, u1, c = logits.shape
+    lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    lab = jnp.asarray(label, jnp.int32)
+    tl = jnp.asarray(input_lengths, jnp.int32)
+    ul = jnp.asarray(label_lengths, jnp.int32)
+
+    blank_lp = lp[:, :, :, blank]  # [B,T,U+1]
+    u_idx = jnp.arange(u1 - 1)
+    emit_lp = jnp.take_along_axis(
+        lp[:, :, :-1, :], lab[:, None, :, None], axis=-1)[..., 0]  # [B,T,U]
+
+    neg_inf = -1e30
+
+    def per_example(blp, elp, tlen, ulen):
+        # alpha [T, U+1]; row t from row t-1:
+        #   alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+        #                           alpha[t,   u-1] + emit[t, u-1])
+        # t = 0 row: alpha[0,0]=0; alpha[0,u]=sum emit[0,:u]
+        a0 = jnp.concatenate([jnp.zeros((1,)),
+                              jnp.cumsum(elp[0])])
+
+        def t_step(alpha_prev, inp):
+            blp_t, elp_t = inp
+            from_top = alpha_prev + blp_t
+            def scan_u(carry, z):
+                ft, e = z
+                val = jnp.logaddexp(ft, carry + e)
+                return val, val
+            init = from_top[0]
+            _, rest = lax.scan(scan_u, init, (from_top[1:], elp_t))
+            alpha_t = jnp.concatenate([init[None], rest])
+            return alpha_t, alpha_t
+
+        _, alpha_rows = lax.scan(t_step, a0, (blp[:-1], elp[1:]))
+        alpha = jnp.concatenate([a0[None], alpha_rows], axis=0)  # [T,U+1]
+        final = alpha[tlen - 1, ulen] + blp[tlen - 1, ulen]
+        return -final
+
+    loss = jax.vmap(per_example)(blank_lp, emit_lp, tl, ul)
+    return loss
+
+
+# --------------------------------------------------------------- decoding
+
+@op()
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True):
+    """CRF Viterbi. potentials [B,T,N], transition [N+2,N+2] if bos/eos."""
+    pot = potentials.astype(jnp.float32)
+    trans = transition_params.astype(jnp.float32)
+    b, t, n = pot.shape
+    if include_bos_eos_tag:
+        # rows/cols n..n+1 are BOS/EOS in paddle's layout ([N+2,N+2]);
+        # here transition is [N,N] core + start/stop vectors when provided
+        if trans.shape[0] == n + 2:
+            start = trans[n, :n]
+            stop = trans[:n, n + 1]
+            core = trans[:n, :n]
+        else:
+            start = jnp.zeros((n,))
+            stop = jnp.zeros((n,))
+            core = trans
+    else:
+        start = jnp.zeros((n,))
+        stop = jnp.zeros((n,))
+        core = trans
+
+    def per_seq(p, ln):
+        alpha0 = p[0] + start
+        # mask steps beyond length: freeze alpha after ln-1
+        valid = jnp.arange(1, t) < ln
+
+        def masked_step(alpha, inp):
+            pt, v = inp
+            scores = alpha[:, None] + core
+            bp = jnp.argmax(scores, axis=0)
+            alpha_new = jnp.max(scores, axis=0) + pt
+            alpha_out = jnp.where(v, alpha_new, alpha)
+            bp_out = jnp.where(v, bp, jnp.arange(n))
+            return alpha_out, bp_out
+
+        alphaT, backptrs = lax.scan(masked_step, alpha0, (p[1:], valid))
+        alphaT = alphaT + (stop if include_bos_eos_tag else 0.0)
+        best_last = jnp.argmax(alphaT)
+        score = jnp.max(alphaT)
+
+        def back_step(tag, bp):
+            prev = bp[tag]
+            return prev, tag
+
+        _, path_rev = lax.scan(back_step, best_last,
+                               jnp.flip(backptrs, 0))
+        path = jnp.concatenate([jnp.flip(path_rev), best_last[None]])
+        return score, path.astype(jnp.int64)
+
+    scores, paths = jax.vmap(per_seq)(pot, jnp.asarray(lengths, jnp.int32))
+    return scores, paths
+
+
+@op()
+def gather_tree(ids, parents):
+    """Beam-search backtrace. ids/parents [T, B, W] → full paths."""
+    t, b, w = ids.shape
+
+    def per_batch(idb, parb):  # [T,W]
+        def step(beam_idx, inp):
+            idt, part = inp  # each [W]
+            tok = idt[beam_idx]
+            prev = part[beam_idx]
+            return prev, tok
+
+        last = jnp.arange(w)
+        _, toks = lax.scan(step, last, (jnp.flip(idb, 0),
+                                        jnp.flip(parb, 0)))
+        return jnp.flip(toks, 0)
+
+    out = jax.vmap(per_batch, in_axes=1, out_axes=1)(ids, parents)
+    return out
+
+
+@op()
+def edit_distance(hyps, refs, hypslength=None, refslength=None,
+                  normalized=True):
+    """Levenshtein distance per pair; hyps/refs [B, L] padded int."""
+    b, lh = hyps.shape
+    lr = refs.shape[1]
+    if hypslength is None:
+        hypslength = jnp.full((b,), lh, jnp.int32)
+    if refslength is None:
+        refslength = jnp.full((b,), lr, jnp.int32)
+
+    def per_pair(h, r, hl, rl):
+        row0 = jnp.arange(lr + 1, dtype=jnp.int32)
+
+        def step(prev_row, i):
+            hi = h[i]
+
+            def col(carry, j):
+                left = carry  # dp[i+1][j]
+                diag = prev_row[j]
+                up = prev_row[j + 1]
+                cost = jnp.where(hi == r[j], 0, 1)
+                val = jnp.minimum(jnp.minimum(up + 1, left + 1), diag + cost)
+                # past ref length: keep propagating minimal value
+                return val, val
+
+            first = prev_row[0] + 1
+            _, rest = lax.scan(col, first, jnp.arange(lr))
+            new_row = jnp.concatenate([first[None], rest])
+            # rows past hyp length: carry previous row through
+            new_row = jnp.where(i < hl, new_row, prev_row)
+            return new_row, None
+
+        final_row, _ = lax.scan(step, row0, jnp.arange(lh))
+        d = final_row[rl]
+        if normalized:
+            return d.astype(jnp.float32) / jnp.maximum(
+                rl.astype(jnp.float32), 1.0)
+        return d.astype(jnp.float32)
+
+    dist = jax.vmap(per_pair)(jnp.asarray(hyps), jnp.asarray(refs),
+                              jnp.asarray(hypslength, jnp.int32),
+                              jnp.asarray(refslength, jnp.int32))
+    return dist.reshape(b, 1), jnp.asarray([b], jnp.int64)
+
+
+# ------------------------------------------------------------ stft helpers
+
+@op()
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames along ``axis``."""
+    if axis in (-1, x.ndim - 1):
+        n = x.shape[-1]
+        n_frames = 1 + (n - frame_length) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(frame_length)[None, :])
+        out = x[..., idx]  # [..., n_frames, frame_length]
+        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, n_frames]
+    # axis == 0
+    n = x.shape[0]
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(n_frames)[None, :] * hop_length
+           + jnp.arange(frame_length)[:, None])
+    return x[idx]  # [frame_length, n_frames, ...]
+
+
+@op()
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame: x [..., frame_length, n_frames] → signal."""
+    if axis in (-1, x.ndim - 1):
+        xt = jnp.swapaxes(x, -1, -2)  # [..., n_frames, frame_length]
+        n_frames, frame_length = xt.shape[-2], xt.shape[-1]
+        out_len = (n_frames - 1) * hop_length + frame_length
+        lead = xt.shape[:-2]
+        flat = xt.reshape((-1, n_frames, frame_length))
+
+        def per(sig):
+            o = jnp.zeros((out_len,), x.dtype)
+            idx = (jnp.arange(n_frames)[:, None] * hop_length
+                   + jnp.arange(frame_length)[None, :])
+            return o.at[idx.reshape(-1)].add(sig.reshape(-1))
+
+        out = jax.vmap(per)(flat)
+        return out.reshape(lead + (out_len,))
+    # axis == 0: x is [frame_length, n_frames, ...]
+    xt = jnp.moveaxis(x, (0, 1), (-1, -2))  # [..., n_frames, frame_length]
+    res = overlap_add.__wrapped__(jnp.swapaxes(xt, -1, -2), hop_length,
+                                  axis=-1)
+    return jnp.moveaxis(res, -1, 0)

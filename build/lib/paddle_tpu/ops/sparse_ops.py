@@ -1,0 +1,94 @@
+"""Sparse-tensor primitive ops (phi sparse kernel layer).
+
+Reference: paddle/phi/kernels/sparse/ (COO/CSR conv/matmul/mask, SURVEY
+§2.1) and tensor types SparseCooTensor/SparseCsrTensor
+(paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h).
+
+TPU design: sparse storage lives as (indices, values) pairs — dense
+gather/scatter/segment ops on the device, matching
+``jax.experimental.sparse.BCOO`` layout; the user-level ``paddle_tpu.sparse``
+package wraps these in SparseCooTensor/SparseCsrTensor classes.  XLA has no
+native sparse HLO, so compute densifies at the op edge (the reference's GPU
+kernels do their own gather/scatter too).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op, register_external
+
+
+@op()
+def sparse_coo_tensor(values, indices, shape):
+    """Build (indices, values, shape) triple — primitive layer."""
+    return (jnp.asarray(indices, jnp.int64), jnp.asarray(values),
+            jnp.asarray(np.asarray(shape).reshape(-1), jnp.int64))
+
+
+@op()
+def coalesce(indices, values, shape=None):
+    """Sum duplicate coordinates; sorted output (phi CoalesceKernel)."""
+    nd, nnz = indices.shape
+    if shape is None:
+        dims = [int(jnp.max(indices[i])) + 1 for i in range(nd)]
+    else:
+        dims = [int(s) for s in shape[:nd]]
+    strides = np.ones(nd, np.int64)
+    for i in range(nd - 2, -1, -1):
+        strides[i] = strides[i + 1] * dims[i + 1]
+    flat = (indices * jnp.asarray(strides)[:, None]).sum(0)
+    uniq, inv = jnp.unique(flat, return_inverse=True, size=nnz,
+                           fill_value=-1)
+    summed = jax.ops.segment_sum(values, inv.reshape(-1), nnz)
+    new_idx = []
+    rem = jnp.where(uniq >= 0, uniq, 0)
+    for i in range(nd):
+        new_idx.append(rem // strides[i])
+        rem = rem % strides[i]
+    return jnp.stack(new_idx), summed
+
+
+@op()
+def to_dense(indices, values, shape):
+    dense = jnp.zeros(tuple(shape) + values.shape[1:], values.dtype)
+    return dense.at[tuple(indices[i] for i in range(indices.shape[0]))] \
+        .add(values)
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    """Dense → COO (host op in eager: nnz is data-dependent)."""
+    from ..core.tensor import Tensor
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    sd = sparse_dim or arr.ndim
+    flat_tail = arr.reshape(arr.shape[:sd] + (-1,))
+    mask = (flat_tail != 0).any(-1).reshape(arr.shape[:sd])
+    idx = np.stack(np.nonzero(mask))
+    vals = arr[tuple(idx)]
+    return (Tensor(jnp.asarray(idx.astype(np.int64))), Tensor(jnp.asarray(vals)),
+            tuple(arr.shape))
+
+
+def to_sparse_csr(x):
+    """Dense 2-D → CSR (host op)."""
+    from ..core.tensor import Tensor
+    arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+    if arr.ndim != 2:
+        raise ValueError("to_sparse_csr expects a 2-D tensor")
+    rows, cols = np.nonzero(arr)
+    vals = arr[rows, cols]
+    crows = np.zeros(arr.shape[0] + 1, np.int64)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    return (Tensor(jnp.asarray(crows)), Tensor(jnp.asarray(cols.astype(np.int64))),
+            Tensor(jnp.asarray(vals)), tuple(arr.shape))
+
+
+@op()
+def values(indices, values, shape=None):
+    """`.values()` of a sparse tensor — primitive passthrough."""
+    return values
+
+
+register_external("to_sparse_coo", to_sparse_coo)
+register_external("to_sparse_csr", to_sparse_csr)

@@ -1,0 +1,959 @@
+"""Vision ops: interpolation, sampling, ROI pooling, detection post-processing.
+
+Reference kernels: paddle/phi/kernels/*/{interpolate,grid_sample,affine_grid,
+roi_align,roi_pool,psroi_pool,nms,yolo_box,yolo_loss,prior_box,box_coder,
+deformable_conv,...}_kernel.* and legacy detection ops under
+paddle/fluid/operators/detection/.
+
+TPU design notes: everything here is expressed as gathers + elementwise math
+(static shapes), which XLA vectorizes well.  Detection post-processing ops
+(NMS family) that are inherently dynamic-shape in the reference return
+fixed-capacity padded outputs plus a valid-count — the standard TPU idiom —
+while the eager wrappers trim to the dynamic size on host when possible.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import op
+
+# ---------------------------------------------------------------- interpolate
+
+def _axis_coords(out_size, in_size, align_corners, align_mode=1):
+    """Source coordinates for each output index along one axis (float32)."""
+    o = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners and out_size > 1:
+        return o * ((in_size - 1) / (out_size - 1))
+    scale = in_size / out_size
+    if align_mode == 1:  # paddle align_mode=1: src = dst * scale
+        return o * scale
+    return jnp.clip((o + 0.5) * scale - 0.5, 0.0, in_size - 1)
+
+
+def _interp_linear_axis(x, axis, out_size, align_corners, align_mode=1):
+    in_size = x.shape[axis]
+    c = _axis_coords(out_size, in_size, align_corners, align_mode)
+    lo = jnp.clip(jnp.floor(c).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    w = (c - lo.astype(jnp.float32))
+    xl = jnp.take(x, lo, axis=axis)
+    xh = jnp.take(x, hi, axis=axis)
+    bshape = [1] * x.ndim
+    bshape[axis] = out_size
+    w = w.reshape(bshape)
+    return (xl.astype(jnp.float32) * (1 - w) + xh.astype(jnp.float32) * w)
+
+
+def _cubic_w(t, a=-0.75):
+    t = jnp.abs(t)
+    w1 = ((a + 2) * t - (a + 3)) * t * t + 1
+    w2 = (((t - 5) * t + 8) * t - 4) * a
+    return jnp.where(t <= 1, w1, jnp.where(t < 2, w2, 0.0))
+
+
+def _interp_cubic_axis(x, axis, out_size, align_corners):
+    in_size = x.shape[axis]
+    c = _axis_coords(out_size, in_size, align_corners, align_mode=0)
+    base = jnp.floor(c).astype(jnp.int32)
+    frac = c - base.astype(jnp.float32)
+    out = 0.0
+    for k in range(-1, 3):
+        idx = jnp.clip(base + k, 0, in_size - 1)
+        w = _cubic_w(frac - k)
+        bshape = [1] * x.ndim
+        bshape[axis] = out_size
+        out = out + jnp.take(x, idx, axis=axis).astype(jnp.float32) * \
+            w.reshape(bshape)
+    return out
+
+
+def _interp_nearest_axis(x, axis, out_size, align_corners):
+    in_size = x.shape[axis]
+    c = _axis_coords(out_size, in_size, align_corners, align_mode=1)
+    idx = (jnp.round(c) if align_corners else jnp.floor(c)).astype(jnp.int32)
+    return jnp.take(x, jnp.clip(idx, 0, in_size - 1), axis=axis)
+
+
+def _spatial_axes(ndim, data_format):
+    if data_format.startswith("NC"):
+        return list(range(2, ndim))
+    return list(range(1, ndim - 1))
+
+
+def _resolve_sizes(x, axes, size, scale_factor):
+    if size is not None:
+        sizes = [int(s) for s in (size if isinstance(size, (list, tuple))
+                                  else [size] * len(axes))]
+    else:
+        sf = (scale_factor if isinstance(scale_factor, (list, tuple))
+              else [scale_factor] * len(axes))
+        sizes = [int(x.shape[a] * float(f)) for a, f in zip(axes, sf)]
+    return sizes
+
+
+def _interp_impl(x, mode, size, scale_factor, align_corners, align_mode,
+                 data_format):
+    axes = _spatial_axes(x.ndim, data_format)
+    sizes = _resolve_sizes(x, axes, size, scale_factor)
+    out = x
+    for a, s in zip(axes, sizes):
+        if mode == "nearest":
+            out = _interp_nearest_axis(out, a, s, align_corners)
+        elif mode in ("linear", "bilinear", "trilinear"):
+            out = _interp_linear_axis(out, a, s, align_corners, align_mode)
+        elif mode == "bicubic":
+            out = _interp_cubic_axis(out, a, s, align_corners)
+        elif mode == "area":
+            out = jax.image.resize(
+                out, tuple(s if i == a else d
+                           for i, d in enumerate(out.shape)), "linear")
+        else:
+            raise ValueError(f"unknown interpolate mode {mode}")
+    return out.astype(x.dtype) if mode == "nearest" else out
+
+
+def _make_interp(mode):
+    def fn(x, out_size=None, size_tensor=None, scale_tensor=None, scale=None,
+           data_format="NCHW", align_corners=True, align_mode=1,
+           size=None, scale_factor=None):
+        size = size if size is not None else out_size
+        scale_factor = scale_factor if scale_factor is not None else scale
+        return _interp_impl(x, mode.replace("_interp", ""), size,
+                            scale_factor, align_corners, align_mode,
+                            data_format)
+    fn.__name__ = mode
+    return fn
+
+
+linear_interp = op("linear_interp")(_make_interp("linear_interp"))
+bilinear_interp = op("bilinear_interp")(_make_interp("bilinear_interp"))
+trilinear_interp = op("trilinear_interp")(_make_interp("trilinear_interp"))
+nearest_interp = op("nearest_interp")(_make_interp("nearest_interp"))
+bicubic_interp = op("bicubic_interp")(_make_interp("bicubic_interp"))
+
+
+# ------------------------------------------------------- affine / grid sample
+
+@op()
+def affine_grid(theta, out_shape, align_corners=True):
+    """theta [N, 2, 3] (or [N, 3, 4] for 3d), out_shape (N, C, H, W)."""
+    out_shape = [int(s) for s in np.asarray(out_shape).reshape(-1)]
+    is_3d = theta.shape[-2] == 3
+    if not is_3d:
+        n, _, h, w = out_shape
+        ys = jnp.linspace(-1, 1, h) if align_corners else \
+            (jnp.arange(h) * 2 + 1) / h - 1
+        xs = jnp.linspace(-1, 1, w) if align_corners else \
+            (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H,W,3]
+        grid = jnp.einsum("hwk,njk->nhwj", base.astype(theta.dtype), theta)
+        return grid  # [N,H,W,2]
+    n, _, d, h, w = out_shape
+    lin = (lambda s: jnp.linspace(-1, 1, s)) if align_corners else \
+        (lambda s: (jnp.arange(s) * 2 + 1) / s - 1)
+    gz, gy, gx = jnp.meshgrid(lin(d), lin(h), lin(w), indexing="ij")
+    base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], -1)
+    return jnp.einsum("dhwk,njk->ndhwj", base.astype(theta.dtype), theta)
+
+
+def _grid_sample_2d(x, grid, mode, padding_mode, align_corners):
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnorm(g, size):
+        if align_corners:
+            return (g + 1) * (size - 1) / 2
+        return ((g + 1) * size - 1) / 2
+
+    ix = unnorm(gx.astype(jnp.float32), w)
+    iy = unnorm(gy.astype(jnp.float32), h)
+
+    if padding_mode == "border":
+        ix = jnp.clip(ix, 0, w - 1)
+        iy = jnp.clip(iy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(v, size):
+            if align_corners:
+                span = 2 * (size - 1)
+                v = jnp.abs(v) % jnp.maximum(span, 1)
+                return jnp.where(v > size - 1, span - v, v)
+            span = 2 * size
+            v = (v + 0.5) % span
+            v = jnp.where(v < 0, v + span, v)
+            v = jnp.where(v >= size, span - v, v) - 0.5
+            return jnp.clip(v, 0, size - 1)
+        ix = reflect(ix, w)
+        iy = reflect(iy, h)
+
+    def sample(iy_i, ix_i):
+        valid = ((ix_i >= 0) & (ix_i <= w - 1) & (iy_i >= 0)
+                 & (iy_i <= h - 1))
+        xi = jnp.clip(ix_i, 0, w - 1).astype(jnp.int32)
+        yi = jnp.clip(iy_i, 0, h - 1).astype(jnp.int32)
+        # x: [N,C,H,W]; yi/xi: [N,Ho,Wo]
+        g = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, yi, xi)
+        return jnp.where(valid[:, None], g.reshape(n, c, -1)
+                         .reshape(n, c, *yi.shape[1:]), 0.0) \
+            if padding_mode == "zeros" else g
+
+    if mode == "nearest":
+        return sample(jnp.round(iy), jnp.round(ix)).astype(x.dtype)
+
+    x0, y0 = jnp.floor(ix), jnp.floor(iy)
+    x1, y1 = x0 + 1, y0 + 1
+    wa = ((x1 - ix) * (y1 - iy))[:, None]
+    wb = ((x1 - ix) * (iy - y0))[:, None]
+    wc = ((ix - x0) * (y1 - iy))[:, None]
+    wd = ((ix - x0) * (iy - y0))[:, None]
+    va = sample(y0, x0).astype(jnp.float32)
+    vb = sample(y1, x0).astype(jnp.float32)
+    vc = sample(y0, x1).astype(jnp.float32)
+    vd = sample(y1, x1).astype(jnp.float32)
+    return (va * wa + vb * wb + vc * wc + vd * wd).astype(x.dtype)
+
+
+@op()
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    return _grid_sample_2d(x, grid, mode, padding_mode, align_corners)
+
+
+# ------------------------------------------------------------------ ROI ops
+
+def _roi_bilinear(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary same-shape float coords → [C, *coords]."""
+    c, h, w = feat.shape
+    y0 = jnp.clip(jnp.floor(y), 0, h - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    ly, lx = y - y0, x - x0
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+            + v10 * ly * (1 - lx) + v11 * ly * lx)
+
+
+@op()
+def roi_align(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+              spatial_scale=1.0, sampling_ratio=-1, aligned=True):
+    """x [N,C,H,W]; boxes [R,4] (x1,y1,x2,y2); boxes_num [N] rois per image."""
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        bn = jnp.asarray(boxes_num, jnp.int32)
+        batch_idx = jnp.sum(
+            jnp.arange(r)[:, None] >= jnp.cumsum(bn)[None, :], axis=1
+        ).astype(jnp.int32)
+    offset = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+    bx = boxes.astype(jnp.float32) * spatial_scale - offset
+
+    def one_roi(box, bidx):
+        x1, y1, x2, y2 = box
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h = rh / pooled_height
+        bin_w = rw / pooled_width
+        py = jnp.arange(pooled_height, dtype=jnp.float32)
+        px = jnp.arange(pooled_width, dtype=jnp.float32)
+        sy = jnp.arange(sr, dtype=jnp.float32)
+        yy = y1 + (py[:, None] + (sy[None, :] + 0.5) / sr) * bin_h
+        xx = x1 + (px[:, None] + (sy[None, :] + 0.5) / sr) * bin_w
+        gy = jnp.clip(yy, 0, h - 1).reshape(-1)  # [PH*sr]
+        gx = jnp.clip(xx, 0, w - 1).reshape(-1)  # [PW*sr]
+        gyy = jnp.repeat(gy, gx.shape[0])
+        gxx = jnp.tile(gx, gy.shape[0])
+        feat = x[bidx].astype(jnp.float32)
+        vals = _roi_bilinear(feat, gyy, gxx)  # [C, PH*sr*PW*sr]
+        vals = vals.reshape(c, pooled_height, sr, pooled_width, sr)
+        return vals.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(bx, batch_idx).astype(x.dtype)
+
+
+@op()
+def roi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+             spatial_scale=1.0):
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        bn = jnp.asarray(boxes_num, jnp.int32)
+        batch_idx = jnp.sum(
+            jnp.arange(r)[:, None] >= jnp.cumsum(bn)[None, :], axis=1
+        ).astype(jnp.int32)
+    bx = jnp.round(boxes.astype(jnp.float32) * spatial_scale)
+
+    def one_roi(box, bidx):
+        x1, y1, x2, y2 = box
+        rw = jnp.maximum(x2 - x1 + 1, 1.0)
+        rh = jnp.maximum(y2 - y1 + 1, 1.0)
+        bin_h, bin_w = rh / pooled_height, rw / pooled_width
+        ys = jnp.arange(h, dtype=jnp.float32)
+        xs = jnp.arange(w, dtype=jnp.float32)
+        feat = x[bidx]
+        py = jnp.clip(jnp.floor((ys - y1) / bin_h), -1, pooled_height)
+        px = jnp.clip(jnp.floor((xs - x1) / bin_w), -1, pooled_width)
+        out = jnp.full((c, pooled_height, pooled_width), -jnp.inf,
+                       jnp.float32)
+        ymask = (py[:, None] == jnp.arange(pooled_height)[None, :])  # [H,PH]
+        xmask = (px[:, None] == jnp.arange(pooled_width)[None, :])   # [W,PW]
+        big = feat[:, :, :, None, None].astype(jnp.float32)  # [C,H,W,1,1]
+        m = ymask[None, :, None, :, None] & xmask[None, None, :, None, :]
+        masked = jnp.where(m, big, -jnp.inf)
+        out = masked.max(axis=(1, 2))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(one_roi)(bx, batch_idx).astype(x.dtype)
+
+
+@op()
+def psroi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
+               output_channels=1, spatial_scale=1.0):
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    if boxes_num is None:
+        batch_idx = jnp.zeros((r,), jnp.int32)
+    else:
+        bn = jnp.asarray(boxes_num, jnp.int32)
+        batch_idx = jnp.sum(
+            jnp.arange(r)[:, None] >= jnp.cumsum(bn)[None, :], axis=1
+        ).astype(jnp.int32)
+    bx = boxes.astype(jnp.float32) * spatial_scale
+
+    def one_roi(box, bidx):
+        x1, y1, x2, y2 = box
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / pooled_height, rw / pooled_width
+        feat = x[bidx].astype(jnp.float32)
+        outs = []
+        sr = 2
+        py = jnp.arange(pooled_height, dtype=jnp.float32)
+        px = jnp.arange(pooled_width, dtype=jnp.float32)
+        sy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
+        yy = jnp.clip(y1 + (py[:, None] + sy[None, :]) * bin_h, 0, h - 1)
+        xx = jnp.clip(x1 + (px[:, None] + sy[None, :]) * bin_w, 0, w - 1)
+        gy = jnp.repeat(yy.reshape(-1), xx.size)
+        gx = jnp.tile(xx.reshape(-1), yy.size)
+        vals = _roi_bilinear(feat, gy, gx).reshape(
+            c, pooled_height, sr, pooled_width, sr).mean(axis=(2, 4))
+        # position-sensitive: channel block (ph*PW+pw)*output_channels + oc
+        ph_idx = jnp.arange(pooled_height)
+        pw_idx = jnp.arange(pooled_width)
+        oc = jnp.arange(output_channels)
+        ch = (ph_idx[:, None, None] * pooled_width + pw_idx[None, :, None]) \
+            * output_channels + oc[None, None, :]
+        out = vals[ch, ph_idx[:, None, None],
+                   pw_idx[None, :, None]]  # [PH,PW,OC]
+        return jnp.transpose(out, (2, 0, 1))
+
+    return jax.vmap(one_roi)(bx, batch_idx).astype(x.dtype)
+
+
+# -------------------------------------------------------------- NMS family
+
+def _iou_matrix(boxes):
+    x1, y1, x2, y2 = (boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3])
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _nms_mask(boxes, scores, iou_threshold):
+    """Greedy NMS as a fixed-trip loop → keep mask (jit-friendly)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    iou = _iou_matrix(boxes)[order][:, order]
+    keep = jnp.ones((n,), jnp.bool_)
+
+    def body(i, keep):
+        sup = (iou[i] > iou_threshold) & keep[i] & \
+            (jnp.arange(n) > i)
+        return keep & ~sup
+
+    keep = jax.lax.fori_loop(0, n, body, keep)
+    inv = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n))
+    return keep[inv]
+
+
+@op()
+def nms(boxes, iou_threshold=0.3, scores=None):
+    if scores is None:
+        scores = -jnp.arange(boxes.shape[0], dtype=jnp.float32)
+    scores = jnp.asarray(scores, jnp.float32)
+    keep = _nms_mask(boxes.astype(jnp.float32), scores, iou_threshold)
+    # kept indices first (score-ordered), suppressed after; count = #kept
+    order = jnp.argsort(-jnp.where(keep, scores, -jnp.inf))
+    return order, keep.sum()
+
+
+@op()
+def matrix_nms(bboxes, scores, score_threshold=0.0, post_threshold=0.0,
+               nms_top_k=-1, keep_top_k=-1, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True):
+    """Matrix NMS (SOLOv2) — decayed scores, fully parallel.
+
+    bboxes [N, M, 4], scores [N, C, M].  Returns (out [N*K, 6], index,
+    rois_num) with K = keep_top_k capacity, padded with -1 scores.
+    """
+    n, cnum, m = scores.shape
+    k = keep_top_k if keep_top_k > 0 else m
+
+    def per_image(bb, sc):
+        top = nms_top_k if 0 < nms_top_k < m else m
+        all_scores, all_cls, all_box = [], [], []
+        for ci in range(cnum):
+            if ci == background_label:
+                continue
+            s = sc[ci]
+            ord_ = jnp.argsort(-s)[:top]
+            s_s = s[ord_]
+            b_s = bb[ord_]
+            iou = _iou_matrix(b_s)
+            iou = jnp.triu(iou, k=1)  # iou[i, j], i higher-scored than j
+            # max_iou[i] = max IoU of box i with any higher-scored box —
+            # the decay of j is compensated by how suppressed i itself is
+            max_iou = jnp.max(iou, axis=0)
+            upper = jnp.triu(jnp.ones_like(iou), 1) > 0
+            if use_gaussian:
+                decay = jnp.exp(-(iou ** 2 - max_iou[:, None] ** 2)
+                                / gaussian_sigma)
+                decay = jnp.min(jnp.where(upper, decay, 1.0), axis=0)
+            else:
+                decay = jnp.min(jnp.where(
+                    upper,
+                    (1 - iou) / jnp.maximum(1 - max_iou[:, None], 1e-9),
+                    1.0), axis=0)
+            s_d = s_s * decay
+            s_d = jnp.where(s_s > score_threshold, s_d, -1.0)
+            s_d = jnp.where(s_d > post_threshold, s_d, -1.0)
+            all_scores.append(s_d)
+            all_cls.append(jnp.full_like(s_d, ci))
+            all_box.append(b_s)
+        s_all = jnp.concatenate(all_scores)
+        c_all = jnp.concatenate(all_cls)
+        b_all = jnp.concatenate(all_box, axis=0)
+        ord_ = jnp.argsort(-s_all)[:k]
+        s_k, c_k, b_k = s_all[ord_], c_all[ord_], b_all[ord_]
+        out = jnp.concatenate([c_k[:, None], s_k[:, None], b_k], axis=1)
+        cnt = (s_k > 0).sum()
+        return out, cnt
+
+    outs, cnts = jax.vmap(per_image)(bboxes.astype(jnp.float32),
+                                     scores.astype(jnp.float32))
+    return outs.reshape(-1, 6), jnp.zeros((n * k,), jnp.int32), \
+        cnts.astype(jnp.int32)
+
+
+@op()
+def multiclass_nms3(bboxes, scores, rois_num=None, score_threshold=0.05,
+                    nms_top_k=-1, keep_top_k=100, nms_threshold=0.3,
+                    normalized=True, nms_eta=1.0, background_label=-1):
+    """bboxes [N, M, 4], scores [N, C, M] → padded [N*K, 6] + counts."""
+    n, cnum, m = scores.shape
+    k = keep_top_k if keep_top_k > 0 else m
+
+    def per_image(bb, sc):
+        all_s, all_c, all_b = [], [], []
+        for ci in range(cnum):
+            if ci == background_label:
+                continue
+            s = sc[ci]
+            keep = _nms_mask(bb, s, nms_threshold)
+            s = jnp.where(keep & (s >= score_threshold), s, -1.0)
+            all_s.append(s)
+            all_c.append(jnp.full_like(s, ci))
+            all_b.append(bb)
+        s_all = jnp.concatenate(all_s)
+        c_all = jnp.concatenate(all_c)
+        b_all = jnp.concatenate(all_b, axis=0)
+        ord_ = jnp.argsort(-s_all)[:k]
+        s_k, c_k, b_k = s_all[ord_], c_all[ord_], b_all[ord_]
+        out = jnp.concatenate([c_k[:, None], s_k[:, None], b_k], axis=1)
+        return out, (s_k > 0).sum()
+
+    outs, cnts = jax.vmap(per_image)(bboxes.astype(jnp.float32),
+                                     scores.astype(jnp.float32))
+    return outs.reshape(-1, 6), jnp.zeros((n * k,), jnp.int32), \
+        cnts.astype(jnp.int32)
+
+
+# ----------------------------------------------------------- box utilities
+
+@op()
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0,
+              variance=None):
+    pb = prior_box.astype(jnp.float32)
+    tb = target_box.astype(jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if prior_box_var is not None:
+        var = prior_box_var.astype(jnp.float32)
+    elif variance:
+        var = jnp.asarray(variance, jnp.float32)[None, :]
+    else:
+        var = jnp.ones((1, 4), jnp.float32)
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+        ow = jnp.log(tw[:, None] / pw[None, :])
+        oh = jnp.log(th[:, None] / ph[None, :])
+        out = jnp.stack([ox, oy, ow, oh], axis=-1)
+        return out / var[None, :, :] if var.ndim == 2 else out / var
+    # decode_center_size: target [R, ..., 4]
+    if tb.ndim == 2:
+        tb = tb[:, None, :]
+    if axis == 0:
+        pcx_, pcy_, pw_, ph_ = (pcx[None, :], pcy[None, :],
+                                pw[None, :], ph[None, :])
+    else:
+        pcx_, pcy_, pw_, ph_ = (pcx[:, None], pcy[:, None],
+                                pw[:, None], ph[:, None])
+    v = var if var.ndim == 2 else var
+    t = tb * (v[None, :, :] if v.shape[0] != tb.shape[0] else v[:, None, :]) \
+        if v.size > 4 else tb * v.reshape(1, 1, 4)
+    dcx = t[..., 0] * pw_ + pcx_
+    dcy = t[..., 1] * ph_ + pcy_
+    dw = jnp.exp(t[..., 2]) * pw_
+    dh = jnp.exp(t[..., 3]) * ph_
+    return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                      dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], axis=-1)
+
+
+@op()
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False):
+    fh, fw = input.shape[-2], input.shape[-1]
+    ih, iw = image.shape[-2], image.shape[-1]
+    step_w = steps[0] if steps[0] > 0 else iw / fw
+    step_h = steps[1] if steps[1] > 0 else ih / fh
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if flip:
+                ars.append(1.0 / ar)
+    boxes = []
+    for ms in min_sizes:
+        boxes.append((ms, ms))
+        if max_sizes:
+            for mx in max_sizes:
+                s = float(np.sqrt(ms * mx))
+                boxes.append((s, s))
+        for ar in ars:
+            if abs(ar - 1.0) < 1e-6:
+                continue
+            boxes.append((ms * float(np.sqrt(ar)), ms / float(np.sqrt(ar))))
+    num = len(boxes)
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    gy, gx = jnp.meshgrid(cy, cx, indexing="ij")
+    wh = jnp.asarray(boxes, jnp.float32)  # [num, 2]
+    bx = jnp.stack([
+        (gx[..., None] - wh[None, None, :, 0] / 2) / iw,
+        (gy[..., None] - wh[None, None, :, 1] / 2) / ih,
+        (gx[..., None] + wh[None, None, :, 0] / 2) / iw,
+        (gy[..., None] + wh[None, None, :, 1] / 2) / ih,
+    ], axis=-1)  # [fh, fw, num, 4]
+    if clip:
+        bx = jnp.clip(bx, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           bx.shape)
+    return bx, var
+
+
+@op()
+def generate_proposals(scores, bbox_deltas, im_shape, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False):
+    """RPN proposal generation. scores [N,A,H,W], deltas [N,A*4,H,W]."""
+    n, a, h, w = scores.shape
+    anc = anchors.reshape(-1, 4).astype(jnp.float32)
+    var = variances.reshape(-1, 4).astype(jnp.float32)
+
+    def per_image(sc, dl, imshape):
+        s = jnp.transpose(sc, (1, 2, 0)).reshape(-1)  # [H*W*A]
+        d = jnp.transpose(dl.reshape(a, 4, h, w), (2, 3, 0, 1)).reshape(-1, 4)
+        k = min(pre_nms_top_n, s.shape[0])
+        top_s, idx = jax.lax.top_k(s, k)
+        d = d[idx]
+        an = anc[idx]
+        va = var[idx]
+        aw = an[:, 2] - an[:, 0] + (1.0 if pixel_offset else 0.0)
+        ah = an[:, 3] - an[:, 1] + (1.0 if pixel_offset else 0.0)
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(va[:, 2] * d[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(va[:, 3] * d[:, 3], 10.0)) * ah
+        off = 1.0 if pixel_offset else 0.0
+        props = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2 - off, cy + bh / 2 - off], axis=1)
+        props = jnp.clip(props,
+                         jnp.zeros((4,)),
+                         jnp.asarray([imshape[1] - 1, imshape[0] - 1,
+                                      imshape[1] - 1, imshape[0] - 1]))
+        ws = props[:, 2] - props[:, 0] + off
+        hs = props[:, 3] - props[:, 1] + off
+        valid = (ws >= min_size) & (hs >= min_size)
+        s2 = jnp.where(valid, top_s, -jnp.inf)
+        keep = _nms_mask(props, s2, nms_thresh) & valid
+        s3 = jnp.where(keep, s2, -jnp.inf)
+        kk = min(post_nms_top_n, s3.shape[0])
+        fs, fi = jax.lax.top_k(s3, kk)
+        return props[fi], fs, jnp.isfinite(fs).sum()
+
+    rois, rscores, cnt = jax.vmap(per_image)(
+        scores.astype(jnp.float32), bbox_deltas.astype(jnp.float32),
+        im_shape.astype(jnp.float32))
+    kk = rois.shape[1]
+    return rois.reshape(-1, 4), rscores.reshape(-1, 1), cnt.astype(jnp.int32)
+
+
+@op()
+def distribute_fpn_proposals(fpn_rois, rois_num=None, min_level=2,
+                             max_level=5, refer_level=4, refer_scale=224,
+                             pixel_offset=False):
+    rois = fpn_rois.astype(jnp.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 1e-6))
+    lvl = jnp.floor(jnp.log2(scale / refer_scale + 1e-6)) + refer_level
+    lvl = jnp.clip(lvl, min_level, max_level).astype(jnp.int32)
+    n_levels = max_level - min_level + 1
+    outs, idxs, nums = [], [], []
+    order = jnp.argsort(lvl, stable=True)
+    for li in range(n_levels):
+        mask = lvl == (min_level + li)
+        cnt = mask.sum()
+        sel = jnp.where(mask, jnp.arange(rois.shape[0]), rois.shape[0])
+        sel = jnp.sort(sel)
+        sel_c = jnp.clip(sel, 0, rois.shape[0] - 1)
+        outs.append(jnp.where((sel < rois.shape[0])[:, None],
+                              rois[sel_c], 0.0))
+        idxs.append(sel)
+        nums.append(cnt)
+    restore = jnp.argsort(jnp.concatenate(
+        [jnp.where(i < rois.shape[0], i, 10 ** 9) for i in idxs]))
+    return outs, restore[:, None].astype(jnp.int32), \
+        [n.astype(jnp.int32) for n in nums]
+
+
+# ---------------------------------------------------------------- YOLO ops
+
+@op()
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    if iou_aware:
+        ious = jax.nn.sigmoid(x[:, :na].astype(jnp.float32))
+        x = x[:, na:]
+    pred = x.reshape(n, na, 5 + class_num, h, w).astype(jnp.float32)
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    bx = (jax.nn.sigmoid(pred[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1) + gx[None, None, None, :]) / w
+    by = (jax.nn.sigmoid(pred[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1) + gy[None, None, :, None]) / h
+    input_w = downsample_ratio * w
+    input_h = downsample_ratio * h
+    bw = jnp.exp(pred[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(pred[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(pred[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * \
+            ious ** iou_aware_factor
+    probs = jax.nn.sigmoid(pred[:, :, 5:]) * conf[:, :, None]
+    score_mask = conf > conf_thresh
+    imh = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    imw = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * imw
+    y1 = (by - bh / 2) * imh
+    x2 = (bx + bw / 2) * imw
+    y2 = (by + bh / 2) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+    boxes = jnp.where(score_mask[..., None], boxes, 0.0)
+    boxes = boxes.reshape(n, -1, 4)
+    scores = jnp.where(score_mask[:, :, None], probs, 0.0)
+    scores = jnp.transpose(scores, (0, 1, 3, 4, 2)).reshape(
+        n, -1, class_num)
+    return boxes, scores
+
+
+@op()
+def yolo_loss(x, gt_box, gt_label, gt_score=None, anchors=(),
+              anchor_mask=(), class_num=1, ignore_thresh=0.7,
+              downsample_ratio=32, use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 loss: xy/wh/obj/cls terms; [N,C,H,W] preds, [N,B,4] gt."""
+    n, c, h, w = x.shape
+    na = len(anchor_mask)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    an = an_all[jnp.asarray(anchor_mask, jnp.int32)]
+    pred = x.reshape(n, na, 5 + class_num, h, w).astype(jnp.float32)
+    input_size = downsample_ratio * h
+    gtb = gt_box.astype(jnp.float32)  # [N,B,4] cx,cy,w,h normalized
+    b = gtb.shape[1]
+
+    px = jax.nn.sigmoid(pred[:, :, 0])
+    py = jax.nn.sigmoid(pred[:, :, 1])
+    pw = pred[:, :, 2]
+    ph = pred[:, :, 3]
+    pobj = pred[:, :, 4]
+    pcls = pred[:, :, 5:]
+
+    gi = jnp.clip((gtb[..., 0] * w).astype(jnp.int32), 0, w - 1)  # [N,B]
+    gj = jnp.clip((gtb[..., 1] * h).astype(jnp.int32), 0, h - 1)
+    valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)
+
+    # best anchor per gt (iou of wh only, against all anchors)
+    gw = gtb[..., 2] * input_size
+    gh = gtb[..., 3] * input_size
+    inter = jnp.minimum(gw[..., None], an_all[None, None, :, 0]) * \
+        jnp.minimum(gh[..., None], an_all[None, None, :, 1])
+    union = gw[..., None] * gh[..., None] + \
+        an_all[None, None, :, 0] * an_all[None, None, :, 1] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+    mask_list = jnp.asarray(anchor_mask, jnp.int32)
+    an_idx = jnp.argmax(best[..., None] == mask_list[None, None, :],
+                        axis=-1)  # position in mask
+    responsible = jnp.any(best[..., None] == mask_list[None, None, :],
+                          axis=-1) & valid
+
+    tx = gtb[..., 0] * w - gi.astype(jnp.float32)
+    ty = gtb[..., 1] * h - gj.astype(jnp.float32)
+    tw = jnp.log(jnp.maximum(gw / jnp.maximum(an[an_idx][..., 0], 1e-9),
+                             1e-9))
+    th = jnp.log(jnp.maximum(gh / jnp.maximum(an[an_idx][..., 1], 1e-9),
+                             1e-9))
+    tscale = 2.0 - gtb[..., 2] * gtb[..., 3]
+    score_w = (gt_score.astype(jnp.float32) if gt_score is not None
+               else jnp.ones((n, b), jnp.float32))
+
+    bidx = jnp.arange(n)[:, None].repeat(b, 1)
+    sel = (bidx, an_idx, gj, gi)
+    wgt = jnp.where(responsible, tscale * score_w, 0.0)
+
+    def bce(p, t):
+        return -(t * jnp.log(jnp.clip(p, 1e-9, 1.0))
+                 + (1 - t) * jnp.log(jnp.clip(1 - p, 1e-9, 1.0)))
+
+    loss_xy = (bce(px[sel], tx) + bce(py[sel], ty)) * wgt
+    loss_wh = (jnp.abs(pw[sel] - tw) + jnp.abs(ph[sel] - th)) * wgt
+
+    # objectness: positive at responsible cells; predictions whose decoded
+    # box overlaps any gt above ignore_thresh are excluded from the
+    # negative term (YOLOv3 semantics; reference kernel
+    # paddle/phi/kernels/cpu/yolo_loss_kernel.cc CalcObjnessLoss)
+    obj_t = jnp.zeros((n, na, h, w))
+    obj_t = obj_t.at[sel].max(jnp.where(responsible, score_w, 0.0))
+    obj_mask = jnp.zeros((n, na, h, w), jnp.bool_)
+    obj_mask = obj_mask.at[sel].max(responsible)
+
+    gx_grid = jnp.arange(w, dtype=jnp.float32)
+    gy_grid = jnp.arange(h, dtype=jnp.float32)
+    pbx = (px + gx_grid[None, None, None, :]) / w
+    pby = (py + gy_grid[None, None, :, None]) / h
+    pbw = jnp.exp(jnp.clip(pw, -10, 10)) * an[None, :, 0, None, None] \
+        / input_size
+    pbh = jnp.exp(jnp.clip(ph, -10, 10)) * an[None, :, 1, None, None] \
+        / input_size
+    # IoU of each predicted box vs each gt (center-size, normalized coords)
+    p1x = (pbx - pbw / 2)[..., None]
+    p1y = (pby - pbh / 2)[..., None]
+    p2x = (pbx + pbw / 2)[..., None]
+    p2y = (pby + pbh / 2)[..., None]
+    g1x = (gtb[..., 0] - gtb[..., 2] / 2)[:, None, None, None, :]
+    g1y = (gtb[..., 1] - gtb[..., 3] / 2)[:, None, None, None, :]
+    g2x = (gtb[..., 0] + gtb[..., 2] / 2)[:, None, None, None, :]
+    g2y = (gtb[..., 1] + gtb[..., 3] / 2)[:, None, None, None, :]
+    iw = jnp.maximum(jnp.minimum(p2x, g2x) - jnp.maximum(p1x, g1x), 0.0)
+    ih = jnp.maximum(jnp.minimum(p2y, g2y) - jnp.maximum(p1y, g1y), 0.0)
+    inter_pg = iw * ih
+    union_pg = (pbw * pbh)[..., None] + \
+        (gtb[..., 2] * gtb[..., 3])[:, None, None, None, :] - inter_pg
+    best_iou = jnp.max(jnp.where(valid[:, None, None, None, :],
+                                 inter_pg / jnp.maximum(union_pg, 1e-9),
+                                 0.0), axis=-1)  # [N,na,H,W]
+    ignore = (best_iou > ignore_thresh) & ~obj_mask
+
+    loss_obj = bce(jax.nn.sigmoid(pobj), obj_t)
+    loss_obj = jnp.where(ignore, 0.0,
+                         jnp.where(obj_mask | (obj_t == 0), loss_obj, 0.0))
+
+    smooth = 1.0 / class_num if use_label_smooth else 0.0
+    cls_t = jnp.full((n, b, class_num), smooth, jnp.float32)
+    lbl = jnp.clip(gt_label.astype(jnp.int32), 0, class_num - 1)
+    cls_t = cls_t.at[jnp.arange(n)[:, None], jnp.arange(b)[None, :], lbl] \
+        .set(1.0 - smooth)
+    pc = jax.nn.sigmoid(jnp.transpose(pcls, (0, 1, 3, 4, 2))[sel])
+    loss_cls = jnp.sum(bce(pc, cls_t), -1) * jnp.where(responsible, 1.0, 0.0)
+
+    total = (loss_xy.sum((1,)) + loss_wh.sum((1,))
+             + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1,)))
+    return total
+
+
+# ------------------------------------------------------- deformable conv
+
+@op()
+def deformable_conv(x, offset, weight, mask=None, strides=(1, 1),
+                    paddings=(0, 0), dilations=(1, 1),
+                    deformable_groups=1, groups=1, im2col_step=64):
+    """Deformable conv v1/v2 via bilinear-sampled im2col + matmul (MXU)."""
+    n, cin, h, w = x.shape
+    cout, cin_g, kh, kw = weight.shape
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    off = offset.astype(jnp.float32).reshape(
+        n, deformable_groups, kh * kw, 2, oh, ow)
+    base_y = (jnp.arange(oh) * sh - ph)[:, None] + \
+        (jnp.arange(kh) * dh)[None, :]  # [oh, kh]
+    base_x = (jnp.arange(ow) * sw - pw)[:, None] + \
+        (jnp.arange(kw) * dw)[None, :]  # [ow, kw]
+    ch_per_dg = cin // deformable_groups
+
+    def per_image(xi, offi, mi):
+        cols = []
+        for dg in range(deformable_groups):
+            feat = xi[dg * ch_per_dg:(dg + 1) * ch_per_dg].astype(jnp.float32)
+            ky = jnp.arange(kh)
+            kx = jnp.arange(kw)
+            # sample coords [kh,kw,oh,ow]
+            oy = offi[dg, :, 0].reshape(kh, kw, oh, ow)
+            ox = offi[dg, :, 1].reshape(kh, kw, oh, ow)
+            yy = base_y.T[:, None, :, None] + oy  # [kh,kw,oh,ow]
+            xx = base_x.T[None, :, None, :] + ox
+            valid = (yy > -1) & (yy < h) & (xx > -1) & (xx < w)
+            yyc = jnp.clip(yy, 0, h - 1)
+            xxc = jnp.clip(xx, 0, w - 1)
+            v = _roi_bilinear(feat, yyc.reshape(-1), xxc.reshape(-1))
+            v = v.reshape(ch_per_dg, kh, kw, oh, ow)
+            v = jnp.where(valid[None], v, 0.0)
+            if mi is not None:
+                mm = mi[dg].reshape(kh, kw, oh, ow)
+                v = v * mm[None]
+            cols.append(v)
+        return jnp.concatenate(cols, axis=0)  # [cin,kh,kw,oh,ow]
+
+    if mask is not None:
+        mi = mask.astype(jnp.float32).reshape(
+            n, deformable_groups, kh * kw, oh, ow)
+        col = jax.vmap(per_image)(x, off, mi)
+    else:
+        col = jax.vmap(lambda xi, offi: per_image(xi, offi, None))(x, off)
+    wmat = weight.reshape(cout, cin_g * kh * kw).astype(jnp.float32)
+    cpg = cin // groups
+    opg = cout // groups
+    outs = []
+    for g in range(groups):
+        cg = col[:, g * cpg:(g + 1) * cpg].reshape(n, cpg * kh * kw, oh * ow)
+        wg = wmat[g * opg:(g + 1) * opg]
+        outs.append(jnp.einsum("ok,nkl->nol", wg, cg))
+    out = jnp.concatenate(outs, axis=1).reshape(n, cout, oh, ow)
+    return out.astype(x.dtype)
+
+
+# --------------------------------------------------------------- fold etc.
+
+@op()
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im: x [N, C*kh*kw, L] → [N, C, H, W] (inverse of unfold)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    n, ckk, loc = x.shape
+    c = ckk // (kh * kw)
+    lh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    lw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    xr = x.reshape(n, c, kh, kw, lh, lw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i * dh
+            xs = j * dw
+            out = out.at[:, :, ys:ys + lh * sh:sh, xs:xs + lw * sw:sw].add(
+                xr[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+@op()
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w) \
+            .transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups) \
+        .transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Host-side JPEG decode (reference: paddle/phi/kernels/gpu/
+    decode_jpeg_kernel.cu uses nvjpeg; TPU has no device JPEG engine, so this
+    is a host op feeding the input pipeline)."""
+    import io as _io
+    data = np.asarray(x, dtype=np.uint8).tobytes()
+    try:
+        from PIL import Image  # noqa: PLC0415
+    except ImportError as e:  # pragma: no cover
+        raise NotImplementedError(
+            "decode_jpeg requires Pillow on the host") from e
+    img = Image.open(_io.BytesIO(data))
+    if mode != "unchanged":
+        img = img.convert(mode.upper() if mode != "gray" else "L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    from ..core.tensor import Tensor
+    return Tensor(jnp.asarray(arr))
+
+
+from .registry import register_external  # noqa: E402
+register_external("decode_jpeg", decode_jpeg)

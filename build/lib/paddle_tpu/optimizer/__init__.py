@@ -1,0 +1,20 @@
+"""paddle_tpu.optimizer (reference python/paddle/optimizer/)."""
+
+from . import lr  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from .optimizer import (  # noqa: F401
+    SGD,
+    Adadelta,
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+)

@@ -1,0 +1,182 @@
+"""SPMD pipeline parallelism: the microbatch loop compiled INTO the program.
+
+The reference drives 1F1B from the host (PipelineParallel at
+meta_parallel/pipeline_parallel.py:188, NCCL P2P per microbatch edge).  On TPU
+the whole schedule lives inside one XLA program: a ``shard_map`` manual only
+over the 'pp' mesh axis (dp/mp stay under GSPMD via ``axis_names``), a
+``lax.scan`` over schedule ticks, and ``lax.ppermute`` moving activations
+stage→stage over ICI.  ``jax.grad`` through the scan yields the reverse
+pipeline automatically — backward scheduling falls out of AD instead of being
+hand-written (the subtle part of the reference's interleaved 1F1B).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.random import key_stream
+
+
+def _layer_scan(block_fn, x, stacked_params, rng_key):
+    """Scan over stacked layers, threading a fresh dropout key per layer."""
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    keys = jax.random.split(rng_key, n_layers) if rng_key is not None else None
+
+    def body(h, xs):
+        if keys is None:
+            return block_fn(xs, h), None
+        lp, k = xs
+        with key_stream(k):
+            return block_fn(lp, h), None
+
+    xs = stacked_params if keys is None else (stacked_params, keys)
+    out, _ = lax.scan(body, x, xs)
+    return out
+
+
+def interleave_permutation(n_layers, pp, v):
+    """Layer order for the interleaved schedule: position (s, c, l) holds
+    layer (c*pp + s)*Lc + l, so a contiguous pp-split gives stage s its v
+    round-robin chunks.  Apply once at parameter-placement time; invert with
+    ``np.argsort`` to recover the canonical stacked layout."""
+    lc = n_layers // (pp * v)
+    return np.array([(c * pp + s) * lc + l
+                     for s in range(pp) for c in range(v) for l in range(lc)])
+
+
+def spmd_pipeline(block_fn, stacked_params, x, *, mesh, n_microbatches,
+                  axis="pp", rng_key=None, activation_spec=None,
+                  virtual_pp=1, prepermuted=False):
+    """Run ``x`` through pipeline stages inside the current jit trace.
+
+    Args:
+      block_fn: pure ``(layer_params, hidden) -> hidden`` for ONE layer.
+      stacked_params: pytree with leaves ``[num_layers, ...]`` — will be
+        split so each stage owns ``num_layers // pp`` consecutive layers
+        (``virtual_pp`` round-robin chunks per stage when > 1).
+      x: activations ``[batch, ...]`` (a global array; dp/mp shardings stay
+        under GSPMD).
+      n_microbatches: must divide batch.
+      virtual_pp: interleaved/virtual-pipeline degree v (reference
+        PipelineParallelWithInterleave, pipeline_parallel.py:565).  Stage s
+        owns layer chunks ``{c*pp + s : c < v}``; activations travel the
+        ring v times under the Megatron grouped schedule, so the pipeline
+        runs ``m*v + pp - 1`` ticks of ``1/v`` the per-tick work — same
+        bubble TICKS as fill-drain but ``v``× less bubble TIME.  The
+        backward schedule falls out of AD through the scan, as for v=1.
+    Returns activations after all layers, same shape as x.
+    """
+    pp = mesh.shape[axis]
+    v = int(virtual_pp)
+    assert v >= 1, f"virtual_pp must be >= 1, got {virtual_pp}"
+    n_layers = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if pp == 1:
+        return _layer_scan(block_fn, x, stacked_params, rng_key)
+
+    m = n_microbatches
+    batch = x.shape[0]
+    assert batch % m == 0, f"batch {batch} not divisible by microbatches {m}"
+    assert n_layers % (pp * v) == 0, \
+        f"num_layers {n_layers} not divisible by pp*virtual_pp {pp}*{v}"
+    if v > 1:
+        assert m % pp == 0, \
+            (f"interleaved schedule needs n_microbatches ({m}) divisible by "
+             f"pp ({pp}) — microbatches advance chunks in groups of pp")
+
+    layers_per_chunk = n_layers // (pp * v)
+    ticks_per_stage = m * v
+    total_ticks = m * v + pp - 1
+
+    if v > 1 and not prepermuted:
+        # Re-order the stacked layers so a contiguous pp-split hands stage s
+        # its v round-robin chunks: position (s, c, l) <- layer (c*pp+s)*Lc+l.
+        # NOTE: inside a jit trace this gather crosses pipeline stages every
+        # step — long-lived callers should permute once at setup with
+        # interleave_permutation() and pass prepermuted=True (SpmdTrainStep
+        # does).
+        stacked_params = jax.tree_util.tree_map(
+            lambda leaf: leaf[interleave_permutation(n_layers, pp, v)],
+            stacked_params)
+
+    def stage_fn(local_params, x_local):
+        # local_params leaves: [v * layers_per_chunk, ...]; x_local: [m, mb,…]
+        stage = lax.axis_index(axis)
+        chunked = jax.tree_util.tree_map(
+            lambda leaf: leaf.reshape((v, layers_per_chunk) + leaf.shape[1:]),
+            local_params)
+        stage_key = (jax.random.fold_in(rng_key, stage)
+                     if rng_key is not None else None)
+
+        def run_chunk(h, c, tick):
+            params_c = jax.tree_util.tree_map(
+                lambda leaf: lax.dynamic_index_in_dim(leaf, c, 0,
+                                                      keepdims=False),
+                chunked)
+            k = (jax.random.fold_in(stage_key, tick)
+                 if stage_key is not None else None)
+            return _layer_scan(block_fn, h, params_c, k)
+
+        state = jnp.zeros_like(x_local[0])
+        outputs = jnp.zeros_like(x_local)
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage-local tick u decodes to (group g, chunk c, slot j):
+            # every stage agrees on the decode, so the activation for
+            # (microbatch g*pp+j, chunk c) moves one stage per global tick
+            # and wraps from stage pp-1 back to stage 0 as chunk c+1.
+            u = jnp.clip(t - stage, 0, ticks_per_stage - 1)
+            r = u % (v * pp)
+            c = r // pp
+            mb = (u // (v * pp)) * pp + (r % pp)
+            # stage 0 ingests fresh microbatches on chunk-0 ticks
+            inject = x_local[mb]
+            state = jnp.where((stage == 0) & (c == 0), inject, state)
+            out = run_chunk(state, c, t)
+            # last stage emits on last-chunk ticks
+            active = (t >= stage) & (t - stage < ticks_per_stage)
+            valid = (stage == pp - 1) & (c == v - 1) & active
+            outputs = jnp.where(
+                valid,
+                lax.dynamic_update_index_in_dim(outputs, out, mb, 0),
+                outputs)
+            state = lax.ppermute(out, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(tick, (state, outputs),
+                                       jnp.arange(total_ticks))
+        # replicate the last stage's outputs to every stage
+        outputs = lax.psum(
+            jnp.where(stage == pp - 1, outputs, jnp.zeros_like(outputs)),
+            axis)
+        return outputs
+
+    mapped = jax.shard_map(
+        stage_fn, mesh=mesh,
+        in_specs=(jax.tree_util.tree_map(lambda _: P(axis), stacked_params),
+                  P()),
+        out_specs=P(),
+        axis_names=frozenset({axis}),
+        check_vma=False)
+
+    x_micro = x.reshape((m, batch // m) + x.shape[1:])
+    if activation_spec is not None:
+        # Keep the caller's activation sharding (e.g. dp on batch, mp on
+        # seq) on the microbatched layout instead of clobbering it — a
+        # mismatched constraint here cannot be transposed by XLA in the
+        # backward pass and triggers involuntary full rematerialization.
+        micro_spec = P(None, *activation_spec)
+        x_micro = lax.with_sharding_constraint(
+            x_micro, jax.sharding.NamedSharding(mesh, micro_spec))
+    elif "dp" in mesh.axis_names:
+        x_micro = lax.with_sharding_constraint(
+            x_micro, jax.sharding.NamedSharding(
+                mesh, P(None, "dp", *([None] * (x_micro.ndim - 2)))))
+    out = mapped(stacked_params, x_micro)
+    return out.reshape(x.shape)

@@ -1,0 +1,188 @@
+"""paddle.quantization parity: QAT (fake-quant) and PTQ (observers).
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT/PTQ drivers,
+quanters, observers).  TPU note: fake-quant is pure elementwise math, so it
+fuses into the surrounding XLA program; int8 deployment uses the quantized
+weights produced by ``convert``.
+"""
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..ops.registry import op
+
+
+@op("fake_quant_dequant")
+def _fake_quant(x, scale, bits=8):
+    qmax = 2.0 ** (bits - 1) - 1
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+class BaseQuanter:
+    def __init__(self, quant_bits=8):
+        self.bits = quant_bits
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT quanter: dynamic abs-max + moving average (reference
+    quanters/abs_max.py)."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8, dtype="float32"):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def __call__(self, x):
+        import jax
+
+        data = x._data if isinstance(x, Tensor) else x
+        absmax_t = jnp.max(jnp.abs(data))
+        if isinstance(absmax_t, jax.core.Tracer):
+            # Under a jit/to_static trace the scale must stay a traced array
+            # (float() would raise ConcretizationTypeError) and the Python
+            # moving-average state must not capture tracers: quantize with
+            # the current batch's abs-max and leave the eager-side moving
+            # average untouched.
+            scale = jnp.maximum(absmax_t.astype(jnp.float32), 1e-9)
+            return _fake_quant(x, scale, bits=self.bits)
+        absmax = float(absmax_t)
+        if self._scale is None:
+            self._scale = absmax
+        else:
+            self._scale = (self.moving_rate * self._scale
+                           + (1 - self.moving_rate) * absmax)
+        return _fake_quant(x, jnp.float32(max(self._scale, 1e-9)),
+                           bits=self.bits)
+
+    def scales(self):
+        return self._scale
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ observer: running abs-max, no fake-quant in forward (reference
+    observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__(quant_bits)
+        self._max = 0.0
+
+    def __call__(self, x):
+        import jax
+
+        data = x._data if isinstance(x, Tensor) else x
+        absmax_t = jnp.max(jnp.abs(data))
+        if isinstance(absmax_t, jax.core.Tracer):
+            return x  # PTQ calibration is an eager pass; no-op under trace
+        self._max = max(self._max, float(absmax_t))
+        return x
+
+    def scales(self):
+        return self._max
+
+
+class QuantConfig:
+    """Maps layer types / instances to (activation, weight) quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = (activation, weight)
+        self._by_type = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._by_type[t] = (activation, weight)
+
+    def factory_for(self, layer):
+        for t, fac in self._by_type.items():
+            if isinstance(layer, t):
+                return fac
+        return self._global
+
+
+class QuantedLayer(Layer):
+    """Wraps a layer with activation/weight fake-quant."""
+
+    def __init__(self, inner, act_quanter, weight_quanter):
+        super().__init__()
+        self._inner = inner
+        self._act_q = act_quanter
+        self._w_q = weight_quanter
+
+    def forward(self, x):
+        if self._act_q is not None:
+            x = self._act_q(x)
+        if self._w_q is not None and hasattr(self._inner, "weight"):
+            w = self._inner.weight
+            orig = w._data
+            quanted = self._w_q(w)
+            if isinstance(quanted, Tensor):
+                w._data = quanted._data
+            try:
+                out = self._inner(x)
+            finally:
+                w._data = orig
+            return out
+        return self._inner(x)
+
+    def state_dict(self, *a, **k):
+        return self._inner.state_dict(*a, **k)
+
+
+def _wrap_model(model, config, quanter_is_observer):
+    from ..nn import Conv2D, Linear
+
+    for name, sub in list(model.named_sublayers()):
+        if isinstance(sub, (Linear, Conv2D)):
+            act_f, w_f = config.factory_for(sub)
+            act_q = act_f() if callable(act_f) else act_f
+            w_q = w_f() if callable(w_f) else w_f
+            wrapped = QuantedLayer(sub, act_q, w_q)
+            parent = model
+            parts = name.split(".")
+            for p in parts[:-1]:
+                parent = getattr(parent, p)
+            setattr(parent, parts[-1], wrapped)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference quantization/qat.py)."""
+
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=True):
+        return _wrap_model(model, self._config, False)
+
+    def convert(self, model, inplace=True):
+        return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference quantization/ptq.py)."""
+
+    def __init__(self, config):
+        self._config = config
+
+    def quantize(self, model, inplace=True):
+        return _wrap_model(model, self._config, True)
+
+    def convert(self, model, inplace=True):
+        """Bake observed scales into int8 weights + dequant scale."""
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, QuantedLayer) and sub._w_q is not None and \
+                    hasattr(sub._inner, "weight"):
+                scale = sub._w_q.scales() if sub._w_q.scales() else None
+                if scale:
+                    w = sub._inner.weight
+                    qmax = 2.0 ** (sub._w_q.bits - 1) - 1
+                    q = jnp.clip(jnp.round(w._data / scale * qmax),
+                                 -qmax, qmax)
+                    w._data = (q * scale / qmax).astype(w._data.dtype)
+        return model

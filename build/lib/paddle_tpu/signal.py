@@ -1,0 +1,128 @@
+"""paddle.signal parity: frame / overlap_add / stft / istft
+(reference python/paddle/signal.py)."""
+
+import jax.numpy as jnp
+
+from .ops.registry import op
+
+
+@op("frame")
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice ``x`` into overlapping frames along ``axis``.
+
+    paddle layout: the frame axis pair replaces ``axis`` —
+    axis=-1 -> [..., frame_length, num_frames]; axis=0 ->
+    [num_frames, frame_length, ...].
+    """
+    front = axis in (0,)
+    work = jnp.moveaxis(x, 0, -1) if front else x
+    if axis not in (-1, 0, x.ndim - 1):
+        work = jnp.moveaxis(x, axis, -1)
+    n = work.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    out = work[..., idx]                    # [..., num_frames, frame_length]
+    if front:
+        # -> [num_frames, frame_length, ...]
+        return jnp.moveaxis(jnp.moveaxis(out, -2, 0), -1, 1)
+    return jnp.moveaxis(out, -2, -1)        # [..., frame_length, num_frames]
+
+
+@op("overlap_add")
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame.  axis=-1: x [..., frame_length, num_frames];
+    axis=0: x [num_frames, frame_length, ...]."""
+    if axis in (0,):
+        # -> [..., frame_length, num_frames]
+        work = jnp.moveaxis(jnp.moveaxis(x, 0, -1), 0, -2)
+    else:
+        work = x
+    frame_length = work.shape[-2]
+    num_frames = work.shape[-1]
+    n = (num_frames - 1) * hop_length + frame_length
+    out = jnp.zeros(work.shape[:-2] + (n,), dtype=work.dtype)
+    for f in range(num_frames):
+        out = out.at[..., f * hop_length:f * hop_length + frame_length].add(
+            work[..., :, f])
+    if axis in (0,):
+        return jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def _padded_window(window, win_length, n_fft, like):
+    if window is None:
+        return jnp.ones((n_fft,), like)
+    w = window
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+    return w
+
+
+@op("stft")
+def _stft_impl(x, window, n_fft, hop_length, win_length, center, pad_mode,
+               normalized, onesided):
+    xd = x
+    if center:
+        pad = n_fft // 2
+        xd = jnp.pad(xd, [(0, 0)] * (xd.ndim - 1) + [(pad, pad)],
+                     mode=pad_mode)
+    n = xd.shape[-1]
+    num_frames = 1 + (n - n_fft) // hop_length
+    idx = (jnp.arange(n_fft)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])
+    frames = xd[..., idx]                      # [..., num_frames, n_fft]
+    if window is not None:
+        frames = frames * _padded_window(window, win_length, n_fft,
+                                         frames.dtype)
+    spec = jnp.fft.rfft(frames, axis=-1) if onesided else \
+        jnp.fft.fft(frames, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    # paddle layout: [..., n_fft/2+1, num_frames]
+    return jnp.swapaxes(spec, -1, -2)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (reference signal.py stft)."""
+    return _stft_impl(x, window, n_fft, hop_length or n_fft // 4,
+                      win_length or n_fft, center, pad_mode, normalized,
+                      onesided)
+
+
+@op("istft")
+def _istft_impl(x, window, n_fft, hop_length, win_length, center,
+                normalized, onesided, length):
+    spec = jnp.swapaxes(x, -1, -2)            # [..., num_frames, bins]
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    frames = jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided else \
+        jnp.fft.ifft(spec, axis=-1).real
+    w = _padded_window(window, win_length, n_fft, frames.dtype)
+    frames = frames * w
+    num_frames = frames.shape[-2]
+    n = (num_frames - 1) * hop_length + n_fft
+    out = jnp.zeros(frames.shape[:-2] + (n,), dtype=frames.dtype)
+    wsq = jnp.zeros((n,), dtype=frames.dtype)
+    for f in range(num_frames):
+        sl = slice(f * hop_length, f * hop_length + n_fft)
+        out = out.at[..., sl].add(frames[..., f, :])
+        wsq = wsq.at[sl].add(w * w)
+    out = out / jnp.maximum(wsq, 1e-11)
+    if center:
+        pad = n_fft // 2
+        out = out[..., pad:n - pad]
+    if length is not None:
+        out = out[..., :length]
+    return out
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    return _istft_impl(x, window, n_fft, hop_length or n_fft // 4,
+                       win_length or n_fft, center, normalized, onesided,
+                       length)

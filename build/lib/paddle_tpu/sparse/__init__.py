@@ -1,0 +1,166 @@
+"""paddle.sparse parity (reference python/paddle/sparse/ over the COO/CSR
+kernels at paddle/phi/kernels/sparse/).
+
+TPU redesign: sparse tensors wrap ``jax.experimental.sparse.BCOO`` — XLA
+compiles scatter/gather-based sparse math natively.  The reference's
+SparseCooTensor/SparseCsrTensor API shape (indices/values/to_dense/...) is
+kept on a ``SparseTensor`` wrapper.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+class SparseTensor:
+    """COO sparse tensor handle (``paddle.sparse.sparse_coo_tensor`` result).
+
+    Backed by BCOO; ``.indices()``/``.values()`` match the reference layout
+    (indices [sparse_ndim, nnz])."""
+
+    def __init__(self, bcoo, fmt="coo"):
+        self._bcoo = bcoo
+        self._fmt = fmt
+
+    # -------- reference accessors --------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def indices(self):
+        return Tensor(self._bcoo.indices.T)
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self):
+        return self._fmt == "csr"
+
+    def coalesce(self):
+        return SparseTensor(self._bcoo.sum_duplicates(), self._fmt)
+
+    # -------- csr view --------
+    def crows(self):
+        indices = np.asarray(self._bcoo.indices)
+        rows = indices[:, 0]
+        nrows = self.shape[0]
+        crows = np.zeros(nrows + 1, dtype=np.int64)
+        for r in rows:
+            crows[r + 1] += 1
+        return Tensor(jnp.asarray(np.cumsum(crows)))
+
+    def cols(self):
+        return Tensor(self._bcoo.indices[:, 1])
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"format={self._fmt})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    val = values._data if isinstance(values, Tensor) else jnp.asarray(values)
+    if dtype is not None:
+        from ..framework.dtype import convert_dtype
+        val = val.astype(convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(jnp.max(idx, axis=1)))
+        shape = shape + val.shape[1:]
+    bcoo = jsparse.BCOO((val, idx.T), shape=tuple(shape))
+    return SparseTensor(bcoo, "coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    crows_np = np.asarray(crows._data if isinstance(crows, Tensor) else crows)
+    cols_np = np.asarray(cols._data if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    indices = jnp.asarray(np.stack([rows, cols_np]))
+    t = sparse_coo_tensor(indices, values, shape, dtype=dtype)
+    t._fmt = "csr"
+    return t
+
+
+def _unary(name, fn):
+    def impl(x):
+        if isinstance(x, SparseTensor):
+            b = x._bcoo
+            return SparseTensor(
+                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape), x._fmt)
+        return Tensor(fn(x._data if isinstance(x, Tensor) else x))
+    impl.__name__ = name
+    return impl
+
+
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+abs = _unary("abs", jnp.abs)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+cast = lambda x, dtype: _unary("cast", lambda v: v.astype(dtype))(x)  # noqa: E731
+
+
+def matmul(a, b):
+    """sparse @ dense (reference sparse.matmul)."""
+    bd = b._data if isinstance(b, Tensor) else b
+    if isinstance(a, SparseTensor):
+        return Tensor(a._bcoo @ bd)
+    ad = a._data if isinstance(a, Tensor) else a
+    return Tensor(ad @ b._bcoo.todense() if isinstance(b, SparseTensor)
+                  else ad @ bd)
+
+
+def masked_matmul(a, b, mask):
+    """dense@dense evaluated only at mask's nonzeros (reference
+    sparse.masked_matmul)."""
+    ad = a._data if isinstance(a, Tensor) else a
+    bd = b._data if isinstance(b, Tensor) else b
+    dense = ad @ bd
+    idx = mask._bcoo.indices
+    vals = dense[idx[:, 0], idx[:, 1]]
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape),
+                        "coo")
+
+
+def add(a, b):
+    if isinstance(a, SparseTensor) and isinstance(b, SparseTensor):
+        out = jsparse.BCOO(
+            (jnp.concatenate([a._bcoo.data, b._bcoo.data]),
+             jnp.concatenate([a._bcoo.indices, b._bcoo.indices])),
+            shape=a._bcoo.shape).sum_duplicates()
+        return SparseTensor(out, a._fmt)
+    raise TypeError("sparse.add expects two sparse tensors")
+
+
+def is_same_shape(a, b):
+    return list(a.shape) == list(b.shape)
+
+
+class nn:
+    """paddle.sparse.nn subset: ReLU layer."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)

@@ -1,0 +1,103 @@
+"""paddle.static surface (reference python/paddle/static/).
+
+The reference's ProgramDesc static graph is replaced by XLA: ``to_static``
+traces to a jaxpr and compiles (SURVEY §7.4 — the pass zoo dissolves into
+the compiler).  What remains meaningful on TPU is kept functional:
+InputSpec, save/load_inference_model (jit.save-backed), and an Executor
+that runs compiled callables.  Program-construction APIs raise with
+guidance instead of silently doing nothing.
+"""
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+class InputSpec:
+    """reference paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name=name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Save a model for inference.  ``fetch_vars`` may be a Layer (the
+    TPU-native path) — serialized via jit.save and loadable by
+    paddle.inference.create_predictor."""
+    from ..jit import save as jit_save
+    from ..nn.layer_base import Layer
+
+    target = None
+    for cand in ([fetch_vars] if not isinstance(fetch_vars, (list, tuple))
+                 else fetch_vars):
+        if isinstance(cand, Layer):
+            target = cand
+            break
+    if target is None and isinstance(program, Layer):
+        target = program
+    if target is None:
+        raise TypeError(
+            "save_inference_model on TPU serializes a Layer (pass the model "
+            "as fetch_vars); ProgramDesc graphs do not exist here — build "
+            "with paddle_tpu.jit.to_static instead.")
+    jit_save(target, path_prefix)
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Returns (program, feed_names, fetch_names) shaped like the reference;
+    ``program`` is a callable TranslatedLayer."""
+    from ..jit import load as jit_load
+
+    layer = jit_load(path_prefix)
+    return layer, ["x0"], ["out0"]
+
+
+class Executor:
+    """Runs callables (TranslatedLayer / to_static functions) — the
+    InterpreterCore analog is the compiled XLA executable inside them."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if not callable(program):
+            raise TypeError(
+                "static.Executor on TPU runs callables (a loaded "
+                "TranslatedLayer or to_static function); legacy ProgramDesc "
+                "execution does not exist")
+        feed = feed or {}
+        args = [Tensor(v) if not isinstance(v, Tensor) else v
+                for v in feed.values()]
+        out = program(*args)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        return [np.asarray(o._data if isinstance(o, Tensor) else o)
+                for o in outs]
+
+
+def _no_static(name):
+    def stub(*a, **k):
+        raise NotImplementedError(
+            f"paddle.static.{name} builds ProgramDesc graphs, which this "
+            "TPU-native framework intentionally does not have; decorate "
+            "with paddle_tpu.jit.to_static to compile (XLA owns the graph).")
+    stub.__name__ = name
+    return stub
+
+
+program_guard = _no_static("program_guard")
+default_main_program = _no_static("default_main_program")
+default_startup_program = _no_static("default_startup_program")
+data = _no_static("data")
+Program = _no_static("Program")

@@ -1,0 +1,8 @@
+"""paddle_tpu.vision (reference python/paddle/vision/).
+
+Model zoo (resnet/vgg/mobilenet) + transforms + datasets.  Round 1 carries the
+resnet family; the rest of the zoo widens in later rounds.
+"""
+
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401

@@ -1,0 +1,18 @@
+"""Vision model zoo (reference python/paddle/vision/models/)."""
+
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .vit import (  # noqa: F401
+    VisionTransformer,
+    vit_base_patch16_224,
+    vit_large_patch16_224,
+    vit_tiny,
+)

@@ -1,0 +1,475 @@
+// Parameter-server RPC service — multi-host sparse tables over DCN.
+//
+// Role parity with the reference's brpc PS data plane
+// (paddle/fluid/distributed/ps/service/brpc_ps_client.cc /
+// brpc_ps_server.cc): trainers pull/push embedding rows from table shards
+// hosted on remote processes.  Design here is new and much smaller: a
+// blocking thread-per-connection TCP server speaking length-prefixed
+// binary frames directly over the pd_table_* C ABI (sparse_table.cc), with
+// key->server sharding done by the client layer (key % num_servers).
+//
+// Wire format (little-endian):
+//   request : u8 opcode | u64 payload_len | payload
+//     PULL payload: i64 n | i64 keys[n]
+//     PUSH payload: u8 opt(0 sgd,1 adagrad) | f32 lr | f32 eps
+//                   | i64 n | i64 keys[n] | f32 grads[n*dim]
+//     SAVE/LOAD payload: path bytes
+//     SIZE/DIM payload: none
+//   response: i32 rc(0 ok) | u64 data_len | data
+#include "paddle_native.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum PsOp : uint8_t {
+  kPull = 1,
+  kPush = 2,
+  kSave = 3,
+  kLoad = 4,
+  kSize = 5,
+  kDim = 6,
+};
+
+constexpr uint64_t kMaxPayload = 1ull << 32;  // 4 GiB per request
+
+thread_local std::string g_ps_error;
+void ps_error(const std::string& m) { g_ps_error = m; }
+
+bool io_send_all(int fd, const void* data, size_t len) {
+  const char* p = static_cast<const char*>(data);
+  while (len) {
+    ssize_t n = send(fd, p, len, MSG_NOSIGNAL);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+bool io_recv_all(int fd, void* data, size_t len, int timeout_ms) {
+  char* p = static_cast<char*>(data);
+  while (len) {
+    if (timeout_ms > 0) {
+      pollfd pfd{fd, POLLIN, 0};
+      int r = poll(&pfd, 1, timeout_ms);
+      if (r == 0) { ps_error("ps recv timeout"); return false; }
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        return false;
+      }
+    }
+    ssize_t n = recv(fd, p, len, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += n;
+    len -= n;
+  }
+  return true;
+}
+
+// One record per connection.  The handler thread never closes its fd: it
+// marks `done` and the pruner (accept loop, or stop()) closes the fd after
+// joining the thread — so a stale fd number can never be shutdown() after
+// the kernel recycled it for an unrelated descriptor.
+struct ConnRec {
+  int fd = -1;
+  std::atomic<bool> done{false};
+  std::thread th;
+};
+
+struct PsServer {
+  void* table = nullptr;  // borrowed pd_table handle (not owned)
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stopping{false};
+  std::thread accept_thread;
+  std::mutex conn_mu;
+  std::vector<ConnRec*> conns;
+};
+
+void reply(int fd, int32_t rc, const void* data, uint64_t len) {
+  std::string hdr;
+  hdr.append(reinterpret_cast<char*>(&rc), 4);
+  hdr.append(reinterpret_cast<char*>(&len), 8);
+  if (!io_send_all(fd, hdr.data(), hdr.size())) return;
+  if (len) io_send_all(fd, data, len);
+}
+
+void handle_conn(PsServer* s, ConnRec* rec) try {
+  int fd = rec->fd;
+  int dim = pd_table_dim(s->table);
+  // per-request row cap: keys fit the payload (plen/8) AND the pull reply
+  // buffer stays under ~2 GiB of floats
+  const uint64_t kMaxRowFloats = 1ull << 29;
+  std::vector<char> payload;
+  while (!s->stopping.load()) {
+    uint8_t op;
+    uint64_t plen;
+    if (!io_recv_all(fd, &op, 1, 0)) break;
+    if (!io_recv_all(fd, &plen, 8, 0)) break;
+    if (plen > kMaxPayload) break;  // corrupt stream
+    payload.resize(plen);
+    if (plen && !io_recv_all(fd, payload.data(), plen, 0)) break;
+
+    switch (op) {
+      case kPull: {
+        if (plen < 8) { reply(fd, -3, nullptr, 0); break; }
+        int64_t n;
+        memcpy(&n, payload.data(), 8);
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 8 ||
+            plen != 8 + static_cast<uint64_t>(n) * 8 ||
+            static_cast<uint64_t>(n) * dim > kMaxRowFloats) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* keys =
+            reinterpret_cast<const int64_t*>(payload.data() + 8);
+        std::vector<float> rows(static_cast<size_t>(n) * dim);
+        pd_table_pull(s->table, keys, n, rows.data());
+        reply(fd, 0, rows.data(), rows.size() * sizeof(float));
+        break;
+      }
+      case kPush: {
+        if (plen < 1 + 4 + 4 + 8) { reply(fd, -3, nullptr, 0); break; }
+        uint8_t opt = static_cast<uint8_t>(payload[0]);
+        float lr, eps;
+        int64_t n;
+        memcpy(&lr, payload.data() + 1, 4);
+        memcpy(&eps, payload.data() + 5, 4);
+        memcpy(&n, payload.data() + 9, 8);
+        // bound n by the payload BEFORE computing sizes so the uint64
+        // arithmetic below cannot wrap on a crafted frame
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 8 ||
+            static_cast<uint64_t>(n) * dim > kMaxRowFloats) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        uint64_t want = 17 + static_cast<uint64_t>(n) * 8 +
+                        static_cast<uint64_t>(n) * dim * 4;
+        if (plen != want) { reply(fd, -3, nullptr, 0); break; }
+        const int64_t* keys =
+            reinterpret_cast<const int64_t*>(payload.data() + 17);
+        const float* grads =
+            reinterpret_cast<const float*>(payload.data() + 17 + n * 8);
+        if (opt == 0)
+          pd_table_push_sgd(s->table, keys, grads, n, lr);
+        else
+          pd_table_push_adagrad(s->table, keys, grads, n, lr, eps);
+        reply(fd, 0, nullptr, 0);
+        break;
+      }
+      case kSave: {
+        std::string path(payload.data(), plen);
+        int rc = pd_table_save(s->table, path.c_str());
+        reply(fd, rc, nullptr, 0);
+        break;
+      }
+      case kLoad: {
+        std::string path(payload.data(), plen);
+        int rc = pd_table_load(s->table, path.c_str());
+        reply(fd, rc, nullptr, 0);
+        break;
+      }
+      case kSize: {
+        int64_t sz = pd_table_size(s->table);
+        reply(fd, 0, &sz, 8);
+        break;
+      }
+      case kDim: {
+        int32_t d = dim;
+        reply(fd, 0, &d, 4);
+        break;
+      }
+      default:
+        reply(fd, -2, nullptr, 0);
+    }
+  }
+  rec->done.store(true);  // fd closed by the pruner after join
+} catch (...) {
+  // never let bad_alloc (oversized frame) escape the thread and terminate
+  // the PS host; drop this connection only
+  rec->done.store(true);
+}
+
+// join + close + erase finished connections (caller holds conn_mu)
+void prune_conns(PsServer* s) {
+  for (size_t i = 0; i < s->conns.size();) {
+    ConnRec* rec = s->conns[i];
+    if (rec->done.load()) {
+      if (rec->th.joinable()) rec->th.join();
+      if (rec->fd >= 0) close(rec->fd);
+      delete rec;
+      s->conns.erase(s->conns.begin() + i);
+    } else {
+      ++i;
+    }
+  }
+}
+
+void accept_loop(PsServer* s) {
+  while (!s->stopping.load()) {
+    pollfd pfd{s->listen_fd, POLLIN, 0};
+    int r = poll(&pfd, 1, 500);
+    {
+      std::lock_guard<std::mutex> lk(s->conn_mu);
+      prune_conns(s);
+    }
+    if (r <= 0) continue;
+    int fd = accept(s->listen_fd, nullptr, nullptr);
+    if (fd < 0) continue;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    auto* rec = new ConnRec;
+    rec->fd = fd;
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    s->conns.push_back(rec);
+    rec->th = std::thread(handle_conn, s, rec);
+  }
+}
+
+struct PsClient {
+  int fd = -1;
+  int timeout_ms = 30000;
+  int dim = 0;
+};
+
+// one request/response; any failure poisons the connection (stream desync)
+bool ps_request(PsClient* c, uint8_t op, const std::string& payload,
+                int32_t* rc, std::string* data) {
+  if (c->fd < 0) {
+    ps_error("ps connection previously failed");
+    return false;
+  }
+  std::string req;
+  req.push_back(static_cast<char>(op));
+  uint64_t plen = payload.size();
+  req.append(reinterpret_cast<char*>(&plen), 8);
+  req.append(payload);
+  if (!io_send_all(c->fd, req.data(), req.size())) {
+    close(c->fd);
+    c->fd = -1;
+    return false;
+  }
+  int32_t code;
+  uint64_t dlen;
+  if (!io_recv_all(c->fd, &code, 4, c->timeout_ms) ||
+      !io_recv_all(c->fd, &dlen, 8, c->timeout_ms) || dlen > kMaxPayload) {
+    close(c->fd);
+    c->fd = -1;
+    return false;
+  }
+  data->resize(dlen);
+  if (dlen && !io_recv_all(c->fd, &data->front(), dlen, c->timeout_ms)) {
+    close(c->fd);
+    c->fd = -1;
+    return false;
+  }
+  *rc = code;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_ps_server_start(void* table, int port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) { ps_error("socket failed"); return nullptr; }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0 ||
+      listen(fd, 64) < 0) {
+    ps_error(std::string("bind/listen: ") + strerror(errno));
+    close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof addr;
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new PsServer;
+  s->table = table;
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s;
+}
+
+int pd_ps_server_port(void* server) {
+  return server ? static_cast<PsServer*>(server)->port : -1;
+}
+
+void pd_ps_server_stop(void* server) {
+  if (!server) return;
+  auto* s = static_cast<PsServer*>(server);
+  s->stopping.store(true);
+  // join the accept thread FIRST so no new connection can slip in after we
+  // shut the existing ones down (the late-accept handler would otherwise
+  // block forever in recv and hang the join below)
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(s->conn_mu);
+    // unblock live handlers stuck in recv; their fds are still owned by
+    // the ConnRec (handlers never close fds), so no recycled-fd hazard
+    for (ConnRec* rec : s->conns)
+      if (!rec->done.load() && rec->fd >= 0) shutdown(rec->fd, SHUT_RDWR);
+    for (ConnRec* rec : s->conns)
+      if (rec->th.joinable()) rec->th.join();
+    for (ConnRec* rec : s->conns) {
+      if (rec->fd >= 0) close(rec->fd);
+      delete rec;
+    }
+    s->conns.clear();
+  }
+  close(s->listen_fd);
+  delete s;  // table is borrowed; caller destroys it
+}
+
+void* pd_ps_client_connect(const char* host, int port, int timeout_ms) {
+  // reuse the store client's retrying connector semantics via a plain
+  // blocking connect loop (servers may come up after trainers)
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) {
+    ps_error(std::string("getaddrinfo failed for ") + host);
+    return nullptr;
+  }
+  int fd = -1;
+  int waited = 0;
+  while (true) {
+    fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+    if (fd < 0) { freeaddrinfo(res); return nullptr; }
+    if (connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    close(fd);
+    fd = -1;
+    if (waited >= timeout_ms) {
+      ps_error(std::string("ps connect timeout to ") + host + ":" + portstr);
+      freeaddrinfo(res);
+      return nullptr;
+    }
+    usleep(200 * 1000);
+    waited += 200;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  auto* c = new PsClient;
+  c->fd = fd;
+  c->timeout_ms = timeout_ms;
+  // cache table dim
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kDim, "", &rc, &data) || rc != 0 || data.size() != 4) {
+    close(c->fd);
+    delete c;
+    ps_error("ps dim handshake failed");
+    return nullptr;
+  }
+  memcpy(&c->dim, data.data(), 4);
+  return c;
+}
+
+void pd_ps_client_close(void* client) {
+  if (!client) return;
+  auto* c = static_cast<PsClient*>(client);
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+}
+
+int pd_ps_client_dim(void* client) {
+  return client ? static_cast<PsClient*>(client)->dim : -1;
+}
+
+int64_t pd_ps_client_size(void* client) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kSize, "", &rc, &data) || rc != 0 || data.size() != 8)
+    return -1;
+  int64_t sz;
+  memcpy(&sz, data.data(), 8);
+  return sz;
+}
+
+int pd_ps_client_pull(void* client, const int64_t* keys, int64_t n,
+                      float* out) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(keys), n * 8);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kPull, payload, &rc, &data)) return -1;
+  if (rc != 0) return rc;
+  if (data.size() != static_cast<size_t>(n) * c->dim * 4) return -4;
+  memcpy(out, data.data(), data.size());
+  return 0;
+}
+
+int pd_ps_client_push(void* client, int opt, const int64_t* keys,
+                      const float* grads, int64_t n, float lr, float eps) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.push_back(static_cast<char>(opt));
+  payload.append(reinterpret_cast<const char*>(&lr), 4);
+  payload.append(reinterpret_cast<const char*>(&eps), 4);
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(keys), n * 8);
+  payload.append(reinterpret_cast<const char*>(grads),
+                 static_cast<size_t>(n) * c->dim * 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kPush, payload, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_save(void* client, const char* path) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kSave, path, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_load(void* client, const char* path) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kLoad, path, &rc, &data)) return -1;
+  return rc;
+}
+
+char* pd_ps_last_error(void) {
+  char* out = static_cast<char*>(malloc(g_ps_error.size() + 1));
+  memcpy(out, g_ps_error.c_str(), g_ps_error.size() + 1);
+  return out;
+}
+
+}  // extern "C"

@@ -1,0 +1,276 @@
+"""Multi-host parameter-server service — key-sharded tables over TCP/DCN.
+
+Reference parity: the brpc PS data plane
+(paddle/fluid/distributed/ps/service/brpc_ps_client.cc,
+brpc_ps_server.cc) serving memory_sparse_table shards
+(paddle/fluid/distributed/ps/table/memory_sparse_table.cc:1071), with
+the_one_ps.py orchestrating server/worker roles.
+
+TPU redesign: each PS host runs a native C++ table + RPC server
+(native/ps_service.cc) on the TPU-VM CPUs; trainers hold one native client
+per server and shard keys by ``key % num_servers``.  Discovery rides the
+existing TCPStore rendezvous (servers publish "ps/server/{i}" endpoints).
+The device only ever sees dense pulled rows; optimizer state for the
+sparse parameters lives in the tables (SGD/Adagrad accessors in-table).
+"""
+
+import ctypes
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ...core import native as _native
+from . import SparseTable, _f32p, _i64p
+
+
+def _lib_ps():
+    lib = _native.load()
+    if lib is None:
+        raise RuntimeError("native library unavailable; the PS service "
+                           "requires the C++ runtime (g++)")
+    if not hasattr(lib.pd_ps_server_start, "_bound"):
+        lib.pd_ps_server_start.restype = ctypes.c_void_p
+        lib.pd_ps_server_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pd_ps_server_port.restype = ctypes.c_int
+        lib.pd_ps_server_port.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_client_connect.restype = ctypes.c_void_p
+        lib.pd_ps_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                             ctypes.c_int]
+        lib.pd_ps_client_close.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_client_dim.restype = ctypes.c_int
+        lib.pd_ps_client_dim.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_client_size.restype = ctypes.c_int64
+        lib.pd_ps_client_size.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_client_pull.restype = ctypes.c_int
+        lib.pd_ps_client_pull.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.pd_ps_client_push.restype = ctypes.c_int
+        lib.pd_ps_client_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_float,
+            ctypes.c_float]
+        lib.pd_ps_client_save.restype = ctypes.c_int
+        lib.pd_ps_client_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_ps_client_load.restype = ctypes.c_int
+        lib.pd_ps_client_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_ps_server_start._bound = True
+    return lib
+
+
+class PsServer:
+    """Serves one table shard over TCP (brpc_ps_server role).
+
+    >>> table = SparseTable(dim=8)
+    >>> srv = PsServer(table)           # port=0 picks a free port
+    >>> srv.port
+    """
+
+    def __init__(self, table, port=0):
+        self._lib = _lib_ps()
+        self.table = table  # keep alive: server borrows the handle
+        self._h = self._lib.pd_ps_server_start(table._h, int(port))
+        if not self._h:
+            raise RuntimeError("PS server failed to start")
+        self.port = self._lib.pd_ps_server_port(self._h)
+
+    def stop(self):
+        if getattr(self, "_h", None):
+            self._lib.pd_ps_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Connection to one PS server (brpc_ps_client role, one shard)."""
+
+    def __init__(self, host, port, timeout=30.0):
+        self._lib = _lib_ps()
+        self._h = self._lib.pd_ps_client_connect(
+            host.encode(), int(port), int(timeout * 1000))
+        if not self._h:
+            raise RuntimeError(f"PS client connect to {host}:{port} failed")
+        self.dim = self._lib.pd_ps_client_dim(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.pd_ps_client_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def size(self):
+        return int(self._lib.pd_ps_client_size(self._h))
+
+    def pull(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty((len(keys), self.dim), dtype=np.float32)
+        rc = self._lib.pd_ps_client_pull(self._h, _i64p(keys), len(keys),
+                                         _f32p(out))
+        if rc != 0:
+            raise IOError(f"ps pull failed rc={rc}")
+        return out
+
+    def push(self, keys, grads, optimizer="adagrad", learning_rate=0.05,
+             epsilon=1e-8):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(len(keys), self.dim))
+        opt = 0 if optimizer == "sgd" else 1
+        rc = self._lib.pd_ps_client_push(self._h, opt, _i64p(keys),
+                                         _f32p(grads), len(keys),
+                                         float(learning_rate), float(epsilon))
+        if rc != 0:
+            raise IOError(f"ps push failed rc={rc}")
+
+    def save(self, path):
+        rc = self._lib.pd_ps_client_save(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"ps save failed rc={rc}")
+
+    def load(self, path):
+        rc = self._lib.pd_ps_client_load(self._h, str(path).encode())
+        if rc != 0:
+            raise IOError(f"ps load failed rc={rc}")
+
+
+class DistributedSparseTable:
+    """SparseTable-compatible facade over key-sharded remote tables.
+
+    Keys route to server ``key % num_servers`` (reference key-shard rule in
+    memory_sparse_table).  Pull/push fan out to all involved servers in
+    parallel (ctypes socket calls release the GIL) and reassemble rows in
+    the caller's original key order.  Drop-in for
+    ``DistributedEmbedding(table=...)``.
+    """
+
+    def __init__(self, endpoints, optimizer="adagrad", learning_rate=0.05,
+                 epsilon=1e-8, timeout=30.0):
+        if not endpoints:
+            raise ValueError("need at least one PS endpoint")
+        self.clients = []
+        for ep in endpoints:
+            host, port = ep.rsplit(":", 1)
+            self.clients.append(PsClient(host, int(port), timeout=timeout))
+        dims = {c.dim for c in self.clients}
+        if len(dims) != 1:
+            raise ValueError(f"PS servers disagree on dim: {dims}")
+        self.dim = dims.pop()
+        self.optimizer = optimizer
+        self.learning_rate = float(learning_rate)
+        self.epsilon = float(epsilon)
+        self._pool = ThreadPoolExecutor(max_workers=len(self.clients))
+
+    @property
+    def num_servers(self):
+        return len(self.clients)
+
+    def __len__(self):
+        return sum(c.size() for c in self.clients)
+
+    def _shard(self, keys):
+        """Return per-server (positions, keys) preserving relative order."""
+        srv = (keys.astype(np.uint64) % np.uint64(self.num_servers)).astype(
+            np.int64)
+        out = []
+        for i in range(self.num_servers):
+            pos = np.nonzero(srv == i)[0]
+            out.append((pos, keys[pos]))
+        return out
+
+    def pull(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty((len(keys), self.dim), dtype=np.float32)
+        shards = self._shard(keys)
+
+        def one(i):
+            pos, sub = shards[i]
+            if len(sub):
+                out[pos] = self.clients[i].pull(sub)
+
+        list(self._pool.map(one, range(self.num_servers)))
+        return out
+
+    def push(self, keys, grads, learning_rate=None):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        grads = np.ascontiguousarray(
+            np.asarray(grads, np.float32).reshape(len(keys), self.dim))
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        shards = self._shard(keys)
+
+        def one(i):
+            pos, sub = shards[i]
+            if len(sub):
+                self.clients[i].push(sub, grads[pos],
+                                     optimizer=self.optimizer,
+                                     learning_rate=lr, epsilon=self.epsilon)
+
+        list(self._pool.map(one, range(self.num_servers)))
+
+    def save(self, path_prefix):
+        """Each server persists its own shard: ``{prefix}.shard{i}``."""
+        for i, c in enumerate(self.clients):
+            c.save(f"{path_prefix}.shard{i}")
+
+    def load(self, path_prefix):
+        for i, c in enumerate(self.clients):
+            c.load(f"{path_prefix}.shard{i}")
+
+    def close(self):
+        for c in self.clients:
+            c.close()
+        self._pool.shutdown(wait=False)
+
+
+# ------------------------------------------------------------- discovery ----
+
+def register_ps_server(store, index, port, host=None):
+    """Publish this server's endpoint on the rendezvous store
+    (the_one_ps server registration parity)."""
+    import socket
+
+    host = host or os.environ.get("POD_IP") or socket.gethostbyname(
+        socket.gethostname())
+    store.set(f"ps/server/{index}", f"{host}:{port}".encode())
+
+
+def wait_ps_endpoints(store, num_servers, timeout=60.0):
+    """Block until all PS servers have registered; return their endpoints."""
+    eps = []
+    for i in range(num_servers):
+        v = store.get(f"ps/server/{i}", timeout=timeout)  # blocking get
+        eps.append(v.decode() if isinstance(v, bytes) else str(v))
+    return eps
+
+
+def start_ps_server(dim, index, store, port=0, optimizer="adagrad",
+                    learning_rate=0.05, init_range=0.01, epsilon=1e-8,
+                    seed=2023):
+    """Create a table shard + server and register it (server-role helper).
+
+    Returns the PsServer; call ``.stop()`` (and destroy the table) on exit.
+    Per-shard init seeds mix in the shard index so identical keys on
+    different shards (impossible under key%n routing, but cheap insurance)
+    don't collide.
+    """
+    table = SparseTable(dim, optimizer=optimizer,
+                        learning_rate=learning_rate, init_range=init_range,
+                        epsilon=epsilon, seed=seed + index)
+    srv = PsServer(table, port=port)
+    register_ps_server(store, index, srv.port)
+    return srv

@@ -13,16 +13,20 @@ from ..distributed.ps import DistributedEmbedding, SparseTable
 class WideDeep(nn.Layer):
     def __init__(self, sparse_feature_dim=8, num_slots=8,
                  hidden_sizes=(64, 32), table_lr=0.05,
-                 table_optimizer="adagrad"):
+                 table_optimizer="adagrad", table=None, wide_table=None):
         super().__init__()
         self.num_slots = num_slots
-        # wide part: per-feature scalar weights in their own 1-dim table
+        # wide part: per-feature scalar weights in their own 1-dim table.
+        # Multi-host runs must pass BOTH tables as DistributedSparseTable
+        # shards — a local wide table would silently diverge across hosts.
         self.wide_table = DistributedEmbedding(
-            1, optimizer=table_optimizer, learning_rate=table_lr)
-        # deep part: shared embedding table over all slots
+            1, optimizer=table_optimizer, learning_rate=table_lr,
+            table=wide_table)
+        # deep part: shared embedding table over all slots; ``table`` lets a
+        # multi-host run pass a DistributedSparseTable (sharded PS service)
         self.deep_table = DistributedEmbedding(
             sparse_feature_dim, optimizer=table_optimizer,
-            learning_rate=table_lr)
+            learning_rate=table_lr, table=table)
         layers = []
         in_dim = sparse_feature_dim * num_slots
         for h in hidden_sizes:

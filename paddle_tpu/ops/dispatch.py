@@ -53,11 +53,12 @@ _dispatch_cache_enabled = True
 
 
 class _CacheEntry:
-    __slots__ = ("jittable", "compiled")
+    __slots__ = ("jittable", "compiled", "banned")
 
     def __init__(self):
         self.jittable = False
         self.compiled = None
+        self.banned = False  # trace failed once: never compile this key
 
 
 def enable_dispatch_cache(flag=True):
@@ -160,22 +161,38 @@ def apply_op(name, fn, args, kwargs):
                     _DISPATCH_CACHE.move_to_end(key)
 
     vjp_fn = None
-    if entry is not None and entry.compiled is None and entry.jittable:
+    if (entry is not None and entry.compiled is None and entry.jittable
+            and not entry.banned):
         # second sighting: compile once, reuse forever for this key
         entry.compiled = (jax.jit(lambda *d: jax.vjp(pure, *d))
                           if requires_grad else jax.jit(pure))
     if entry is not None and entry.compiled is not None:
-        if requires_grad:
-            out, raw_vjp = entry.compiled(*datas)
-            vjp_fn = lambda cots: _run_vjp(raw_vjp, cots)
-        else:
-            out = entry.compiled(*datas)
+        try:
+            if requires_grad:
+                out, raw_vjp = entry.compiled(*datas)
+                vjp_fn = lambda cots: _run_vjp(raw_vjp, cots)
+            else:
+                out = entry.compiled(*datas)
+        except Exception:
+            # ops with value-dependent output shapes (masked_select,
+            # nonzero, unique, ...) run eagerly but cannot trace — jax
+            # raises at the jit's first call.  Pin this key to the
+            # uncached path forever and retry eagerly (a genuine user
+            # error will re-raise below with the eager traceback).
+            entry.banned = True
+            entry.jittable = False
+            entry.compiled = None
+            vjp_fn = None
+            if requires_grad:
+                out, vjp_fn = jax.vjp(pure, *datas)
+            else:
+                out = pure(*datas)
     elif requires_grad:
         out, vjp_fn = jax.vjp(pure, *datas)
     else:
         out = pure(*datas)
 
-    if entry is not None and entry.compiled is None:
+    if entry is not None and entry.compiled is None and not entry.banned:
         # first sighting: mark jittable only if every output leaf is a jax
         # array (ops returning aux python values stay on the uncached path)
         entry.jittable = all(

@@ -1,0 +1,32 @@
+"""Wheel build for paddle_tpu, including the native C++ host runtime.
+
+Reference parity: /root/reference/setup.py (the cmake superbuild +
+python/setup.py.in wheel pipeline, SURVEY §2.11).  The TPU build needs no
+CUDA or third-party superbuild — XLA/PjRt ship with jax — so packaging
+reduces to: compile native/*.cc into libpaddle_native.so with g++ and ship
+it inside the package (``paddle_tpu/native/``), where the ctypes loader
+(paddle_tpu/core/native.py) finds it without a source checkout.
+"""
+
+import os
+import shutil
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+NATIVE_DIR = os.path.join(ROOT, "native")
+
+
+class BuildPyWithNative(build_py):
+    def run(self):
+        super().run()
+        subprocess.check_call(["make"], cwd=NATIVE_DIR)
+        dest = os.path.join(self.build_lib, "paddle_tpu", "native")
+        os.makedirs(dest, exist_ok=True)
+        shutil.copy2(os.path.join(NATIVE_DIR, "libpaddle_native.so"), dest)
+        shutil.copy2(os.path.join(NATIVE_DIR, "paddle_native.h"), dest)
+
+
+setup(cmdclass={"build_py": BuildPyWithNative})

@@ -102,6 +102,19 @@ class TestDispatchCache:
         finally:
             paddle.set_flags({"FLAGS_check_nan_inf": False})
 
+    def test_value_dependent_shape_ops_fall_back(self):
+        """masked_select & co. have value-dependent output shapes: they run
+        eagerly but cannot trace.  Repeated calls with the same input shapes
+        (the compile trigger) must keep working — and keep returning the
+        value-dependent shape, not a baked one."""
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(3, 4))
+        for n_true in (5, 2, 7, 3):  # same shapes, different mask contents
+            mask = np.zeros(12, bool)
+            mask[:n_true] = True
+            out = paddle.masked_select(x, paddle.to_tensor(
+                mask.reshape(3, 4)))
+            assert out.shape == [n_true], out.shape
+
     def test_steady_state_speedup(self):
         """Cached grad-path dispatch must beat fresh jax.vjp tracing.
 

@@ -1,0 +1,194 @@
+"""Multi-host PS service: sharded pull/push over TCP, save/load, 2-process
+Wide&Deep (reference: brpc_ps_client/server + memory_sparse_table;
+test pattern: test/ps/ + TestDistBase multi-process-on-one-box)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (
+    DistributedSparseTable,
+    PsClient,
+    PsServer,
+    SparseTable,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def two_servers():
+    tables = [SparseTable(dim=4, optimizer="sgd", learning_rate=0.5,
+                          init_range=0.0, seed=11),
+              SparseTable(dim=4, optimizer="sgd", learning_rate=0.5,
+                          init_range=0.0, seed=11)]
+    servers = [PsServer(t) for t in tables]
+    yield tables, servers
+    for s in servers:
+        s.stop()
+
+
+class TestPsService:
+    def test_client_pull_push_roundtrip(self, two_servers):
+        tables, servers = two_servers
+        c = PsClient("127.0.0.1", servers[0].port)
+        assert c.dim == 4
+        rows = c.pull([7, 8])
+        np.testing.assert_array_equal(rows, np.zeros((2, 4)))
+        c.push([7], np.ones((1, 4), np.float32), optimizer="sgd",
+               learning_rate=0.5)
+        np.testing.assert_allclose(c.pull([7]), -0.5 * np.ones((1, 4)),
+                                   rtol=1e-6)
+        # the push went to the server's local table
+        np.testing.assert_allclose(tables[0].pull([7]),
+                                   -0.5 * np.ones((1, 4)), rtol=1e-6)
+        assert c.size() == 2
+        c.close()
+
+    def test_sharded_table_matches_local(self, two_servers):
+        _, servers = two_servers
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        dist = DistributedSparseTable(eps, optimizer="sgd",
+                                      learning_rate=0.1)
+        local = SparseTable(dim=4, optimizer="sgd", learning_rate=0.1,
+                            init_range=0.0, seed=11)
+        keys = np.array([0, 1, 2, 3, 10, 11, 5, 2], np.int64)
+        rng = np.random.RandomState(0)
+        grads = rng.rand(len(keys), 4).astype(np.float32)
+        # identical init (range 0) -> identical rows after identical pushes,
+        # including sequential accumulation for duplicate key 2
+        dist.push(keys, grads)
+        local.push(keys, grads)
+        np.testing.assert_allclose(dist.pull(keys), local.pull(keys),
+                                   rtol=1e-6)
+        # keys landed on both shards
+        sizes = [c.size() for c in dist.clients]
+        assert all(s > 0 for s in sizes) and sum(sizes) == 7
+        dist.close()
+
+    def test_save_load_survives(self, two_servers, tmp_path):
+        _, servers = two_servers
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        dist = DistributedSparseTable(eps, optimizer="sgd",
+                                      learning_rate=0.5)
+        keys = np.arange(10, dtype=np.int64)
+        dist.push(keys, np.ones((10, 4), np.float32))
+        before = dist.pull(keys).copy()
+        prefix = str(tmp_path / "ps_ckpt")
+        dist.save(prefix)
+        # clobber the tables, then restore
+        dist.push(keys, 100 * np.ones((10, 4), np.float32))
+        assert not np.allclose(dist.pull(keys), before)
+        dist.load(prefix)
+        np.testing.assert_allclose(dist.pull(keys), before, rtol=1e-6)
+        dist.close()
+
+    def test_distributed_embedding_over_service(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.distributed.ps import DistributedEmbedding
+
+        # nonzero init so (out*out).sum() has nonzero row gradients
+        tables = [SparseTable(dim=4, optimizer="sgd", learning_rate=0.1,
+                              init_range=0.1, seed=3) for _ in range(2)]
+        servers = [PsServer(t) for t in tables]
+        eps = [f"127.0.0.1:{s.port}" for s in servers]
+        dist = DistributedSparseTable(eps, optimizer="sgd",
+                                      learning_rate=0.1)
+        emb = DistributedEmbedding(dim=4, table=dist)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        before = dist.pull([1]).copy()
+        (out * out).sum().backward()
+        assert not np.allclose(before, dist.pull([1]))
+        dist.close()
+        for s in servers:
+            s.stop()
+
+
+def test_wide_deep_two_process_convergence(tmp_path):
+    """Launcher-driven 2-rank Wide&Deep: each rank hosts one PS shard and
+    trains against the sharded table; losses must drop on both ranks and
+    rank 0's save/load round-trip must preserve rows."""
+    script = tmp_path / "wd_worker.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import optimizer
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.distributed.ps import (
+            DistributedSparseTable, start_ps_server, wait_ps_endpoints)
+        from paddle_tpu.models.wide_deep import WideDeep
+
+        rank = int(os.environ["PADDLE_TRAINER_ID"])
+        world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        host, port = os.environ["PADDLE_MASTER"].split(":")
+        store = TCPStore(host, int(port), is_master=False, world_size=world)
+
+        # every rank hosts one deep shard (index rank) and one wide shard
+        # (index world+rank) — both embedding tables are truly multi-host
+        srv = start_ps_server(dim=4, index=rank, store=store,
+                              optimizer="adagrad", learning_rate=0.1)
+        srv_w = start_ps_server(dim=1, index=world + rank, store=store,
+                                optimizer="adagrad", learning_rate=0.1)
+        eps = wait_ps_endpoints(store, 2 * world)
+        table = DistributedSparseTable(eps[:world], optimizer="adagrad",
+                                       learning_rate=0.1)
+        wide = DistributedSparseTable(eps[world:], optimizer="adagrad",
+                                      learning_rate=0.1)
+
+        paddle.seed(100 + rank)
+        model = WideDeep(sparse_feature_dim=4, num_slots=3,
+                         hidden_sizes=(16,), table=table, wide_table=wide)
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=model.parameters())
+        rs = np.random.RandomState(rank)
+        ids_np = rs.randint(0, 1000, (256, 3)).astype(np.int64)
+        y_np = (ids_np[:, 0] % 2 == 0).astype(np.float32)
+
+        losses = []
+        for epoch in range(12):
+            for lo in range(0, 256, 64):
+                ids = paddle.to_tensor(ids_np[lo:lo+64])
+                y = paddle.to_tensor(y_np[lo:lo+64])
+                from paddle_tpu import nn as pnn
+                logits = model(ids).reshape([-1])
+                loss = pnn.functional.binary_cross_entropy_with_logits(
+                    logits, y)
+                loss.backward()
+                opt.step(); opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.7 * losses[0], f"no convergence: {{losses}}"
+
+        store.barrier(tag="trained")
+        if rank == 0:
+            keys = np.arange(50, dtype=np.int64)
+            before = table.pull(keys).copy()
+            prefix = os.path.join({str(tmp_path)!r}, "wd_table")
+            table.save(prefix)
+            table.load(prefix)
+            np.testing.assert_allclose(table.pull(keys), before, rtol=1e-6)
+        store.barrier(tag="saved")
+        table.close(); wide.close()
+        srv.stop(); srv_w.stop()
+        print("RANK", rank, "WD OK", losses[0], "->", losses[-1])
+    """))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    log_dir = str(tmp_path / "logs")
+    rc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", log_dir, str(script)],
+        cwd=REPO, capture_output=True, timeout=300, env=env)
+    assert rc.returncode == 0, (rc.stderr.decode()[-2000:],
+                                rc.stdout.decode()[-500:])
+    for r in range(2):
+        with open(os.path.join(log_dir, f"workerlog.{r}")) as f:
+            assert f"RANK {r} WD OK" in f.read()
