@@ -124,6 +124,11 @@ from . import distribution  # noqa: F401, E402
 from . import quantization  # noqa: F401, E402
 from . import geometric  # noqa: F401, E402
 from . import static  # noqa: F401, E402
+from . import onnx  # noqa: F401, E402
+from . import utils  # noqa: F401, E402
+from . import audio  # noqa: F401, E402
+from . import text  # noqa: F401, E402
+from . import cost_model  # noqa: F401, E402
 
 
 def disable_static(place=None):

@@ -1,0 +1,21 @@
+"""paddle.audio parity — feature layers + DSP functional.
+
+Reference: python/paddle/audio/ (features, functional, backends,
+datasets).  Backends (soundfile IO) and downloadable datasets are gated:
+this environment has no egress and no soundfile; ``load``/``save`` raise
+with guidance, while the compute path (spectrogram/mel/mfcc) is fully
+native jax (see features.py / functional.py).
+"""
+
+from . import features, functional  # noqa: F401
+
+
+def load(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle_tpu.audio.load requires an audio IO backend (soundfile); "
+        "decode to numpy yourself and feed the array to audio.features.")
+
+
+def save(*args, **kwargs):
+    raise NotImplementedError(
+        "paddle_tpu.audio.save requires an audio IO backend (soundfile).")

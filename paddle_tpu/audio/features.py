@@ -1,0 +1,102 @@
+"""paddle.audio.features parity — Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC as nn.Layers.
+
+Reference: python/paddle/audio/features/layers.py.  Built on the
+framework's own stft (paddle_tpu/signal.py) and the mel/dct math in
+audio.functional; the whole pipeline is jax — it jits, differentiates,
+and runs on device.
+"""
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from .. import signal as _signal
+from . import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, hop_length=self.hop_length,
+                            win_length=self.win_length,
+                            window=Tensor(self.window), center=self.center,
+                            pad_mode=self.pad_mode)
+        data = spec._data if isinstance(spec, Tensor) else spec
+        mag = jnp.abs(data)
+        if self.power != 1.0:
+            mag = mag ** self.power
+        return Tensor(mag)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(
+            n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode)
+        self.fbank = F.compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)._data     # [..., freq, time]
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank, spec)
+        return Tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False,
+                 norm="slaney", ref_value=1.0, amin=1e-10, top_db=None,
+                 dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._mel(x)
+        return Tensor(F.power_to_db(mel._data, ref_value=self.ref_value,
+                                    amin=self.amin, top_db=self.top_db))
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr=sr, n_fft=n_fft, hop_length=hop_length, win_length=win_length,
+            window=window, power=power, center=center, pad_mode=pad_mode,
+            n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk, norm=norm,
+            ref_value=ref_value, amin=amin, top_db=top_db)
+        self.dct = F.create_dct(n_mfcc, n_mels)
+
+    def forward(self, x):
+        logmel = self._log_mel(x)._data       # [..., n_mels, time]
+        mfcc = jnp.einsum("mk,...mt->...kt", self.dct, logmel)
+        return Tensor(mfcc)

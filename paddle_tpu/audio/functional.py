@@ -1,0 +1,153 @@
+"""paddle.audio.functional parity — mel/window/dct math as pure jax.
+
+Reference: python/paddle/audio/functional/{functional,window}.py (hz↔mel,
+fbank matrices, dct basis, windows, power_to_db).  Implementations are
+standard DSP formulas over jnp; everything jits and differentiates.
+"""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..ops.registry import register_external
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def _data(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk=False):
+    """Hertz → mel.  Slaney (default) or HTK scale (reference parity)."""
+    f = _data(freq)
+    scalar = np.isscalar(freq)
+    f = jnp.asarray(f, jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(
+                            jnp.maximum(f, 1e-10) / min_log_hz) / logstep,
+                        mel)
+    return float(mel) if scalar else Tensor(mel) \
+        if isinstance(freq, Tensor) else mel
+
+
+def mel_to_hz(mel, htk=False):
+    m = _data(mel)
+    scalar = np.isscalar(mel)
+    m = jnp.asarray(m, jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                       hz)
+    return float(hz) if scalar else Tensor(hz) \
+        if isinstance(mel, Tensor) else hz
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return jnp.linspace(0.0, float(sr) / 2, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)                  # [F]
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)  # [M+2]
+    fdiff = jnp.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]          # [M+2, F]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))  # [M, F]
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights = weights * enorm[:, None]
+    return weights
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    x = jnp.asarray(_data(spect))
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec) if isinstance(spect, Tensor) else log_spec
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    """[n_mels, n_mfcc] DCT-II basis (reference create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k[None, :])
+    if norm == "ortho":
+        dct = dct * math.sqrt(2.0 / n_mels)
+        dct = dct.at[:, 0].set(dct[:, 0] * (1.0 / math.sqrt(2)))
+    else:
+        dct = dct * 2.0
+    return dct
+
+
+_WINDOWS = {}
+
+
+def _win_hann(n, periodic):
+    m = n if periodic else n - 1
+    return 0.5 - 0.5 * jnp.cos(2 * math.pi * jnp.arange(n) / max(m, 1))
+
+
+def _win_hamming(n, periodic):
+    m = n if periodic else n - 1
+    return 0.54 - 0.46 * jnp.cos(2 * math.pi * jnp.arange(n) / max(m, 1))
+
+
+def _win_blackman(n, periodic):
+    m = n if periodic else n - 1
+    t = 2 * math.pi * jnp.arange(n) / max(m, 1)
+    return 0.42 - 0.5 * jnp.cos(t) + 0.08 * jnp.cos(2 * t)
+
+
+_WINDOWS.update(hann=_win_hann, hamming=_win_hamming,
+                blackman=_win_blackman,
+                rect=lambda n, periodic: jnp.ones(n))
+_WINDOWS["boxcar"] = _WINDOWS["rect"]
+
+
+def get_window(window, win_length, fftbins=True):
+    if isinstance(window, tuple):  # ("gaussian", std) style: unsupported tail
+        window = window[0]
+    if window not in _WINDOWS:
+        raise ValueError(f"unsupported window {window!r}; "
+                         f"have {sorted(_WINDOWS)}")
+    return _WINDOWS[window](int(win_length), bool(fftbins)) \
+        .astype(jnp.float32)
+
+
+for _name in ("hz_to_mel", "mel_to_hz", "compute_fbank_matrix",
+              "power_to_db"):
+    register_external(f"audio.{_name}", globals()[_name], tags=("audio",))

@@ -1,0 +1,85 @@
+"""paddle.cost_model parity — measured per-op cost lookup.
+
+Reference: python/paddle/cost_model/cost_model.py (profile a program,
+report per-op times; static_op_benchmark.json lookup for the pass/planner
+stack).  TPU redesign: costs come from the framework's own profiler host
+events (eager) or from timing jitted ops directly; results are cached and
+exportable as JSON — the same role the reference's benchmark json plays
+for auto-parallel/tuner decisions.
+"""
+
+import json
+import time
+
+import numpy as np
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self):
+        self._static_table = {}
+
+    # ------------------------------------------------------------ profile --
+    def profile_measure(self, fn, *args, fetch_cost_list=("time",),
+                        warmup=2, iters=5):
+        """Measure per-op host costs of running ``fn(*args)`` eagerly.
+
+        Returns {op_name: {"op_time_ms": total, "calls": n}} from the
+        profiler's RecordEvent stream (the reference profiles a Program
+        run and aggregates per-op; here ops are eager dispatches).
+        """
+        from ..profiler import Profiler
+
+        for _ in range(warmup):
+            fn(*args)
+        prof = Profiler(timer_only=True)
+        prof.start()
+        for _ in range(iters):
+            fn(*args)
+        agg_raw = prof.aggregated_events()
+        prof.stop()
+        return {name: {"op_time_ms": tot * 1e3 / iters, "calls": cnt}
+                for name, (tot, cnt, _mx) in agg_raw.items()}
+
+    # ------------------------------------------------------- static table --
+    def measure_op(self, name, shapes=((1024, 1024),), dtype="float32",
+                   iters=10):
+        """Time one registered op on synthetic inputs (jitted, device)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ops.registry import OPS
+
+        if name not in OPS or OPS[name].jax_fn is None:
+            raise KeyError(f"op {name!r} has no pure-jax impl to measure")
+        fn = jax.jit(OPS[name].jax_fn)
+        rng = np.random.RandomState(0)
+        args = [jnp.asarray(rng.rand(*s).astype(dtype)) for s in shapes]
+        out = fn(*args)
+        jax.block_until_ready(out)
+        t = float("inf")
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            t = min(t, time.perf_counter() - t0)
+        key = f"{name}|{shapes}|{dtype}"
+        self._static_table[key] = t * 1e3
+        return t * 1e3
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32",
+                           shapes=((1024, 1024),)):
+        """Cost (ms) for an op, measuring on first request (the reference
+        reads static_op_benchmark.json; ours measures on the live chip)."""
+        key = f"{op_name}|{shapes}|{dtype}"
+        if key not in self._static_table:
+            self.measure_op(op_name, shapes=shapes, dtype=dtype)
+        return {"op_time": self._static_table[key]}
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(self._static_table, f, indent=1)
+
+    def load(self, path):
+        with open(path) as f:
+            self._static_table.update(json.load(f))

@@ -1,0 +1,9 @@
+"""paddle.onnx parity — native ONNX export (+ a numpy mini-runtime).
+
+Reference: python/paddle/onnx/export.py (thin wrapper over the external
+paddle2onnx).  Here export is native jaxpr→ONNX: see export.py.
+"""
+
+from . import onnx_subset_pb2  # noqa: F401
+from . import runtime  # noqa: F401
+from .export import export  # noqa: F401

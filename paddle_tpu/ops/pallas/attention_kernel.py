@@ -261,17 +261,56 @@ def _flash_attention_bnsh(q, k, v, causal, scale, interpret):
     return out
 
 
+def _block_candidates(seq_q, seq_k):
+    """(block_q, block_k) candidates, author heuristic first."""
+    qs = [b for b in (128, 256, 512, 64) if seq_q % b == 0]
+    ks = [b for b in (128, 256, 512, 64) if seq_k % b == 0]
+    if not qs:
+        qs = [_pick_block(seq_q, DEFAULT_BLOCK_Q)]
+    if not ks:
+        ks = [_pick_block(seq_k, DEFAULT_BLOCK_K)]
+    head = [(qs[0], ks[0])]
+    rest = [(bq, bk) for bq in qs for bk in ks if (bq, bk) != head[0]]
+    return head + rest
+
+
+def _tuned_blocks(q, k, causal, scale, interpret):
+    """Autotuned (block_q, block_k) for this shape (FLAGS_use_autotune);
+    the heuristic (128-preferred divisor) wins with the flag off."""
+    from . import autotune
+
+    bn, seq_q, head = q.shape
+    seq_k = k.shape[1]
+    cands = _block_candidates(seq_q, seq_k)
+
+    def measure(cand):
+        bq, bk = cand
+        import numpy as _np
+
+        rng = _np.random.RandomState(0)
+        shape_q = (min(bn, 8), seq_q, head)
+        shape_k = (min(bn, 8), seq_k, head)
+        qq = jnp.asarray(rng.rand(*shape_q), q.dtype)
+        kk = jnp.asarray(rng.rand(*shape_k), q.dtype)
+        vv = jnp.asarray(rng.rand(*shape_k), q.dtype)
+        out, _ = _flash_fwd(qq, kk, vv, causal, scale, bq, bk, interpret)
+        jax.block_until_ready(out)
+
+    return autotune.pick(
+        "flash_attention",
+        (seq_q, seq_k, head, str(q.dtype), causal),
+        cands, measure=measure)
+
+
 def _fwd_rule(q, k, v, causal, scale, interpret):
-    block_q = _pick_block(q.shape[1], DEFAULT_BLOCK_Q)
-    block_k = _pick_block(k.shape[1], DEFAULT_BLOCK_K)
+    block_q, block_k = _tuned_blocks(q, k, causal, scale, interpret)
     out, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
 
 def _bwd_rule(causal, scale, interpret, res, do):
     q, k, v, out, lse = res
-    block_q = _pick_block(q.shape[1], DEFAULT_BLOCK_Q)
-    block_k = _pick_block(k.shape[1], DEFAULT_BLOCK_K)
+    block_q, block_k = _tuned_blocks(q, k, causal, scale, interpret)
     return _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k,
                       interpret)
 

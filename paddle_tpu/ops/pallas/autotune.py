@@ -1,0 +1,120 @@
+"""Pallas kernel autotune — block-size selection cache.
+
+Reference parity: the kernel/layout autotune cache at
+paddle/phi/kernels/autotune/ (cache.h, auto_tune_base.h): measure candidate
+configs once per (kernel, shape key), remember the winner.  On TPU, XLA
+autotunes its own fusions; what remains worth tuning is OUR Pallas grid
+/block choices, where VMEM footprint vs. occupancy is shape-dependent.
+
+Off by default (``FLAGS_use_autotune``): the first sighting of a shape
+otherwise pays ``len(candidates)`` compiles.  With the flag off the first
+candidate (the kernel author's heuristic) wins unconditionally.  Results
+persist in-process and, when ``PADDLE_TPU_AUTOTUNE_CACHE`` names a file,
+across processes as JSON.
+"""
+
+import json
+import os
+import threading
+import time
+
+_CACHE = {}
+_LOCK = threading.Lock()
+_loaded_file = False
+
+
+def _cache_file():
+    return os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+
+
+def _load_file_once():
+    global _loaded_file
+    path = _cache_file()
+    if _loaded_file or not path or not os.path.exists(path):
+        _loaded_file = True
+        return
+    try:
+        with open(path) as f:
+            for k, v in json.load(f).items():
+                winner, tuned = v
+                if isinstance(winner, list):
+                    winner = tuple(winner)
+                _CACHE.setdefault(k, (winner, bool(tuned)))
+    except Exception:
+        pass
+    _loaded_file = True
+
+
+def _save_file():
+    path = _cache_file()
+    if not path:
+        return
+    try:
+        with open(path, "w") as f:
+            json.dump({k: v for k, v in _CACHE.items()}, f)
+    except Exception:
+        pass
+
+
+def _enabled():
+    from ...framework.flags import get_flags
+
+    try:
+        return bool(get_flags("FLAGS_use_autotune")["FLAGS_use_autotune"])
+    except Exception:
+        return False
+
+
+def autotune_cache_info():
+    with _LOCK:
+        return dict(_CACHE)
+
+
+def autotune_cache_clear():
+    with _LOCK:
+        _CACHE.clear()
+
+
+def pick(kernel, key, candidates, measure=None, warmup=1, iters=3):
+    """Return the winning candidate for ``(kernel, key)``.
+
+    ``candidates``: non-empty list, first = author heuristic (the flag-off
+    winner).  ``measure(candidate) -> None`` runs the kernel once with that
+    config on real inputs; it is timed with ``warmup`` untimed runs then
+    best-of-``iters``.  A candidate whose measure raises is skipped (e.g.
+    VMEM overflow for an oversized block).
+    """
+    if not candidates:
+        raise ValueError("no candidates")
+    ck = f"{kernel}|{key}"
+    want_tuning = measure is not None and _enabled() and len(candidates) > 1
+    with _LOCK:
+        _load_file_once()
+        if ck in _CACHE:
+            winner, tuned = _CACHE[ck]
+            # a heuristic (untuned) entry does not satisfy a tuning request
+            # — flipping FLAGS_use_autotune on later must still measure
+            if tuned or not want_tuning:
+                return winner
+    if not want_tuning:
+        winner, tuned = candidates[0], False
+    else:
+        tuned = True
+        best_t, winner = float("inf"), candidates[0]
+        for cand in candidates:
+            try:
+                for _ in range(warmup):
+                    measure(cand)
+                t = float("inf")
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    measure(cand)
+                    t = min(t, time.perf_counter() - t0)
+            except Exception:
+                continue
+            if t < best_t:
+                best_t, winner = t, cand
+    with _LOCK:
+        _CACHE[ck] = (winner, tuned)
+        _save_file()
+    return winner

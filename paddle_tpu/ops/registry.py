@@ -78,3 +78,11 @@ def coverage(yaml_names=None):
         yaml_names = OP_INVENTORY
     have = sum(1 for n in yaml_names if n in OPS)
     return have, len(yaml_names), 100.0 * have / max(1, len(yaml_names))
+
+
+def schema(name):
+    """Reference-YAML signature schema for an op (args/outputs/backward/
+    inplace), or None.  Single-source parity surface: generated from
+    paddle/phi/api/yaml/*.yaml by tools/gen_schema.py."""
+    from .schema import get_schema
+    return get_schema(name)

@@ -1,0 +1,144 @@
+"""paddle.text parity — viterbi decoding + dataset scaffolds.
+
+Reference: python/paddle/text/viterbi_decode.py (ViterbiDecoder over the
+viterbi_decode op) and text/datasets/ (downloadable corpora — gated here,
+no egress).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..ops.registry import register_external
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _vit_pure(potentials, transitions, lengths, include_bos_eos_tag):
+    """potentials [B, T, N], transitions [N, N], lengths [B] int64.
+
+    Returns (scores [B], paths [B, T]) — best-path score and tag indices;
+    positions beyond a sequence's length hold zeros (reference semantics:
+    outputs are only meaningful up to lengths[b]).
+    """
+    b, t, n = potentials.shape
+    lengths = lengths.astype(jnp.int32)
+
+    if include_bos_eos_tag:
+        # reference convention: tag N-2 = BOS, N-1 = EOS
+        bos_idx, eos_idx = n - 2, n - 1
+        start = potentials[:, 0] + transitions[bos_idx][None, :]
+    else:
+        start = potentials[:, 0]
+
+    def step(carry, inp):
+        alpha, hist_t = carry
+        emit, tpos = inp                      # emit [B, N], tpos scalar
+        # score[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
+        scores = alpha[:, :, None] + transitions[None, :, :] \
+            + emit[:, None, :]
+        best_prev = jnp.argmax(scores, axis=1)            # [B, N]
+        new_alpha = jnp.max(scores, axis=1)               # [B, N]
+        # frozen once past the sequence end
+        active = (tpos < lengths)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        return (new_alpha, hist_t), best_prev
+
+    emits = jnp.moveaxis(potentials[:, 1:], 1, 0)          # [T-1, B, N]
+    tpos = jnp.arange(1, t)
+    (alpha, _), backptrs = jax.lax.scan(step, (start, 0), (emits, tpos))
+    # backptrs: [T-1, B, N]
+
+    if include_bos_eos_tag:
+        alpha = alpha + transitions[:, n - 1][None, :]
+
+    last_tag = jnp.argmax(alpha, axis=-1)                  # [B]
+    scores = jnp.max(alpha, axis=-1)
+
+    def back_step(tag, inp):
+        bp, tpos = inp                                     # bp [B, N]
+        prev = jnp.take_along_axis(bp, tag[:, None], axis=1)[:, 0]
+        # only follow pointers inside the sequence
+        tag_prev = jnp.where(tpos < lengths, prev, tag)
+        return tag_prev, tag
+
+    rev_bp = backptrs[::-1]
+    rev_tpos = tpos[::-1]
+    first_tag, rev_path = jax.lax.scan(back_step, last_tag,
+                                       (rev_bp, rev_tpos))
+    path = jnp.concatenate([first_tag[None], rev_path[::-1]], axis=0)
+    path = jnp.moveaxis(path, 0, 1)                        # [B, T]
+    # zero out positions past each length (reference: unused tail)
+    mask = jnp.arange(t)[None, :] < lengths[:, None]
+    path = jnp.where(mask, path, 0)
+    return scores, path.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Best tag sequence under a linear-chain CRF (reference
+    python/paddle/text/viterbi_decode.py:25)."""
+    pot = potentials._data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params._data \
+        if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    lens = lengths._data if isinstance(lengths, Tensor) \
+        else jnp.asarray(lengths)
+    scores, path = _vit_pure(pot, trans, lens, bool(include_bos_eos_tag))
+    return Tensor(scores), Tensor(path)
+
+
+register_external("viterbi_decode", viterbi_decode, jax_fn=_vit_pure,
+                  tags=("text",))
+
+
+class ViterbiDecoder(Layer):
+    """Reference python/paddle/text/viterbi_decode.py:93."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _GatedDataset:
+    """Downloadable corpora are unavailable (no egress): raise w/ guidance."""
+
+    NAME = "dataset"
+
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            f"paddle_tpu.text.{self.NAME}: automatic download is "
+            "unavailable in this environment; load the corpus from local "
+            "files with paddle_tpu.io.Dataset instead.")
+
+
+class Imdb(_GatedDataset):
+    NAME = "Imdb"
+
+
+class Conll05st(_GatedDataset):
+    NAME = "Conll05st"
+
+
+class Movielens(_GatedDataset):
+    NAME = "Movielens"
+
+
+class UCIHousing(_GatedDataset):
+    NAME = "UCIHousing"
+
+
+class WMT14(_GatedDataset):
+    NAME = "WMT14"
+
+
+class WMT16(_GatedDataset):
+    NAME = "WMT16"

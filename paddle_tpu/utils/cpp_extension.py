@@ -1,0 +1,117 @@
+"""JIT C++ extension loading — paddle.utils.cpp_extension parity.
+
+Reference: python/paddle/utils/cpp_extension/ (setup/CppExtension/load —
+compile user C++ sources against the framework and register their ops).
+
+TPU redesign: there is no device code to compile (XLA/Pallas own the
+chip), so a C++ extension is a HOST library: g++ compiles the sources to a
+shared object, ctypes binds the exported functions, and
+``host_op_from_extension`` lifts one of them into a registered op through
+``jax.pure_callback`` — runnable eagerly and under jit (the callback runs
+on the host, so use it for CPU-side logic: tokenizers, samplers, custom
+data transforms — not for device math).
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+from types import SimpleNamespace
+
+import numpy as np
+
+_CTYPE_MAP = {
+    "void": None,
+    "int": ctypes.c_int,
+    "int64": ctypes.c_int64,
+    "float": ctypes.c_float,
+    "double": ctypes.c_double,
+    "char*": ctypes.c_char_p,
+    "void*": ctypes.c_void_p,
+    "float*": ctypes.POINTER(ctypes.c_float),
+    "double*": ctypes.POINTER(ctypes.c_double),
+    "int64*": ctypes.POINTER(ctypes.c_int64),
+    "int*": ctypes.POINTER(ctypes.c_int),
+}
+
+
+def _as_ctype(spec):
+    if spec is None or isinstance(spec, str):
+        return _CTYPE_MAP[spec] if spec is not None else None
+    return spec  # already a ctypes type
+
+
+def load(name, sources, functions=None, extra_cflags=(),
+         build_directory=None, verbose=False):
+    """Compile ``sources`` (C++ files or inline source strings) into a
+    shared library and return a namespace of bound functions.
+
+    ``functions`` maps exported symbol -> (restype, [argtypes...]) where
+    types are ctypes types or the string shorthands "int", "float*", ....
+    Parity: paddle.utils.cpp_extension.load (JIT path).
+
+    >>> mod = load("my_ext", ["ext.cc"],
+    ...            functions={"my_op": ("void", ["float*", "int"])})
+    >>> mod.my_op(buf, n)
+    """
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+
+    src_paths = []
+    blob = hashlib.sha1()
+    for i, src in enumerate(sources):
+        if os.path.exists(src):
+            src_paths.append(os.path.abspath(src))
+            with open(src, "rb") as f:
+                blob.update(f.read())
+        else:  # inline source string
+            p = os.path.join(build_dir, f"{name}_src{i}.cc")
+            with open(p, "w") as f:
+                f.write(src)
+            src_paths.append(p)
+            blob.update(src.encode())
+    blob.update(" ".join(extra_cflags).encode())
+
+    so_path = os.path.join(build_dir, f"lib{name}_{blob.hexdigest()[:12]}.so")
+    if not os.path.exists(so_path):
+        cmd = (["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-o",
+                so_path] + list(extra_cflags) + src_paths)
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+
+    lib = ctypes.CDLL(so_path)
+    ns = SimpleNamespace(_lib=lib, _so_path=so_path)
+    for fname, (restype, argtypes) in (functions or {}).items():
+        fn = getattr(lib, fname)
+        fn.restype = _as_ctype(restype)
+        fn.argtypes = [_as_ctype(a) for a in argtypes]
+        setattr(ns, fname, fn)
+    return ns
+
+
+def host_op_from_extension(name, fn, out_shape_fn, backward=None,
+                           tags=("custom", "host")):
+    """Register a host function (numpy in/out) as a jittable op.
+
+    ``fn(*np_arrays) -> np_array`` runs on the host via
+    ``jax.pure_callback``; ``out_shape_fn(*avals) -> ShapeDtypeStruct``
+    declares the result (InferMeta parity — shapes must not depend on
+    input VALUES).  ``backward`` as in ``register_custom_op`` (required
+    for training: callbacks are opaque to jax AD).
+    """
+    import jax
+
+    from .custom_op import register_custom_op
+
+    def jax_fn(*args):
+        out_aval = out_shape_fn(
+            *[jax.ShapeDtypeStruct(np.shape(a), a.dtype) for a in args])
+        return jax.pure_callback(
+            lambda *xs: np.asarray(fn(*[np.asarray(x) for x in xs]),
+                                   dtype=out_aval.dtype),
+            out_aval, *args, vmap_method="sequential")
+
+    return register_custom_op(name, jax_fn, backward=backward, tags=tags)

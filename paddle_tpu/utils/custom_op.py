@@ -1,0 +1,82 @@
+"""User custom-op registration — the TPU analog of the reference's
+runtime-registered external ops (paddle/fluid/framework/custom_operator.cc,
+OpMetaInfo at paddle/phi/api/lib/op_meta_info.cc).
+
+On TPU a "custom kernel" is a pure jax function — jnp composition, a
+``pallas_call`` kernel, or a host callback — so registration reduces to:
+wire the function (plus an optional hand-written backward) into the op
+registry, from which it gets eager dispatch with autograd, the jit-cache,
+AMP casting, profiler events, and coverage accounting for free.
+
+>>> def fwd(x, alpha): return x * alpha
+>>> def bwd(gout, x, alpha): return gout * alpha, None   # None: no grad
+>>> my_scale = register_custom_op("my_scale", fwd, backward=bwd)
+>>> y = my_scale(paddle.to_tensor(arr), 3.0)             # Tensor in/out
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import registry
+
+
+def register_custom_op(name, forward, backward=None, tags=("custom",)):
+    """Register ``forward`` (pure jax) as an eager op named ``name``.
+
+    ``backward(*cotangents, *primals) -> per-primal cotangents`` overrides
+    jax's automatic VJP (reference custom ops supply an explicit grad
+    kernel).  Return ``None`` for a primal that gets no gradient (its
+    cotangent becomes symbolic zero).  Without ``backward``, gradients come
+    from ``jax.vjp`` over ``forward`` — if ``forward`` is not
+    differentiable by jax (e.g. wraps ``pure_callback``), a backward is
+    required for training use.
+
+    Returns the user-facing function (Tensors in/out, autograd recorded);
+    also imports it into the op registry so ``ops.raw(name)`` works in jit
+    paths and coverage counts it.
+    """
+    if name in registry.OPS:
+        raise ValueError(f"op {name!r} is already registered")
+
+    jfn = forward
+    if backward is not None:
+        jfn = jax.custom_vjp(forward)
+
+        def _fwd(*args):
+            return forward(*args), args
+
+        def _bwd(args, cots):
+            cot_list = list(cots) if isinstance(cots, (tuple, list)) \
+                else [cots]
+            grads = backward(*cot_list, *args)
+            if grads is None:
+                grads = (None,) * len(args)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(args):
+                raise ValueError(
+                    f"custom backward for {name!r} returned {len(grads)} "
+                    f"gradients for {len(args)} inputs")
+            return tuple(
+                jnp.zeros_like(a) if g is None else g
+                for g, a in zip(grads, args))
+
+        jfn.defvjp(_fwd, _bwd)
+
+    return registry.op(name, tags=tags)(jfn)
+
+
+def register_pallas_op(name, kernel_fn, backward=None, tags=("custom",
+                                                             "pallas")):
+    """Register a Pallas kernel as an op.
+
+    ``kernel_fn`` is any function whose body invokes
+    ``jax.experimental.pallas.pallas_call`` (see
+    paddle_tpu/ops/pallas/attention_kernel.py for the house style: TPU
+    grid/block specs, VMEM-sized tiles, custom_vjp for the backward).
+    Pallas kernels are jax-transparent, so this is ``register_custom_op``
+    with pallas tags — the separate entry point exists to document the
+    path and keep the registry's kernel provenance queryable
+    (``OPS[name].tags``).
+    """
+    return register_custom_op(name, kernel_fn, backward=backward, tags=tags)

@@ -4,5 +4,6 @@ Model zoo (resnet/vgg/mobilenet) + transforms + datasets.  Round 1 carries the
 resnet family; the rest of the zoo widens in later rounds.
 """
 
+from . import datasets  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401

@@ -1,0 +1,215 @@
+"""paddle.vision.datasets parity — MNIST/FashionMNIST/Cifar/ImageFolder.
+
+Reference: python/paddle/vision/datasets/{mnist,cifar,folder}.py.  Those
+download from Baidu mirrors; this environment has no egress, so
+``download=True`` raises with instructions and the parsers consume local
+files in the standard formats (idx-ubyte for MNIST, the python-pickle
+batch tarball for CIFAR, class-per-directory trees for ImageFolder).
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        "(no network egress). Place the standard dataset files locally and "
+        "pass their paths (image_path/label_path or data_file).")
+
+
+def _read_idx(path):
+    """Parse an idx-ubyte file (optionally .gz): the MNIST wire format."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        dims = [struct.unpack(">I", f.read(4))[0] for _ in range(ndim)]
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+class MNIST(Dataset):
+    """MNIST from local idx files (reference mnist.py API).
+
+    >>> ds = MNIST(image_path="train-images-idx3-ubyte.gz",
+    ...            label_path="train-labels-idx1-ubyte.gz")
+    >>> img, label = ds[0]    # img: float32 [28, 28] in [0, 1]
+    """
+
+    NAME = "MNIST"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(self.NAME)
+            raise ValueError(
+                f"{self.NAME} requires image_path and label_path "
+                "(no download available)")
+        self.mode = mode
+        self.transform = transform
+        self.images = _read_idx(image_path)
+        self.labels = _read_idx(label_path)
+        if len(self.images) != len(self.labels):
+            raise ValueError("image/label count mismatch")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+
+class FashionMNIST(MNIST):
+    NAME = "FashionMNIST"
+
+
+class _CifarBase(Dataset):
+    """CIFAR from the standard python-version tarball."""
+
+    MODE_TRAIN_FILES = ()
+    MODE_TEST_FILES = ()
+    LABEL_KEY = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download(type(self).__name__)
+            raise ValueError(f"{type(self).__name__} requires data_file "
+                             "(no download available)")
+        self.mode = mode
+        self.transform = transform
+        wanted = (self.MODE_TRAIN_FILES if mode == "train"
+                  else self.MODE_TEST_FILES)
+        data, labels = [], []
+        with tarfile.open(data_file) as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in wanted:
+                    d = pickle.load(tf.extractfile(member),
+                                    encoding="bytes")
+                    data.append(np.asarray(d[b"data"], np.uint8))
+                    labels.extend(d[self.LABEL_KEY])
+        if not data:
+            raise ValueError(f"no {mode} batches found in {data_file}")
+        self.data = np.concatenate(data).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, np.int64)
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        img = self.data[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+
+class Cifar10(_CifarBase):
+    MODE_TRAIN_FILES = tuple(f"data_batch_{i}" for i in range(1, 6))
+    MODE_TEST_FILES = ("test_batch",)
+    LABEL_KEY = b"labels"
+
+
+class Cifar100(_CifarBase):
+    MODE_TRAIN_FILES = ("train",)
+    MODE_TEST_FILES = ("test",)
+    LABEL_KEY = b"fine_labels"
+
+
+_IMG_EXTS = (".png", ".npy", ".npz")
+
+
+def _load_image(path):
+    """Local image loader: .npy/.npz arrays always; .png via PIL when
+    available (PIL ships with many images; gated, not required)."""
+    if path.endswith(".npy"):
+        return np.load(path)
+    if path.endswith(".npz"):
+        return np.load(path)["arr_0"]
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(
+            f"loading {path} requires Pillow; use .npy files instead") from e
+    return np.asarray(Image.open(path))
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory tree (reference folder.py semantics)."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _load_image
+        self.transform = transform
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                p = os.path.join(cdir, fn)
+                ok = (is_valid_file(p) if is_valid_file
+                      else fn.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append((p, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no samples found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(target)
+
+
+class ImageFolder(Dataset):
+    """flat/unlabeled folder of images (reference folder.py ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=_IMG_EXTS,
+                 transform=None, is_valid_file=None):
+        self.loader = loader or _load_image
+        self.transform = transform
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                p = os.path.join(dirpath, fn)
+                ok = (is_valid_file(p) if is_valid_file
+                      else fn.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(p)
+        if not self.samples:
+            raise ValueError(f"no images found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]

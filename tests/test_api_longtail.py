@@ -1,0 +1,272 @@
+"""API long tail: vision datasets (format parsers), audio features, text
+viterbi, ONNX export, cost model.
+
+Reference targets: python/paddle/vision/datasets/, python/paddle/audio/,
+python/paddle/text/viterbi_decode.py, python/paddle/onnx/export.py,
+python/paddle/cost_model/cost_model.py.  Datasets are exercised against
+synthetic files in the standard wire formats (no downloads here).
+"""
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+# ------------------------------------------------------------ vision data --
+
+def _write_idx(path, arr):
+    arr = np.asarray(arr, np.uint8)
+    with gzip.open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x0800 + arr.ndim))
+        for d in arr.shape:
+            f.write(struct.pack(">I", d))
+        f.write(arr.tobytes())
+
+
+class TestVisionDatasets:
+    def test_mnist_idx_parser(self, tmp_path):
+        from paddle_tpu.vision.datasets import MNIST
+
+        imgs = np.random.randint(0, 256, (10, 28, 28), dtype=np.uint8)
+        labels = np.random.randint(0, 10, (10,), dtype=np.uint8)
+        ip, lp = str(tmp_path / "img.gz"), str(tmp_path / "lab.gz")
+        _write_idx(ip, imgs)
+        _write_idx(lp, labels)
+        ds = MNIST(image_path=ip, label_path=lp)
+        assert len(ds) == 10
+        img, lab = ds[3]
+        np.testing.assert_allclose(img, imgs[3] / 255.0, rtol=1e-6)
+        assert lab == labels[3]
+        # feeds a DataLoader end-to-end
+        from paddle_tpu import io
+        xb, yb = next(iter(io.DataLoader(ds, batch_size=4)))
+        assert xb.shape == [4, 28, 28]
+
+    def test_cifar10_tar_parser(self, tmp_path):
+        from paddle_tpu.vision.datasets import Cifar10
+
+        tar_path = str(tmp_path / "cifar-10-python.tar.gz")
+        rng = np.random.RandomState(0)
+        with tarfile.open(tar_path, "w:gz") as tf:
+            for name, n in [("data_batch_1", 6), ("test_batch", 4)]:
+                payload = pickle.dumps({
+                    b"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+                    b"labels": list(rng.randint(0, 10, n))})
+                import io as _io
+                info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+                info.size = len(payload)
+                tf.addfile(info, _io.BytesIO(payload))
+        train = Cifar10(data_file=tar_path, mode="train")
+        test = Cifar10(data_file=tar_path, mode="test")
+        assert len(train) == 6 and len(test) == 4
+        img, lab = train[0]
+        assert img.shape == (3, 32, 32) and img.max() <= 1.0
+
+    def test_dataset_folder(self, tmp_path):
+        from paddle_tpu.vision.datasets import DatasetFolder
+
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / cls)
+            for i in range(3):
+                np.save(tmp_path / cls / f"{i}.npy",
+                        np.ones((4, 4), np.float32) * i)
+        ds = DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"] and len(ds) == 6
+        img, target = ds[5]
+        assert target == 1
+
+    def test_download_gated(self):
+        from paddle_tpu.vision.datasets import MNIST
+
+        with pytest.raises((RuntimeError, ValueError)):
+            MNIST(download=True)
+
+
+# ------------------------------------------------------------------ audio --
+
+class TestAudio:
+    def test_mel_hz_roundtrip(self):
+        from paddle_tpu.audio import functional as F
+
+        freqs = np.array([100.0, 440.0, 1000.0, 4000.0], np.float32)
+        back = np.asarray(F.mel_to_hz(F.hz_to_mel(freqs)))
+        np.testing.assert_allclose(back, freqs, rtol=1e-4)
+        # htk variant too
+        back_htk = np.asarray(F.mel_to_hz(F.hz_to_mel(freqs, htk=True),
+                                          htk=True))
+        np.testing.assert_allclose(back_htk, freqs, rtol=1e-4)
+
+    def test_fbank_partition_of_unity_shape(self):
+        from paddle_tpu.audio import functional as F
+
+        fb = np.asarray(F.compute_fbank_matrix(sr=16000, n_fft=512,
+                                               n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+
+    def test_spectrogram_tone_peak(self):
+        from paddle_tpu.audio.features import Spectrogram
+
+        sr, n_fft = 8000, 256
+        tsig = np.arange(sr // 4) / sr
+        tone = np.sin(2 * np.pi * 1000.0 * tsig).astype(np.float32)
+        spec = Spectrogram(n_fft=n_fft, hop_length=128)(
+            paddle.to_tensor(tone[None]))
+        s = spec.numpy()[0]                     # [freq, time]
+        peak_bin = s.mean(axis=1).argmax()
+        expect = round(1000.0 * n_fft / sr)
+        assert abs(int(peak_bin) - expect) <= 1
+
+    def test_mfcc_pipeline_shapes(self):
+        from paddle_tpu.audio.features import (
+            LogMelSpectrogram,
+            MelSpectrogram,
+            MFCC,
+        )
+
+        x = paddle.to_tensor(
+            np.random.randn(2, 4000).astype(np.float32))
+        mel = MelSpectrogram(sr=8000, n_fft=256, n_mels=32, f_min=0.0)(x)
+        assert mel.shape[0] == 2 and mel.shape[1] == 32
+        logmel = LogMelSpectrogram(sr=8000, n_fft=256, n_mels=32,
+                                   f_min=0.0)(x)
+        assert logmel.shape == mel.shape
+        mfcc = MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=32, f_min=0.0)(x)
+        assert mfcc.shape[1] == 13
+
+    def test_io_gated(self):
+        with pytest.raises(NotImplementedError):
+            paddle.audio.load("x.wav")
+
+
+# ------------------------------------------------------------------- text --
+
+class TestViterbi:
+    def test_matches_numpy_reference(self):
+        from paddle_tpu.text import viterbi_decode
+
+        rng = np.random.RandomState(0)
+        B, T, N = 3, 6, 5
+        pot = rng.rand(B, T, N).astype(np.float32)
+        trans = rng.rand(N, N).astype(np.float32)
+        lens = np.array([6, 4, 1], np.int64)
+
+        scores, paths = viterbi_decode(
+            paddle.to_tensor(pot), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+
+        # brute force per sequence
+        for b in range(B):
+            L = lens[b]
+            best, best_path = -1e30, None
+            import itertools
+            for path in itertools.product(range(N), repeat=int(L)):
+                s = pot[b, 0, path[0]]
+                for i in range(1, L):
+                    s += trans[path[i - 1], path[i]] + pot[b, i, path[i]]
+                if s > best:
+                    best, best_path = s, path
+            np.testing.assert_allclose(scores.numpy()[b], best, rtol=1e-5)
+            np.testing.assert_array_equal(
+                paths.numpy()[b, :L], np.asarray(best_path))
+
+    def test_decoder_layer_and_bos_eos(self):
+        from paddle_tpu.text import ViterbiDecoder
+
+        rng = np.random.RandomState(1)
+        pot = paddle.to_tensor(rng.rand(2, 4, 6).astype(np.float32))
+        trans = paddle.to_tensor(rng.rand(6, 6).astype(np.float32))
+        lens = paddle.to_tensor(np.array([4, 3], np.int64))
+        dec = ViterbiDecoder(trans, include_bos_eos_tag=True)
+        scores, path = dec(pot, lens)
+        assert scores.shape == [2] and path.shape == [2, 4]
+
+
+# ------------------------------------------------------------------- onnx --
+
+class TestOnnxExport:
+    def test_mlp_numeric_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, 8).astype(np.float32))
+        path = paddle.onnx.export(m, str(tmp_path / "mlp"), input_spec=[x])
+        assert path.endswith(".onnx") and os.path.getsize(path) > 100
+        out = paddle.onnx.runtime.run(path, [x.numpy()])[0]
+        np.testing.assert_allclose(out, m(x).numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_softmax_layernorm_composition(self, tmp_path):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(8, 8)
+                self.ln = nn.LayerNorm(8)
+
+            def forward(self, x):
+                return nn.functional.softmax(self.ln(self.fc(x)), axis=-1)
+
+        n = Net()
+        x = paddle.to_tensor(np.random.rand(3, 8).astype(np.float32))
+        p = paddle.onnx.export(n, str(tmp_path / "net"), input_spec=[x])
+        out = paddle.onnx.runtime.run(p, [x.numpy()])[0]
+        np.testing.assert_allclose(out, n(x).numpy(), rtol=1e-4, atol=1e-5)
+
+    def test_model_proto_structure(self, tmp_path):
+        m = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.zeros((1, 4), np.float32))
+        p = paddle.onnx.export(m, str(tmp_path / "lin"), input_spec=[x])
+        model = paddle.onnx.runtime.load(p)
+        assert model.producer_name == "paddle_tpu"
+        assert model.opset_import[0].version == 13
+        assert len(model.graph.input) == 1
+        assert len(model.graph.output) == 1
+        assert any(n.op_type == "MatMul" for n in model.graph.node)
+
+    def test_unsupported_primitive_raises_loudly(self, tmp_path):
+        class Weird(nn.Layer):
+            def forward(self, x):
+                import jax.numpy as jnp
+
+                from paddle_tpu.core.tensor import Tensor
+                return Tensor(jnp.fft.fft(x._data).real)
+
+        with pytest.raises(NotImplementedError, match="primitive"):
+            paddle.onnx.export(Weird(), str(tmp_path / "w"), input_spec=[
+                paddle.to_tensor(np.zeros(8, np.float32))])
+
+
+# ------------------------------------------------------------- cost model --
+
+class TestCostModel:
+    def test_profile_measure_collects_ops(self):
+        from paddle_tpu.cost_model import CostModel
+
+        x = paddle.to_tensor(np.random.rand(64, 64).astype(np.float32))
+
+        def fn():
+            return paddle.matmul(x, x) + x
+
+        costs = CostModel().profile_measure(fn)
+        assert any("matmul" in k for k in costs), costs.keys()
+        for rec in costs.values():
+            assert rec["op_time_ms"] >= 0 and rec["calls"] >= 1
+
+    def test_static_op_time_and_save_load(self, tmp_path):
+        from paddle_tpu.cost_model import CostModel
+
+        cm = CostModel()
+        t = cm.get_static_op_time("matmul", shapes=((64, 64), (64, 64)))
+        assert t["op_time"] > 0
+        p = str(tmp_path / "costs.json")
+        cm.save(p)
+        cm2 = CostModel()
+        cm2.load(p)
+        assert cm2._static_table

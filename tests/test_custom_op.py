@@ -1,0 +1,259 @@
+"""Custom-op extension path: register_custom_op / register_pallas_op /
+cpp_extension.load / host_op_from_extension, plus the op-schema single
+source and the Pallas autotune cache.
+
+Reference parity targets: paddle/fluid/framework/custom_operator.cc
+(runtime op registration), python/paddle/utils/cpp_extension/ (JIT C++
+build), paddle/phi/kernels/autotune/ (config cache),
+paddle/phi/api/yaml/ops.yaml (single-source signatures).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import OPS, registry
+from paddle_tpu.utils import cpp_extension, register_custom_op
+
+
+def _unique(name):
+    i = 0
+    while f"{name}{i}" in OPS:
+        i += 1
+    return f"{name}{i}"
+
+
+class TestRegisterCustomOp:
+    def test_forward_only_uses_jax_vjp(self):
+        import jax.numpy as jnp
+
+        name = _unique("cube_op")
+        cube = register_custom_op(name, lambda x: x * x * x)
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        y = cube(x)
+        np.testing.assert_allclose(y.numpy(), [8.0])
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])  # 3x^2
+        assert name in OPS and "custom" in OPS[name].tags
+
+    def test_custom_backward_overrides(self):
+        name = _unique("scale2")
+        # deliberately wrong-by-2 backward proves the override is used
+        op = register_custom_op(
+            name,
+            lambda x: 2.0 * x,
+            backward=lambda gout, x: 10.0 * gout)
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        y = op(x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 10.0 * np.ones(3))
+
+    def test_none_grad_becomes_zero(self):
+        name = _unique("axpy")
+        op = register_custom_op(
+            name,
+            lambda x, y: x + y,
+            backward=lambda gout, x, y: (gout, None))
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        y = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        op(x, y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.ones(2))
+        np.testing.assert_allclose(y.grad.numpy(), np.zeros(2))
+
+    def test_duplicate_name_rejected(self):
+        with pytest.raises(ValueError, match="already registered"):
+            register_custom_op("matmul", lambda x: x)
+
+    def test_works_under_jit(self):
+        from paddle_tpu.jit import to_static
+
+        name = _unique("jit_custom")
+        op = register_custom_op(name, lambda x: x * 5.0)
+
+        @to_static
+        def f(x):
+            return op(x) + 1.0
+
+        x = paddle.to_tensor(np.ones(4, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), 6.0 * np.ones(4))
+
+
+class TestCppExtension:
+    SRC = """
+    extern "C" {
+    void saxpy(const float* x, const float* y, float* out, long long n,
+               float a) {
+      for (long long i = 0; i < n; ++i) out[i] = a * x[i] + y[i];
+    }
+    long long checksum(const long long* v, long long n) {
+      long long s = 0;
+      for (long long i = 0; i < n; ++i) s += v[i];
+      return s;
+    }
+    }
+    """
+
+    def test_load_inline_source_and_call(self):
+        import ctypes
+
+        mod = cpp_extension.load(
+            "test_ext", [self.SRC],
+            functions={
+                "saxpy": ("void", ["float*", "float*", "float*", "int64",
+                                   "float"]),
+                "checksum": ("int64", ["int64*", "int64"]),
+            })
+        x = np.arange(5, dtype=np.float32)
+        y = np.ones(5, dtype=np.float32)
+        out = np.empty(5, dtype=np.float32)
+        fp = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+        mod.saxpy(fp(x), fp(y), fp(out), 5, 2.0)
+        np.testing.assert_allclose(out, 2 * x + y)
+
+        v = np.arange(10, dtype=np.int64)
+        assert mod.checksum(
+            v.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), 10) == 45
+
+    def test_build_is_cached(self):
+        m1 = cpp_extension.load("cache_ext", [self.SRC])
+        m2 = cpp_extension.load("cache_ext", [self.SRC])
+        assert m1._so_path == m2._so_path
+
+    def test_host_op_from_extension(self):
+        import jax
+
+        name = _unique("host_relu")
+
+        def host_fn(x):
+            return np.maximum(x, 0.0)
+
+        op = cpp_extension.host_op_from_extension(
+            name, host_fn,
+            out_shape_fn=lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            backward=lambda gout, x: gout * (x > 0))
+        x = paddle.to_tensor(np.array([-1.0, 2.0], np.float32),
+                             stop_gradient=False)
+        y = op(x)
+        np.testing.assert_allclose(y.numpy(), [0.0, 2.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0])
+
+        # host callback must also work under jit
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(t):
+            return op(t) * 2.0
+
+        np.testing.assert_allclose(f(x).numpy(), [0.0, 4.0])
+
+
+class TestOpSchema:
+    def test_schema_loaded_and_canonical(self):
+        from paddle_tpu.ops.schema import OP_SCHEMA
+
+        assert len(OP_SCHEMA) >= 389
+        m = registry.schema("matmul")
+        assert [a[1] for a in m["args"]] == ["x", "y", "transpose_x",
+                                            "transpose_y"]
+        assert m["backward"] == "matmul_grad"
+        assert registry.schema("sparse.matmul")["group"] == "sparse_ops"
+
+    def test_schema_covers_inventory(self):
+        from paddle_tpu.ops.inventory import OP_INVENTORY
+        from paddle_tpu.ops.schema import OP_SCHEMA
+
+        missing = [n for n in OP_INVENTORY if n not in OP_SCHEMA]
+        assert not missing, missing[:10]
+
+
+class TestAutotune:
+    def test_pick_flag_off_returns_heuristic(self):
+        from paddle_tpu.ops.pallas import autotune
+
+        autotune.autotune_cache_clear()
+        calls = []
+        got = autotune.pick("k", (1,), ["a", "b"],
+                            measure=lambda c: calls.append(c))
+        assert got == "a" and calls == []  # flag off: no measurement
+
+    def test_pick_measures_and_caches_with_flag(self):
+        from paddle_tpu.ops.pallas import autotune
+
+        autotune.autotune_cache_clear()
+        paddle.set_flags({"FLAGS_use_autotune": True})
+        try:
+            import time
+
+            def measure(c):
+                time.sleep(0.02 if c == "slow" else 0.001)
+
+            got = autotune.pick("k2", (2,), ["slow", "fast"],
+                                measure=measure)
+            assert got == "fast"
+            # cached: a failing measure proves it is not re-run
+            got2 = autotune.pick("k2", (2,), ["slow", "fast"],
+                                 measure=lambda c: 1 / 0)
+            assert got2 == "fast"
+        finally:
+            paddle.set_flags({"FLAGS_use_autotune": False})
+
+    def test_heuristic_entry_does_not_block_later_tuning(self):
+        from paddle_tpu.ops.pallas import autotune
+
+        autotune.autotune_cache_clear()
+        # flag off: heuristic cached
+        assert autotune.pick("k4", (4,), ["a", "b"],
+                             measure=lambda c: None) == "a"
+        # flag on: the untuned entry must not satisfy the tuning request
+        paddle.set_flags({"FLAGS_use_autotune": True})
+        try:
+            import time
+
+            def measure(c):
+                time.sleep(0.02 if c == "a" else 0.001)
+
+            assert autotune.pick("k4", (4,), ["a", "b"],
+                                 measure=measure) == "b"
+        finally:
+            paddle.set_flags({"FLAGS_use_autotune": False})
+
+    def test_failing_candidate_skipped(self):
+        from paddle_tpu.ops.pallas import autotune
+
+        autotune.autotune_cache_clear()
+        paddle.set_flags({"FLAGS_use_autotune": True})
+        try:
+            def measure(c):
+                if c == "bad":
+                    raise MemoryError("vmem")
+
+            assert autotune.pick("k3", (3,), ["bad", "ok"],
+                                 measure=measure) == "ok"
+        finally:
+            paddle.set_flags({"FLAGS_use_autotune": False})
+
+    def test_flash_attention_still_correct(self):
+        # interpret-mode pallas on CPU: autotuned block path must match XLA
+        from paddle_tpu.ops.pallas.attention_kernel import (
+            flash_attention_pallas,
+        )
+        import jax.numpy as jnp
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.rand(1, 128, 2, 16).astype(np.float32))
+        k = jnp.asarray(rng.rand(1, 128, 2, 16).astype(np.float32))
+        v = jnp.asarray(rng.rand(1, 128, 2, 16).astype(np.float32))
+        out = flash_attention_pallas(q, k, v, is_causal=True,
+                                     interpret=True)
+        # dense reference
+        scale = 1.0 / np.sqrt(16)
+        qt = np.transpose(q, (0, 2, 1, 3))
+        kt = np.transpose(k, (0, 2, 1, 3))
+        vt = np.transpose(v, (0, 2, 1, 3))
+        s = (qt @ np.transpose(kt, (0, 1, 3, 2))) * scale
+        mask = np.triu(np.full((128, 128), -1e30, np.float32), 1)
+        p = np.exp(s + mask - (s + mask).max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        ref = np.transpose(p @ vt, (0, 2, 1, 3))
+        np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
