@@ -43,4 +43,5 @@ from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import ps  # noqa: F401
+from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401

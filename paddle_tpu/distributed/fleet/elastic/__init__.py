@@ -73,6 +73,15 @@ class ElasticManager:
                 dead.append(nid)
         return dead
 
+    def rescale(self, node_ids):
+        """New rank assignment over the surviving nodes (reference
+        manager rewrites PADDLE_TRAINER_* env before relaunch).
+
+        Returns ({node_id: new_rank}, dead_nodes)."""
+        dead = set(self.dead_nodes(node_ids))
+        alive = sorted(n for n in node_ids if n not in dead)
+        return {nid: i for i, nid in enumerate(alive)}, sorted(dead)
+
     def watch(self, node_ids, on_change=None, poll=None):
         """Blocks until membership changes; returns (status, dead_nodes)."""
         poll = poll or self.interval

@@ -30,6 +30,11 @@ def _parse_args(argv=None):
     p.add_argument("--nproc_per_node", type=int, default=1)
     p.add_argument("--log_dir", default="log")
     p.add_argument("--job_id", default="default")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_MAX_RESTARTS", "0")),
+                   help="elastic: relaunch the local ranks up to N times "
+                        "after a failure (reference elastic/manager.py "
+                        "watch->rescale->restart loop)")
     p.add_argument("--devices", default=None,
                    help="visible device ids, comma separated")
     p.add_argument("training_script")
@@ -79,51 +84,80 @@ def launch(argv=None):
         master = args.master
 
     os.makedirs(args.log_dir, exist_ok=True)
+
     procs = []
-    logs = []
-    log_files = []
-    for local_rank in range(args.nproc_per_node):
-        rank = args.node_rank * args.nproc_per_node + local_rank
-        log_path = os.path.join(args.log_dir,
-                                f"workerlog.{rank}")
-        logf = open(log_path, "w")
-        log_files.append(logf)
-        cmd = [sys.executable, args.training_script] + \
-            args.training_script_args
-        proc = subprocess.Popen(
-            cmd, env=_rank_env(args, local_rank, world_size, master),
-            stdout=logf, stderr=subprocess.STDOUT)
-        procs.append(proc)
-        logs.append(log_path)
+
+    def _spawn(restart_idx):
+        """(Re)launch all local ranks; rank env is rebuilt each attempt
+        (reference ElasticManager rewrites rank env before relaunch)."""
+        local_procs, local_logs, files = [], [], []
+        for local_rank in range(args.nproc_per_node):
+            rank = args.node_rank * args.nproc_per_node + local_rank
+            suffix = f".restart{restart_idx}" if restart_idx else ""
+            log_path = os.path.join(args.log_dir,
+                                    f"workerlog.{rank}{suffix}")
+            logf = open(log_path, "w")
+            files.append(logf)
+            env = _rank_env(args, local_rank, world_size, master)
+            env["PADDLE_RESTART_COUNT"] = str(restart_idx)
+            cmd = [sys.executable, args.training_script] + \
+                args.training_script_args
+            local_procs.append(subprocess.Popen(
+                cmd, env=env, stdout=logf, stderr=subprocess.STDOUT))
+            local_logs.append(log_path)
+        return local_procs, local_logs, files
+
+    shutting_down = []  # non-empty once the operator asked us to stop
 
     def _terminate(*_):
+        shutting_down.append(True)
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        deadline = time.time() + 10
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
 
     signal.signal(signal.SIGTERM, _terminate)
     rc = 0
+    restarts = 0
+    logs, log_files = [], []
     try:
-        while any(p.poll() is None for p in procs):
+        while True:
+            procs, logs, log_files = _spawn(restarts)
+            rc = 0
+            while any(p.poll() is None for p in procs):
+                for p in procs:
+                    code = p.poll()
+                    if code is not None and code != 0:
+                        # one rank failed: tear down the rest (reference
+                        # controller restart/abort policy)
+                        _terminate()
+                        rc = code
+                time.sleep(0.2)
             for p in procs:
-                code = p.poll()
-                if code is not None and code != 0:
-                    # one rank failed: tear down the rest (reference
-                    # controller restart/abort policy)
-                    _terminate()
-                    rc = code
-            time.sleep(0.2)
-        for p in procs:
-            rc = rc or (p.returncode or 0)
+                rc = rc or (p.returncode or 0)
+            for f in log_files:
+                try:
+                    f.close()
+                except OSError:
+                    pass
+            # an operator-initiated SIGTERM is a shutdown, not a rank
+            # failure — never elastic-restart against the supervisor
+            if rc == 0 or restarts >= args.max_restarts or shutting_down:
+                break
+            restarts += 1
+            sys.stderr.write(
+                f"[launch] job failed (exit {rc}); elastic restart "
+                f"{restarts}/{args.max_restarts}\n")
+            time.sleep(1)
     except KeyboardInterrupt:
         _terminate()
         rc = 130
-    finally:
-        for f in log_files:
-            try:
-                f.close()
-            except OSError:
-                pass
     if rc != 0:
         sys.stderr.write(
             f"[launch] job failed (exit {rc}); logs: {', '.join(logs)}\n")

@@ -1,3 +1,3 @@
 """paddle.incubate parity namespace (reference python/paddle/incubate/)."""
 
-from . import autograd, distributed  # noqa: F401
+from . import autograd, distributed, nn  # noqa: F401

@@ -29,6 +29,8 @@ class Config:
         self.memory_optimized = True
         self._enable_profile = False
         self._precision = "float32"
+        self._dist_mesh = None
+        self._dist_batch_axis = "dp"
 
     def set_model(self, model_path, params_path=None):
         self.model_path = model_path
@@ -42,7 +44,19 @@ class Config:
         self._enable_profile = True
 
     def enable_mixed_precision(self, dtype="bfloat16"):
+        """Convert-to-mixed-precision pass parity
+        (paddle/fluid/inference/analysis/passes convert_to_mixed_precision):
+        float parameters are cast once at predictor build, activations run
+        in ``dtype``."""
         self._precision = dtype
+
+    def enable_dist_inference(self, mesh, batch_axis="dp"):
+        """Distributed inference over a jax Mesh (reference DistModel /
+        dist inference over FleetExecutor): inputs are sharded along
+        ``batch_axis``, parameters replicated, one XLA program spans the
+        mesh."""
+        self._dist_mesh = mesh
+        self._dist_batch_axis = batch_axis
 
     def switch_ir_optim(self, flag=True):
         pass  # XLA always optimizes
@@ -92,6 +106,57 @@ class Predictor:
         self._outputs = [_IOHandle("out0")]
         self._compiled_cache = {}
 
+        # mixed-precision convert pass: cast float params ONCE (the
+        # reference rewrites the program + params; here params are leaves)
+        if config._precision in ("bfloat16", "float16"):
+            import jax.numpy as jnp
+
+            target = jnp.dtype(config._precision)
+            for p in getattr(self._model, "state_dict", dict)().values():
+                data = getattr(p, "_data", None)
+                if data is not None and jnp.issubdtype(data.dtype,
+                                                       jnp.floating):
+                    p._data = data.astype(target)
+
+    def _compiled(self, avals):
+        """One cached XLA executable per input-signature (the reference's
+        optimized-program + shape cache, AnalysisPredictor::Run path)."""
+        key = tuple((a.shape, str(a.dtype)) for a in avals)
+        jitted = self._compiled_cache.get(key)
+        if jitted is None:
+            from ..jit import functional_call
+
+            model = self._model
+
+            def pure(state, *xs):
+                out = functional_call(model, state, *(Tensor(x)
+                                                      for x in xs))
+                outs = out if isinstance(out, (tuple, list)) else (out,)
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in outs)
+
+            mesh = self._config._dist_mesh
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                axis = self._config._dist_batch_axis
+                in_shard = NamedSharding(mesh, P(axis))
+                jitted = jax.jit(pure, in_shardings=(
+                    None, *([in_shard] * len(avals))))
+            else:
+                jitted = jax.jit(pure)
+            self._compiled_cache[key] = jitted
+        # live weights every call: only the EXECUTABLE is cached, so a
+        # fine-tuned / set_state_dict'ed model is picked up immediately
+        state = {k: v._data for k, v in self._model.state_dict().items()}
+        if self._config._dist_mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            rep = NamedSharding(self._config._dist_mesh, P())
+            state = jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, rep), state)
+        return jitted, state
+
     # ------------------------------------------------------------- handles --
     def get_input_names(self):
         return [h.name for h in self._inputs]
@@ -121,16 +186,15 @@ class Predictor:
             arrays = [np.asarray(a) for a in inputs]
         else:
             arrays = [h._host for h in self._inputs if h._host is not None]
-        args = tuple(Tensor(jax.numpy.asarray(a)) for a in arrays)
+        datas = [jax.numpy.asarray(a) for a in arrays]
         if self._config._precision in ("bfloat16", "float16"):
-            args = tuple(
-                t.astype(self._config._precision)
-                if jax.numpy.issubdtype(t.dtype, jax.numpy.floating) else t
-                for t in args)
-        out = self._model(*args)
-        outs = out if isinstance(out, (tuple, list)) else (out,)
-        host = [np.asarray(o._data if isinstance(o, Tensor) else o)
-                for o in outs]
+            datas = [
+                d.astype(self._config._precision)
+                if jax.numpy.issubdtype(d.dtype, jax.numpy.floating) else d
+                for d in datas]
+        jitted, state = self._compiled(datas)
+        outs = jitted(state, *datas)
+        host = [np.asarray(o) for o in outs]
         while len(self._outputs) < len(host):
             self._outputs.append(_IOHandle(f"out{len(self._outputs)}"))
         for h, o in zip(self._outputs, host):
@@ -143,3 +207,10 @@ class Predictor:
 def create_predictor(config):
     """Reference CreatePaddlePredictor/create_predictor entry."""
     return Predictor(config)
+
+
+def get_fused_multi_transformer(model, **kwargs):
+    """KV-cache fused decoder for generative inference (see
+    incubate.nn.FusedMultiTransformer)."""
+    from ..incubate.nn import FusedMultiTransformer
+    return FusedMultiTransformer(model, **kwargs)

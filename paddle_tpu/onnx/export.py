@@ -347,7 +347,8 @@ def export(layer, path, input_spec=None, opset_version=13, **kwargs):
     from ..jit import functional_call
 
     if opset_version != _OPSET:
-        pass  # single supported opset; argument kept for API parity
+        raise ValueError(
+            f"only opset {_OPSET} is supported (requested {opset_version})")
     if input_spec is None:
         raise ValueError("input_spec (example inputs) is required")
 

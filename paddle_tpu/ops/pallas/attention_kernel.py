@@ -293,8 +293,13 @@ def _tuned_blocks(q, k, causal, scale, interpret):
         qq = jnp.asarray(rng.rand(*shape_q), q.dtype)
         kk = jnp.asarray(rng.rand(*shape_k), q.dtype)
         vv = jnp.asarray(rng.rand(*shape_k), q.dtype)
-        out, _ = _flash_fwd(qq, kk, vv, causal, scale, bq, bk, interpret)
-        jax.block_until_ready(out)
+        out, lse = _flash_fwd(qq, kk, vv, causal, scale, bq, bk, interpret)
+        # measure (and VMEM-validate) the backward too: a candidate that
+        # fits the fwd can overflow the bwd's working set, and training
+        # pays both
+        grads = _flash_bwd(qq, kk, vv, out, lse, out, causal, scale,
+                           bq, bk, interpret)
+        jax.block_until_ready((out, grads))
 
     return autotune.pick(
         "flash_attention",

@@ -33,8 +33,7 @@ def _vit_pure(potentials, transitions, lengths, include_bos_eos_tag):
     else:
         start = potentials[:, 0]
 
-    def step(carry, inp):
-        alpha, hist_t = carry
+    def step(alpha, inp):
         emit, tpos = inp                      # emit [B, N], tpos scalar
         # score[b, i, j] = alpha[b, i] + trans[i, j] + emit[b, j]
         scores = alpha[:, :, None] + transitions[None, :, :] \
@@ -44,11 +43,11 @@ def _vit_pure(potentials, transitions, lengths, include_bos_eos_tag):
         # frozen once past the sequence end
         active = (tpos < lengths)[:, None]
         new_alpha = jnp.where(active, new_alpha, alpha)
-        return (new_alpha, hist_t), best_prev
+        return new_alpha, best_prev
 
     emits = jnp.moveaxis(potentials[:, 1:], 1, 0)          # [T-1, B, N]
     tpos = jnp.arange(1, t)
-    (alpha, _), backptrs = jax.lax.scan(step, (start, 0), (emits, tpos))
+    alpha, backptrs = jax.lax.scan(step, start, (emits, tpos))
     # backptrs: [T-1, B, N]
 
     if include_bos_eos_tag:
