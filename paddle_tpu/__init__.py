@@ -116,7 +116,7 @@ from . import profiler  # noqa: F401, E402
 from . import inference  # noqa: F401, E402
 from . import incubate  # noqa: F401, E402
 from . import hapi  # noqa: F401, E402
-from .hapi import Model, summary  # noqa: F401, E402
+from .hapi import Model, flops, summary  # noqa: F401, E402
 from . import fft  # noqa: F401, E402
 from . import signal  # noqa: F401, E402
 from . import sparse  # noqa: F401, E402
@@ -129,6 +129,13 @@ from . import utils  # noqa: F401, E402
 from . import audio  # noqa: F401, E402
 from . import text  # noqa: F401, E402
 from . import cost_model  # noqa: F401, E402
+from .tensor_array import (  # noqa: F401, E402
+    TensorArray,
+    array_length,
+    array_read,
+    array_write,
+    create_array,
+)
 
 
 def disable_static(place=None):

@@ -263,3 +263,6 @@ def scaler_guarded_update(scaler, scaler_state, grads, grad_clip, optimizer,
             lambda o, n: jnp.where(found_inf, o, n), old, new)
 
     return merge(params, cand_params), merge(opt_state, cand_opt), new_sstate
+
+
+from . import debugging  # noqa: E402,F401

@@ -21,3 +21,9 @@ from .interface import (  # noqa: F401
 )
 from .strategy import Strategy  # noqa: F401
 from .engine import Engine  # noqa: F401
+from .tuner import (  # noqa: F401
+    ClusterSpec,
+    CostEstimator,
+    Mapper,
+    ParallelTuner,
+)

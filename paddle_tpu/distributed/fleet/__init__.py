@@ -67,6 +67,9 @@ def is_initialized():
 def distributed_model(model):
     """Wrap per active strategy (reference fleet.py distributed_model)."""
     from ..parallel import DataParallel
+    from .meta_optimizers import apply_recompute_to_model
+
+    model = apply_recompute_to_model(model, _state.strategy)
     hcg = get_hybrid_communicate_group()
     if hcg.get_pipe_parallel_world_size() > 1:
         from .meta_parallel.pipeline_parallel import PipelineParallel
@@ -80,6 +83,11 @@ def distributed_model(model):
 
 
 def distributed_optimizer(optimizer, strategy=None):
+    from .meta_optimizers import apply_strategy_to_optimizer
+
+    strategy = strategy or _state.strategy
+    optimizer = apply_strategy_to_optimizer(optimizer, strategy,
+                                            hcg=_state.hcg)
     hcg = _state.hcg
     if hcg is None:
         return optimizer

@@ -97,6 +97,15 @@ def _dispatch_key(name, fn, treedef, leaves, t_pos, datas, requires_grad):
     return key
 
 
+_debug_hook = None
+
+
+def set_debug_hook(fn):
+    """Install/remove the amp.debugging per-op output hook (None clears)."""
+    global _debug_hook
+    _debug_hook = fn
+
+
 @jax.jit
 def _run_vjp(vjp_fn, cots):
     """Shared jitted pullback runner.
@@ -203,6 +212,13 @@ def apply_op(name, fn, args, kwargs):
         record_host_event(name, t0, time.perf_counter() - t0)
 
     out_leaves, out_treedef = jax.tree_util.tree_flatten(out)
+
+    # amp.debugging hook: TensorCheckerConfig + operator stats (reference
+    # generates these checks into every ad_func; 5.2).  Registered by
+    # amp.debugging on enable so the disabled hot path pays one None check.
+    if _debug_hook is not None:
+        _debug_hook(name, out_leaves)
+
     node = None
     if requires_grad:
         avals = [jax.ShapeDtypeStruct(o.shape, o.dtype) for o in out_leaves]

@@ -14,6 +14,7 @@ from .optimizer import (  # noqa: F401
     Adamax,
     AdamW,
     Lamb,
+    Lars,
     Momentum,
     Optimizer,
     RMSProp,

@@ -364,3 +364,36 @@ class Lamb(Optimizer):
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         return (p - (lr * trust * r).astype(p.dtype),
                 {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p})
+
+
+class Lars(Optimizer):
+    """LARS momentum (reference fluid LarsMomentumOptimizer, used by the
+    lars meta-optimizer): per-layer trust ratio ||w|| / (||g|| + wd*||w||)
+    scales the learning rate so large-batch training keeps layer-wise
+    update magnitudes balanced."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9,
+                 lars_coeff=0.001, lars_weight_decay=0.0005,
+                 parameters=None, grad_clip=None, epsilon=1e-9, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._coeff = lars_coeff
+        self._wd = lars_weight_decay
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p, dtype=jnp.float32)}
+
+    def _update(self, p, g, state, lr, ctx):
+        g = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        w_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(g)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._coeff * w_norm / (g_norm + self._wd * w_norm
+                                    + self._epsilon),
+            1.0)
+        v = self._momentum * state["velocity"] + \
+            lr * local_lr * (g + self._wd * pf)
+        return (p - v.astype(p.dtype)), {"velocity": v}

@@ -159,8 +159,21 @@ def is_same_shape(a, b):
 
 
 class nn:
-    """paddle.sparse.nn subset: ReLU layer."""
+    """paddle.sparse.nn: activation + sparse 3D conv/pool layers."""
 
     class ReLU:
         def __call__(self, x):
             return relu(x)
+
+
+def _install_conv_layers():
+    # conv.py imports back from this module; bind after definitions
+    from .conv import Conv3D, MaxPool3D, SubmConv3D, sparse_conv3d
+
+    nn.Conv3D = Conv3D
+    nn.SubmConv3D = SubmConv3D
+    nn.MaxPool3D = MaxPool3D
+    globals()["sparse_conv3d"] = sparse_conv3d
+
+
+_install_conv_layers()

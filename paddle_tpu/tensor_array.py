@@ -1,0 +1,66 @@
+"""TensorArray (reference phi::TensorArray + paddle.tensor.array_*).
+
+The reference's TensorArray is a runtime vector<DenseTensor> used by
+static-graph control flow (while loops writing per-step outputs).  On TPU
+compiled control flow uses lax.scan carries instead, so the eager API is a
+thin list container with the reference's function surface
+(create_array / array_write / array_read / array_length); under jit
+tracing, writes at traced indices raise with guidance to use lax.scan.
+"""
+
+import jax
+
+from .core.tensor import Tensor
+
+__all__ = ["TensorArray", "create_array", "array_write", "array_read",
+           "array_length"]
+
+
+class TensorArray(list):
+    """list of Tensors with the reference's access semantics."""
+
+    def write(self, index, value):
+        index = _static_index(index, "array_write")
+        if index < len(self):
+            self[index] = value
+        else:
+            while len(self) < index:
+                self.append(None)
+            self.append(value)
+        return self
+
+    def read(self, index):
+        return self[_static_index(index, "array_read")]
+
+
+def _static_index(i, what):
+    if isinstance(i, Tensor):
+        i = i._data
+    if isinstance(i, jax.core.Tracer):
+        raise TypeError(
+            f"{what} with a traced index is not supported under jit — "
+            "per-step outputs inside compiled loops use lax.scan's ys "
+            "(see paddle_tpu.jit docs); TensorArray is an eager container.")
+    return int(i)
+
+
+def create_array(dtype=None, initialized_list=None):
+    arr = TensorArray()
+    if initialized_list:
+        arr.extend(initialized_list)
+    return arr
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = TensorArray()
+    array.write(i, x)
+    return array
+
+
+def array_read(array, i):
+    return array.read(i)
+
+
+def array_length(array):
+    return len(array)
