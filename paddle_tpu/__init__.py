@@ -127,6 +127,7 @@ from . import static  # noqa: F401, E402
 from . import onnx  # noqa: F401, E402
 from . import utils  # noqa: F401, E402
 from . import audio  # noqa: F401, E402
+from . import strings  # noqa: F401, E402
 from . import text  # noqa: F401, E402
 from . import cost_model  # noqa: F401, E402
 from .tensor_array import (  # noqa: F401, E402

@@ -110,11 +110,14 @@ class _OpStats:
     def record(self, op_name, out_leaves):
         import jax
 
+        seen_dtypes = set()
         for o in out_leaves:
             dt = str(getattr(o, "dtype", "other"))
             row = self.table.setdefault(op_name, {}).setdefault(
                 dt, [0, 0])
-            row[0] += 1
+            if dt not in seen_dtypes:  # one call per op invocation
+                row[0] += 1
+                seen_dtypes.add(dt)
             if (not isinstance(o, jax.core.Tracer)
                     and hasattr(o, "dtype")
                     and jnp.issubdtype(o.dtype, jnp.inexact)

@@ -19,3 +19,21 @@ def load(*args, **kwargs):
 def save(*args, **kwargs):
     raise NotImplementedError(
         "paddle_tpu.audio.save requires an audio IO backend (soundfile).")
+
+
+class datasets:
+    """Downloadable audio corpora (reference audio/datasets/: TESS, ESC50)
+    are unavailable without egress; the classes raise with guidance."""
+
+    class _Gated:
+        def __init__(self, *a, **k):
+            raise RuntimeError(
+                f"paddle_tpu.audio.datasets.{type(self).__name__}: "
+                "automatic download is unavailable (no egress); decode "
+                "local files and feed arrays through audio.features.")
+
+    class TESS(_Gated):
+        pass
+
+    class ESC50(_Gated):
+        pass

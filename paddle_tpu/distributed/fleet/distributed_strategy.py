@@ -39,6 +39,7 @@ class DistributedStrategy:
         self.lars = False
         self.dgc = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4}
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

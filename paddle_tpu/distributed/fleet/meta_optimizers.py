@@ -159,10 +159,15 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
             optimizer, k_steps=cfg.get("k_steps", 1),
             avg=cfg.get("avg", True))
     if getattr(strategy, "localsgd", False):
+        cfg = getattr(strategy, "localsgd_configs", None) or {}
+        dp_group = None
+        if hcg is not None:
+            # hybrid runs must average over the DP axis only — the world
+            # group would mix mp/pp shards holding different tensors
+            dp_group = hcg.get_data_parallel_group()
         optimizer = LocalSGDOptimizer(optimizer,
-                                      k_steps=strategy.a_sync_configs.get(
-                                          "k_steps", 4)
-                                      if strategy.a_sync_configs else 4)
+                                      k_steps=cfg.get("k_steps", 4),
+                                      group=dp_group)
     return optimizer
 
 

@@ -141,10 +141,17 @@ class ReduceLROnPlateau(Callback):
                 old = float(opt.get_lr())
                 new = max(old * self.factor, self.min_lr)
                 if new < old:
-                    opt.set_lr(new)
-                    if self.verbose:
-                        print(f"ReduceLROnPlateau: lr {old:.2e} -> "
-                              f"{new:.2e}")
+                    try:
+                        opt.set_lr(new)
+                        if self.verbose:
+                            print(f"ReduceLROnPlateau: lr {old:.2e} -> "
+                                  f"{new:.2e}")
+                    except RuntimeError:
+                        # optimizer drives lr from an LRScheduler —
+                        # plateau reduction cannot compose; warn once
+                        if self.verbose:
+                            print("ReduceLROnPlateau: optimizer uses an "
+                                  "LRScheduler; skipping reduction")
             self.cooldown_counter = self.cooldown
             self.wait = 0
 

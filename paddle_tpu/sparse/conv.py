@@ -109,6 +109,12 @@ def sparse_conv3d(indices, values, weight, kernel_size, stride=1,
     Returns (out_indices [M, 4], out_values [M, Cout]).
     """
     ks = _as_tuple3(kernel_size)
+    vals_arr = values._data if isinstance(values, Tensor) else values
+    if len(np.asarray(indices)) == 0:  # empty input -> empty output
+        cout = (weight._data if isinstance(weight, Tensor)
+                else np.asarray(weight)).shape[-1]
+        return (np.zeros((0, 4), np.int64),
+                jnp.zeros((0, cout), np.asarray(vals_arr).dtype))
     if spatial is None:
         c = np.asarray(indices, np.int64)
         spatial = tuple(int(c[:, i].max()) + 1 for i in (1, 2, 3))
@@ -211,6 +217,11 @@ class MaxPool3D(Layer):
             idx, vals = x
             vals = vals._data if isinstance(vals, Tensor) else vals
         idx = np.asarray(idx, np.int64)
+        if len(idx) == 0:  # empty input -> empty output
+            return sparse_coo_tensor(
+                np.zeros((4, 0), np.int64),
+                Tensor(jnp.zeros((0, vals.shape[-1]), vals.dtype)),
+                shape=(1, 1, 1, 1, vals.shape[-1]))
         if spatial is None:
             spatial = tuple(int(idx[:, i].max()) + 1 for i in (1, 2, 3))
         ks, st, pad = self.kernel_size, self.stride, self.padding

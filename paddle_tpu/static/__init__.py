@@ -101,3 +101,93 @@ default_main_program = _no_static("default_main_program")
 default_startup_program = _no_static("default_startup_program")
 data = _no_static("data")
 Program = _no_static("Program")
+
+
+# --------------------------------------------------- compiled control flow --
+
+def _tensorize(x):
+    import jax.numpy as jnp
+
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _unwrap_tree(obj):
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda x: x._data if isinstance(x, Tensor) else x, obj,
+        is_leaf=lambda x: isinstance(x, Tensor))
+
+
+def _wrap_tree(obj):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: Tensor(x), obj)
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Data-dependent branch (reference static.nn.cond over
+    conditional_block ops).
+
+    Eager: the taken branch runs natively (tape autograd flows through
+    it — reference dygraph semantics).  Under jit/to_static tracing: both
+    branches trace into ``lax.cond`` and one runs on device — the
+    supported way to branch on tensor values inside compiled code (a
+    plain python ``if`` on a traced tensor raises the trace guard)."""
+    import jax
+
+    p = _tensorize(pred)
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn() if bool(p) else false_fn()
+    return _wrap_tree(jax.lax.cond(
+        p.astype(bool).reshape(()),
+        lambda _: _unwrap_tree(true_fn()),
+        lambda _: _unwrap_tree(false_fn()),
+        None))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Data-dependent loop (reference static.nn.while_loop over while op).
+
+    Eager: the python loop runs (unrolled on the tape, differentiable).
+    Under tracing: lowers to ``lax.while_loop``; loop_var shapes must be
+    loop-invariant (XLA requirement, same as the reference's static
+    shapes), and reverse-mode grad through the compiled loop is
+    unsupported (lax.while_loop limitation — use lax.scan-style
+    fixed-trip loops for differentiable recurrences)."""
+    import jax
+
+    vals = [_tensorize(v) for v in loop_vars]
+    traced = any(isinstance(v, jax.core.Tracer) for v in vals)
+    if not traced:
+        out = _tensorize(cond_fn(*loop_vars))
+        traced = isinstance(out, jax.core.Tracer)
+        if not traced:
+            vars_ = list(loop_vars)
+            while bool(_tensorize(cond_fn(*vars_))):
+                out = body_fn(*vars_)
+                vars_ = list(out) if isinstance(out, (tuple, list)) \
+                    else [out]
+            return vars_
+
+    def c(vs):
+        return _tensorize(cond_fn(*[Tensor(v) for v in vs])) \
+            .astype(bool).reshape(())
+
+    def b(vs):
+        out = body_fn(*[Tensor(v) for v in vs])
+        out = out if isinstance(out, (tuple, list)) else (out,)
+        return tuple(_tensorize(o) for o in out)
+
+    return [Tensor(v) for v in jax.lax.while_loop(c, b, tuple(vals))]
+
+
+class nn:
+    """paddle.static.nn namespace (cond/while_loop are the TPU-meaningful
+    subset; the rest of static.nn builds ProgramDesc graphs, which XLA
+    replaced)."""
+
+    cond = staticmethod(cond)
+    while_loop = staticmethod(while_loop)

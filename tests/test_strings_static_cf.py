@@ -1,0 +1,82 @@
+"""StringTensor kernels + compiled control flow (static.nn.cond /
+while_loop).
+
+Reference targets: paddle/phi/core/string_tensor.h + strings kernels;
+python/paddle/static/nn/control_flow.py (cond over conditional_block,
+while_loop over while op) — here lax.cond / lax.while_loop.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static, strings
+
+
+class TestStringTensor:
+    def test_basic_and_kernels(self):
+        st = strings.StringTensor([["Hello", "WORLD"], ["déjà", "vu"]])
+        assert st.shape == [2, 2] and st.size == 4
+        low = st.lower()
+        assert low.tolist() == [["hello", "world"], ["déjà", "vu"]]
+        up = strings.upper(st)
+        assert up[0, 1] == "WORLD"
+        np.testing.assert_array_equal(st.str_len(),
+                                      [[5, 5], [4, 2]])
+        # déjà is 4 code points but 6 utf-8 bytes
+        assert st.byte_len()[1, 0] == 6
+
+    def test_empty(self):
+        e = strings.empty((3,))
+        assert e.tolist() == ["", "", ""]
+        assert (e == strings.StringTensor(["", "", ""])).all()
+
+
+class TestCompiledControlFlow:
+    def test_cond_eager_and_grad(self):
+        x = paddle.to_tensor(np.float32(3.0), stop_gradient=False)
+        out = static.nn.cond(x > 2.0, lambda: x * 10.0, lambda: x / 10.0)
+        np.testing.assert_allclose(out.numpy(), 30.0)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 10.0)
+
+        y = paddle.to_tensor(np.float32(1.0))
+        out2 = static.nn.cond(y > 2.0, lambda: y * 10.0, lambda: y / 10.0)
+        np.testing.assert_allclose(out2.numpy(), 0.1, rtol=1e-6)
+
+    def test_cond_under_to_static(self):
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(x):
+            return static.nn.cond(x.sum() > 0,
+                                  lambda: x + 1.0, lambda: x - 1.0)
+
+        pos = paddle.to_tensor(np.ones(3, np.float32))
+        neg = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(f(pos).numpy(), 2 * np.ones(3))
+        np.testing.assert_allclose(f(neg).numpy(), -2 * np.ones(3))
+
+    def test_while_loop(self):
+        i = paddle.to_tensor(np.int32(0))
+        acc = paddle.to_tensor(np.float32(1.0))
+        i2, acc2 = static.nn.while_loop(
+            lambda i, a: i < 5,
+            lambda i, a: (i + 1, a * 2.0),
+            [i, acc])
+        assert int(i2.numpy()) == 5
+        np.testing.assert_allclose(acc2.numpy(), 32.0)
+
+    def test_while_loop_under_jit(self):
+        from paddle_tpu.jit import to_static
+
+        @to_static
+        def f(n):
+            _, total = static.nn.while_loop(
+                lambda i, s: i < n,
+                lambda i, s: (i + 1, s + i),
+                [paddle.to_tensor(np.int32(0)),
+                 paddle.to_tensor(np.int32(0))])
+            return total
+
+        assert int(f(paddle.to_tensor(np.int32(5))).numpy()) == 10
