@@ -83,14 +83,14 @@ class TCPStore:
                 self._start_py_server(port)
         else:
             self.port = port
-        if self._lib is not None:
-            self._client = self._lib.pd_store_client_connect(
-                self.host.encode(), self.port, int(timeout * 1000))
-            if not self._client:
-                raise RuntimeError("TCPStore connect failed: "
-                                   + _native.last_error(self._lib))
-        else:
-            self._client = self._connect_py()
+        # One connection PER THREAD: pd_store_* / _py_req are a full
+        # request/response on one socket, so two threads sharing a
+        # connection (e.g. an elastic heartbeat thread + the main thread's
+        # watch loop) would interleave frames and poison the stream.
+        self._tls = threading.local()
+        self._all_conns = []          # every live conn, for close()
+        self._conns_lock = threading.Lock()
+        self._require_client()        # eager: validates reachability
 
     # --------------------------------------------------------------- ops ---
     def set(self, key, value):
@@ -180,45 +180,74 @@ class TCPStore:
                     self._reconnect()
                     raise
 
+    def _drop_conn(self, conn):
+        with self._conns_lock:
+            if conn in self._all_conns:
+                self._all_conns.remove(conn)
+        try:
+            if self._lib is not None:
+                self._lib.pd_store_client_close(conn)
+            else:
+                conn.close()
+        except Exception:
+            pass
+
     def _reconnect(self):
-        """Replace a poisoned/closed connection with a fresh one.
+        """Replace this thread's poisoned/closed connection with a fresh
+        one.
 
         Bounded by a short timeout — this runs inside failure paths (a
         timed-out WAIT) where stalling the caller for the full store
         timeout would delay the original error by up to 30s.  On failure
-        _client is None; subsequent ops raise via :meth:`_require_client`.
+        the thread's connection is marked failed; subsequent ops raise via
+        :meth:`_require_client`.
         """
         short = min(self.timeout, 2.0)
+        conn = getattr(self._tls, "client", None)
+        if conn is not None:
+            self._drop_conn(conn)
+        self._tls.client = None
         if self._lib is not None:
-            if getattr(self, "_client", None):
-                try:
-                    self._lib.pd_store_client_close(self._client)
-                except Exception:
-                    pass
-            self._client = self._lib.pd_store_client_connect(
+            c = self._lib.pd_store_client_connect(
                 self.host.encode(), self.port, int(short * 1000)) or None
         else:
-            if getattr(self, "_client", None) is not None:
-                try:
-                    self._client.close()
-                except OSError:
-                    pass
             try:
-                s = socket.create_connection((self.host, self.port),
+                c = socket.create_connection((self.host, self.port),
                                              timeout=short)
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                s.settimeout(None)
-                self._client = s
+                c.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                c.settimeout(None)
             except OSError:
-                self._client = None
+                c = None
+        self._tls.client = c
+        self._tls.failed = c is None
+        if c is not None:
+            with self._conns_lock:
+                self._all_conns.append(c)
 
     def _require_client(self):
-        """Native client handle, or a catchable error if reconnect failed
-        (passing NULL into the C API would SIGSEGV the rank)."""
-        if self._client is None:
+        """This thread's connection handle, creating it on first use.
+
+        Raises (rather than SIGSEGV-ing the C API with NULL) if this
+        thread's last reconnect attempt failed."""
+        c = getattr(self._tls, "client", None)
+        if c is not None:
+            return c
+        if getattr(self._tls, "failed", False):
             raise RuntimeError(
                 "store connection previously failed; reconnect required")
-        return self._client
+        if self._lib is not None:
+            c = self._lib.pd_store_client_connect(
+                self.host.encode(), self.port, int(self.timeout * 1000))
+            if not c:
+                self._tls.failed = True
+                raise RuntimeError("TCPStore connect failed: "
+                                   + _native.last_error(self._lib))
+        else:
+            c = self._connect_py()
+        self._tls.client = c
+        with self._conns_lock:
+            self._all_conns.append(c)
+        return c
 
     def delete_key(self, key):
         if self._lib is not None:
@@ -251,16 +280,19 @@ class TCPStore:
 
     def __del__(self):
         try:
+            for conn in list(getattr(self, "_all_conns", [])):
+                try:
+                    if self._lib is not None:
+                        self._lib.pd_store_client_close(conn)
+                    else:
+                        conn.close()
+                except Exception:
+                    pass
             if self._lib is not None:
-                if getattr(self, "_client", None):
-                    self._lib.pd_store_client_close(self._client)
                 if getattr(self, "_server", None):
                     self._lib.pd_store_server_stop(self._server)
-            else:
-                if getattr(self, "_client", None) is not None:
-                    self._client.close()
-                if getattr(self, "_py_server", None) is not None:
-                    self._py_server.shutdown()
+            elif getattr(self, "_py_server", None) is not None:
+                self._py_server.shutdown()
         except Exception:
             pass
 
@@ -340,34 +372,34 @@ class TCPStore:
         desynchronized, so the connection is closed and poisoned — mirroring
         the native client's behavior.
         """
-        if self._client is None:
-            raise RuntimeError(
-                "store connection previously failed; reconnect required")
+        conn = self._require_client()
         key_b = key.encode()
         msg = bytes([op]) + struct.pack("<I", len(key_b)) + key_b + payload
-        self._client.settimeout(timeout_s if timeout_s is not None
-                                else self.timeout)
+        conn.settimeout(timeout_s if timeout_s is not None
+                        else self.timeout)
         try:
-            self._client.sendall(msg)
-            hdr = self._recv_n(9)
+            conn.sendall(msg)
+            hdr = self._recv_n(conn, 9)
             status, vlen = hdr[0], struct.unpack("<Q", hdr[1:])[0]
-            value = self._recv_n(vlen)
+            value = self._recv_n(conn, vlen)
         except socket.timeout:
-            self._client.close()
-            self._client = None
+            self._drop_conn(conn)
+            self._tls.client = None
+            self._tls.failed = True
             raise TimeoutError(
                 f"TCPStore request op={op} key={key!r} timed out "
                 "(connection closed; reconnect required)")
         except OSError:
-            self._client.close()
-            self._client = None
+            self._drop_conn(conn)
+            self._tls.client = None
+            self._tls.failed = True
             raise
         return status, value
 
-    def _recv_n(self, n):
+    def _recv_n(self, conn, n):
         buf = b""
         while len(buf) < n:
-            chunk = self._client.recv(n - len(buf))
+            chunk = conn.recv(n - len(buf))
             if not chunk:
                 raise ConnectionError("store connection closed")
             buf += chunk

@@ -11,7 +11,9 @@ from .gpt import (  # noqa: F401
     gpt_6_7b,
 )
 from .wide_deep import WideDeep  # noqa: F401
+from .deepfm import DeepFM  # noqa: F401
 from .deepspeech import DeepSpeech2, deepspeech2_tiny  # noqa: F401
+from .conformer import Conformer, conformer_tiny  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig,
     BertForPretraining,

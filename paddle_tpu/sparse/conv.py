@@ -217,11 +217,15 @@ class MaxPool3D(Layer):
             idx, vals = x
             vals = vals._data if isinstance(vals, Tensor) else vals
         idx = np.asarray(idx, np.int64)
-        if len(idx) == 0:  # empty input -> empty output
+        if len(idx) == 0:  # empty input -> empty output, shape preserved
+            out_sp = (_out_extent(spatial, self.kernel_size, self.stride,
+                                  self.padding)
+                      if spatial is not None else (1, 1, 1))
+            batch = shp[0] if spatial is not None else 1
             return sparse_coo_tensor(
                 np.zeros((4, 0), np.int64),
                 Tensor(jnp.zeros((0, vals.shape[-1]), vals.dtype)),
-                shape=(1, 1, 1, 1, vals.shape[-1]))
+                shape=(batch, *out_sp, vals.shape[-1]))
         if spatial is None:
             spatial = tuple(int(idx[:, i].max()) + 1 for i in (1, 2, 3))
         ks, st, pad = self.kernel_size, self.stride, self.padding

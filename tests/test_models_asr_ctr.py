@@ -1,0 +1,132 @@
+"""Conformer (ASR) + DeepFM (CTR/PS) model families — BASELINE.md's ASR
+and sparse/PS configs beyond DeepSpeech2 and Wide&Deep."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+
+
+class TestConformer:
+    def test_forward_shapes_and_grad(self):
+        from paddle_tpu.models.conformer import conformer_tiny
+
+        paddle.seed(0)
+        m = conformer_tiny()
+        feats = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, 32, 32).astype(np.float32))
+        logits = m(feats)
+        assert logits.shape == [2, 8, 17]  # 4x time subsample, vocab+blank
+        labels = paddle.to_tensor(
+            np.random.RandomState(1).randint(1, 17, (2, 3)).astype(np.int32))
+        loss = m.loss(logits, labels)
+        assert np.isfinite(float(loss.numpy()))
+        loss.backward()
+        assert m.head.weight.grad is not None
+        assert m.blocks[0].conv.dw.weight.grad is not None
+
+    def test_overfits_tiny_batch(self):
+        from paddle_tpu.models.conformer import conformer_tiny
+
+        paddle.seed(0)
+        m = conformer_tiny(num_layers=1)
+        opt = optimizer.Adam(learning_rate=3e-3,
+                             parameters=m.parameters())
+        feats = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, 32, 32).astype(np.float32))
+        labels = paddle.to_tensor(
+            np.array([[1, 2, 3], [4, 5, 6]], np.int32))
+        losses = []
+        for _ in range(30):
+            loss = m.loss(m(feats), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+    def test_jits_whole_model(self):
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.models.conformer import conformer_tiny
+
+        paddle.seed(0)
+        m = conformer_tiny(num_layers=1)
+        m.eval()
+
+        @to_static
+        def f(x):
+            return m(x)
+
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 32, 32).astype(np.float32))
+        np.testing.assert_allclose(f(x).numpy(), m(x).numpy(), rtol=2e-5,
+                                   atol=1e-5)
+
+
+class TestDeepFM:
+    def test_fm_math_matches_manual(self):
+        from paddle_tpu.models.deepfm import DeepFM
+
+        paddle.seed(0)
+        m = DeepFM(sparse_feature_dim=4, num_slots=3, hidden_sizes=(8,))
+        ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+        out = m(ids)
+        assert out.shape == [1, 1]
+        # manual FM second order from the same pulled rows
+        emb = m.emb_table(ids).numpy()[0]      # [S, K]
+        second = 0.5 * ((emb.sum(0) ** 2 - (emb ** 2).sum(0)).sum())
+        first = m.fo_table(ids).numpy().sum()
+        deep = float(m.dnn(paddle.to_tensor(
+            emb.reshape(1, -1))).numpy().item())
+        np.testing.assert_allclose(out.numpy().item(),
+                                   first + second + deep, rtol=1e-4)
+
+    def test_converges_on_ctr_task(self):
+        from paddle_tpu.models.deepfm import DeepFM
+
+        paddle.seed(0)
+        m = DeepFM(sparse_feature_dim=4, num_slots=3, hidden_sizes=(16,))
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=m.parameters())
+        rs = np.random.RandomState(0)
+        ids_np = rs.randint(0, 500, (256, 3)).astype(np.int64)
+        y_np = (ids_np[:, 0] % 2 == 0).astype(np.float32)
+        losses = []
+        for epoch in range(10):
+            for lo in range(0, 256, 64):
+                ids = paddle.to_tensor(ids_np[lo:lo + 64])
+                y = paddle.to_tensor(y_np[lo:lo + 64])
+                loss = m.loss(m(ids), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+    def test_over_sharded_ps_service(self):
+        from paddle_tpu.distributed.ps import (
+            DistributedSparseTable,
+            PsServer,
+            SparseTable,
+        )
+        from paddle_tpu.models.deepfm import DeepFM
+
+        tables = [SparseTable(dim=4, init_range=0.01, seed=i)
+                  for i in range(2)]
+        servers = [PsServer(t) for t in tables]
+        try:
+            eps = [f"127.0.0.1:{s.port}" for s in servers]
+            dist = DistributedSparseTable(eps, learning_rate=0.05)
+            paddle.seed(0)
+            m = DeepFM(sparse_feature_dim=4, num_slots=3,
+                       hidden_sizes=(8,), table=dist)
+            ids = paddle.to_tensor(np.array([[1, 2, 3], [4, 5, 6]],
+                                            np.int64))
+            before = dist.pull([1]).copy()
+            m.loss(m(ids), paddle.to_tensor(
+                np.array([1.0, 0.0], np.float32))).backward()
+            assert not np.allclose(before, dist.pull([1]))
+            dist.close()
+        finally:
+            for s in servers:
+                s.stop()

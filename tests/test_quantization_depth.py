@@ -1,0 +1,137 @@
+"""Quantization depth: observer zoo, per-channel weight quant, int8
+convert pipeline (reference python/paddle/quantization/ observers +
+quanters + qat/ptq convert)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, quantization as Q
+
+
+class TestObservers:
+    def test_moving_average_absmax(self):
+        ob = Q.MovingAverageAbsMaxObserver(moving_rate=0.5)
+        ob(paddle.to_tensor(np.array([1.0, -4.0], np.float32)))
+        assert abs(ob.scales() - 4.0) < 1e-6
+        ob(paddle.to_tensor(np.array([2.0], np.float32)))
+        assert abs(ob.scales() - 3.0) < 1e-6  # 0.5*4 + 0.5*2
+
+    def test_hist_observer_clips_outliers(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(10000).astype(np.float32)
+        x[0] = 1000.0  # a single outlier
+        ob = Q.HistObserver(percentile=0.999)
+        ob(paddle.to_tensor(x))
+        s = ob.scales()
+        # abs-max would say 1000; percentile clipping stays near the bulk
+        assert 2.0 < s < 50.0, s
+
+    def test_kl_observer_reasonable_threshold(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(20000).astype(np.float32)
+        ob = Q.KLObserver()
+        ob(paddle.to_tensor(x))
+        s = ob.scales()
+        assert 1.0 < s < 6.0, s  # near the distribution's useful range
+
+    def test_per_channel_quanter(self):
+        w = paddle.to_tensor(np.array([[1.0, 100.0], [-2.0, -50.0]],
+                                      np.float32))
+        q = Q.PerChannelAbsMaxQuanter(channel_axis=-1)
+        out = q(w)
+        # per-channel: small channel keeps resolution despite the big one
+        np.testing.assert_allclose(out.numpy()[:, 0], [1.0, -2.0],
+                                   atol=2.0 / 127)
+        scales = q.scales()
+        np.testing.assert_allclose(scales, [2.0, 100.0])
+
+
+class TestConvertPipeline:
+    def _calibrated_model(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 4))
+        cfg = Q.QuantConfig(activation=None,
+                            weight=lambda: Q.PerChannelAbsMaxQuanter())
+        ptq = Q.PTQ(cfg)
+        model = ptq.quantize(model)
+        # calibration pass
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(16, 8).astype(np.float32))
+        ref = model(x).numpy()
+        return ptq, model, x, ref
+
+    def test_ptq_convert_to_int8_linear(self):
+        ptq, model, x, ref = self._calibrated_model()
+        model = ptq.convert(model)
+        kinds = [type(s).__name__ for _, s in model.named_sublayers()]
+        assert "QuantizedLinear" in kinds
+        out = model(x).numpy()
+        # int8 weight-only quantization: close to the calibrated forward
+        assert np.max(np.abs(out - ref)) < 0.1, np.max(np.abs(out - ref))
+        # weights really are int8
+        for _, s in model.named_sublayers():
+            if type(s).__name__ == "QuantizedLinear":
+                assert str(s.qweight.dtype) == "int8"
+
+    def test_converted_model_save_load_roundtrip(self):
+        ptq, model, x, ref = self._calibrated_model()
+        model = ptq.convert(model)
+        out = model(x).numpy()
+        sd = model.state_dict()
+        assert any("qweight" in k for k in sd), list(sd)
+        # reload into a freshly converted structure
+        ptq2, m2, _, _ = self._calibrated_model()
+        m2 = ptq2.convert(m2)
+        for p in m2.parameters():
+            p._data = p._data * 0  # clobber
+        m2.set_state_dict(sd)
+        np.testing.assert_allclose(m2(x).numpy(), out, rtol=1e-6)
+
+    def test_convert_without_calibration_unwraps(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 4))
+        cfg = Q.QuantConfig(activation=None,
+                            weight=lambda: Q.AbsmaxObserver())
+        ptq = Q.PTQ(cfg)
+        model = ptq.quantize(model)
+        ref_w = None
+        for _, s in model.named_sublayers():
+            if isinstance(s, Q.QuantedLayer):
+                ref_w = s._inner.weight.numpy().copy()
+        model = ptq.convert(model)  # NO calibration ran: must unwrap
+        kinds = [type(s).__name__ for _, s in model.named_sublayers()]
+        assert "QuantizedLinear" not in kinds
+        x = paddle.to_tensor(np.eye(4, dtype=np.float32))
+        got_w = model(x).numpy() - model[0].bias.numpy()
+        np.testing.assert_allclose(got_w, ref_w, rtol=1e-6)
+
+    def test_qat_trains_through_fake_quant_then_converts(self):
+        from paddle_tpu import optimizer
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 1))
+        cfg = Q.QuantConfig(
+            activation=lambda: Q.FakeQuanterWithAbsMaxObserver(),
+            weight=lambda: Q.PerChannelAbsMaxQuanter())
+        qat = Q.QAT(cfg)
+        model = qat.quantize(model)
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=model.parameters())
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 8).astype(np.float32)
+        Y = X.sum(1, keepdims=True).astype(np.float32)
+        losses = []
+        for _ in range(150):
+            loss = ((model(paddle.to_tensor(X))
+                     - paddle.to_tensor(Y)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.4 * losses[0], (losses[0], losses[-1])
+        deployed = qat.convert(model)
+        out = deployed(paddle.to_tensor(X)).numpy()
+        assert np.mean((out - Y) ** 2) < 2.0 * losses[-1] + 0.1
