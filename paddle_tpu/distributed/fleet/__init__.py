@@ -17,6 +17,7 @@ from .topology import (  # noqa: F401
     build_mesh,
 )
 from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .meta_parallel import mp_layers  # noqa: F401
 

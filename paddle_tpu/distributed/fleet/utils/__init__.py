@@ -1,0 +1,8 @@
+"""fleet.utils — timers + hybrid-parallel helpers namespace.
+
+Reference: python/paddle/distributed/fleet/utils/ (timer_helper,
+hybrid_parallel_util, ...).
+"""
+
+from . import timer_helper  # noqa: F401
+from .timer_helper import get_timers, set_timers  # noqa: F401

@@ -89,6 +89,7 @@ class TCPStore:
         # watch loop) would interleave frames and poison the stream.
         self._tls = threading.local()
         self._all_conns = []          # every live conn, for close()
+        self._conn_owners = {}        # thread ident -> conn (leak sweep)
         self._conns_lock = threading.Lock()
         self._require_client()        # eager: validates reachability
 
@@ -223,6 +224,28 @@ class TCPStore:
         if c is not None:
             with self._conns_lock:
                 self._all_conns.append(c)
+                self._conn_owners[threading.get_ident()] = c
+
+    def _sweep_dead_threads(self):
+        """Close connections whose owning thread has exited (runs when a
+        NEW thread connects, so short-lived-thread patterns can't leak
+        fds unboundedly).  Caller must not hold _conns_lock."""
+        alive = {t.ident for t in threading.enumerate()}
+        with self._conns_lock:
+            dead = [(ident, c) for ident, c in self._conn_owners.items()
+                    if ident not in alive]
+            for ident, c in dead:
+                del self._conn_owners[ident]
+                if c in self._all_conns:
+                    self._all_conns.remove(c)
+        for _, c in dead:
+            try:
+                if self._lib is not None:
+                    self._lib.pd_store_client_close(c)
+                else:
+                    c.close()
+            except Exception:
+                pass
 
     def _require_client(self):
         """This thread's connection handle, creating it on first use.
@@ -235,6 +258,7 @@ class TCPStore:
         if getattr(self._tls, "failed", False):
             raise RuntimeError(
                 "store connection previously failed; reconnect required")
+        self._sweep_dead_threads()
         if self._lib is not None:
             c = self._lib.pd_store_client_connect(
                 self.host.encode(), self.port, int(self.timeout * 1000))
@@ -247,6 +271,7 @@ class TCPStore:
         self._tls.client = c
         with self._conns_lock:
             self._all_conns.append(c)
+            self._conn_owners[threading.get_ident()] = c
         return c
 
     def delete_key(self, key):

@@ -1,3 +1,4 @@
 """paddle.incubate parity namespace (reference python/paddle/incubate/)."""
 
-from . import autograd, distributed, nn  # noqa: F401
+from . import asp, autograd, distributed, nn  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

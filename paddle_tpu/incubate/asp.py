@@ -1,0 +1,113 @@
+"""ASP — automatic 2:4 structured sparsity (reference
+python/paddle/incubate/asp/: prune_model computes n:m masks,
+decorate() wraps the optimizer so masks re-apply after every step).
+
+TPU note: 2:4 sparsity targets sparse tensor cores on GPUs; TPUs have no
+sparse MXU mode, so the value here is model-compression parity (the
+pruned checkpoint is exportable) and exact mask-semantics parity: keep
+the top-n-of-m magnitudes per group along the reduced dimension, and
+keep pruned weights at zero through training.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "ASPHelper"]
+
+
+def create_mask(weight, n=2, m=4):
+    """n:m mask along the last axis: keep the n largest |w| per group of m.
+
+    Matches reference asp/utils.py get_mask_1d semantics.
+    """
+    w = np.asarray(weight._data if isinstance(weight, Tensor) else weight)
+    orig_shape = w.shape
+    if w.size % m != 0:
+        return np.ones(orig_shape, w.dtype)  # not maskable
+    groups = np.abs(w).reshape(-1, m)
+    keep = np.argsort(groups, axis=1)[:, m - n:]
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, keep, 1.0, axis=1)
+    return mask.reshape(orig_shape).astype(w.dtype)
+
+
+def calculate_density(x):
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+class ASPHelper:
+    # id(param) -> (weakref(param), mask).  The weakref guards against id
+    # recycling: a dead param's id reused by a fresh Tensor must NOT pick
+    # up the stale mask; dead entries are swept on every prune/reapply.
+    _masks = {}
+
+    @classmethod
+    def prunable(cls, layer, name, param):
+        # reference: prune supported layers' weight matrices only
+        return name.endswith("weight") and param.ndim == 2
+
+    @classmethod
+    def _sweep(cls):
+        dead = [k for k, (wr, _) in cls._masks.items() if wr() is None]
+        for k in dead:
+            del cls._masks[k]
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo="mask_1d",
+                    with_mask=True):
+        import weakref
+
+        cls._sweep()
+        for name, sub in model.named_sublayers(include_self=True):
+            for pname, p in getattr(sub, "_parameters", {}).items():
+                if cls.prunable(sub, pname, p):
+                    mask = create_mask(p, n=n, m=m)
+                    p._rebind(p._data * jnp.asarray(mask))
+                    if with_mask:
+                        cls._masks[id(p)] = (weakref.ref(p),
+                                             jnp.asarray(mask))
+        return {k: m for k, (_, m) in cls._masks.items()}
+
+    @classmethod
+    def reapply(cls, parameters):
+        cls._sweep()
+        for p in parameters:
+            entry = cls._masks.get(id(p))
+            if entry is not None and entry[0]() is p:
+                p._rebind(p._data * entry[1])
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Reference paddle.incubate.asp.prune_model."""
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=mask_algo,
+                                 with_mask=with_mask)
+
+
+class _ASPOptimizer:
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self):
+        self._inner.step()
+        # pruned weights stay pruned (reference OptimizerWithSparsityGuarantee)
+        ASPHelper.reapply(self._inner._parameters)
+
+    def minimize(self, loss, **kw):
+        # must route through OUR step so the masks re-apply
+        loss.backward()
+        self.step()
+        return None, None
+
+
+def decorate(optimizer):
+    """Reference paddle.incubate.asp.decorate: masks re-apply after every
+    optimizer step so pruned coordinates never regrow."""
+    return _ASPOptimizer(optimizer)

@@ -1,0 +1,98 @@
+"""incubate optimizers: LookAhead, ModelAverage (reference
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py).
+
+Both are wrappers over a base optimizer, implemented against the same
+eager step()/clear_grad() contract the meta-optimizer wrappers use.
+"""
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k steps forward, one step back (Zhang et al.; reference
+    lookahead.py): every k inner steps, slow weights move alpha of the
+    way toward the fast weights and the fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow = {}
+
+    def __getattr__(self, name):
+        return getattr(self.inner_optimizer, name)
+
+    def step(self):
+        params = self.inner_optimizer._parameters
+        if self._step_num == 0:
+            for p in params:
+                self._slow[id(p)] = p._data
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for p in params:
+                slow = self._slow[id(p)]
+                slow = slow + self.alpha * (p._data - slow)
+                self._slow[id(p)] = slow
+                p._rebind(slow)
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage:
+    """Maintain a running average of parameters for evaluation (reference
+    modelaverage.py): apply() swaps averaged weights in, restore() swaps
+    the training weights back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters is required")
+        self._parameters = list(parameters)
+        self._sum = {id(p): jnp.zeros_like(p._data)
+                     for p in self._parameters}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (call after optimizer.step())."""
+        for p in self._parameters:
+            self._sum[id(p)] = self._sum[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in the averaged weights."""
+        if self._count == 0:
+            return
+        self._backup = {id(p): p._data for p in self._parameters}
+        for p in self._parameters:
+            p._rebind(self._sum[id(p)] / self._count)
+
+    def restore(self, executor=None):
+        """Swap the training weights back."""
+        if self._backup is None:
+            return
+        for p in self._parameters:
+            p._rebind(self._backup[id(p)])
+        self._backup = None
+
+    def __enter__(self):
+        self.apply()
+        return self
+
+    def __exit__(self, *exc):
+        self.restore()

@@ -3,10 +3,12 @@ op substrate — warpctc/warprnnt kernels, SURVEY §2.9 audio — and model
 zoos live in PaddleSpeech).
 
 TPU-native implementation of the standard conformer block: feed-forward
-"macaron" halves, MHSA with relative-ish positional bias, a depthwise
-conv module (Pallas-friendly: all convs are jax lax.conv with static
-shapes), CTC head.  Everything jits; the hot path is MXU matmuls +
-depthwise conv fused by XLA.
+"macaron" halves, MHSA, a depthwise conv module (Pallas-friendly: all
+convs are jax lax.conv with static shapes), CTC head.  Positional
+information comes from the convolution modules (no explicit relative
+positional encoding — the lightweight "conv-is-the-position-model"
+variant).  Everything jits; the hot path is MXU matmuls + depthwise conv
+fused by XLA.
 """
 
 import math

@@ -18,7 +18,7 @@ import numpy as np
 from ...io.dataset import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012"]
 
 
 def _no_download(name):
@@ -213,3 +213,145 @@ class ImageFolder(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return [img]
+
+
+class Flowers(Dataset):
+    """Flowers-102 from local files (reference flowers.py): images tarball
+    + scipy-format .mat label/setid files.  scipy isn't guaranteed, so
+    labels may also be a .npz with 'labels' and 'setids' arrays."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download("Flowers")
+            raise ValueError("Flowers requires data_file (no download)")
+        self.transform = transform
+        self.mode = mode
+        self._data_file = data_file
+        self._tars = {}  # pid -> TarFile: fork-safe (workers reopen)
+        labels, setids = self._load_labels(label_file, setid_file, mode)
+        members = {os.path.basename(m.name): m.name
+                   for m in self._tar().getmembers()
+                   if m.name.endswith(".jpg") or m.name.endswith(".npy")}
+        self.samples = []
+        for idx in setids:
+            for ext in (".jpg", ".npy"):
+                name = f"image_{int(idx):05d}{ext}"
+                if name in members:
+                    self.samples.append((members[name],
+                                         int(labels[int(idx) - 1]) - 1))
+                    break
+
+    @staticmethod
+    def _load_labels(label_file, setid_file, mode):
+        key = {"train": "trnid", "valid": "valid", "test": "tstid"}[mode]
+        if label_file and label_file.endswith(".npz"):
+            d = np.load(label_file)
+            return d["labels"].reshape(-1), d[key].reshape(-1)
+        try:
+            from scipy.io import loadmat
+        except ImportError as e:
+            raise RuntimeError(
+                "Flowers .mat labels need scipy; convert to .npz with "
+                "arrays 'labels' and 'trnid'/'valid'/'tstid'") from e
+        labels = loadmat(label_file)["labels"].reshape(-1)
+        setids = loadmat(setid_file)[key].reshape(-1)
+        return labels, setids
+
+    def _tar(self):
+        """One TarFile per process: a fork-inherited handle shares the
+        file offset across DataLoader workers (corrupted reads)."""
+        pid = os.getpid()
+        t = self._tars.get(pid)
+        if t is None:
+            t = tarfile.open(self._data_file)
+            self._tars[pid] = t
+        return t
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        member, label = self.samples[idx]
+        import io as _io
+        f = _io.BytesIO(self._tar().extractfile(member).read())
+        if member.endswith(".npy"):
+            img = np.load(f)
+        else:
+            try:
+                from PIL import Image
+            except ImportError as e:
+                raise RuntimeError("jpg decoding needs Pillow; use .npy "
+                                   "images instead") from e
+            img = np.asarray(Image.open(f))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation pairs from the standard devkit tarball
+    (reference voc2012.py): JPEGImages/*.jpg + SegmentationClass/*.png
+    listed by ImageSets/Segmentation/{train,val,trainval}.txt."""
+
+    _LIST = {"train": "train.txt", "valid": "val.txt",
+             "test": "val.txt", "trainval": "trainval.txt"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download("VOC2012")
+            raise ValueError("VOC2012 requires data_file (no download)")
+        self.transform = transform
+        self._data_file = data_file
+        self._tars = {}  # pid -> TarFile (fork-safe, like Flowers)
+        # one pass over the members: index by dir/basename suffix
+        by_suffix = {}
+        for m in self._tar().getmembers():
+            parts = m.name.rsplit("/", 2)
+            by_suffix["/".join(parts[-2:])] = m.name
+        list_name = self._LIST[mode]
+        list_member = by_suffix.get(f"Segmentation/{list_name}")
+        if list_member is None:
+            raise ValueError(f"no {list_name} index in {data_file}")
+        ids = self._tar().extractfile(list_member).read().decode().split()
+        self.pairs = []
+        for i in ids:
+            img = (by_suffix.get(f"JPEGImages/{i}.jpg")
+                   or by_suffix.get(f"JPEGImages/{i}.npy"))
+            lab = (by_suffix.get(f"SegmentationClass/{i}.png")
+                   or by_suffix.get(f"SegmentationClass/{i}.npy"))
+            if img is not None and lab is not None:
+                self.pairs.append((img, lab))
+
+    def __len__(self):
+        return len(self.pairs)
+
+    def _tar(self):
+        pid = os.getpid()
+        t = self._tars.get(pid)
+        if t is None:
+            t = tarfile.open(self._data_file)
+            self._tars[pid] = t
+        return t
+
+    def _decode(self, member):
+        import io as _io
+        f = _io.BytesIO(self._tar().extractfile(member).read())
+        if member.endswith(".npy"):
+            return np.load(f)
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise RuntimeError("image decoding needs Pillow; use .npy "
+                               "tarballs instead") from e
+        return np.asarray(Image.open(f))
+
+    def __getitem__(self, idx):
+        img_m, lab_m = self.pairs[idx]
+        img, label = self._decode(img_m), self._decode(lab_m)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label

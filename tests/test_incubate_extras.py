@@ -1,0 +1,212 @@
+"""incubate long tail: LookAhead, ModelAverage, ASP 2:4 sparsity; fleet
+timer_helper; Flowers/VOC2012 parsers.
+
+Reference targets: python/paddle/incubate/optimizer/{lookahead,
+modelaverage}.py, python/paddle/incubate/asp/,
+fleet/utils/timer_helper.py, vision/datasets/{flowers,voc2012}.py.
+"""
+
+import io as _io
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate, nn, optimizer
+
+
+class TestLookAhead:
+    def test_slow_weights_follow_fast(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        inner = optimizer.SGD(learning_rate=0.1,
+                              parameters=m.parameters())
+        la = incubate.LookAhead(inner, alpha=0.5, k=2)
+        w0 = m.weight.numpy().copy()
+        x = paddle.to_tensor(np.ones((8, 4), np.float32))
+        # step 1: fast step only
+        ((m(x) - 1.0) ** 2).mean().backward()
+        la.step()
+        la.clear_grad()
+        w_fast1 = m.weight.numpy().copy()
+        assert not np.allclose(w_fast1, w0)
+        # step 2: sync point — weights = slow + alpha*(fast - slow)
+        ((m(x) - 1.0) ** 2).mean().backward()
+        la.step()
+        la.clear_grad()
+        w_after = m.weight.numpy()
+        # after sync, weights moved back toward w0 (alpha=0.5 averaging)
+        fast2_estimate = w_after * 2 - w0  # w_after = (w0 + fast2)/2
+        assert not np.allclose(w_after, fast2_estimate)
+
+    def test_converges(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 1)
+        la = incubate.LookAhead(
+            optimizer.Adam(learning_rate=0.05,
+                           parameters=m.parameters()), alpha=0.8, k=5)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(32, 4).astype(np.float32))
+        y = paddle.to_tensor(
+            x.numpy().sum(1, keepdims=True).astype(np.float32))
+        losses = []
+        for _ in range(60):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < 0.1 * losses[0]
+
+
+class TestModelAverage:
+    def test_apply_restore(self):
+        paddle.seed(0)
+        m = nn.Linear(2, 1)
+        ma = incubate.ModelAverage(parameters=m.parameters())
+        snapshots = []
+        for k in range(4):
+            for p in m.parameters():
+                p._rebind(p._data + 1.0)
+            ma.step()
+            snapshots.append(m.weight.numpy().copy())
+        train_w = m.weight.numpy().copy()
+        ma.apply()
+        np.testing.assert_allclose(m.weight.numpy(),
+                                   np.mean(snapshots, axis=0), rtol=1e-6)
+        ma.restore()
+        np.testing.assert_allclose(m.weight.numpy(), train_w)
+
+    def test_context_manager(self):
+        paddle.seed(0)
+        m = nn.Linear(2, 1)
+        ma = incubate.ModelAverage(parameters=m.parameters())
+        ma.step()
+        w = m.weight.numpy().copy()
+        for p in m.parameters():
+            p._rebind(p._data * 100)
+        with ma:
+            np.testing.assert_allclose(m.weight.numpy(), w, rtol=1e-6)
+        np.testing.assert_allclose(m.weight.numpy(), w * 100, rtol=1e-6)
+
+
+class TestASP:
+    def test_mask_is_2_of_4(self):
+        from paddle_tpu.incubate.asp import calculate_density, create_mask
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        mask = create_mask(w)
+        assert mask.shape == w.shape
+        groups = mask.reshape(-1, 4)
+        np.testing.assert_array_equal(groups.sum(1), 2 * np.ones(len(groups)))
+        # keeps the two largest magnitudes per group
+        for g_w, g_m in zip(np.abs(w).reshape(-1, 4), groups):
+            kept = set(np.nonzero(g_m)[0])
+            top2 = set(np.argsort(g_w)[-2:])
+            assert kept == top2
+        assert abs(calculate_density(w * mask) - 0.5) < 1e-6
+
+    def test_prune_and_decorate_keep_sparsity_through_training(self):
+        from paddle_tpu.incubate import asp
+
+        paddle.seed(0)
+        m = nn.Sequential(nn.Linear(16, 16), nn.ReLU(), nn.Linear(16, 1))
+        asp.prune_model(m)
+        opt = asp.decorate(optimizer.Adam(learning_rate=0.01,
+                                          parameters=m.parameters()))
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(32, 16).astype(np.float32))
+        y = paddle.to_tensor(
+            x.numpy().sum(1, keepdims=True).astype(np.float32))
+        for _ in range(10):
+            loss = ((m(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        for name, p in m.named_parameters():
+            if name.endswith("weight") and p.ndim == 2:
+                d = asp.calculate_density(p)
+                assert abs(d - 0.5) < 1e-6, (name, d)
+
+
+class TestTimerHelper:
+    def test_timers(self, capsys):
+        import time
+
+        from paddle_tpu.distributed.fleet.utils import get_timers, set_timers
+
+        set_timers()
+        timers = get_timers()
+        timers("fwd").start()
+        time.sleep(0.01)
+        timers("fwd").stop()
+        timers("bwd").start()
+        timers("bwd").stop()
+        el = timers("fwd").elapsed(reset=False)
+        assert el >= 0.01
+        line = timers.log(normalizer=1.0)
+        assert "fwd" in line and "bwd" in line
+        # log(reset=True) cleared the accumulators
+        assert timers("fwd").elapsed() == 0.0
+
+
+def _npz_flower_tar(tmp_path, n=6):
+    tar_path = str(tmp_path / "102flowers.tgz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for i in range(1, n + 1):
+            buf = _io.BytesIO()
+            np.save(buf, np.full((4, 4, 3), i, np.uint8))
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.npy")
+            info.size = len(data)
+            tf.addfile(info, _io.BytesIO(data))
+    labels = np.arange(1, n + 1)  # 1-based class per image
+    np.savez(tmp_path / "labels.npz", labels=labels,
+             trnid=np.array([1, 2, 3]), valid=np.array([4]),
+             tstid=np.array([5, 6]))
+    return tar_path, str(tmp_path / "labels.npz")
+
+
+class TestFlowersVoc:
+    def test_flowers_modes(self, tmp_path):
+        from paddle_tpu.vision.datasets import Flowers
+
+        tar_path, labels = _npz_flower_tar(tmp_path)
+        train = Flowers(data_file=tar_path, label_file=labels, mode="train")
+        test = Flowers(data_file=tar_path, label_file=labels, mode="test")
+        assert len(train) == 3 and len(test) == 2
+        img, lab = train[0]
+        assert img.shape == (4, 4, 3) and lab == 0  # 1-based -> 0-based
+
+    def test_voc2012_pairs(self, tmp_path):
+        from paddle_tpu.vision.datasets import VOC2012
+
+        tar_path = str(tmp_path / "voc.tar")
+        with tarfile.open(tar_path, "w") as tf:
+            def add(name, arr):
+                buf = _io.BytesIO()
+                np.save(buf, arr)
+                data = buf.getvalue()
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+
+            ids = ["2007_000001", "2007_000002"]
+            for k, i in enumerate(ids):
+                add(f"VOC2012/JPEGImages/{i}.npy",
+                    np.full((6, 6, 3), k, np.uint8))
+                add(f"VOC2012/SegmentationClass/{i}.npy",
+                    np.full((6, 6), k, np.uint8))
+            listing = "\n".join(ids).encode()
+            info = tarfile.TarInfo(
+                "VOC2012/ImageSets/Segmentation/train.txt")
+            info.size = len(listing)
+            tf.addfile(info, _io.BytesIO(listing))
+
+        ds = VOC2012(data_file=tar_path, mode="train")
+        assert len(ds) == 2
+        img, seg = ds[1]
+        assert img.shape == (6, 6, 3) and seg.shape == (6, 6)
+        assert (seg == 1).all()
