@@ -104,6 +104,7 @@ from .autograd import grad  # noqa: F401, E402
 from . import autograd  # noqa: F401, E402
 from . import amp  # noqa: F401, E402
 from . import nn  # noqa: F401, E402
+from .nn.layer_base import ParamAttr  # noqa: F401, E402
 from . import optimizer  # noqa: F401, E402
 from . import io  # noqa: F401, E402
 from . import jit  # noqa: F401, E402

@@ -185,6 +185,12 @@ class TCPStore:
         with self._conns_lock:
             if conn in self._all_conns:
                 self._all_conns.remove(conn)
+            # unregister from the owner map too — otherwise the dead-thread
+            # sweep would close the same native handle a second time
+            # (double-free in the C library, not a catchable exception)
+            for ident, c in list(self._conn_owners.items()):
+                if c is conn:
+                    del self._conn_owners[ident]
         try:
             if self._lib is not None:
                 self._lib.pd_store_client_close(conn)
@@ -224,7 +230,9 @@ class TCPStore:
         if c is not None:
             with self._conns_lock:
                 self._all_conns.append(c)
-                self._conn_owners[threading.get_ident()] = c
+                if not isinstance(threading.current_thread(),
+                                  threading._DummyThread):
+                    self._conn_owners[threading.get_ident()] = c
 
     def _sweep_dead_threads(self):
         """Close connections whose owning thread has exited (runs when a
@@ -271,7 +279,12 @@ class TCPStore:
         self._tls.client = c
         with self._conns_lock:
             self._all_conns.append(c)
-            self._conn_owners[threading.get_ident()] = c
+            # foreign threads (no threading.Thread object) never appear in
+            # threading.enumerate(), so the sweep could close their LIVE
+            # conn; leave them out of the owner map (closed at store close)
+            if not isinstance(threading.current_thread(),
+                              threading._DummyThread):
+                self._conn_owners[threading.get_ident()] = c
         return c
 
     def delete_key(self, key):

@@ -26,7 +26,9 @@ def create_mask(weight, n=2, m=4):
     """
     w = np.asarray(weight._data if isinstance(weight, Tensor) else weight)
     orig_shape = w.shape
-    if w.size % m != 0:
+    # groups must lie along the reduced (last) axis — a flat reshape would
+    # straddle row boundaries and break the hardware n:m pattern
+    if w.ndim == 0 or w.shape[-1] % m != 0:
         return np.ones(orig_shape, w.dtype)  # not maskable
     groups = np.abs(w).reshape(-1, m)
     keep = np.argsort(groups, axis=1)[:, m - n:]

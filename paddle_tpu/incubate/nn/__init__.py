@@ -20,7 +20,9 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["FusedMultiTransformer"]
+__all__ = ["FusedMultiTransformer", "functional"]
+
+from . import functional  # noqa: E402,F401
 
 
 def _layernorm(x, w, b, eps):

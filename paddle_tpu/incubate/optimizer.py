@@ -53,9 +53,16 @@ class LookAhead:
 
 
 class ModelAverage:
-    """Maintain a running average of parameters for evaluation (reference
+    """Maintain a windowed average of parameters for evaluation (reference
     modelaverage.py): apply() swaps averaged weights in, restore() swaps
-    the training weights back."""
+    the training weights back.
+
+    Window semantics follow the reference's tiered-sum scheme: when the
+    accumulated count reaches ``max_average_window`` the current sums
+    roll into an "old" block and restart, and the old block is dropped
+    when the fresh one fills — so the average always covers between one
+    and two windows of trailing steps, never the full history.
+    """
 
     def __init__(self, average_window_rate=0.15, parameters=None,
                  min_average_window=10000, max_average_window=10000,
@@ -63,9 +70,14 @@ class ModelAverage:
         if parameters is None:
             raise ValueError("parameters is required")
         self._parameters = list(parameters)
+        self.max_average_window = int(max_average_window)
+        self.min_average_window = int(min_average_window)
+        self.average_window_rate = average_window_rate
         self._sum = {id(p): jnp.zeros_like(p._data)
                      for p in self._parameters}
+        self._old_sum = None
         self._count = 0
+        self._old_count = 0
         self._backup = None
 
     def step(self):
@@ -73,14 +85,27 @@ class ModelAverage:
         for p in self._parameters:
             self._sum[id(p)] = self._sum[id(p)] + p._data
         self._count += 1
+        if self._count >= self.max_average_window:
+            # roll the window (reference sum_1/sum_2 rotation)
+            self._old_sum = self._sum
+            self._old_count = self._count
+            self._sum = {id(p): jnp.zeros_like(p._data)
+                         for p in self._parameters}
+            self._count = 0
 
     def apply(self, executor=None, need_restore=True):
         """Swap in the averaged weights."""
-        if self._count == 0:
+        total = self._count + self._old_count
+        if total == 0:
             return
-        self._backup = {id(p): p._data for p in self._parameters}
+        backup = {id(p): p._data for p in self._parameters}
+        if need_restore:
+            self._backup = backup
         for p in self._parameters:
-            p._rebind(self._sum[id(p)] / self._count)
+            s = self._sum[id(p)]
+            if self._old_sum is not None:
+                s = s + self._old_sum[id(p)]
+            p._rebind(s / total)
 
     def restore(self, executor=None):
         """Swap the training weights back."""

@@ -118,8 +118,9 @@ def memory_efficient_attention(query, key, value, bias=None, cu_seqlens_q=None,
 def fused_attention(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
                     ln_scale=None, ln_bias=None, ln2_scale=None,
                     ln2_bias=None, num_heads=1, pre_layer_norm=False,
-                    epsilon=1e-5, attn_dropout_rate=0.0, dropout_rate=0.0,
-                    is_test=True, attn_mask=None, ring_id=-1):
+                    epsilon=1e-5, epsilon2=None, attn_dropout_rate=0.0,
+                    dropout_rate=0.0, is_test=True, attn_mask=None,
+                    ring_id=-1):
     """fused_attention op parity (paddle/fluid/operators/fused/
     fused_attention_op.cu): [LN] → QKV → MHA → out-proj → residual [→ LN]."""
     b, t, c = x.shape
@@ -142,7 +143,8 @@ def fused_attention(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
         out = out + linear_bias.astype(jnp.float32)
     out = residual.astype(jnp.float32) + out
     if not pre_layer_norm:
-        out = _ln(out.astype(x.dtype), ln2_scale, ln2_bias, epsilon) \
+        out = _ln(out.astype(x.dtype), ln2_scale, ln2_bias,
+                  epsilon if epsilon2 is None else epsilon2) \
             .astype(jnp.float32)
     return out.astype(x.dtype)
 

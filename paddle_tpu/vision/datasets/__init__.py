@@ -215,6 +215,38 @@ class ImageFolder(Dataset):
         return [img]
 
 
+
+class _PerPidTar:
+    """One TarFile handle per process: a fork-inherited handle shares its
+    file offset across DataLoader workers (corrupted concurrent reads)."""
+
+    def __init__(self, path):
+        self.path = path
+        self._tars = {}
+
+    def get(self):
+        pid = os.getpid()
+        t = self._tars.get(pid)
+        if t is None:
+            t = tarfile.open(self.path)
+            self._tars[pid] = t
+        return t
+
+
+def _decode_member_bytes(name, raw):
+    """Decode one archive member: .npy natively, images via Pillow."""
+    import io as _io
+
+    if name.endswith(".npy"):
+        return np.load(_io.BytesIO(raw))
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise RuntimeError(f"decoding {name} requires Pillow; use .npy "
+                           "archives instead") from e
+    return np.asarray(Image.open(_io.BytesIO(raw)))
+
+
 class Flowers(Dataset):
     """Flowers-102 from local files (reference flowers.py): images tarball
     + scipy-format .mat label/setid files.  scipy isn't guaranteed, so
@@ -228,11 +260,10 @@ class Flowers(Dataset):
             raise ValueError("Flowers requires data_file (no download)")
         self.transform = transform
         self.mode = mode
-        self._data_file = data_file
-        self._tars = {}  # pid -> TarFile: fork-safe (workers reopen)
+        self._tarsrc = _PerPidTar(data_file)
         labels, setids = self._load_labels(label_file, setid_file, mode)
         members = {os.path.basename(m.name): m.name
-                   for m in self._tar().getmembers()
+                   for m in self._tarsrc.get().getmembers()
                    if m.name.endswith(".jpg") or m.name.endswith(".npy")}
         self.samples = []
         for idx in setids:
@@ -259,32 +290,13 @@ class Flowers(Dataset):
         setids = loadmat(setid_file)[key].reshape(-1)
         return labels, setids
 
-    def _tar(self):
-        """One TarFile per process: a fork-inherited handle shares the
-        file offset across DataLoader workers (corrupted reads)."""
-        pid = os.getpid()
-        t = self._tars.get(pid)
-        if t is None:
-            t = tarfile.open(self._data_file)
-            self._tars[pid] = t
-        return t
-
     def __len__(self):
         return len(self.samples)
 
     def __getitem__(self, idx):
         member, label = self.samples[idx]
-        import io as _io
-        f = _io.BytesIO(self._tar().extractfile(member).read())
-        if member.endswith(".npy"):
-            img = np.load(f)
-        else:
-            try:
-                from PIL import Image
-            except ImportError as e:
-                raise RuntimeError("jpg decoding needs Pillow; use .npy "
-                                   "images instead") from e
-            img = np.asarray(Image.open(f))
+        raw = self._tarsrc.get().extractfile(member).read()
+        img = _decode_member_bytes(member, raw)
         if self.transform is not None:
             img = self.transform(img)
         return img, np.int64(label)
@@ -305,18 +317,18 @@ class VOC2012(Dataset):
                 _no_download("VOC2012")
             raise ValueError("VOC2012 requires data_file (no download)")
         self.transform = transform
-        self._data_file = data_file
-        self._tars = {}  # pid -> TarFile (fork-safe, like Flowers)
+        self._tarsrc = _PerPidTar(data_file)
         # one pass over the members: index by dir/basename suffix
         by_suffix = {}
-        for m in self._tar().getmembers():
+        for m in self._tarsrc.get().getmembers():
             parts = m.name.rsplit("/", 2)
             by_suffix["/".join(parts[-2:])] = m.name
         list_name = self._LIST[mode]
         list_member = by_suffix.get(f"Segmentation/{list_name}")
         if list_member is None:
             raise ValueError(f"no {list_name} index in {data_file}")
-        ids = self._tar().extractfile(list_member).read().decode().split()
+        ids = self._tarsrc.get().extractfile(list_member) \
+            .read().decode().split()
         self.pairs = []
         for i in ids:
             img = (by_suffix.get(f"JPEGImages/{i}.jpg")
@@ -329,25 +341,9 @@ class VOC2012(Dataset):
     def __len__(self):
         return len(self.pairs)
 
-    def _tar(self):
-        pid = os.getpid()
-        t = self._tars.get(pid)
-        if t is None:
-            t = tarfile.open(self._data_file)
-            self._tars[pid] = t
-        return t
-
     def _decode(self, member):
-        import io as _io
-        f = _io.BytesIO(self._tar().extractfile(member).read())
-        if member.endswith(".npy"):
-            return np.load(f)
-        try:
-            from PIL import Image
-        except ImportError as e:
-            raise RuntimeError("image decoding needs Pillow; use .npy "
-                               "tarballs instead") from e
-        return np.asarray(Image.open(f))
+        raw = self._tarsrc.get().extractfile(member).read()
+        return _decode_member_bytes(member, raw)
 
     def __getitem__(self, idx):
         img_m, lab_m = self.pairs[idx]

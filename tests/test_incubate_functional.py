@@ -1,0 +1,126 @@
+"""incubate.nn.functional fused-op API surface (reference
+python/paddle/incubate/nn/functional/)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate.nn import functional as IF
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+class TestFusedFunctional:
+    def test_fused_matmul_bias_and_linear(self):
+        rng = np.random.RandomState(0)
+        x, w, b = rng.rand(4, 8), rng.rand(8, 3), rng.rand(3)
+        out = IF.fused_matmul_bias(_t(x), _t(w), _t(b))
+        np.testing.assert_allclose(out.numpy(), x @ w + b, rtol=1e-5)
+        out2 = IF.fused_linear(_t(x), _t(w), _t(b))
+        np.testing.assert_allclose(out2.numpy(), x @ w + b, rtol=1e-5)
+
+    def test_fused_feedforward_matches_manual(self):
+        rng = np.random.RandomState(1)
+        x = rng.rand(2, 5, 8).astype(np.float32)
+        w1, b1 = rng.rand(8, 16).astype(np.float32), np.zeros(16, np.float32)
+        w2, b2 = rng.rand(16, 8).astype(np.float32), np.zeros(8, np.float32)
+        out = IF.fused_feedforward(_t(x), _t(w1), _t(w2), _t(b1), _t(b2),
+                                   activation="relu", training=False)
+        h = x + np.maximum(x @ w1 + b1, 0) @ w2 + b2
+        # post-LN applies when pre_layer_norm=False (reference semantics)
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        manual = (h - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(out.numpy(), manual, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_fused_mha_runs_and_differentiates(self):
+        rng = np.random.RandomState(2)
+        h, nh = 16, 2
+        hd = h // nh
+        x = paddle.to_tensor(rng.rand(2, 6, h).astype(np.float32),
+                             stop_gradient=False)
+        # reference qkv layout: [3, num_heads, head_dim, C]
+        qkv_w = paddle.to_tensor(
+            rng.rand(3, nh, hd, h).astype(np.float32), stop_gradient=False)
+        qkv_b = _t(np.zeros((3, nh, hd)))
+        lin_w = _t(rng.rand(h, h))
+        lin_b = _t(np.zeros(h))
+        out = IF.fused_multi_head_attention(
+            x, qkv_w, lin_w, qkv_bias=qkv_b, linear_bias=lin_b,
+            num_heads=nh, training=False)
+        assert out.shape == [2, 6, h]
+        out.sum().backward()
+        assert x.grad is not None and qkv_w.grad is not None
+
+    def test_fused_dropout_add_eval_and_train(self):
+        x, y = _t(np.ones((32, 32))), _t(np.ones((32, 32)))
+        out = IF.fused_dropout_add(x, y, p=0.5, training=False)
+        np.testing.assert_allclose(out.numpy(), 2.0 * np.ones((32, 32)))
+        paddle.seed(0)
+        tr = IF.fused_dropout_add(x, y, p=0.5, training=True).numpy()
+        assert not np.allclose(tr, 2.0)  # some elements dropped
+
+    def test_fused_bias_dropout_residual_ln(self):
+        rng = np.random.RandomState(3)
+        x = rng.rand(2, 4, 8).astype(np.float32)
+        res = rng.rand(2, 4, 8).astype(np.float32)
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            _t(x), _t(res), training=False)
+        h = x + res
+        mu = h.mean(-1, keepdims=True)
+        var = h.var(-1, keepdims=True)
+        np.testing.assert_allclose(out.numpy(),
+                                   (h - mu) / np.sqrt(var + 1e-5),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_fused_ec_moe(self):
+        rng = np.random.RandomState(4)
+        B, S, H, E, M = 2, 3, 8, 4, 16
+        x = rng.rand(B, S, H).astype(np.float32)
+        gw, gb = rng.rand(H, E).astype(np.float32), np.zeros(E, np.float32)
+        w1 = rng.rand(E, H, M).astype(np.float32)
+        b1 = np.zeros((E, M), np.float32)
+        w2 = rng.rand(E, M, H).astype(np.float32)
+        b2 = np.zeros((E, H), np.float32)
+        out = IF.fused_ec_moe(_t(x), _t(gw), _t(gb), _t(w1), _t(b1),
+                              _t(w2), _t(b2), act_type="relu")
+        assert out.shape == [B, S, H]
+        # manual reference
+        def softmax(z):
+            e = np.exp(z - z.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+        gates = softmax(x @ gw + gb)           # [B,S,E]
+        ref = np.zeros_like(x)
+        for e in range(E):
+            h = np.maximum(x @ w1[e] + b1[e], 0) @ w2[e] + b2[e]
+            ref += gates[..., e:e + 1] * h
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_rope_rotates_and_preserves_norm(self):
+        rng = np.random.RandomState(5)
+        q = _t(rng.rand(1, 6, 2, 8))
+        k = _t(rng.rand(1, 6, 2, 8))
+        q2, k2, _ = IF.fused_rotary_position_embedding(q, k)
+        assert q2.shape == q.shape
+        # rotation preserves per-pair L2 norm
+        np.testing.assert_allclose(
+            np.linalg.norm(q2.numpy(), axis=-1),
+            np.linalg.norm(q.numpy(), axis=-1), rtol=1e-5)
+        # position 0 is unrotated
+        np.testing.assert_allclose(q2.numpy()[:, 0], q.numpy()[:, 0],
+                                   rtol=1e-5)
+        assert not np.allclose(q2.numpy()[:, 1], q.numpy()[:, 1])
+
+    def test_swiglu(self):
+        rng = np.random.RandomState(6)
+        x = rng.rand(4, 16).astype(np.float32)
+        out = IF.swiglu(_t(x))
+        a, b = x[:, :8], x[:, 8:]
+        silu = a / (1 + np.exp(-a)) * a / a  # silu(a) = a*sigmoid(a)
+        ref = (a * (1 / (1 + np.exp(-a)))) * b
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+        # two-arg form
+        out2 = IF.swiglu(_t(a), _t(b))
+        np.testing.assert_allclose(out2.numpy(), ref, rtol=1e-5)
