@@ -131,6 +131,8 @@ from . import audio  # noqa: F401, E402
 from . import strings  # noqa: F401, E402
 from . import text  # noqa: F401, E402
 from . import cost_model  # noqa: F401, E402
+from . import linalg  # noqa: F401, E402
+from . import version  # noqa: F401, E402
 from .tensor_array import (  # noqa: F401, E402
     TensorArray,
     array_length,

@@ -1,0 +1,56 @@
+"""Hybrid-parallel helpers (reference
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py:
+fused_allreduce_gradients :227 — the manual data-parallel grad sync used
+by custom training loops, broadcast helpers for mp/sharding params)."""
+
+from ....core.tensor import Tensor
+
+__all__ = ["fused_allreduce_gradients", "broadcast_mp_parameters",
+           "broadcast_dp_parameters"]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Average gradients across the data-parallel group.
+
+    The reference fuses grads into flat buffers before NCCL; under XLA
+    one AVG collective per tensor compiles to the same fused transfers,
+    so "fused" is the compiler's job here.  No-op when dp == 1.
+    """
+    from ... import communication as dist
+
+    group = None
+    if hcg is not None:
+        if hcg.get_data_parallel_world_size() <= 1:
+            return
+        group = hcg.get_data_parallel_group()
+    for p in parameter_list:
+        g = getattr(p, "grad", None)
+        if g is None:
+            continue
+        out = dist.all_reduce(g, op=dist.ReduceOp.AVG, group=group)
+        if out is not None:
+            p.grad = out if isinstance(out, Tensor) \
+                else Tensor(out, stop_gradient=True)
+
+
+def _broadcast_params(parameters, group, src_rank=0):
+    from ... import communication as dist
+
+    for p in parameters:
+        dist.broadcast(p, src=src_rank, group=group)
+
+
+def broadcast_mp_parameters(model, hcg):
+    """Sync replicated params inside the model-parallel group (reference
+    broadcast_mp_parameters)."""
+    if hcg.get_model_parallel_world_size() <= 1:
+        return
+    _broadcast_params(model.parameters(), hcg.get_model_parallel_group())
+
+
+def broadcast_dp_parameters(model, hcg):
+    """Rank-0 weights win across the dp group (reference
+    broadcast_dp_parameters)."""
+    if hcg.get_data_parallel_world_size() <= 1:
+        return
+    _broadcast_params(model.parameters(), hcg.get_data_parallel_group())

@@ -370,3 +370,16 @@ def kl_divergence(p, q):
     raise NotImplementedError(
         f"kl_divergence not registered for {type(p).__name__}/"
         f"{type(q).__name__}")
+
+
+from .transform import (  # noqa: E402,F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    PowerTransform,
+    SigmoidTransform,
+    TanhTransform,
+    Transform,
+    TransformedDistribution,
+)

@@ -1,0 +1,177 @@
+"""paddle.distribution.transform — bijective transforms +
+TransformedDistribution (reference python/paddle/distribution/transform.py:
+Transform base with forward/inverse/log_det_jacobian and the standard
+zoo; transformed_distribution.py).
+
+All math is jnp and differentiable; sampling composes transform.forward
+over the base distribution's samples, log_prob subtracts the forward
+log-det-Jacobian at the pre-image (standard change of variables).
+"""
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform",
+           "SigmoidTransform", "TanhTransform", "PowerTransform",
+           "AbsTransform", "ChainTransform", "TransformedDistribution"]
+
+
+def _d(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Bijection base (reference transform.py Transform)."""
+
+    def forward(self, x):
+        return Tensor(self._forward(_d(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_d(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._fldj(_d(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._fldj(self._inverse(_d(y))))
+
+    # subclass hooks over jnp arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _d(loc)
+        self.scale = _d(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _d(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return 1.0 / (1.0 + jnp.exp(-x))
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        # log sigmoid'(x) = -softplus(-x) - softplus(x)
+        return -jnp.logaddexp(0.0, -x) - jnp.logaddexp(0.0, x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        return 2.0 * (math.log(2.0) - x - jnp.logaddexp(0.0, -2.0 * x))
+
+
+class AbsTransform(Transform):
+    """Non-bijective |x| (reference AbsTransform): inverse returns the
+    positive branch."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = jnp.zeros_like(x)
+        for t in self.transforms:
+            total = total + t._fldj(x)
+            x = t._forward(x)
+        return total
+
+
+class TransformedDistribution:
+    """Reference transformed_distribution.TransformedDistribution."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transform = ChainTransform(transforms) \
+            if len(transforms) != 1 else transforms[0]
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape) if hasattr(self.base, "rsample") \
+            else self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        y = _d(value)
+        x = self.transform._inverse(y)
+        base_lp = _d(self.base.log_prob(Tensor(x)))
+        return Tensor(base_lp - self.transform._fldj(x))
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_d(self.log_prob(value))))

@@ -11,6 +11,7 @@ flash-attention kernel, and the MoE dispatch einsums.
 import jax.numpy as jnp
 
 from ...core.tensor import Tensor
+from ...ops.dispatch import apply_op
 from ...ops.registry import OPS
 
 __all__ = ["fused_matmul_bias", "fused_linear", "fused_feedforward",
@@ -70,10 +71,10 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight,
     """Reference fused_multi_head_attention (fused_attention_op.cu)."""
     return _u("fused_attention")(
         x, qkv_weight, qkv_bias, linear_weight, linear_bias,
-        ln_scale=pre_ln_scale if pre_layer_norm else ln_scale,
-        ln_bias=pre_ln_bias if pre_layer_norm else ln_bias,
-        ln2_scale=ln_scale if pre_layer_norm else None,
-        ln2_bias=ln_bias if pre_layer_norm else None,
+        ln_scale=pre_ln_scale if pre_layer_norm else None,
+        ln_bias=pre_ln_bias if pre_layer_norm else None,
+        ln2_scale=ln_scale,
+        ln2_bias=ln_bias,
         num_heads=num_heads, pre_layer_norm=pre_layer_norm,
         epsilon=pre_ln_epsilon, epsilon2=ln_epsilon,
         attn_dropout_rate=attn_dropout_rate,
@@ -95,17 +96,19 @@ def fused_bias_dropout_residual_layer_norm(
     """Reference fused_bias_dropout_residual_layer_norm."""
     h = x if bias is None else x + bias
     h = fused_dropout_add(h, residual, p=dropout_rate, training=training)
-    data = h._data if isinstance(h, Tensor) else h
-    mu = data.mean(-1, keepdims=True)
-    var = ((data - mu) ** 2).mean(-1, keepdims=True)
-    out = (data - mu) / jnp.sqrt(var + ln_epsilon)
-    if ln_scale is not None:
-        s = ln_scale._data if isinstance(ln_scale, Tensor) else ln_scale
-        out = out * s
-    if ln_bias is not None:
-        b = ln_bias._data if isinstance(ln_bias, Tensor) else ln_bias
-        out = out + b
-    return Tensor(out) if isinstance(h, Tensor) else out
+
+    def pure(data, scale, shift):
+        mu = data.mean(-1, keepdims=True)
+        var = ((data - mu) ** 2).mean(-1, keepdims=True)
+        out = (data - mu) / jnp.sqrt(var + ln_epsilon)
+        if scale is not None:
+            out = out * scale
+        if shift is not None:
+            out = out + shift
+        return out
+
+    return apply_op("fused_bias_dropout_residual_ln", pure,
+                    (h, ln_scale, ln_bias), {})
 
 
 def fused_ec_moe(x, gate_weight, gate_bias, expert_w1, expert_b1, expert_w2,
@@ -116,32 +119,30 @@ def fused_ec_moe(x, gate_weight, gate_bias, expert_w1, expert_b1, expert_w2,
     call (GSPMD shards the expert axis when params carry 'ep')."""
     import jax
 
-    d = lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t)
-    xx = d(x)                                   # [B, S, H]
-    gates = jax.nn.softmax(
-        jnp.einsum("bsh,he->bse", xx, d(gate_weight)) + d(gate_bias), -1)
-    h = jnp.einsum("bsh,ehm->besm", xx, d(expert_w1)) + \
-        d(expert_b1)[None, :, None, :]
-    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
-    h = act(h)
-    h = jnp.einsum("besm,emh->besh", h, d(expert_w2)) + \
-        d(expert_b2)[None, :, None, :]
-    out = jnp.einsum("besh,bse->bsh", h, gates)
-    return Tensor(out) if isinstance(x, Tensor) else out
+    def pure(xx, gw, gb, w1, b1, w2, b2):
+        gates = jax.nn.softmax(
+            jnp.einsum("bsh,he->bse", xx, gw) + gb, -1)
+        h = jnp.einsum("bsh,ehm->besm", xx, w1) + b1[None, :, None, :]
+        act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+        h = act(h)
+        h = jnp.einsum("besm,emh->besh", h, w2) + b2[None, :, None, :]
+        return jnp.einsum("besh,bse->bsh", h, gates)
+
+    return apply_op("fused_ec_moe", pure,
+                    (x, gate_weight, gate_bias, expert_w1, expert_b1,
+                     expert_w2, expert_b2), {})
 
 
 def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                     position_ids=None, use_neox_rotary_style=True,
                                     name=None):
     """RoPE applied to q/k (reference incubate fused_rope): interleaved
-    (GPT-NeoX) or half-split style."""
-    import numpy as np
+    (GPT-NeoX) or half-split style.  Differentiable (dispatched op)."""
 
     def d(t):
         return t._data if isinstance(t, Tensor) else jnp.asarray(t)
 
-    def rope(t):
-        tt = d(t)                                # [B, S, N, D]
+    def rope_pure(tt):
         b, s, n, hd = tt.shape
         if position_ids is not None:
             pos = d(position_ids).reshape(b, s).astype(jnp.float32)
@@ -153,11 +154,12 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             ang = pos[..., None] * inv[None, None, :]   # [B, S, D/2]
             sn, cs = jnp.sin(ang), jnp.cos(ang)
         else:
-            # cache layout [*, S, *, D]: neox caches duplicate each
-            # frequency interleaved (s0,s0,s1,s1,...) — de-interleave;
-            # half-split caches repeat the half — take the first half
-            sn_full = d(sin).reshape(s, hd)
-            cs_full = d(cos).reshape(s, hd)
+            # cache layout [*, S_max, *, D] with S_max >= s: take the
+            # first s rows.  neox caches duplicate each frequency
+            # interleaved (s0,s0,s1,s1,...) — de-interleave; half-split
+            # caches repeat the half — take the first half
+            sn_full = d(sin).reshape(-1, hd)[:s]
+            cs_full = d(cos).reshape(-1, hd)[:s]
             if use_neox_rotary_style:
                 sn, cs = sn_full[:, 0::2], cs_full[:, 0::2]
             else:
@@ -174,27 +176,26 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
             x1, x2 = tt[..., 0::2], tt[..., 1::2]
             r1 = x1 * cs - x2 * sn
             r2 = x2 * cs + x1 * sn
-            out = jnp.stack([r1, r2], axis=-1).reshape(tt.shape)
-        else:
-            half = hd // 2
-            x1, x2 = tt[..., :half], tt[..., half:]
-            out = jnp.concatenate([x1 * cs - x2 * sn,
-                                   x2 * cs + x1 * sn], axis=-1)
-        return Tensor(out) if isinstance(t, Tensor) else out
+            return jnp.stack([r1, r2], axis=-1).reshape(tt.shape)
+        half = hd // 2
+        x1, x2 = tt[..., :half], tt[..., half:]
+        return jnp.concatenate([x1 * cs - x2 * sn,
+                                x2 * cs + x1 * sn], axis=-1)
 
-    outs = [rope(t) if t is not None else None for t in (q, k, v)]
+    outs = [apply_op("fused_rope", rope_pure, (t,), {})
+            if t is not None else None for t in (q, k, v)]
     return tuple(outs)
 
 
 def swiglu(x, y=None, name=None):
-    """SwiGLU activation (reference incubate swiglu op)."""
-    xx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-    if y is None:
-        a, b = jnp.split(xx, 2, axis=-1)
-    else:
-        a = xx
-        b = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    """SwiGLU activation (reference incubate swiglu op); differentiable."""
     import jax
 
-    out = jax.nn.silu(a) * b
-    return Tensor(out) if isinstance(x, Tensor) else out
+    def pure(xx, yy):
+        if yy is None:
+            a, b = jnp.split(xx, 2, axis=-1)
+        else:
+            a, b = xx, yy
+        return jax.nn.silu(a) * b
+
+    return apply_op("swiglu", pure, (x, y), {})

@@ -78,14 +78,23 @@ class ModelAverage:
         self._old_sum = None
         self._count = 0
         self._old_count = 0
+        self._num_updates = 0
         self._backup = None
+
+    def _effective_window(self):
+        """Reference dynamic rule: min(max(num_updates * rate,
+        min_average_window), max_average_window)."""
+        dyn = self._num_updates * self.average_window_rate
+        return int(min(max(dyn, self.min_average_window),
+                       self.max_average_window))
 
     def step(self):
         """Accumulate the current weights (call after optimizer.step())."""
         for p in self._parameters:
             self._sum[id(p)] = self._sum[id(p)] + p._data
         self._count += 1
-        if self._count >= self.max_average_window:
+        self._num_updates += 1
+        if self._count >= self._effective_window():
             # roll the window (reference sum_1/sum_2 rotation)
             self._old_sum = self._sum
             self._old_count = self._count

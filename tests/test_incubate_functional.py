@@ -124,3 +124,39 @@ class TestFusedFunctional:
         # two-arg form
         out2 = IF.swiglu(_t(a), _t(b))
         np.testing.assert_allclose(out2.numpy(), ref, rtol=1e-5)
+
+    def test_fused_fns_are_differentiable(self):
+        """swiglu / rope / ec_moe / bias-dropout-residual-LN must record
+        autograd (they route through the dispatcher)."""
+        rng = np.random.RandomState(7)
+        x = paddle.to_tensor(rng.rand(4, 16).astype(np.float32),
+                             stop_gradient=False)
+        IF.swiglu(x).sum().backward()
+        assert x.grad is not None
+
+        q = paddle.to_tensor(rng.rand(1, 4, 2, 8).astype(np.float32),
+                             stop_gradient=False)
+        q2, _, _ = IF.fused_rotary_position_embedding(q)
+        q2.sum().backward()
+        assert q.grad is not None
+
+        h = paddle.to_tensor(rng.rand(2, 3, 8).astype(np.float32),
+                             stop_gradient=False)
+        res = paddle.to_tensor(rng.rand(2, 3, 8).astype(np.float32))
+        IF.fused_bias_dropout_residual_layer_norm(
+            h, res, training=False).sum().backward()
+        assert h.grad is not None
+
+    def test_rope_accepts_longer_cache(self):
+        rng = np.random.RandomState(8)
+        q = _t(rng.rand(1, 4, 2, 8))
+        ang = np.arange(64).reshape(64, 1) * (1.0 / 10000 ** (
+            np.arange(0, 8, 2) / 8))
+        sin = np.repeat(np.sin(ang), 2, axis=-1)[None, :, None, :]
+        cos = np.repeat(np.cos(ang), 2, axis=-1)[None, :, None, :]
+        q2, _, _ = IF.fused_rotary_position_embedding(
+            q, sin=_t(sin), cos=_t(cos))
+        # matches the internally-computed angles for positions 0..3
+        q_ref, _, _ = IF.fused_rotary_position_embedding(q)
+        np.testing.assert_allclose(q2.numpy(), q_ref.numpy(), rtol=1e-4,
+                                   atol=1e-5)

@@ -1,0 +1,98 @@
+"""paddle.linalg / paddle.version namespaces + distribution transforms.
+
+Reference: python/paddle/linalg.py, python/paddle/version.py,
+python/paddle/distribution/transform.py + transformed_distribution.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+class TestNamespaces:
+    def test_linalg_namespace(self):
+        rng = np.random.RandomState(0)
+        a = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+        u, s, vt = paddle.linalg.svd(a)
+        np.testing.assert_allclose(
+            (u.numpy() * s.numpy()[None]) @ vt.numpy(), a.numpy(),
+            rtol=1e-4, atol=1e-5)
+        assert paddle.linalg.det(a).shape == []
+        assert "cholesky" in paddle.linalg.__all__
+
+    def test_version(self):
+        assert paddle.version.full_version == "0.2.0"
+        assert paddle.version.cuda() == "False"  # TPU build: no CUDA
+        paddle.version.show()
+
+
+class TestTransforms:
+    def test_affine_roundtrip_and_jacobian(self):
+        t = D.AffineTransform(loc=2.0, scale=3.0)
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(y.numpy(), [5.0, -1.0])
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                                   np.log(3.0) * np.ones(2), rtol=1e-6)
+
+    def test_exp_sigmoid_tanh_jacobians_match_autodiff(self):
+        import jax
+
+        x = np.array([0.3, -0.7, 1.2], np.float32)
+        for t in (D.ExpTransform(), D.SigmoidTransform(),
+                  D.TanhTransform()):
+            xt = paddle.to_tensor(x)
+            ldj = t.forward_log_det_jacobian(xt).numpy()
+            grad = jax.vmap(jax.grad(lambda v: t._forward(v)))(
+                jax.numpy.asarray(x))
+            np.testing.assert_allclose(ldj, np.log(np.abs(np.asarray(grad))),
+                                       rtol=1e-4, atol=1e-5)
+            # bijectivity
+            np.testing.assert_allclose(
+                t.inverse(t.forward(xt)).numpy(), x, rtol=1e-5, atol=1e-6)
+
+    def test_chain_transform(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.5], np.float32))
+        y = chain.forward(x)
+        np.testing.assert_allclose(y.numpy(), np.exp(2 * 0.5), rtol=1e-6)
+        np.testing.assert_allclose(chain.inverse(y).numpy(), 0.5,
+                                   rtol=1e-6)
+        # ldj = log|2| + (2x)  (affine then exp evaluated at 2x)
+        np.testing.assert_allclose(
+            chain.forward_log_det_jacobian(x).numpy(),
+            np.log(2.0) + 1.0, rtol=1e-6)
+
+    def test_transformed_distribution_lognormal(self):
+        base = D.Normal(loc=0.0, scale=1.0)
+        lognorm = D.TransformedDistribution(base, [D.ExpTransform()])
+        paddle.seed(0)
+        s = lognorm.sample((2000,))
+        assert (s.numpy() > 0).all()
+        v = paddle.to_tensor(np.array([0.5, 1.0, 2.0], np.float32))
+        lp = lognorm.log_prob(v).numpy()
+        ref = D.LogNormal(loc=0.0, scale=1.0).log_prob(v).numpy()
+        np.testing.assert_allclose(lp, ref, rtol=1e-4, atol=1e-5)
+
+
+class TestHybridParallelUtil:
+    def test_fused_allreduce_gradients_single_dp(self):
+        """dp=1 world: AVG over one distinct copy leaves grads unchanged."""
+        from paddle_tpu import nn
+        from paddle_tpu.distributed.fleet.utils import (
+            fused_allreduce_gradients,
+        )
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        x = paddle.to_tensor(np.random.RandomState(0).rand(4, 4)
+                             .astype(np.float32))
+        m(x).sum().backward()
+        before = m.weight.grad.numpy().copy()
+        fused_allreduce_gradients(list(m.parameters()))
+        np.testing.assert_allclose(m.weight.grad.numpy(), before,
+                                   rtol=1e-6)
