@@ -18,6 +18,7 @@ from .topology import (  # noqa: F401
 )
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .meta_parallel import mp_layers  # noqa: F401
 

@@ -26,6 +26,7 @@ from .sampler import (  # noqa: F401
     SubsetRandomSampler,
     WeightedRandomSampler,
 )
+from .device_prefetch import prefetch_to_device  # noqa: F401
 from .dataloader import (  # noqa: F401
     DataLoader,
     default_collate_fn,

@@ -1,0 +1,165 @@
+"""PS data pipeline (InMemoryDataset/QueueDataset MultiSlot format) +
+device prefetch iterator.
+
+Reference: fleet/dataset/dataset.py over the C++ Dataset/DataFeed engine;
+buffered readers.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import InMemoryDataset, QueueDataset
+
+
+def _write_multislot(path, n, rng, truncated=False):
+    """2 sparse slots + 1 dense label per line."""
+    with open(path, "w") as f:
+        for i in range(n):
+            ids1 = rng.randint(0, 100, rng.randint(1, 4))
+            ids2 = rng.randint(0, 100, 2)
+            label = float(ids1[0] % 2)
+            parts = ([str(len(ids1))] + [str(v) for v in ids1]
+                     + [str(len(ids2))] + [str(v) for v in ids2]
+                     + ["1", str(label)])
+            if truncated and i == n - 1:
+                parts = parts[:2]
+            f.write(" ".join(parts) + "\n")
+
+
+class TestInMemoryDataset:
+    def _make(self, tmp_path, files=2, n=10):
+        rng = np.random.RandomState(0)
+        paths = []
+        for k in range(files):
+            p = str(tmp_path / f"part-{k:03d}")
+            _write_multislot(p, n, rng)
+            paths.append(p)
+        ds = InMemoryDataset()
+        ds.init(batch_size=4,
+                use_var=[("slot_a", "sparse"), ("slot_b", "sparse"),
+                         ("label", "dense")])
+        ds.set_filelist(paths)
+        return ds
+
+    def test_load_parse_batch(self, tmp_path):
+        ds = self._make(tmp_path)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 20
+        batches = list(ds)
+        assert len(batches) == 5
+        b = batches[0]
+        assert set(b) == {"slot_a", "slot_b", "label"}
+        assert b["slot_b"].shape == (4, 2)
+        assert b["slot_a"].dtype == np.int64
+        assert b["label"].shape == (4, 1) and b["label"].dtype == np.float32
+        # variable-length slot padded to the batch max
+        assert b["slot_a"].shape[1] >= 1
+
+    def test_local_shuffle_changes_order(self, tmp_path):
+        ds = self._make(tmp_path)
+        ds.load_into_memory()
+        before = [r[0].tolist() for r in ds._records]
+        ds.local_shuffle(seed=3)
+        after = [r[0].tolist() for r in ds._records]
+        assert before != after
+        assert sorted(map(str, before)) == sorted(map(str, after))
+
+    def test_global_shuffle_partitions_disjointly(self, tmp_path):
+        ds0 = self._make(tmp_path)
+        ds0.load_into_memory()
+        total = ds0.get_memory_data_size()
+        shards = []
+        for rank in range(2):
+            ds = self._make(tmp_path)
+            ds.load_into_memory()
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            os.environ["PADDLE_TRAINERS_NUM"] = "2"
+            try:
+                ds.global_shuffle(seed=7)
+            finally:
+                del os.environ["PADDLE_TRAINER_ID"]
+                del os.environ["PADDLE_TRAINERS_NUM"]
+            shards.append([str(r[0].tolist()) + str(r[2].tolist())
+                           for r in ds._records])
+        assert len(shards[0]) + len(shards[1]) == total
+        assert not set(shards[0]) & set(shards[1])
+
+    def test_truncated_line_raises(self, tmp_path):
+        p = str(tmp_path / "bad")
+        _write_multislot(p, 3, np.random.RandomState(0), truncated=True)
+        ds = InMemoryDataset()
+        ds.init(batch_size=1, use_var=["a", "b", ("label", "dense")])
+        ds.set_filelist([p])
+        with pytest.raises(ValueError, match="truncated"):
+            ds.load_into_memory()
+
+    def test_feeds_deepfm_training(self, tmp_path):
+        from paddle_tpu import optimizer
+        from paddle_tpu.models.deepfm import DeepFM
+
+        ds = self._make(tmp_path, files=2, n=32)
+        ds.load_into_memory()
+        ds.local_shuffle(seed=0)
+        paddle.seed(0)
+        m = DeepFM(sparse_feature_dim=4, num_slots=4, hidden_sizes=(8,))
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=m.parameters())
+        losses = []
+        for epoch in range(6):
+            for b in ds:
+                ids = np.concatenate(
+                    [b["slot_a"][:, :2], b["slot_b"]], axis=1)
+                loss = m.loss(m(paddle.to_tensor(ids)),
+                              paddle.to_tensor(b["label"][:, 0]))
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+
+class TestQueueDataset:
+    def test_streams_batches(self, tmp_path):
+        rng = np.random.RandomState(1)
+        p = str(tmp_path / "stream")
+        _write_multislot(p, 10, rng)
+        ds = QueueDataset()
+        ds.init(batch_size=4, use_var=["a", "b", ("label", "dense")])
+        ds.set_filelist([p])
+        batches = list(ds)
+        assert len(batches) == 2  # trailing partial batch dropped
+        assert batches[0]["label"].shape == (4, 1)
+
+
+class TestDevicePrefetch:
+    def test_prefetch_preserves_order_and_values(self):
+        from paddle_tpu import io
+
+        data = [(paddle.to_tensor(np.full((2, 2), i, np.float32)),
+                 np.int64(i)) for i in range(7)]
+        got = list(io.prefetch_to_device(data, size=3))
+        assert len(got) == 7
+        for i, (x, y) in enumerate(got):
+            np.testing.assert_allclose(x.numpy(), i)
+            assert int(y) == i
+        # arrays are device-resident jax arrays
+        import jax
+        assert isinstance(got[0][0]._data, jax.Array)
+
+    def test_prefetch_with_dataloader(self):
+        from paddle_tpu import io
+
+        class DS(io.Dataset):
+            def __len__(self):
+                return 12
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        loader = io.DataLoader(DS(), batch_size=4)
+        vals = [b.numpy().tolist()
+                for b in io.prefetch_to_device(loader, size=2)]
+        assert vals == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9, 10, 11]]
