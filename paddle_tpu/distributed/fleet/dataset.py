@@ -90,6 +90,9 @@ class DatasetBase:
             if i >= len(toks):
                 raise ValueError(f"truncated MultiSlot line: {line[:80]!r}")
             n = int(toks[i])
+            if n < 0:
+                raise ValueError(
+                    f"negative slot count in MultiSlot line: {line[:80]!r}")
             vals = toks[i + 1:i + 1 + n]
             if len(vals) < n:
                 raise ValueError(
@@ -124,6 +127,11 @@ class DatasetBase:
                 for j, c in enumerate(cols):
                     arr[j, :len(c)] = c
                 out[name] = arr
+                # padding uses id 0, which is a LEGAL feature id — ship
+                # per-row lengths so models can mask pad positions (the
+                # reference's LoD information, rectangularized)
+                out[f"{name}_lens"] = np.asarray(
+                    [len(c) for c in cols], np.int64)
         return out
 
 
@@ -149,6 +157,10 @@ class InMemoryDataset(DatasetBase):
         self._records = []
         for path in self._filelist:
             self._records.extend(self._iter_file(path))
+        # canonical load order: global_shuffle partitions from THIS list,
+        # so prior local_shuffle calls can't break the cross-rank
+        # partition (ranks agree on file order, not on shuffle history)
+        self._canonical = list(self._records)
         self._loaded = True
         if is_shuffle:
             self.local_shuffle()
@@ -168,16 +180,20 @@ class InMemoryDataset(DatasetBase):
         with no data plane.  (Per-rank file shards would need a real
         exchange; use local_shuffle + your own sharding instead.)
         """
-        import jax
+        if "PADDLE_TRAINER_ID" in os.environ:
+            rank = int(os.environ["PADDLE_TRAINER_ID"])
+            world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        else:  # only touch jax (backend init) when env isn't set
+            import jax
 
-        rank = int(os.environ.get("PADDLE_TRAINER_ID",
-                                  jax.process_index()))
-        world = int(os.environ.get("PADDLE_TRAINERS_NUM",
-                                   jax.process_count()))
+            rank = jax.process_index()
+            world = jax.process_count()
+        # shuffle the CANONICAL load order so every rank computes the
+        # same permutation regardless of earlier local_shuffle calls
+        records = list(self._canonical)
         rng = random.Random(seed)
-        rng.shuffle(self._records)
-        if world > 1:
-            self._records = self._records[rank::world]
+        rng.shuffle(records)
+        self._records = records[rank::world] if world > 1 else records
 
     def get_memory_data_size(self, fleet=None):
         return len(self._records)

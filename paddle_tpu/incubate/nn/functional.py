@@ -199,3 +199,37 @@ def swiglu(x, y=None, name=None):
         return jax.nn.silu(a) * b
 
     return apply_op("swiglu", pure, (x, y), {})
+
+
+def ragged_decode_attention(q, k_cache, v_cache, lengths,
+                            use_pallas=None, interpret=False):
+    """Single-token decode attention over a ragged KV cache (GQA-aware).
+
+    q [B, Nq, D]; k_cache/v_cache [B, S_max, Nkv, D] with Nq % Nkv == 0
+    (query heads grouped contiguously per KV head); lengths [B] = valid
+    prefix.  Uses the Pallas kernel
+    (ops/pallas/decode_attention_kernel.py) when the shapes qualify,
+    else the dense masked XLA fallback — identical semantics.
+    """
+    from ...ops.pallas import decode_attention_kernel as dk
+
+    def pure(qq, kk, vv, ll):
+        import jax as _jax
+
+        b, nq, d = qq.shape
+        s_max, nkv = kk.shape[1], kk.shape[2]
+        ok = dk.supports(s_max, d, nq, nkv) and (
+            interpret or _jax.default_backend() == "tpu")
+        # on hardware the kernel is opt-in (use_pallas=True) until its
+        # scalar-lengths layout is validated on a real chip; interpret
+        # mode (numerics-verified) auto-selects it
+        default_on = interpret
+        use = (default_on and ok) if use_pallas is None \
+            else (use_pallas and ok)
+        if use:
+            return dk.decode_attention_pallas(qq, kk, vv, ll,
+                                              interpret=interpret)
+        return dk.decode_attention_xla(qq, kk, vv, ll)
+
+    return apply_op("ragged_decode_attention", pure,
+                    (q, k_cache, v_cache, lengths), {})

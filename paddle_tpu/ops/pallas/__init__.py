@@ -79,3 +79,12 @@ def flash_attention(q, k, v, attn_mask=None, is_causal=False, dropout_p=0.0,
     return _xla_attention(q, k, v, attn_mask=attn_mask, is_causal=is_causal,
                           dropout_p=dropout_p, dropout_key=dropout_key,
                           scale=scale)
+
+
+def pick_block(size, preferred, candidates=(512, 256, 128, 64, 32, 16, 8)):
+    """Largest candidate <= preferred that divides ``size`` (shared block
+    -size heuristic for the Pallas kernels)."""
+    for b in (preferred,) + tuple(candidates):
+        if b <= preferred and size % b == 0:
+            return b
+    return None

@@ -30,10 +30,9 @@ _NEG_INF = -1e30
 
 
 def _pick_block(seq, preferred):
-    for b in (preferred, 512, 256, 128, 64, 32, 16, 8):
-        if b <= preferred and seq % b == 0:
-            return b
-    return None
+    from . import pick_block
+
+    return pick_block(seq, preferred)
 
 
 def supports(seq_q, seq_k, head_dim):

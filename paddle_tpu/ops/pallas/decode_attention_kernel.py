@@ -1,0 +1,121 @@
+"""Pallas decode attention — single-token query over a ragged KV cache.
+
+The generative-decode hot loop (reference analog: the masked attention
+inside fused_multi_transformer_op.cu's decode branch).  Shapes:
+
+    q        [B, Nq, D]          one new token per sequence
+    k_cache  [B, S_max, Nkv, D]  Nq % Nkv == 0 (GQA: G = Nq//Nkv query
+    v_cache  [B, S_max, Nkv, D]  heads share one KV head)
+    lengths  [B] int32           valid cache prefix per sequence
+
+Kernel layout: one program per (batch, kv_head); the program streams the
+KV cache in S-blocks from VMEM, computing all G grouped query heads at
+once ([G, D] @ [D, S_blk] rides the MXU), with an online softmax across
+blocks and per-position masking by ``lengths`` — ragged sequences cost
+only their occupied blocks' bandwidth, never S_max compute on the VPU
+path.
+
+TPU-shape constraints: D <= 128, S_max % block_s == 0.  ``supports``
+gates callers; the XLA fallback (used by FusedMultiTransformer by
+default) computes the same masked attention densely.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_S = 128
+_NEG_INF = -1e30
+
+
+def _pick_block(s_max, preferred=DEFAULT_BLOCK_S):
+    from . import pick_block
+
+    return pick_block(s_max, preferred,
+                      candidates=(256, 128, 64, 32, 16, 8))
+
+
+def supports(s_max, head_dim, num_q_heads, num_kv_heads):
+    return (head_dim <= 128 and _pick_block(s_max) is not None
+            and num_q_heads % num_kv_heads == 0)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_s):
+    """One (batch, kv_head) program: G query heads over the KV prefix."""
+    q = q_ref[0, :, 0, :].astype(jnp.float32)          # [G, D]
+    s_max = k_ref.shape[1]
+    g, d = q.shape
+    length = len_ref[0]
+
+    def body(i, carry):
+        o, m, l = carry
+        k = k_ref[0, pl.ds(i * block_s, block_s), 0, :] \
+            .astype(jnp.float32)                        # [S, D]
+        v = v_ref[0, pl.ds(i * block_s, block_s), 0, :] \
+            .astype(jnp.float32)                        # [S, D]
+        s = q @ k.T / jnp.sqrt(jnp.float32(d))          # [G, S]
+        pos = i * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, (g, block_s), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # [G, S]
+        alpha = jnp.exp(m - m_new)
+        o = o * alpha + p @ v                           # [G, D]
+        l = l * alpha[:, 0] + p.sum(axis=1)
+        return o, m_new, l
+
+    num_blocks = s_max // block_s
+    o0 = jnp.zeros((g, d), jnp.float32)
+    m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((g,), jnp.float32)
+    o, m, l = jax.lax.fori_loop(0, num_blocks, body, (o0, m0, l0))
+    o_ref[0, :, 0, :] = (o / l[:, None]).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k_cache, v_cache, lengths, block_s=None,
+                            interpret=False):
+    """Returns [B, Nq, D] attention outputs for one decode step."""
+    b, nq, d = q.shape
+    s_max, nkv = k_cache.shape[1], k_cache.shape[2]
+    g = nq // nkv
+    block_s = block_s or _pick_block(s_max)
+    # regroup query heads by their kv head: [B, Nkv, G, D]
+    qg = q.reshape(b, nkv, g, d)
+    lengths = lengths.astype(jnp.int32)
+
+    grid = (b, nkv)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_s=block_s),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, g, 1, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((1, s_max, 1, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((1, s_max, 1, d), lambda i, j: (i, 0, j, 0)),
+            pl.BlockSpec((1,), lambda i, j: (i,)),
+        ],
+        out_specs=pl.BlockSpec((1, g, 1, d), lambda i, j: (i, 0, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, g, nkv, d), q.dtype),
+        interpret=interpret,
+    )(qg.transpose(0, 2, 1, 3), k_cache, v_cache, lengths)
+    # out [B, G, Nkv, D] -> [B, Nq, D]
+    return out.transpose(0, 2, 1, 3).reshape(b, nq, d)
+
+
+def decode_attention_xla(q, k_cache, v_cache, lengths):
+    """Dense masked reference/fallback (same semantics)."""
+    b, nq, d = q.shape
+    s_max, nkv = k_cache.shape[1], k_cache.shape[2]
+    g = nq // nkv
+    qg = q.reshape(b, nkv, g, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("bngd,bsnd->bngs", qg.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) * scale
+    mask = jnp.arange(s_max)[None, None, None, :] < \
+        lengths[:, None, None, None]
+    logits = jnp.where(mask, logits, _NEG_INF)
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bngs,bsnd->bngd", p,
+                     v_cache.astype(jnp.float32))
+    return out.reshape(b, nq, d).astype(q.dtype)

@@ -51,7 +51,10 @@ class TestInMemoryDataset:
         batches = list(ds)
         assert len(batches) == 5
         b = batches[0]
-        assert set(b) == {"slot_a", "slot_b", "label"}
+        assert set(b) == {"slot_a", "slot_a_lens", "slot_b",
+                          "slot_b_lens", "label"}
+        assert b["slot_a_lens"].shape == (4,)
+        assert (b["slot_a_lens"] >= 1).all()
         assert b["slot_b"].shape == (4, 2)
         assert b["slot_a"].dtype == np.int64
         assert b["label"].shape == (4, 1) and b["label"].dtype == np.float32
@@ -79,6 +82,28 @@ class TestInMemoryDataset:
             os.environ["PADDLE_TRAINERS_NUM"] = "2"
             try:
                 ds.global_shuffle(seed=7)
+            finally:
+                del os.environ["PADDLE_TRAINER_ID"]
+                del os.environ["PADDLE_TRAINERS_NUM"]
+            shards.append([str(r[0].tolist()) + str(r[2].tolist())
+                           for r in ds._records])
+        assert len(shards[0]) + len(shards[1]) == total
+        assert not set(shards[0]) & set(shards[1])
+
+    def test_global_shuffle_partition_survives_prior_local_shuffle(
+            self, tmp_path):
+        total = None
+        shards = []
+        for rank in range(2):
+            ds = self._make(tmp_path)
+            # unseeded per-rank shuffle BEFORE global: partition must
+            # still come out disjoint (computed from canonical order)
+            ds.load_into_memory(is_shuffle=True)
+            total = ds.get_memory_data_size()
+            os.environ["PADDLE_TRAINER_ID"] = str(rank)
+            os.environ["PADDLE_TRAINERS_NUM"] = "2"
+            try:
+                ds.global_shuffle(seed=11)
             finally:
                 del os.environ["PADDLE_TRAINER_ID"]
                 del os.environ["PADDLE_TRAINERS_NUM"]
