@@ -1,0 +1,251 @@
+// Inference C API — native client for the predictor server.
+//
+// Role parity with the reference C inference API
+// (paddle/fluid/inference/capi_exp/pd_inference_api.h): C/C++/Go programs
+// create a predictor handle, feed tensors, run, and fetch outputs.  The
+// compute engine here is the Python/XLA runtime, so the handle wraps a
+// TCP connection to a PredictorServer (paddle_tpu/inference/serving.py)
+// instead of an in-process C++ engine; the tensor wire format is the
+// length-prefixed encoding documented in serving.py.
+#include "paddle_native.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_infer_error;
+
+struct InferTensor {
+  uint8_t dtype;
+  std::vector<uint64_t> dims;
+  std::vector<uint8_t> data;
+};
+
+struct InferClient {
+  int fd = -1;
+  int timeout_ms = 60000;
+  std::vector<InferTensor> inputs;
+  std::vector<InferTensor> outputs;
+};
+
+size_t dtype_size(uint8_t code) {
+  switch (code) {
+    case 0: return 4;  // f32
+    case 1: return 8;  // f64
+    case 2: return 4;  // i32
+    case 3: return 8;  // i64
+    case 4: return 1;  // u8
+    case 5: return 1;  // bool
+  }
+  return 0;
+}
+
+bool send_all(int fd, const void* p, size_t n) {
+  const char* c = static_cast<const char*>(p);
+  while (n) {
+    ssize_t w = send(fd, c, n, MSG_NOSIGNAL);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      g_infer_error = std::string("send: ") + strerror(errno);
+      return false;
+    }
+    c += w;
+    n -= w;
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* p, size_t n, int timeout_ms) {
+  char* c = static_cast<char*>(p);
+  while (n) {
+    pollfd pfd{fd, POLLIN, 0};
+    int r = poll(&pfd, 1, timeout_ms);
+    if (r == 0) { g_infer_error = "infer recv timeout"; return false; }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    ssize_t got = recv(fd, c, n, 0);
+    if (got <= 0) {
+      if (got < 0 && errno == EINTR) continue;
+      g_infer_error = "infer server closed connection";
+      return false;
+    }
+    c += got;
+    n -= got;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_infer_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%d", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) {
+    g_infer_error = std::string("getaddrinfo failed for ") + host;
+    return nullptr;
+  }
+  int fd = socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+  if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+    g_infer_error = std::string("connect failed: ") + strerror(errno);
+    if (fd >= 0) close(fd);
+    freeaddrinfo(res);
+    return nullptr;
+  }
+  freeaddrinfo(res);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  auto* c = new InferClient;
+  c->fd = fd;
+  if (timeout_ms > 0) c->timeout_ms = timeout_ms;
+  return c;
+}
+
+void pd_infer_close(void* client) {
+  if (!client) return;
+  auto* c = static_cast<InferClient*>(client);
+  if (c->fd >= 0) close(c->fd);
+  delete c;
+}
+
+// Stage one input tensor (copied). dtype codes as in serving.py.
+// Returns -3 for an unknown dtype code.
+int pd_infer_add_input(void* client, int dtype, const int64_t* dims,
+                       int ndim, const void* data) {
+  auto* c = static_cast<InferClient*>(client);
+  if (dtype_size(static_cast<uint8_t>(dtype)) == 0) {
+    g_infer_error = "unknown dtype code";
+    return -3;
+  }
+  InferTensor t;
+  t.dtype = static_cast<uint8_t>(dtype);
+  size_t elems = 1;
+  for (int i = 0; i < ndim; ++i) {
+    t.dims.push_back(static_cast<uint64_t>(dims[i]));
+    elems *= static_cast<size_t>(dims[i]);
+  }
+  size_t bytes = elems * dtype_size(t.dtype);
+  t.data.assign(static_cast<const uint8_t*>(data),
+                static_cast<const uint8_t*>(data) + bytes);
+  c->inputs.push_back(std::move(t));
+  return 0;
+}
+
+namespace {
+// A failed/timed-out exchange leaves the stream desynced: poison the
+// connection so a retry errors loudly instead of parsing stale bytes.
+int poison_client(InferClient* c) {
+  if (c->fd >= 0) close(c->fd);
+  c->fd = -1;
+  return -1;
+}
+}  // namespace
+
+// Run: sends staged inputs, receives outputs. Returns 0 ok, -1 transport
+// error (connection poisoned; reconnect), -2 remote error (message via
+// pd_infer_last_error; connection still usable).
+int pd_infer_run(void* client) {
+  auto* c = static_cast<InferClient*>(client);
+  if (c->fd < 0) {
+    g_infer_error = "connection previously failed; reconnect";
+    return -1;
+  }
+  c->outputs.clear();
+  uint32_t n = static_cast<uint32_t>(c->inputs.size());
+  if (!send_all(c->fd, &n, 4)) return poison_client(c);
+  for (auto& t : c->inputs) {
+    uint8_t hdr[2] = {t.dtype, static_cast<uint8_t>(t.dims.size())};
+    if (!send_all(c->fd, hdr, 2)) return poison_client(c);
+    if (!t.dims.empty() &&
+        !send_all(c->fd, t.dims.data(), t.dims.size() * 8))
+      return poison_client(c);
+    if (!send_all(c->fd, t.data.data(), t.data.size()))
+      return poison_client(c);
+  }
+  c->inputs.clear();
+  uint8_t status;
+  if (!recv_all(c->fd, &status, 1, c->timeout_ms)) return poison_client(c);
+  uint32_t count;
+  if (!recv_all(c->fd, &count, 4, c->timeout_ms)) return poison_client(c);
+  if (status != 0) {
+    std::string msg(count, '\0');
+    if (count && !recv_all(c->fd, &msg[0], count, c->timeout_ms))
+      return poison_client(c);
+    g_infer_error = "remote: " + msg;
+    return -2;
+  }
+  for (uint32_t i = 0; i < count; ++i) {
+    uint8_t hdr[2];
+    if (!recv_all(c->fd, hdr, 2, c->timeout_ms)) return poison_client(c);
+    InferTensor t;
+    t.dtype = hdr[0];
+    if (dtype_size(t.dtype) == 0) {
+      g_infer_error = "server sent unknown dtype code";
+      return poison_client(c);
+    }
+    t.dims.resize(hdr[1]);
+    if (hdr[1] &&
+        !recv_all(c->fd, t.dims.data(), t.dims.size() * 8, c->timeout_ms))
+      return poison_client(c);
+    size_t elems = 1;
+    for (auto d : t.dims) elems *= d;
+    t.data.resize(elems * dtype_size(t.dtype));
+    if (!t.data.empty() &&
+        !recv_all(c->fd, t.data.data(), t.data.size(), c->timeout_ms))
+      return poison_client(c);
+    c->outputs.push_back(std::move(t));
+  }
+  return 0;
+}
+
+int pd_infer_num_outputs(void* client) {
+  return static_cast<int>(static_cast<InferClient*>(client)->outputs.size());
+}
+
+// Output metadata; dims buffer must hold >= 8 entries. Returns ndim or -1.
+int pd_infer_output_dims(void* client, int index, int* dtype,
+                         int64_t* dims) {
+  auto* c = static_cast<InferClient*>(client);
+  if (index < 0 || index >= static_cast<int>(c->outputs.size())) return -1;
+  auto& t = c->outputs[index];
+  *dtype = t.dtype;
+  for (size_t i = 0; i < t.dims.size() && i < 8; ++i)
+    dims[i] = static_cast<int64_t>(t.dims[i]);
+  return static_cast<int>(t.dims.size());
+}
+
+// Copy output payload into caller buffer of byte size buf_len.
+int pd_infer_output_data(void* client, int index, void* buf,
+                         int64_t buf_len) {
+  auto* c = static_cast<InferClient*>(client);
+  if (index < 0 || index >= static_cast<int>(c->outputs.size())) return -1;
+  auto& t = c->outputs[index];
+  if (buf_len < static_cast<int64_t>(t.data.size())) return -2;
+  memcpy(buf, t.data.data(), t.data.size());
+  return 0;
+}
+
+char* pd_infer_last_error(void) {
+  char* out = static_cast<char*>(malloc(g_infer_error.size() + 1));
+  memcpy(out, g_infer_error.c_str(), g_infer_error.size() + 1);
+  return out;
+}
+
+}  // extern "C"

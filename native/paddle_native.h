@@ -91,6 +91,22 @@ int     pd_ps_client_save(void* client, const char* path);
 int     pd_ps_client_load(void* client, const char* path);
 char*   pd_ps_last_error(void);
 
+// ------------------------------------------------------------ Inference C --
+// C inference API (infer_client.cc): connect to a PredictorServer
+// (paddle_tpu/inference/serving.py) and run tensors through it.
+// dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8 5=bool.
+void* pd_infer_connect(const char* host, int port, int timeout_ms);
+void  pd_infer_close(void* client);
+int   pd_infer_add_input(void* client, int dtype, const int64_t* dims,
+                         int ndim, const void* data);
+int   pd_infer_run(void* client);
+int   pd_infer_num_outputs(void* client);
+int   pd_infer_output_dims(void* client, int index, int* dtype,
+                           int64_t* dims);
+int   pd_infer_output_data(void* client, int index, void* buf,
+                           int64_t buf_len);
+char* pd_infer_last_error(void);
+
 // ------------------------------------------------------------------ Errors --
 // Thread-local last-error string for all pd_* calls; malloc'd copy.
 char* pd_last_error(void);

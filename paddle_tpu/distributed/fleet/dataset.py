@@ -151,6 +151,7 @@ class InMemoryDataset(DatasetBase):
     def __init__(self):
         super().__init__()
         self._records = []
+        self._canonical = []
         self._loaded = False
 
     def load_into_memory(self, is_shuffle=False):
@@ -180,14 +181,17 @@ class InMemoryDataset(DatasetBase):
         with no data plane.  (Per-rank file shards would need a real
         exchange; use local_shuffle + your own sharding instead.)
         """
+        rank = world = None
         if "PADDLE_TRAINER_ID" in os.environ:
             rank = int(os.environ["PADDLE_TRAINER_ID"])
-            world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-        else:  # only touch jax (backend init) when env isn't set
+        if "PADDLE_TRAINERS_NUM" in os.environ:
+            world = int(os.environ["PADDLE_TRAINERS_NUM"])
+        if rank is None or world is None:
+            # only touch jax (backend init) for whichever is missing
             import jax
 
-            rank = jax.process_index()
-            world = jax.process_count()
+            rank = jax.process_index() if rank is None else rank
+            world = jax.process_count() if world is None else world
         # shuffle the CANONICAL load order so every rank computes the
         # same permutation regardless of earlier local_shuffle calls
         records = list(self._canonical)
@@ -200,6 +204,7 @@ class InMemoryDataset(DatasetBase):
 
     def release_memory(self):
         self._records = []
+        self._canonical = []
         self._loaded = False
 
     # ------------------------------------------------------------ batches --

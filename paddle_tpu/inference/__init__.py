@@ -214,3 +214,7 @@ def get_fused_multi_transformer(model, **kwargs):
     incubate.nn.FusedMultiTransformer)."""
     from ..incubate.nn import FusedMultiTransformer
     return FusedMultiTransformer(model, **kwargs)
+
+
+from . import serving  # noqa: E402,F401
+from .serving import PredictorServer  # noqa: E402,F401

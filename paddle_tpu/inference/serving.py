@@ -1,0 +1,129 @@
+"""Predictor serving — the process boundary behind the C API.
+
+Reference: the inference C/Go APIs (paddle/fluid/inference/capi_exp/,
+goapi/) wrap an in-process C++ predictor.  Here the predictor's compute
+lives in the Python/XLA runtime, so out-of-language callers get a
+PROCESS boundary instead: ``PredictorServer`` serves a compiled
+Predictor over a length-prefixed TCP protocol, and the native C client
+(native/infer_client.cc, header paddle_native.h pd_infer_*) gives
+C/C++/Go programs the familiar create/run/fetch surface.
+
+Wire format (little-endian), shared with the C client:
+  request : u32 n_inputs | per input: u8 dtype | u8 ndim | u64 dims[ndim]
+            | raw bytes
+  response: u8 status (0 ok) | u32 n_outputs | same tensor encoding
+            (status 1: u32 len | utf-8 error message)
+dtype codes: 0=f32 1=f64 2=i32 3=i64 4=u8 5=bool
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+
+_DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
+_CODES = {np.dtype(d): i for i, d in enumerate(_DTYPES)}
+
+
+def _send_tensor(conn, arr):
+    arr = np.ascontiguousarray(arr)
+    code = _CODES.get(arr.dtype)
+    if code is None:
+        arr = arr.astype(np.float32)
+        code = 0
+    conn.sendall(struct.pack("<BB", code, arr.ndim))
+    conn.sendall(struct.pack(f"<{arr.ndim}Q", *arr.shape)
+                 if arr.ndim else b"")
+    conn.sendall(arr.tobytes())
+
+
+def _recv_exact(conn, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_tensor(conn):
+    code, ndim = struct.unpack("<BB", _recv_exact(conn, 2))
+    if code >= len(_DTYPES):
+        raise ValueError(f"invalid wire dtype code {code}")
+    dims = struct.unpack(f"<{ndim}Q", _recv_exact(conn, 8 * ndim)) \
+        if ndim else ()
+    dtype = np.dtype(_DTYPES[code])
+    n_bytes = int(np.prod(dims, dtype=np.int64)) * dtype.itemsize \
+        if ndim else dtype.itemsize
+    raw = _recv_exact(conn, n_bytes)
+    return np.frombuffer(raw, dtype=dtype).reshape(dims).copy()
+
+
+class PredictorServer:
+    """Serve a Predictor to out-of-process (C/C++/Go) callers.
+
+    >>> cfg = Config(); cfg.set_model_obj(model)
+    >>> srv = PredictorServer(create_predictor(cfg))     # port=0: free port
+    >>> # C side: pd_infer_connect("127.0.0.1", srv.port) ... pd_infer_run
+    """
+
+    def __init__(self, predictor, host="0.0.0.0", port=0):
+        self._predictor = predictor
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(16)
+        self.port = self._sock.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            with conn:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        (n_in,) = struct.unpack("<I", _recv_exact(conn, 4))
+                    except ConnectionError:
+                        return
+                    try:
+                        inputs = [_recv_tensor(conn)
+                                  for _ in range(n_in)]
+                    except ValueError as e:
+                        # protocol violation: report it, then drop the
+                        # (desynced) connection
+                        msg = str(e).encode()[:4096]
+                        conn.sendall(struct.pack("<BI", 1, len(msg)) + msg)
+                        return
+                    try:
+                        outs = self._predictor.run(inputs)
+                        conn.sendall(struct.pack("<BI", 0, len(outs)))
+                        for o in outs:
+                            _send_tensor(conn, np.asarray(o))
+                    except Exception as e:  # ship the error, keep serving
+                        msg = str(e).encode()[:4096]
+                        conn.sendall(struct.pack("<BI", 1, len(msg)) + msg)
+        except (ConnectionError, OSError):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
