@@ -174,14 +174,29 @@ def _hashable(x):
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, **kwargs):
-    """Compile a function or a Layer's forward (paddle.jit.to_static parity)."""
+    """Compile a function or a Layer's forward (paddle.jit.to_static parity).
+
+    Data-dependent python ``if``/``while`` on tensor values are converted
+    by the dy2static AST pass (reference python/paddle/jit/dy2static/)
+    into lax control flow; statements the pass can't convert keep the
+    explicit trace-guard behavior, and any conversion failure falls back
+    to plain tracing.
+    """
+    import types
+
+    from .dy2static import ast_transform
 
     def decorate(fn):
         if isinstance(fn, Layer):
-            static = StaticFunction(fn.forward, layer=fn)
+            raw = getattr(fn.forward, "__func__", fn.forward)
+            conv = ast_transform(raw)
+            fwd = types.MethodType(conv, fn) if conv is not None \
+                else fn.forward
+            static = StaticFunction(fwd, layer=fn)
             fn.forward = static
             return fn
-        return StaticFunction(fn)
+        conv = ast_transform(fn)
+        return StaticFunction(conv if conv is not None else fn)
 
     if function is not None:
         return decorate(function)

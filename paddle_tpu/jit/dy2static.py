@@ -1,0 +1,392 @@
+"""dy2static — AST conversion of python control flow on tensor values.
+
+Reference: python/paddle/jit/dy2static/ (20 AST transformers rewriting
+``if``/``while``/``for`` into conditional_block/while ops via runtime
+``convert_ifelse``/``convert_while_loop`` helpers).
+
+TPU redesign keeps the reference's two-phase architecture but targets
+lax: the AST pass rewrites ``if``/``while`` statements into calls to the
+runtime converters below; the converters check the condition at RUN time
+— a plain python value falls through to ordinary python control flow
+(zero behavior change), a traced Tensor dispatches to
+``static.nn.cond`` / ``while_loop`` so the branch compiles instead of
+hitting the trace guard.
+
+Rewrite shape (the reference's convert_ifelse pattern):
+
+    if t.sum() > 0:          def __d2s_true_1(x, y):
+        x = x + 1                x = x + 1
+    else:                        return (x, y)
+        y = x * 2     ==>    def __d2s_false_1(x, y):
+                                 y = x * 2
+                                 return (x, y)
+                             (x, y) = __d2s_convert_ifelse(
+                                 t.sum() > 0, __d2s_true_1, __d2s_false_1,
+                                 (__d2s_get('x'), __d2s_get('y')))
+
+Assigned names become branch-function parameters seeded from the call
+site (``__d2s_get`` reads the caller's frame; missing names seed the
+``_UNDEF`` sentinel so one-branch definitions still work on the python
+path and raise a clear error if a compiled path leaves them unset).
+
+Out of scope (left untransformed; the trace guard reports them if a
+tensor condition reaches one): ``return``/``break``/``continue``/
+``yield`` inside the converted block, ``while ... else``, closures with
+free variables.  Conversion failure of any kind falls back to the
+original function.
+"""
+
+import ast
+import functools
+import inspect
+import sys
+import textwrap
+
+__all__ = ["convert_ifelse", "convert_while", "ast_transform"]
+
+
+class _Undefined:
+    """Poison sentinel: ANY use raises, mirroring python's
+    UnboundLocalError-on-read for a name assigned in an untaken branch."""
+
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+    def _explode(self, *a, **k):
+        raise NameError(
+            "variable assigned only inside an untaken to_static branch "
+            "was used before assignment (dy2static)")
+
+    __bool__ = __getattr__ = __call__ = __iter__ = __len__ = _explode
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _explode
+    __truediv__ = __rtruediv__ = __eq__ = __lt__ = __gt__ = _explode
+    __getitem__ = __neg__ = __abs__ = _explode
+
+
+_UNDEF = _Undefined()
+
+
+def _branch_checked(fn, values):
+    """Run a branch under trace with an in-trace _UNDEF scan: raising HERE
+    (python level, during tracing) gives a clean NameError instead of
+    lax.cond's opaque invalid-JAX-type error."""
+    out = fn(*values)
+    seq = out if isinstance(out, (tuple, list)) else (out,)
+    for o in seq:
+        if o is _UNDEF:
+            raise NameError(
+                "a variable assigned in only one branch of a compiled "
+                "(tensor-condition) `if` is undefined on the other path; "
+                "assign it on both paths or before the if")
+    return out
+
+
+def _frame_get(name):
+    """Call-site seed: the converted function's local, or _UNDEF."""
+    frame = sys._getframe(1)
+    return frame.f_locals.get(name, _UNDEF)
+
+
+def _is_traced_bool(pred):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    data = pred._data if isinstance(pred, Tensor) else pred
+    return isinstance(data, jax.core.Tracer)
+
+
+def convert_ifelse(pred, true_fn, false_fn, values):
+    """Runtime dispatch for a rewritten ``if``.
+
+    Python bool → run ONE branch natively (exact eager semantics, tape
+    autograd included).  Traced Tensor → both branches trace into
+    lax.cond; every output must be defined on both paths.
+    """
+    if not _is_traced_bool(pred):
+        # the untaken path may leave names bound to the _UNDEF poison —
+        # python parity: error fires on first USE, not on binding
+        return true_fn(*values) if bool(pred) else false_fn(*values)
+    from ..static import nn as static_nn
+
+    return static_nn.cond(pred,
+                          lambda: _branch_checked(true_fn, values),
+                          lambda: _branch_checked(false_fn, values))
+
+
+def convert_while(test_fn, body_fn, names, values):
+    """Runtime dispatch for a rewritten ``while``.
+
+    Python-bool tests loop natively; a traced test lowers to
+    lax.while_loop (loop-invariant shapes required)."""
+    first = test_fn(*values)
+    if not _is_traced_bool(first):
+        while bool(first):
+            values = body_fn(*values)
+            first = test_fn(*values)
+        return tuple(values)
+    from ..static import nn as static_nn
+
+    for name, v in zip(names, values):
+        if v is _UNDEF:
+            raise NameError(
+                f"loop variable {name!r} is used in a compiled (tensor-"
+                "condition) while before assignment; initialize it before "
+                "the loop")
+    return tuple(static_nn.while_loop(
+        lambda *vs: test_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
+        list(values)))
+
+
+# ------------------------------------------------------------- AST pass ----
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by assignments in a statement list (no nested defs)."""
+
+    def __init__(self):
+        self.names = []
+
+    def _add(self, name):
+        if name not in self.names:
+            self.names.append(name)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self._add(node.id)
+
+    def visit_Import(self, node):
+        for a in node.names:
+            self._add(a.asname or a.name.split(".")[0])
+
+    def visit_ImportFrom(self, node):
+        for a in node.names:
+            self._add(a.asname or a.name)
+
+    def visit_FunctionDef(self, node):
+        self._add(node.name)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_ClassDef(self, node):
+        self._add(node.name)
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _HasEscape(ast.NodeVisitor):
+    """return/yield anywhere, break/continue not enclosed in a nested
+    loop, nonlocal/global declarations (param-passing would break them)."""
+
+    def __init__(self):
+        self.found = False
+        self._loop_depth = 0
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_Yield(self, node):
+        self.found = True
+
+    def visit_YieldFrom(self, node):
+        self.found = True
+
+    def visit_Nonlocal(self, node):
+        self.found = True
+
+    def visit_Global(self, node):
+        self.found = True
+
+    def visit_Delete(self, node):
+        self.found = True  # del unbinds: param-passing can't model it
+
+    def visit_ExceptHandler(self, node):
+        if node.name:  # `except E as e`: e is unbound after the block
+            self.found = True
+        self.generic_visit(node)
+
+    def visit_Break(self, node):
+        if self._loop_depth == 0:
+            self.found = True
+
+    def visit_Continue(self, node):
+        if self._loop_depth == 0:
+            self.found = True
+
+    def _loop(self, node):
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_While = _loop
+    visit_For = _loop
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    def visit_AsyncFunctionDef(self, node):
+        pass
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _has_escape(stmts):
+    v = _HasEscape()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _args(names):
+    return ast.arguments(posonlyargs=[], args=[ast.arg(arg=n)
+                                               for n in names],
+                         kwonlyargs=[], kw_defaults=[], defaults=[])
+
+
+def _seed_tuple(names):
+    return ast.Tuple(elts=[ast.Call(
+        func=ast.Name(id="__d2s_get", ctx=ast.Load()),
+        args=[ast.Constant(value=n)], keywords=[]) for n in names],
+        ctx=ast.Load())
+
+
+def _ret_tuple(names):
+    return ast.Return(value=ast.Tuple(
+        elts=[ast.Name(id=n, ctx=ast.Load()) for n in names],
+        ctx=ast.Load()))
+
+
+def _bind_target(names):
+    # always a tuple target — the branch/body fns return tuples even for
+    # one name, so `(y,) = call` unpacks consistently
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ast.Store())
+                           for n in names], ctx=ast.Store())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+
+    def _fresh(self, hint):
+        self.counter += 1
+        return f"__d2s_{hint}_{self.counter}"
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_escape(node.body) or _has_escape(node.orelse):
+            return node
+        names = sorted(n for n in set(_assigned(node.body)
+                                      + _assigned(node.orelse))
+                       if not n.startswith("__d2s"))
+
+        true_name = self._fresh("true")
+        false_name = self._fresh("false")
+        body = list(node.body) + ([_ret_tuple(names)] if names
+                                  else [ast.Return(value=ast.Constant(
+                                      value=None))])
+        orelse = (list(node.orelse) or [ast.Pass()]) + \
+            ([_ret_tuple(names)] if names
+             else [ast.Return(value=ast.Constant(value=None))])
+        true_def = ast.FunctionDef(name=true_name, args=_args(names),
+                                   body=body, decorator_list=[])
+        false_def = ast.FunctionDef(name=false_name, args=_args(names),
+                                    body=orelse, decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__d2s_convert_ifelse", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=true_name, ctx=ast.Load()),
+                  ast.Name(id=false_name, ctx=ast.Load()),
+                  _seed_tuple(names)],
+            keywords=[])
+        stmt = (ast.Assign(targets=[_bind_target(names)], value=call)
+                if names else ast.Expr(value=call))
+        return [true_def, false_def, stmt]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body):
+            return node
+        if any(isinstance(n, ast.NamedExpr) for n in ast.walk(node.test)):
+            return node  # walrus binds inside the nested test fn
+        names = sorted(n for n in set(_assigned(node.body))
+                       if not n.startswith("__d2s"))
+        if not names:
+            return node
+
+        test_name = self._fresh("test")
+        body_name = self._fresh("body")
+        test_def = ast.FunctionDef(
+            name=test_name, args=_args(names),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_def = ast.FunctionDef(
+            name=body_name, args=_args(names),
+            body=list(node.body) + [_ret_tuple(names)], decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__d2s_convert_while", ctx=ast.Load()),
+            args=[ast.Name(id=test_name, ctx=ast.Load()),
+                  ast.Name(id=body_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  _seed_tuple(names)],
+            keywords=[])
+        assign = ast.Assign(targets=[_bind_target(names)], value=call)
+        return [test_def, body_def, assign]
+
+
+def ast_transform(fn):
+    """Control-flow-converted clone of ``fn``, or None when conversion
+    isn't possible (no source, closures, nothing to convert, exec
+    failure).  Identical behavior for python-bool conditions."""
+    if getattr(fn, "__closure__", None):
+        return None  # free variables would need cell surgery
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return None
+    fdef = tree.body[0]
+    if not isinstance(fdef, ast.FunctionDef):
+        return None
+    fdef.decorator_list = []  # the caller re-wraps
+
+    transformer = _ControlFlowTransformer()
+    new_tree = transformer.visit(tree)
+    if transformer.counter == 0:
+        return None
+    ast.fix_missing_locations(new_tree)
+
+    try:
+        code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+    except (SyntaxError, ValueError):
+        return None
+    # exec against the LIVE module globals so helpers defined after
+    # decoration (or monkeypatched later) resolve exactly as they would
+    # in the original function; only prefixed helper names are injected
+    glb = fn.__globals__
+    glb["__d2s_convert_ifelse"] = convert_ifelse
+    glb["__d2s_convert_while"] = convert_while
+    glb["__d2s_get"] = _frame_get
+    loc = {}
+    try:
+        exec(code, glb, loc)
+    except Exception:
+        return None
+    converted = loc.get(fdef.name) or glb.get(fdef.name)
+    if converted is None:
+        return None
+    converted.__defaults__ = fn.__defaults__
+    if fn.__kwdefaults__:
+        converted.__kwdefaults__ = dict(fn.__kwdefaults__)
+    return functools.wraps(fn)(converted)

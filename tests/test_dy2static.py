@@ -1,0 +1,307 @@
+"""dy2static AST conversion: python if/while on tensor values compile
+under to_static instead of hitting the trace guard.
+
+Reference: python/paddle/jit/dy2static/ (convert_ifelse /
+convert_while_loop rewrite pattern).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import ast_transform
+
+
+class TestIfConversion:
+    def test_tensor_if_compiles_both_paths(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x + 1.0
+            else:
+                y = x - 1.0
+            return y * 2.0
+
+        pos = paddle.to_tensor(np.ones(3, np.float32))
+        neg = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(f(pos).numpy(), 4.0 * np.ones(3))
+        np.testing.assert_allclose(f(neg).numpy(), -4.0 * np.ones(3))
+
+    def test_python_bool_path_unchanged(self):
+        calls = []
+
+        @to_static
+        def f(x, flag):
+            if flag:  # plain python bool: native branch
+                calls.append("t")
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x, True).numpy(), 2.0 * np.ones(2))
+        np.testing.assert_allclose(f(x, False).numpy(), 3.0 * np.ones(2))
+        assert calls == ["t"]  # the false call never ran the true branch
+
+    def test_elif_chain_and_reassignment(self):
+        @to_static
+        def f(x):
+            s = x.sum()
+            out = x
+            if s > 10.0:
+                out = out * 10.0
+            elif s > 0.0:
+                out = out + 100.0
+            else:
+                out = out - 100.0
+            return out
+
+        big = paddle.to_tensor(np.full(3, 5.0, np.float32))
+        small = paddle.to_tensor(np.full(3, 0.1, np.float32))
+        neg = paddle.to_tensor(np.full(3, -1.0, np.float32))
+        np.testing.assert_allclose(f(big).numpy(), 50.0 * np.ones(3))
+        np.testing.assert_allclose(f(small).numpy(),
+                                   100.1 * np.ones(3), rtol=1e-5)
+        np.testing.assert_allclose(f(neg).numpy(), -101.0 * np.ones(3))
+
+    def test_one_branch_assignment_with_prior_def(self):
+        @to_static
+        def f(x):
+            y = x * 0.0
+            if x.sum() > 0:
+                y = x + 5.0
+            return y
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.ones(2, np.float32))).numpy(), 6.0)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(-np.ones(2, np.float32))).numpy(), 0.0)
+
+    def test_nested_if(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                if x.max() > 2.0:
+                    y = x * 100.0
+                else:
+                    y = x * 10.0
+            else:
+                y = x * 1.0
+            return y
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.full(2, 3.0, np.float32))).numpy(),
+            300.0)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.full(2, 1.0, np.float32))).numpy(), 10.0)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.full(2, -1.0, np.float32))).numpy(),
+            -1.0)
+
+    def test_gradients_flow_through_converted_if(self):
+        def f(x):
+            if x.sum() > 0:
+                y = x * 3.0
+            else:
+                y = x * 7.0
+            return y.sum()
+
+        conv = ast_transform(f)
+        assert conv is not None
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        conv(x).backward()
+        np.testing.assert_allclose(x.grad.numpy(), 3.0 * np.ones(3))
+
+    def test_return_inside_branch_falls_back_to_guard(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0  # early return: not convertible
+            return x * 3.0
+
+        with pytest.raises(TypeError, match="bool"):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+
+class TestWhileConversion:
+    def test_tensor_while_compiles(self):
+        @to_static
+        def f(x):
+            i = paddle.to_tensor(np.int32(0))
+            while i < 4:
+                x = x * 2.0
+                i = i + 1
+            return x
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.ones(2, np.float32))).numpy(), 16.0)
+
+    def test_python_while_unchanged(self):
+        @to_static
+        def f(x, n):
+            i = 0
+            while i < n:  # plain ints: native loop
+                x = x + 1.0
+                i += 1
+            return x
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.zeros(2, np.float32)), 3).numpy(), 3.0)
+
+    def test_while_on_tensor_values(self):
+        # countdown driven by a tensor value that changes in the loop
+        def f(t):
+            total = t * 0.0
+            while t.sum() > 0.5:
+                total = total + t
+                t = t * 0.5
+            return total
+
+        conv = ast_transform(f)
+        assert conv is not None
+        t0 = np.full(2, 4.0, np.float32)
+        # eager reference
+        ref_t, ref_total = t0.copy(), np.zeros(2, np.float32)
+        while ref_t.sum() > 0.5:
+            ref_total += ref_t
+            ref_t *= 0.5
+        out = conv(paddle.to_tensor(t0))
+        np.testing.assert_allclose(out.numpy(), ref_total, rtol=1e-6)
+        # and compiled
+        jit_out = to_static(f)(paddle.to_tensor(t0))
+        np.testing.assert_allclose(jit_out.numpy(), ref_total, rtol=1e-6)
+
+
+class TestFallbacks:
+    def test_function_without_control_flow_untouched(self):
+        def f(x):
+            return x * 2.0
+
+        assert ast_transform(f) is None  # nothing to convert
+
+    def test_closure_functions_fall_back(self):
+        k = 3.0
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * k
+            else:
+                y = -x * k
+            return y
+
+        assert ast_transform(f) is None  # free variable: plain tracing
+
+    def test_layer_forward_converts(self):
+        from paddle_tpu import nn
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.sum() > 0:
+                    out = h * 2.0
+                else:
+                    out = h * 0.5
+                return out
+
+        paddle.seed(0)
+        m = to_static(Gate())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = m(x)
+        assert out.shape == [2, 4]
+        # eager reference from an unconverted twin
+        paddle.seed(0)
+        m2 = Gate()
+        h = m2.fc(x)
+        ref = (h * 2.0 if float(h.sum().numpy()) > 0 else h * 0.5).numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5)
+
+
+class TestEdgeSemantics:
+    def test_one_branch_unbound_poisons_on_use(self):
+        @to_static
+        def f(x, flag):
+            if flag:
+                y = x + 1.0
+            return y  # python parity: error on USE when untaken
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x, True).numpy(), 2.0)
+        # untaken branch: returning the unbound name raises (python
+        # parity: UnboundLocalError fires at the read in `return y`)
+        with pytest.raises(NameError, match="before assignment"):
+            f(x, False)
+
+    def test_compiled_one_branch_unbound_raises_nameerror(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x + 5.0
+            return y
+
+        with pytest.raises(NameError, match="both paths"):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+    def test_late_defined_global_helper_resolves(self):
+        conv = ast_transform(_late_caller)
+        assert conv is not None
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(conv(x, True).numpy(), 42.0)
+
+    def test_walrus_while_left_untouched(self):
+        def f(xs):
+            it = iter(xs)
+            total = 0.0
+            while (v := next(it, None)) is not None:
+                total = total + v
+            return total
+
+        conv = ast_transform(f)
+        fn = conv if conv is not None else f
+        assert fn([1.0, 2.0, 3.0]) == 6.0
+
+    def test_del_in_branch_left_untouched(self):
+        @to_static
+        def f(x, flag):
+            if flag:
+                tmp = 1
+                del tmp
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x, True).numpy(), 2.0)
+
+    def test_import_in_branch(self):
+        @to_static
+        def f(x, flag):
+            if flag:
+                import math as _m
+                y = x * _m.pi
+            else:
+                import math as _m
+                y = x * 0.0
+            return y + _m.e
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(f(x, True).numpy(),
+                                   np.pi + np.e, rtol=1e-6)
+
+
+def _late_helper(x):
+    return x * 42.0
+
+
+def _late_caller(x, flag):
+    if flag:
+        y = _late_helper(x)  # resolved via LIVE globals at call time
+    else:
+        y = x
+    return y
