@@ -12,7 +12,7 @@ import jax.numpy as jnp
 
 from ...core.tensor import Tensor
 from ...ops.dispatch import apply_op
-from ...ops.registry import OPS
+from ...ops.registry import OPS, register_external
 
 __all__ = ["fused_matmul_bias", "fused_linear", "fused_feedforward",
            "fused_multi_head_attention", "fused_dropout_add",
@@ -233,3 +233,15 @@ def ragged_decode_attention(q, k_cache, v_cache, lengths,
 
     return apply_op("ragged_decode_attention", pure,
                     (q, k_cache, v_cache, lengths), {})
+
+
+# coverage-table registration for the dispatched fused ops (names appear
+# in the registry even though their public entry points live here)
+for _name, _fn in [("swiglu", swiglu),
+                   ("fused_rotary_position_embedding",
+                    fused_rotary_position_embedding),
+                   ("fused_ec_moe", fused_ec_moe),
+                   ("fused_bias_dropout_residual_layer_norm",
+                    fused_bias_dropout_residual_layer_norm),
+                   ("ragged_decode_attention", ragged_decode_attention)]:
+    register_external(_name, _fn, tags=("fused",))

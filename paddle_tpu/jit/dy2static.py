@@ -51,9 +51,6 @@ class _Undefined:
 
     __slots__ = ()
 
-    def __repr__(self):
-        return "<dy2static undefined>"
-
     def _explode(self, *a, **k):
         raise NameError(
             "variable assigned only inside an untaken to_static branch "
@@ -63,24 +60,16 @@ class _Undefined:
     __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _explode
     __truediv__ = __rtruediv__ = __eq__ = __lt__ = __gt__ = _explode
     __getitem__ = __neg__ = __abs__ = _explode
+    __repr__ = __str__ = __format__ = _explode  # no silent leak via print
 
 
 _UNDEF = _Undefined()
 
 
-def _branch_checked(fn, values):
-    """Run a branch under trace with an in-trace _UNDEF scan: raising HERE
-    (python level, during tracing) gives a clean NameError instead of
-    lax.cond's opaque invalid-JAX-type error."""
+def _select_outputs(fn, values, keep):
     out = fn(*values)
     seq = out if isinstance(out, (tuple, list)) else (out,)
-    for o in seq:
-        if o is _UNDEF:
-            raise NameError(
-                "a variable assigned in only one branch of a compiled "
-                "(tensor-condition) `if` is undefined on the other path; "
-                "assign it on both paths or before the if")
-    return out
+    return tuple(o for i, o in enumerate(seq) if i in keep)
 
 
 def _frame_get(name):
@@ -98,22 +87,42 @@ def _is_traced_bool(pred):
     return isinstance(data, jax.core.Tracer)
 
 
-def convert_ifelse(pred, true_fn, false_fn, values):
+def convert_ifelse(pred, true_fn, false_fn, both, values):
     """Runtime dispatch for a rewritten ``if``.
 
     Python bool → run ONE branch natively (exact eager semantics, tape
-    autograd included).  Traced Tensor → both branches trace into
-    lax.cond; every output must be defined on both paths.
+    autograd included; a name assigned only in the untaken branch binds
+    the poison sentinel, which raises on first use — UnboundLocalError
+    parity).
+
+    Traced Tensor → both branches trace into lax.cond.  ``both`` marks
+    (by position) names assigned in BOTH branches: those, plus names
+    with a defined seed, are cond outputs; a name with an _UNDEF seed
+    assigned in only one branch cannot cross lax.cond (the other path
+    has no value of matching type) — it binds the poison instead, so
+    dead branch-local temporaries are fine and a genuine read raises.
     """
     if not _is_traced_bool(pred):
-        # the untaken path may leave names bound to the _UNDEF poison —
-        # python parity: error fires on first USE, not on binding
         return true_fn(*values) if bool(pred) else false_fn(*values)
     from ..static import nn as static_nn
 
-    return static_nn.cond(pred,
-                          lambda: _branch_checked(true_fn, values),
-                          lambda: _branch_checked(false_fn, values))
+    keep = [i for i, v in enumerate(values)
+            if i in both or v is not _UNDEF]
+    keep_set = set(keep)
+    outs = static_nn.cond(
+        pred,
+        lambda: _select_outputs(true_fn, values, keep_set),
+        lambda: _select_outputs(false_fn, values, keep_set))
+    outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+    full = []
+    k = 0
+    for i in range(len(values)):
+        if i in keep_set:
+            full.append(outs[k])
+            k += 1
+        else:
+            full.append(_UNDEF)
+    return tuple(full)
 
 
 def convert_while(test_fn, body_fn, names, values):
@@ -156,6 +165,11 @@ class _AssignedNames(ast.NodeVisitor):
         if isinstance(node.ctx, ast.Store):
             self._add(node.id)
 
+    def visit_ListComp(self, node):
+        pass  # comprehension targets live in their own scope
+
+    visit_SetComp = visit_DictComp = visit_GeneratorExp = visit_ListComp
+
     def visit_Import(self, node):
         for a in node.names:
             self._add(a.asname or a.name.split(".")[0])
@@ -193,6 +207,11 @@ class _HasEscape(ast.NodeVisitor):
         self._loop_depth = 0
 
     def visit_Return(self, node):
+        self.found = True
+
+    def visit_Raise(self, node):
+        # both branches trace under lax.cond: a conditional raise would
+        # fire unconditionally at trace time
         self.found = True
 
     def visit_Yield(self, node):
@@ -286,9 +305,13 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if _has_escape(node.body) or _has_escape(node.orelse):
             return node
-        names = sorted(n for n in set(_assigned(node.body)
-                                      + _assigned(node.orelse))
-                       if not n.startswith("__d2s"))
+        body_names = [n for n in _assigned(node.body)
+                      if not n.startswith("__d2s")]
+        orelse_names = [n for n in _assigned(node.orelse)
+                        if not n.startswith("__d2s")]
+        names = sorted(set(body_names) | set(orelse_names))
+        both = [i for i, n in enumerate(names)
+                if n in body_names and n in orelse_names]
 
         true_name = self._fresh("true")
         false_name = self._fresh("false")
@@ -307,6 +330,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             args=[node.test,
                   ast.Name(id=true_name, ctx=ast.Load()),
                   ast.Name(id=false_name, ctx=ast.Load()),
+                  ast.Call(func=ast.Name(id="frozenset", ctx=ast.Load()),
+                           args=[ast.Tuple(
+                               elts=[ast.Constant(value=i) for i in both],
+                               ctx=ast.Load())], keywords=[]),
                   _seed_tuple(names)],
             keywords=[])
         stmt = (ast.Assign(targets=[_bind_target(names)], value=call)
@@ -359,6 +386,17 @@ def ast_transform(fn):
     if not isinstance(fdef, ast.FunctionDef):
         return None
     fdef.decorator_list = []  # the caller re-wraps
+
+    def _mangled(name):
+        return name.startswith("__") and not name.endswith("__")
+
+    for n in ast.walk(fdef):
+        # private-name mangling (self.__x -> _Cls__x) happens at class
+        # compile time; re-exec at module scope loses it — fall back
+        if isinstance(n, ast.Attribute) and _mangled(n.attr):
+            return None
+        if isinstance(n, ast.Name) and _mangled(n.id):
+            return None
 
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)

