@@ -109,8 +109,9 @@ def launch(argv=None):
 
     shutting_down = []  # non-empty once the operator asked us to stop
 
-    def _terminate(*_):
-        shutting_down.append(True)
+    def _teardown():
+        """Kill remaining local ranks without marking operator shutdown —
+        the elastic restart decision must stay based on WHY we tore down."""
         for p in procs:
             if p.poll() is None:
                 p.terminate()
@@ -121,6 +122,10 @@ def launch(argv=None):
                     p.wait(timeout=max(0.1, deadline - time.time()))
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+    def _terminate(*_):
+        shutting_down.append(True)
+        _teardown()
 
     signal.signal(signal.SIGTERM, _terminate)
     rc = 0
@@ -133,11 +138,14 @@ def launch(argv=None):
             while any(p.poll() is None for p in procs):
                 for p in procs:
                     code = p.poll()
-                    if code is not None and code != 0:
+                    if code is not None and code != 0 and rc == 0:
                         # one rank failed: tear down the rest (reference
-                        # controller restart/abort policy)
-                        _terminate()
+                        # controller restart/abort policy) — but do NOT mark
+                        # operator shutdown, or --max_restarts never fires.
+                        # Keep the FIRST failing rank's code; the ranks
+                        # _teardown kills exit -SIGTERM and must not mask it.
                         rc = code
+                        _teardown()
                 time.sleep(0.2)
             for p in procs:
                 rc = rc or (p.returncode or 0)

@@ -71,10 +71,17 @@ def _recv_msg(conn):
 
 
 class _Server:
-    def __init__(self):
+    def __init__(self, bind_ip="127.0.0.1"):
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self.sock.bind(("0.0.0.0", 0))
+        try:
+            self.sock.bind((bind_ip, 0))
+        except OSError as e:
+            raise OSError(
+                f"rpc server could not bind {bind_ip!r} ({e}); if this "
+                "host cannot bind its advertised POD_IP (NAT/VIP), set "
+                "PADDLE_RPC_BIND_IP to a local interface address "
+                "(0.0.0.0 restores the old bind-all behavior)") from e
         self.sock.listen(64)
         self.port = self.sock.getsockname()[1]
         self._stop = threading.Event()
@@ -142,9 +149,15 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None,
             store = TCPStore(host, int(port), is_master=False,
                              world_size=world_size)
 
-    _state.server = _Server()
-    _state.store = store
+    # Trust boundary: the server executes pickled callables, so it must
+    # only be reachable inside the cluster.  Default: loopback for a
+    # single-worker job; the worker's own POD_IP (not 0.0.0.0) otherwise.
+    # PADDLE_RPC_BIND_IP overrides for multi-homed hosts.
     my_ip = os.environ.get("POD_IP", "127.0.0.1")
+    bind_ip = os.environ.get("PADDLE_RPC_BIND_IP") or \
+        ("127.0.0.1" if world_size == 1 else my_ip)
+    _state.server = _Server(bind_ip=bind_ip)
+    _state.store = store
     store.set(f"rpc/worker/{rank}",
               pickle.dumps((name, rank, my_ip, _state.server.port)))
     for r in range(world_size):

@@ -16,6 +16,9 @@ _FLAG_DEFS = {
     # XLA attention wins on TPU (profiled: v5e, head_dim 64).
     "FLAGS_flash_min_seqlen": (1024, int),
     "FLAGS_eager_vjp_cache": (True, lambda v: str(v).lower() not in ("0", "false")),
+    # Pallas block-size autotune (ops/pallas/autotune.py); off by default —
+    # the first sighting of a shape would otherwise pay N compiles.
+    "FLAGS_use_autotune": (False, lambda v: str(v).lower() in ("1", "true")),
     "FLAGS_allocator_strategy": ("auto_growth", str),
     "FLAGS_stop_check_timeout": (900, int),
 }

@@ -25,6 +25,13 @@ import numpy as np
 _DTYPES = [np.float32, np.float64, np.int32, np.int64, np.uint8, np.bool_]
 _CODES = {np.dtype(d): i for i, d in enumerate(_DTYPES)}
 
+# Wire-safety caps: a malformed/hostile request must not be able to make
+# the server allocate unbounded memory before the predictor ever runs.
+# max_bytes is a CUMULATIVE per-request budget across all input tensors.
+_MAX_NDIM = 16
+_MAX_INPUTS = 256
+_MAX_TENSOR_BYTES = 1 << 31  # 2 GiB per request; override per-server below
+
 
 def _send_tensor(conn, arr):
     arr = np.ascontiguousarray(arr)
@@ -48,15 +55,23 @@ def _recv_exact(conn, n):
     return buf
 
 
-def _recv_tensor(conn):
+def _recv_tensor(conn, max_bytes=_MAX_TENSOR_BYTES):
     code, ndim = struct.unpack("<BB", _recv_exact(conn, 2))
     if code >= len(_DTYPES):
         raise ValueError(f"invalid wire dtype code {code}")
+    if ndim > _MAX_NDIM:
+        raise ValueError(f"tensor ndim {ndim} exceeds limit {_MAX_NDIM}")
     dims = struct.unpack(f"<{ndim}Q", _recv_exact(conn, 8 * ndim)) \
         if ndim else ()
     dtype = np.dtype(_DTYPES[code])
-    n_bytes = int(np.prod(dims, dtype=np.int64)) * dtype.itemsize \
-        if ndim else dtype.itemsize
+    n_elems = 1
+    for d in dims:
+        n_elems *= d
+    # python ints can't overflow, so one post-product check suffices —
+    # and it also covers scalars (ndim==0) against an exhausted budget
+    if n_elems * dtype.itemsize > max_bytes:
+        raise ValueError(f"tensor payload exceeds {max_bytes} byte limit")
+    n_bytes = n_elems * dtype.itemsize
     raw = _recv_exact(conn, n_bytes)
     return np.frombuffer(raw, dtype=dtype).reshape(dims).copy()
 
@@ -67,10 +82,17 @@ class PredictorServer:
     >>> cfg = Config(); cfg.set_model_obj(model)
     >>> srv = PredictorServer(create_predictor(cfg))     # port=0: free port
     >>> # C side: pd_infer_connect("127.0.0.1", srv.port) ... pd_infer_run
+
+    Trust boundary: the protocol is unauthenticated (reference C API is an
+    in-process library), so the listener defaults to loopback.  Pass
+    ``host="0.0.0.0"`` explicitly to serve a trusted network; ``max_bytes``
+    caps each request tensor's payload.
     """
 
-    def __init__(self, predictor, host="0.0.0.0", port=0):
+    def __init__(self, predictor, host="127.0.0.1", port=0,
+                 max_bytes=_MAX_TENSOR_BYTES):
         self._predictor = predictor
+        self._max_bytes = max_bytes
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -102,8 +124,16 @@ class PredictorServer:
                     except ConnectionError:
                         return
                     try:
-                        inputs = [_recv_tensor(conn)
-                                  for _ in range(n_in)]
+                        if n_in > _MAX_INPUTS:
+                            raise ValueError(
+                                f"n_inputs {n_in} exceeds limit "
+                                f"{_MAX_INPUTS}")
+                        budget = self._max_bytes
+                        inputs = []
+                        for _ in range(n_in):
+                            t = _recv_tensor(conn, budget)
+                            budget -= t.nbytes
+                            inputs.append(t)
                     except ValueError as e:
                         # protocol violation: report it, then drop the
                         # (desynced) connection

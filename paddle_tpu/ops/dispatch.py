@@ -51,6 +51,23 @@ _DISPATCH_CACHE_MAX = 2048
 _DISPATCH_CACHE_LOCK = _threading.Lock()
 _dispatch_cache_enabled = True
 
+# Calls proven untraceable (value-dependent output shapes: nonzero, unique,
+# masked_select...).  Banned by SHAPE-GENERALIZED key — (op, fn, structure,
+# static args, grad mode) WITHOUT the input avals — otherwise every new
+# shape of such a call pays one failed jit trace + exception; but a static
+# -arg combo or grad mode that traces fine keeps its cache.
+_UNJITTABLE_OPS = set()
+
+# Only these prove the OP ITSELF cannot trace; anything else (device OOM,
+# transient XLA errors) stays a per-key ban so one bad call can't disable
+# caching for an op name process-wide.
+_TRACE_ERRORS = tuple(
+    e for e in (getattr(jax.errors, n, None) for n in (
+        "ConcretizationTypeError", "TracerArrayConversionError",
+        "TracerBoolConversionError", "TracerIntegerConversionError",
+        "NonConcreteBooleanIndexError"))
+    if e is not None)
+
 
 class _CacheEntry:
     __slots__ = ("jittable", "compiled", "banned")
@@ -70,6 +87,7 @@ def enable_dispatch_cache(flag=True):
 def dispatch_cache_clear():
     with _DISPATCH_CACHE_LOCK:
         _DISPATCH_CACHE.clear()
+        _UNJITTABLE_OPS.clear()
     # the shared pullback runner holds one backward executable per distinct
     # forward trace; release those too
     _run_vjp.clear_cache()
@@ -83,7 +101,8 @@ def dispatch_cache_info():
 
 
 def _dispatch_key(name, fn, treedef, leaves, t_pos, datas, requires_grad):
-    """Build a hashable cache key, or None if any static arg is unhashable."""
+    """Build (cache key, shape-generalized ban key), or (None, None) if any
+    static arg is unhashable."""
     t_set = set(t_pos)
     try:
         statics = tuple((i, type(l), l) for i, l in enumerate(leaves)
@@ -93,8 +112,8 @@ def _dispatch_key(name, fn, treedef, leaves, t_pos, datas, requires_grad):
         key = (name, fn, treedef, statics, avals, requires_grad)
         hash(key)
     except TypeError:
-        return None
-    return key
+        return None, None
+    return key, (name, fn, treedef, statics, requires_grad)
 
 
 _debug_hook = None
@@ -154,11 +173,12 @@ def apply_op(name, fn, args, kwargs):
     t0 = time.perf_counter() if timing else 0.0
 
     entry = None
+    ban_key = None
     if (_dispatch_cache_enabled
             and not any(isinstance(d, jax.core.Tracer) for d in datas)):
-        key = _dispatch_key(name, fn, treedef, leaves, t_pos, datas,
-                            requires_grad)
-        if key is not None:
+        key, ban_key = _dispatch_key(name, fn, treedef, leaves, t_pos, datas,
+                                     requires_grad)
+        if key is not None and ban_key not in _UNJITTABLE_OPS:
             with _DISPATCH_CACHE_LOCK:
                 entry = _DISPATCH_CACHE.get(key)
                 if entry is None:
@@ -170,43 +190,59 @@ def apply_op(name, fn, args, kwargs):
                     _DISPATCH_CACHE.move_to_end(key)
 
     vjp_fn = None
-    if (entry is not None and entry.compiled is None and entry.jittable
-            and not entry.banned):
-        # second sighting: compile once, reuse forever for this key
-        entry.compiled = (jax.jit(lambda *d: jax.vjp(pure, *d))
-                          if requires_grad else jax.jit(pure))
-    if entry is not None and entry.compiled is not None:
+    compiled = None
+    if entry is not None:
+        # compile/ban transitions are atomic under the cache lock so two
+        # threads on the same key can't duplicate jax.jit wrappers or read
+        # a half-cleared entry; the (lazy) jit call itself runs unlocked.
+        with _DISPATCH_CACHE_LOCK:
+            if (entry.compiled is None and entry.jittable
+                    and not entry.banned):
+                # second sighting: compile once, reuse forever for this key
+                entry.compiled = (jax.jit(lambda *d: jax.vjp(pure, *d))
+                                  if requires_grad else jax.jit(pure))
+            compiled = entry.compiled
+    if compiled is not None:
         try:
             if requires_grad:
-                out, raw_vjp = entry.compiled(*datas)
+                out, raw_vjp = compiled(*datas)
                 vjp_fn = lambda cots: _run_vjp(raw_vjp, cots)
             else:
-                out = entry.compiled(*datas)
-        except Exception:
+                out = compiled(*datas)
+        except Exception as trace_err:
             # ops with value-dependent output shapes (masked_select,
             # nonzero, unique, ...) run eagerly but cannot trace — jax
             # raises at the jit's first call.  Pin this key to the
             # uncached path forever and retry eagerly (a genuine user
             # error will re-raise below with the eager traceback).
-            entry.banned = True
-            entry.jittable = False
-            entry.compiled = None
+            with _DISPATCH_CACHE_LOCK:
+                entry.banned = True
+                entry.jittable = False
+                entry.compiled = None
             vjp_fn = None
             if requires_grad:
                 out, vjp_fn = jax.vjp(pure, *datas)
             else:
                 out = pure(*datas)
+            # eager retry succeeded AND the failure was a jax trace error:
+            # this call shape-generalizes to untraceable, so new shapes
+            # skip the failed compile (other static-arg/grad combos don't)
+            if isinstance(trace_err, _TRACE_ERRORS) and ban_key is not None:
+                _UNJITTABLE_OPS.add(ban_key)
     elif requires_grad:
         out, vjp_fn = jax.vjp(pure, *datas)
     else:
         out = pure(*datas)
 
-    if entry is not None and entry.compiled is None and not entry.banned:
+    if entry is not None and compiled is None:
         # first sighting: mark jittable only if every output leaf is a jax
         # array (ops returning aux python values stay on the uncached path)
-        entry.jittable = all(
+        jittable = all(
             isinstance(o, jax.Array)
             for o in jax.tree_util.tree_leaves(out))
+        with _DISPATCH_CACHE_LOCK:
+            if not entry.banned and entry.compiled is None:
+                entry.jittable = jittable
 
     if timing:
         record_host_event(name, t0, time.perf_counter() - t0)

@@ -71,7 +71,11 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_s):
     m0 = jnp.full((g, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((g,), jnp.float32)
     o, m, l = jax.lax.fori_loop(0, num_blocks, body, (o0, m0, l0))
-    o_ref[0, :, 0, :] = (o / l[:, None]).astype(o_ref.dtype)
+    # lengths[b] == 0: every position is masked, the running max collapses
+    # to the mask value so p == 1 everywhere and o/l silently averages the
+    # whole (uninitialized) cache — emit zeros for empty sequences instead
+    safe = jnp.where(length > 0, o / jnp.maximum(l[:, None], 1e-30), 0.0)
+    o_ref[0, :, 0, :] = safe.astype(o_ref.dtype)
 
 
 def decode_attention_pallas(q, k_cache, v_cache, lengths, block_s=None,
@@ -118,4 +122,7 @@ def decode_attention_xla(q, k_cache, v_cache, lengths):
     p = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bngs,bsnd->bngd", p,
                      v_cache.astype(jnp.float32))
+    # empty sequences: the all-masked softmax degenerates to a uniform
+    # average over the cache — zero those rows (matches the Pallas kernel)
+    out = jnp.where(lengths[:, None, None, None] > 0, out, 0.0)
     return out.reshape(b, nq, d).astype(q.dtype)

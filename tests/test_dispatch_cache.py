@@ -115,6 +115,38 @@ class TestDispatchCache:
                 mask.reshape(3, 4)))
             assert out.shape == [n_true], out.shape
 
+    def test_untraceable_op_banned_across_shapes(self):
+        """Advisor round-2: the trace-failure ban used to be per shape-key,
+        so every NEW shape of nonzero/unique paid a failed jit trace.  Now
+        the shape-generalized call key lands in _UNJITTABLE_OPS after the
+        first failure and later shapes skip the cache entirely."""
+        from paddle_tpu.ops import dispatch
+
+        dispatch.dispatch_cache_clear()
+        x = paddle.to_tensor(np.array([1.0, 0.0, 2.0], np.float32))
+        for _ in range(2):  # second sighting triggers the compile attempt
+            _ = paddle.masked_select(x, paddle.to_tensor(
+                np.array([True, False, True])))
+        assert any("masked_select" in k[0]
+                   for k in dispatch._UNJITTABLE_OPS)
+        # a brand-new shape must not create a cache entry for this op
+        before = dispatch.dispatch_cache_info()["entries"]
+        y = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        out = paddle.masked_select(y, paddle.to_tensor(
+            np.array([True] * 3 + [False] * 5)))
+        assert out.shape == [3]
+        assert dispatch.dispatch_cache_info()["entries"] == before
+        dispatch.dispatch_cache_clear()
+
+    def test_autotune_flag_registered(self):
+        """Advisor round-2: FLAGS_use_autotune must be a registered flag so
+        the FLAGS_* env-var default path and get_flags work."""
+        from paddle_tpu.framework.flags import _FLAG_DEFS
+
+        assert "FLAGS_use_autotune" in _FLAG_DEFS
+        val = paddle.get_flags("FLAGS_use_autotune")["FLAGS_use_autotune"]
+        assert val in (True, False)
+
     def test_steady_state_speedup(self):
         """Cached grad-path dispatch must beat fresh jax.vjp tracing.
 

@@ -106,6 +106,41 @@ class TestInferCApi:
         finally:
             lib.pd_infer_close(h)
 
+    def test_oversized_request_rejected(self, served_model):
+        """Advisor round-2 regression: a hostile dims header must not make
+        the server allocate unbounded memory; it errors and drops the
+        (desynced) connection instead."""
+        import socket
+        import struct
+
+        from paddle_tpu.inference import serving
+
+        _, srv = served_model
+        srv._max_bytes = 1 << 20  # tighten for the test
+        with socket.create_connection(("127.0.0.1", srv.port)) as conn:
+            conn.sendall(struct.pack("<I", 1))
+            # f32 tensor claiming 2**40 elements — never send the payload
+            conn.sendall(struct.pack("<BB", 0, 2))
+            conn.sendall(struct.pack("<QQ", 1 << 20, 1 << 20))
+            status, n = struct.unpack("<BI",
+                                      serving._recv_exact(conn, 5))
+            assert status == 1
+            msg = serving._recv_exact(conn, n).decode()
+            assert "byte limit" in msg
+
+    def test_default_bind_is_loopback(self):
+        from paddle_tpu.inference.serving import PredictorServer
+
+        class _FakePred:
+            def run(self, inputs):
+                return inputs
+
+        srv = PredictorServer(_FakePred())
+        try:
+            assert srv._sock.getsockname()[0] == "127.0.0.1"
+        finally:
+            srv.stop()
+
     def test_python_side_protocol(self, served_model):
         """The same server also serves pure-python clients."""
         import socket

@@ -161,6 +161,25 @@ class TestDecodeAttention:
         np.testing.assert_allclose(
             np.asarray(out)[0, 0], np.asarray(v)[0, 0, 0], atol=2e-5)
 
+    def test_empty_sequence_emits_zeros(self):
+        """Advisor round-2 regression: lengths[b]==0 used to degenerate the
+        online softmax into a uniform average over the uninitialized cache."""
+        from paddle_tpu.ops.pallas.decode_attention_kernel import (
+            decode_attention_pallas,
+            decode_attention_xla,
+        )
+        import jax.numpy as jnp
+
+        q, k, v, _ = self._mk(seed=3)
+        lens = jnp.asarray(np.array([0, 17, 0], np.int32))
+        out = decode_attention_pallas(q, k, v, lens, interpret=True)
+        ref = decode_attention_xla(q, k, v, lens)
+        np.testing.assert_allclose(np.asarray(out)[0], 0.0)
+        np.testing.assert_allclose(np.asarray(out)[2], 0.0)
+        np.testing.assert_allclose(np.asarray(ref)[0], 0.0)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
     def test_api_entry_matches_and_jits(self):
         import paddle_tpu as paddle
         from paddle_tpu.incubate.nn import functional as IF

@@ -104,6 +104,33 @@ class TestElasticRelaunch:
         with open(os.path.join(log_dir, "workerlog.0.restart1")) as f:
             assert "second attempt: ok (restart 1" in f.read()
 
+    def test_relaunch_fires_with_multiple_local_ranks(self, tmp_path):
+        """Advisor round-2 regression: with nproc_per_node > 1 the failure
+        teardown path used to set the operator-shutdown flag, so
+        --max_restarts never fired."""
+        marker = tmp_path / "attempt"
+        script = tmp_path / "flaky2.py"
+        script.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            marker = {str(marker)!r} + os.environ["PADDLE_LOCAL_RANK"]
+            n = int(open(marker).read()) if os.path.exists(marker) else 0
+            open(marker, "w").write(str(n + 1))
+            if n == 0 and os.environ["PADDLE_LOCAL_RANK"] == "1":
+                sys.exit(4)          # only rank 1 fails, only first attempt
+            time.sleep(1.0)          # rank 0 survives until torn down
+            print("rank", os.environ["PADDLE_TRAINER_ID"], "ok")
+        """))
+        log_dir = str(tmp_path / "logs")
+        rc = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node", "2", "--max_restarts", "2",
+             "--log_dir", log_dir, str(script)],
+            cwd=REPO, capture_output=True, timeout=120, env=_cpu_env())
+        assert rc.returncode == 0, (rc.stderr.decode(), rc.stdout.decode())
+        assert "elastic restart 1/2" in rc.stderr.decode()
+        with open(os.path.join(log_dir, "workerlog.0.restart1")) as f:
+            assert "rank 0 ok" in f.read()
+
     def test_no_restart_without_flag(self, tmp_path):
         script = tmp_path / "fail.py"
         script.write_text("import sys; sys.exit(5)\n")
