@@ -2,49 +2,131 @@
 
 Reference: auto_parallel/static/cost/ (op/comm cost model),
 static/tuner/ (rule-based + profile-based optimization tuner),
-static/mapper.py (logical rank -> physical device mapping).
+static/mapper.py (logical rank -> physical device mapping),
+static/cluster.py (machine/device/link capability model from json).
 
 TPU redesign: the search space is mesh factorizations (dp, mp, pp) of the
-device count plus recompute on/off.  Candidate cost = analytic memory
+device count plus recompute on/off, sequence-parallel mode, microbatch
+count and interleaved virtual-pp depth.  Candidate cost = analytic memory
 model (params + activations vs HBM) and per-step time model (compute
-FLOPs / chip + collective bytes over ICI), with an optional measured
-refinement (profile-based tuner parity) that jit-compiles the best K
-candidates on a virtual mesh and times one step.
+FLOPs / chip + collective bytes over ICI), with a measured refinement
+(profile-based tuner parity) that jit-compiles the best K candidates on
+the live (or virtual) mesh and times one step.  Chip capabilities come
+from the attached device kind (``ClusterSpec.from_devices``) instead of
+the reference's hand-written cluster json, with a measured-calibration
+fallback for unknown parts.
 """
 
 import math
+import time
 
 import numpy as np
 
 __all__ = ["ClusterSpec", "CostEstimator", "ParallelTuner", "Mapper"]
 
 
+# Public per-chip capability numbers by device kind (bf16 peak FLOPs, HBM
+# bytes, ICI bandwidth per direction).  Sources: cloud TPU public specs.
+_DEVICE_KINDS = {
+    "tpu v4":  dict(flops_bf16=275e12, hbm_bytes=32e9, ici_bandwidth=1.2e11),
+    "tpu v5e": dict(flops_bf16=197e12, hbm_bytes=16e9, ici_bandwidth=4.5e10),
+    "tpu v5p": dict(flops_bf16=459e12, hbm_bytes=95e9, ici_bandwidth=9.8e10),
+    "tpu v5":  dict(flops_bf16=459e12, hbm_bytes=95e9, ici_bandwidth=9.8e10),
+    "tpu v6e": dict(flops_bf16=918e12, hbm_bytes=32e9, ici_bandwidth=9.0e10),
+    "tpu v6":  dict(flops_bf16=918e12, hbm_bytes=32e9, ici_bandwidth=9.0e10),
+}
+
+
 class ClusterSpec:
     """Per-chip capability numbers used by the analytic model.
 
-    Defaults are TPU v5p-ish; override for other parts.  (Reference
-    cluster.py models machines/devices/links from a json.)
+    ``ClusterSpec()`` auto-detects from ``jax.devices()[0].device_kind``
+    (+ ``memory_stats()`` for the real HBM budget when the runtime exposes
+    it); unknown kinds (CPU hosts, future parts) fall back to a measured
+    matmul calibration so the tuner never ranks with fictional constants.
+    Explicit keyword overrides always win.
     """
 
-    def __init__(self, num_devices=None, hbm_bytes=95e9,
-                 flops_bf16=459e12, ici_bandwidth=9.8e10,
-                 dcn_bandwidth=2.5e9):
+    def __init__(self, num_devices=None, hbm_bytes=None, flops_bf16=None,
+                 ici_bandwidth=None, dcn_bandwidth=2.5e9, calibrate=True):
         import jax
 
-        self.num_devices = num_devices or len(jax.devices())
-        self.hbm_bytes = hbm_bytes
-        self.flops_bf16 = flops_bf16
-        self.ici_bandwidth = ici_bandwidth
+        devices = jax.devices()
+        self.num_devices = num_devices or len(devices)
+        self.device_kind = getattr(devices[0], "device_kind", "cpu")
+        base = _DEVICE_KINDS.get(self.device_kind.lower())
+        if base is None:
+            base = dict(flops_bf16=None, hbm_bytes=None, ici_bandwidth=2e10)
+        self.flops_bf16 = flops_bf16 or base["flops_bf16"]
+        self.hbm_bytes = hbm_bytes or base["hbm_bytes"]
+        self.ici_bandwidth = ici_bandwidth or base["ici_bandwidth"]
         self.dcn_bandwidth = dcn_bandwidth
+
+        # real HBM budget when the runtime exposes it (PjRt memory_stats)
+        if hbm_bytes is None:
+            try:
+                stats = devices[0].memory_stats()
+                limit = stats.get("bytes_limit")
+                if limit:
+                    self.hbm_bytes = float(limit)
+            except Exception:
+                pass
+        if self.flops_bf16 is None and calibrate:
+            self.flops_bf16 = self._measure_flops()
+        if self.flops_bf16 is None:
+            self.flops_bf16 = 1e12  # last-resort nominal
+        if self.hbm_bytes is None:
+            self.hbm_bytes = 8e9
+
+    @classmethod
+    def from_devices(cls, **overrides):
+        return cls(**overrides)
+
+    _measured_flops_cache = {}
+
+    @classmethod
+    def _measure_flops(cls, n=1024, iters=5):
+        """Time a jitted bf16 matmul on the attached device — honest
+        capability for device kinds not in the table (e.g. CPU meshes).
+        Memoized per device kind: calibration is per-process, not per
+        ClusterSpec instance."""
+        import jax
+
+        kind = getattr(jax.devices()[0], "device_kind", "cpu")
+        if kind in cls._measured_flops_cache:
+            return cls._measured_flops_cache[kind]
+        got = cls._measure_flops_uncached(n, iters)
+        cls._measured_flops_cache[kind] = got
+        return got
+
+    @staticmethod
+    def _measure_flops_uncached(n=1024, iters=5):
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            a = jnp.ones((n, n), jnp.bfloat16)
+            f = jax.jit(lambda x: x @ x)
+            jax.block_until_ready(f(a))
+            best = float("inf")
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(f(a))
+                best = min(best, time.perf_counter() - t0)
+            return 2.0 * n ** 3 / best
+        except Exception:
+            return None
 
 
 class CostEstimator:
-    """Analytic memory + step-time estimate for one (dp, mp, pp) config.
+    """Analytic memory + step-time estimate for one parallel config.
 
     Model taxonomy follows the reference comp/comm CostEstimator
     (static/cost/estimate_cost.py): per-op compute from FLOPs, comm from
     collective bytes x bandwidth, memory from param/grad/optimizer-state
-    + activation partitioning.
+    + activation partitioning.  Extends the reference's (dp, mp, pp)
+    space with sequence-parallel, microbatch count, and interleaved
+    virtual-pp (Megatron grouped schedule — parallel/pipeline.py).
     """
 
     def __init__(self, cluster, n_params, flops_per_token, tokens_per_batch,
@@ -58,47 +140,68 @@ class CostEstimator:
         self.layers = num_layers
         self.bytes_per_param = bytes_per_param
 
-    def memory_bytes(self, dp, mp, pp, sharding=1, recompute=False):
+    def memory_bytes(self, dp, mp, pp, sharding=1, recompute=False,
+                     sp=False, n_micro=1, virtual_pp=1):
         shard = max(1, mp) * max(1, pp) * max(1, sharding)
         param_mem = self.n_params * self.bytes_per_param / shard
-        act_per_layer = 2.0 * self.tokens_per_batch * self.hidden / dp \
-            * (1.0 / max(1, mp))
+        # per-microbatch live activations; ~2/3 of layer activations split
+        # over mp always (matmul partials), the LN/residual third only
+        # under sequence parallel
+        tok = self.tokens_per_batch / dp / max(1, n_micro)
+        act_per_layer = 2.0 * tok * self.hidden * (
+            (2.0 / 3.0) / max(1, mp)
+            + (1.0 / 3.0) * (1.0 / max(1, mp) if sp else 1.0))
         n_live = self.layers if not recompute else math.sqrt(self.layers)
-        act_mem = 14.0 * act_per_layer * n_live / max(1, pp)
+        # pipeline keeps ~pp in-flight microbatches of stage activations
+        in_flight = min(max(1, n_micro * virtual_pp), max(1, pp))
+        act_mem = 14.0 * act_per_layer * n_live / max(1, pp) * in_flight
         return param_mem + act_mem
 
-    def step_time(self, dp, mp, pp, recompute=False):
+    def step_time(self, dp, mp, pp, recompute=False, sp=False, n_micro=None,
+                  virtual_pp=1):
         c = self.cluster
+        if n_micro is None:
+            n_micro = 4 * pp if pp > 1 else 1
         compute = self.flops_per_token * self.tokens_per_batch \
             / (dp * mp * pp) / c.flops_bf16
         if recompute:
             compute *= 4.0 / 3.0
-        # mp: 4 allreduces of activations per layer over ICI
         act_bytes = 2.0 * self.tokens_per_batch / dp * self.hidden
+        # mp: per layer, 2 allreduce of activations fwd + 2 bwd; under SP
+        # they become allgather+reduce-scatter pairs at half the volume
         comm_mp = (0.0 if mp == 1
                    else 4 * self.layers * act_bytes * (mp - 1) / mp
-                   / c.ici_bandwidth)
+                   / c.ici_bandwidth * (0.5 if sp else 1.0))
         # dp: gradient allreduce (2x params bf16), overlapped ~50%
         comm_dp = (0.0 if dp == 1
                    else 2.0 * self.n_params * 2 * (dp - 1) / dp
                    / c.ici_bandwidth * 0.5)
-        # pp: fwd+bwd activation p2p at each stage boundary, plus bubble
-        # fraction (pp-1)/(pp-1+m) with m microbatches ~ 4*pp
+        # pp: fwd+bwd activation p2p per stage boundary per microbatch;
+        # interleaving multiplies boundary crossings by virtual_pp
         comm_pp = (0.0 if pp == 1
-                   else 2.0 * (pp - 1) * act_bytes / c.ici_bandwidth)
-        bubble = 0.0 if pp == 1 else (pp - 1) / (pp - 1 + 4.0 * pp)
+                   else 2.0 * (pp - 1) * act_bytes * max(1, virtual_pp)
+                   / c.ici_bandwidth)
+        # interleaved 1F1B bubble: (pp-1) / (pp-1 + m*v)
+        bubble = 0.0 if pp == 1 else \
+            (pp - 1) / (pp - 1 + float(n_micro) * max(1, virtual_pp))
         return (compute + comm_mp + comm_dp + comm_pp) / (1.0 - bubble)
 
 
 class ParallelTuner:
     """Rule-based tuner (reference static/tuner/optimization_tuner.py):
-    enumerate mesh factorizations, drop configs that exceed HBM, rank by
-    the analytic step time; optionally refine the top-K by measuring."""
+    enumerate mesh factorizations x {recompute, sp, n_micro, virtual_pp},
+    drop configs that exceed HBM, rank by the analytic step time; optional
+    measured refinement (``refine``) re-ranks the analytic top-K by timing
+    a real jitted train step per candidate — the reference's
+    profile-based OptimizationTuner loop."""
 
-    def __init__(self, estimator, mp_limit=8, pp_limit=8):
+    def __init__(self, estimator, mp_limit=8, pp_limit=8,
+                 micro_options=(1, 2, 4, 8, 16, 32), vpp_options=(1, 2)):
         self.est = estimator
         self.mp_limit = mp_limit
         self.pp_limit = pp_limit
+        self.micro_options = micro_options
+        self.vpp_options = vpp_options
 
     def candidates(self):
         n = self.est.cluster.num_devices
@@ -108,20 +211,35 @@ class ParallelTuner:
             for pp in [d for d in range(1, self.pp_limit + 1)
                        if rest % d == 0]:
                 dp = rest // pp
+                micro = [m for m in self.micro_options
+                         if self.est.tokens_per_batch % (dp * m) == 0] \
+                    if pp > 1 else [1]
+                vpps = [v for v in self.vpp_options
+                        if self.est.layers % (pp * v) == 0] if pp > 1 \
+                    else [1]
+                sps = (False, True) if mp > 1 else (False,)
                 for rc in (False, True):
-                    out.append({"dp": dp, "mp": mp, "pp": pp,
-                                "recompute": rc})
+                    for sp in sps:
+                        for m in micro or [1]:
+                            for v in vpps or [1]:
+                                out.append({"dp": dp, "mp": mp, "pp": pp,
+                                            "recompute": rc, "sp": sp,
+                                            "n_micro": m, "virtual_pp": v})
         return out
 
     def tune(self, top_k=1):
         scored = []
         for cand in self.candidates():
-            mem = self.est.memory_bytes(cand["dp"], cand["mp"], cand["pp"],
-                                        recompute=cand["recompute"])
+            mem = self.est.memory_bytes(
+                cand["dp"], cand["mp"], cand["pp"],
+                recompute=cand["recompute"], sp=cand["sp"],
+                n_micro=cand["n_micro"], virtual_pp=cand["virtual_pp"])
             if mem > self.est.cluster.hbm_bytes:
                 continue
-            t = self.est.step_time(cand["dp"], cand["mp"], cand["pp"],
-                                   recompute=cand["recompute"])
+            t = self.est.step_time(
+                cand["dp"], cand["mp"], cand["pp"],
+                recompute=cand["recompute"], sp=cand["sp"],
+                n_micro=cand["n_micro"], virtual_pp=cand["virtual_pp"])
             scored.append((t, mem, cand))
         if not scored:
             raise RuntimeError(
@@ -131,6 +249,48 @@ class ParallelTuner:
         best = [dict(c, est_step_time=t, est_memory=m)
                 for t, m, c in scored[:top_k]]
         return best[0] if top_k == 1 else best
+
+    def refine(self, model_factory, optimizer_factory, batch_factory,
+               top_k=3, steps=2):
+        """Measured refinement: build + time a real SpmdTrainStep for the
+        analytic top-K, return candidates with ``measured_step_time``,
+        re-ranked by it (reference profile-based tuner parity)."""
+        import jax
+
+        from ...parallel import SpmdTrainStep
+        from ..fleet.topology import build_mesh
+
+        cands = self.tune(top_k=top_k)
+        if isinstance(cands, dict):  # tune(top_k=1) returns the bare dict
+            cands = [cands]
+        results = []
+        for cand in cands:
+            try:
+                mesh = build_mesh(dp=cand["dp"], pp=cand["pp"],
+                                  mp=cand["mp"],
+                                  devices=jax.devices()[
+                                      :self.est.cluster.num_devices])
+                model = model_factory()
+                opt = optimizer_factory(model)
+                tr = SpmdTrainStep(
+                    model, opt, mesh, n_microbatches=cand["n_micro"],
+                    sequence_parallel=cand["sp"], remat=cand["recompute"],
+                    virtual_pp=cand["virtual_pp"])
+                ids, labels = batch_factory(cand)
+                tr.step(ids, labels)  # compile
+                best = float("inf")
+                for _ in range(steps):
+                    t0 = time.perf_counter()
+                    loss = tr.step(ids, labels)
+                    jax.block_until_ready(
+                        loss._data if hasattr(loss, "_data") else loss)
+                    best = min(best, time.perf_counter() - t0)
+                results.append(dict(cand, measured_step_time=best))
+            except Exception as e:  # candidate failed to build: record why
+                results.append(dict(cand, measured_step_time=float("inf"),
+                                    error=str(e)[:200]))
+        results.sort(key=lambda c: c["measured_step_time"])
+        return results
 
 
 class Mapper:

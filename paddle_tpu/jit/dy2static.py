@@ -29,10 +29,28 @@ site (``__d2s_get`` reads the caller's frame; missing names seed the
 ``_UNDEF`` sentinel so one-branch definitions still work on the python
 path and raise a clear error if a compiled path leaves them unset).
 
+Supported beyond plain if/while (reference loop_transformer.py,
+return_transformer.py, break_continue_transformer.py semantics):
+
+- ``return`` inside converted ``if`` blocks: early returns are
+  canonicalized into if/else tail form (statements after a returning
+  ``if`` move into its else-continuation), then both-return ifs lower
+  to a value-returning ``lax.cond``.  A ``return`` whose branch only
+  *sometimes* returns, or inside a loop body, is left for the trace
+  guard.
+- ``break``/``continue`` in ``while``/``for``: eliminated into flag
+  variables + guard-ifs (the reference's break_continue_transformer
+  rewrite); the loop test conjoins ``not brk``, so the flag rides the
+  compiled ``lax.while_loop`` carry.
+- ``for x in tensor``: lowered to an index-carried ``while_loop`` over
+  the leading axis (python iterables keep the native loop).  Only
+  simple ``for NAME in ...`` targets convert; the loop variable's
+  post-loop value is carried (python scoping parity).
+
 Out of scope (left untransformed; the trace guard reports them if a
-tensor condition reaches one): ``return``/``break``/``continue``/
-``yield`` inside the converted block, ``while ... else``, closures with
-free variables.  Conversion failure of any kind falls back to the
+tensor condition reaches one): ``yield``, ``while ... else`` /
+``for ... else``, tuple for-targets, ``return`` under a loop, closures
+with free variables.  Conversion failure of any kind falls back to the
 original function.
 """
 
@@ -42,7 +60,8 @@ import inspect
 import sys
 import textwrap
 
-__all__ = ["convert_ifelse", "convert_while", "ast_transform"]
+__all__ = ["convert_ifelse", "convert_while", "convert_for",
+           "convert_ifelse_ret", "ast_transform"]
 
 
 class _Undefined:
@@ -147,6 +166,340 @@ def convert_while(test_fn, body_fn, names, values):
     return tuple(static_nn.while_loop(
         lambda *vs: test_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
         list(values)))
+
+
+def convert_ifelse_ret(pred, true_fn, false_fn, values):
+    """Value-returning ``if``: both branches END in return (after
+    canonicalization).  Python bool → one branch runs; traced → both
+    trace into lax.cond, whose branches must return matching
+    shapes/dtypes (lax raises a structure error otherwise — same
+    restriction the reference places on static return_transformer
+    outputs).  ``values`` seed the names assigned within the branches
+    (reads of outer locals resolve by closure)."""
+    if not _is_traced_bool(pred):
+        return true_fn(*values) if bool(pred) else false_fn(*values)
+    from ..static import nn as static_nn
+
+    return static_nn.cond(pred, lambda: true_fn(*values),
+                          lambda: false_fn(*values))
+
+
+def _is_tensorish(v):
+    import jax
+
+    from ..core.tensor import Tensor
+
+    return isinstance(v, (Tensor, jax.Array)) or \
+        isinstance(v, jax.core.Tracer)
+
+
+def d2s_not(v):
+    """``not`` that stays traceable: logical_not for tensors."""
+    if not _is_tensorish(v):
+        return not v
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    data = v._data if isinstance(v, Tensor) else v
+    out = jnp.logical_not(data)
+    return Tensor(out) if isinstance(v, Tensor) else out
+
+
+def d2s_or(a, b):
+    """Eager-argument logical or (flag combination — both args cheap)."""
+    if not _is_tensorish(a) and not _is_tensorish(b):
+        return a or b
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    da = a._data if isinstance(a, Tensor) else a
+    db = b._data if isinstance(b, Tensor) else b
+    return Tensor(jnp.logical_or(da, db))
+
+
+def d2s_and_lazy(a, b_thunk):
+    """``a and b`` with python short-circuit preserved on the python
+    path; tensor path evaluates both and combines (pure, so safe)."""
+    if not _is_tensorish(a):
+        return b_thunk() if bool(a) else False
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    b = b_thunk()
+    da = a._data if isinstance(a, Tensor) else a
+    db = b._data if isinstance(b, Tensor) else b
+    return Tensor(jnp.logical_and(da, db))
+
+
+def convert_for(it, body_fn, names, values, brk_name=None):
+    """Runtime dispatch for a rewritten ``for NAME in it``.
+
+    ``body_fn(x, *values) -> (x, *values)`` (the loop variable is carried
+    so its post-loop value matches python scoping).  Python iterables run
+    the native loop (honoring a break flag with a REAL break);
+    tensor/array iterables lower to an index-carried while_loop over the
+    leading axis — ragged early exit rides the ``brk`` flag in the test.
+    Returns ``(*values, x_last)``; ``x_last`` is ``_UNDEF`` for an empty
+    python iterable (python's unbound-after-empty-loop parity).
+    """
+    brk_idx = names.index(brk_name) if brk_name else None
+    if not _is_tensorish(it):
+        x_last = _UNDEF
+        for x in it:
+            out = body_fn(x, *values)
+            x_last, values = out[0], tuple(out[1:])
+            if brk_idx is not None and bool(values[brk_idx]):
+                break
+        return (*values, x_last)
+
+    from ..core.tensor import Tensor
+    from ..static import nn as static_nn
+
+    for name, v in zip(names, values):
+        if v is _UNDEF:
+            raise NameError(
+                f"loop variable {name!r} is used in a compiled (tensor-"
+                "iterable) for before assignment; initialize it before "
+                "the loop")
+    tens = it if isinstance(it, Tensor) else Tensor(it)
+    n = int(tens.shape[0])  # static leading axis (XLA requirement)
+    if n == 0:
+        return (*values, _UNDEF)
+
+    import jax.numpy as jnp
+
+    def test(i, x, *vals):
+        ok = Tensor(jnp.asarray(True)) if brk_idx is None \
+            else d2s_not(vals[brk_idx])
+        return d2s_and_lazy(i < n, lambda: ok)
+
+    def body(i, x, *vals):
+        out = body_fn(tens[i], *vals)
+        return (i + 1, out[0], *out[1:])
+
+    i0 = Tensor(jnp.asarray(0, jnp.int32))
+    out = static_nn.while_loop(test, body, [i0, tens[0], *values])
+    return (*out[2:], out[1])
+
+
+# ----------------------------------------------------- return canonical ----
+
+class _Unsupported(Exception):
+    """A return pattern the canonicalizer can't restructure — the caller
+    skips return handling and leaves those ifs for the trace guard."""
+
+
+class _ReturnFinder(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = \
+        visit_FunctionDef
+
+
+def _contains_return(stmts):
+    v = _ReturnFinder()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _always_returns(stmts):
+    """True when every path through ``stmts`` ends in a return."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _always_returns(last.body) and _always_returns(last.orelse)
+    return False
+
+
+def _canonicalize_returns(stmts):
+    """Restructure so every return is a trailing statement or inside an If
+    both of whose branches always return (statements after a returning If
+    fold into its continuation branch — the reference return_transformer's
+    early-return elimination).  Raises _Unsupported for partial-return
+    branches and returns under loops/try/with."""
+    out = []
+    for idx, s in enumerate(stmts):
+        rest = stmts[idx + 1:]
+        if isinstance(s, (ast.While, ast.For, ast.Try, ast.With)) \
+                and _contains_return([s]):
+            raise _Unsupported
+        if isinstance(s, ast.If) and _contains_return([s]):
+            b_ret = _contains_return(s.body)
+            o_ret = _contains_return(s.orelse)
+            if b_ret and not _always_returns(s.body):
+                raise _Unsupported
+            if o_ret and not _always_returns(s.orelse):
+                raise _Unsupported
+            if b_ret and o_ret:
+                s.body = _canonicalize_returns(s.body)
+                s.orelse = _canonicalize_returns(s.orelse)
+                out.append(s)
+                return out  # rest is unreachable
+            if b_ret:
+                s.body = _canonicalize_returns(s.body)
+                s.orelse = _canonicalize_returns(list(s.orelse) + rest)
+            else:
+                s.orelse = _canonicalize_returns(s.orelse)
+                s.body = _canonicalize_returns(list(s.body) + rest)
+            out.append(s)
+            return out
+        out.append(s)
+    return out
+
+
+# ------------------------------------------------- break/continue flags ----
+
+class _BreakContinueFinder(ast.NodeVisitor):
+    """break/continue belonging to THIS loop level (not nested loops)."""
+
+    def __init__(self):
+        self.has_break = False
+        self.has_continue = False
+
+    def visit_Break(self, node):
+        self.has_break = True
+
+    def visit_Continue(self, node):
+        self.has_continue = True
+
+    def visit_While(self, node):
+        pass
+
+    visit_For = visit_FunctionDef = visit_AsyncFunctionDef = visit_While
+    visit_Lambda = visit_ClassDef = visit_While
+
+
+def _find_bc(stmts):
+    v = _BreakContinueFinder()
+    for s in stmts:
+        v.visit(s)
+    return v.has_break, v.has_continue
+
+
+def _assign_flag(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value=value))
+
+
+def _flag_expr(brk, cont):
+    names = [n for n in (brk, cont) if n]
+    if len(names) == 1:
+        return ast.Name(id=names[0], ctx=ast.Load())
+    return ast.Call(func=ast.Name(id="__d2s_or", ctx=ast.Load()),
+                    args=[ast.Name(id=names[0], ctx=ast.Load()),
+                          ast.Name(id=names[1], ctx=ast.Load())],
+                    keywords=[])
+
+
+def _guard_rewrite(stmts, brk, cont):
+    """Replace this-level break/continue with flag sets and wrap every
+    statement suffix following a may-escape statement in
+    ``if not (brk or cont):`` (the reference break_continue_transformer
+    rewrite, targeting tensor-traceable guard ifs)."""
+    out = []
+    for i, s in enumerate(stmts):
+        b, c = _find_bc([s])
+        if isinstance(s, ast.Break):
+            out.append(_assign_flag(brk, True))
+        elif isinstance(s, ast.Continue):
+            out.append(_assign_flag(cont, True))
+        elif isinstance(s, ast.If) and (b or c):
+            s.body = _guard_rewrite(s.body, brk, cont)
+            s.orelse = _guard_rewrite(s.orelse, brk, cont)
+            out.append(s)
+        else:
+            out.append(s)
+            continue
+        rest = _guard_rewrite(stmts[i + 1:], brk, cont)
+        if rest:
+            guard = ast.If(
+                test=ast.Call(func=ast.Name(id="__d2s_not", ctx=ast.Load()),
+                              args=[_flag_expr(brk if b else None,
+                                               cont if c else None)
+                                    if (b != c) else _flag_expr(brk, cont)],
+                              keywords=[]),
+                body=rest, orelse=[])
+            out.append(guard)
+        return out
+    return out
+
+
+class _LoopEscapeTransformer(ast.NodeTransformer):
+    """Eliminate break/continue into carried flag variables (post-order:
+    innermost loops first).  Flags are named ``_d2s_*`` (single
+    underscore) so the control-flow transformer carries them through
+    cond/while outputs like user variables."""
+
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _fresh(self, hint):
+        self.counter += 1
+        return f"_d2s_{hint}{self.counter}"
+
+    def _handle_loop(self, node, is_for):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        has_b, has_c = _find_bc(node.body)
+        if not (has_b or has_c):
+            return node
+        # Only rewrite loops the control-flow transformer WILL convert;
+        # a declined loop (tuple for-target, other escapes in body) must
+        # keep its real break/continue for native semantics.
+        if _has_escape_sans_bc(node.body):
+            return node
+        if is_for and not isinstance(node.target, ast.Name):
+            return node
+        if not is_for and any(isinstance(n, ast.NamedExpr)
+                              for n in ast.walk(node.test)):
+            return node
+        brk = self._fresh("brk") if has_b else None
+        cont = self._fresh("cont") if has_c else None
+        node._d2s_brk = brk  # this loop's OWN flag (nested loops get
+        # their own; name scanning would confuse them)
+        body = _guard_rewrite(node.body, brk, cont)
+        if cont:
+            body = [_assign_flag(cont, False)] + body
+        node.body = body
+        pre = []
+        if brk:
+            pre.append(_assign_flag(brk, False))
+            if not is_for:
+                # while test := (not brk) and (test); lazy on python path
+                node.test = ast.Call(
+                    func=ast.Name(id="__d2s_and", ctx=ast.Load()),
+                    args=[ast.Call(func=ast.Name(id="__d2s_not",
+                                                 ctx=ast.Load()),
+                                   args=[ast.Name(id=brk, ctx=ast.Load())],
+                                   keywords=[]),
+                          ast.Lambda(args=_args([]), body=node.test)],
+                    keywords=[])
+        if cont:
+            pre.append(_assign_flag(cont, False))
+        self.changed = True
+        return pre + [node]
+
+    def visit_While(self, node):
+        return self._handle_loop(node, is_for=False)
+
+    def visit_For(self, node):
+        return self._handle_loop(node, is_for=True)
 
 
 # ------------------------------------------------------------- AST pass ----
@@ -267,6 +620,29 @@ def _has_escape(stmts):
     return v.found
 
 
+def _has_escape_sans_return(stmts):
+    """Escapes OTHER than return (yield/raise/nonlocal/del/...) — used for
+    canonical both-return ifs, where returns are the expected exit."""
+    v = _HasEscape()
+    v.visit_Return = lambda node: None
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _has_escape_sans_bc(stmts):
+    """Escapes other than this-level break/continue — the pre-check before
+    the flag rewrite: a loop the control-flow transformer would decline
+    anyway (return/yield/raise/... in body) must KEEP its real break, or
+    the flag-only form silently changes native-loop semantics."""
+    v = _HasEscape()
+    v.visit_Break = lambda node: None
+    v.visit_Continue = lambda node: None
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
 def _args(names):
     return ast.arguments(posonlyargs=[], args=[ast.arg(arg=n)
                                                for n in names],
@@ -303,6 +679,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_If(self, node):
         self.generic_visit(node)
+        if (_contains_return(node.body) or _contains_return(node.orelse)):
+            if _always_returns(node.body) and _always_returns(node.orelse) \
+                    and not (_has_escape_sans_return(node.body)
+                             or _has_escape_sans_return(node.orelse)):
+                return self._ret_if(node)
+            return node
         if _has_escape(node.body) or _has_escape(node.orelse):
             return node
         body_names = [n for n in _assigned(node.body)
@@ -339,6 +721,63 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         stmt = (ast.Assign(targets=[_bind_target(names)], value=call)
                 if names else ast.Expr(value=call))
         return [true_def, false_def, stmt]
+
+    def _ret_if(self, node):
+        """Both branches end in return (canonical form): lower to a
+        value-returning convert_ifelse_ret and RETURN its result."""
+        names = sorted(set(
+            n for n in _assigned(node.body) + _assigned(node.orelse)
+            if not n.startswith("__d2s")))
+        true_name = self._fresh("rtrue")
+        false_name = self._fresh("rfalse")
+        true_def = ast.FunctionDef(name=true_name, args=_args(names),
+                                   body=list(node.body), decorator_list=[])
+        false_def = ast.FunctionDef(name=false_name, args=_args(names),
+                                    body=list(node.orelse),
+                                    decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__d2s_convert_ifelse_ret", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Name(id=true_name, ctx=ast.Load()),
+                  ast.Name(id=false_name, ctx=ast.Load()),
+                  _seed_tuple(names)],
+            keywords=[])
+        self.counter += 1
+        return [true_def, false_def, ast.Return(value=call)]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_escape(node.body):
+            return node
+        if not isinstance(node.target, ast.Name):
+            return node  # tuple targets: python scoping can't be carried
+        target = node.target.id
+        names = sorted(n for n in set(_assigned(node.body))
+                       if not n.startswith("__d2s") and n != target)
+        brk_name = getattr(node, "_d2s_brk", None)
+        if brk_name is not None and brk_name not in names:
+            brk_name = None  # defensive: flag must be carried to matter
+        body_name = self._fresh("forbody")
+        x_arg = "__d2s_x"
+        body = [ast.Assign(targets=[ast.Name(id=target, ctx=ast.Store())],
+                           value=ast.Name(id=x_arg, ctx=ast.Load()))] \
+            + list(node.body) + [_ret_tuple([target] + names)]
+        body_def = ast.FunctionDef(name=body_name,
+                                   args=_args([x_arg] + names),
+                                   body=body, decorator_list=[])
+        call = ast.Call(
+            func=ast.Name(id="__d2s_convert_for", ctx=ast.Load()),
+            args=[node.iter,
+                  ast.Name(id=body_name, ctx=ast.Load()),
+                  ast.Tuple(elts=[ast.Constant(value=n) for n in names],
+                            ctx=ast.Load()),
+                  _seed_tuple(names),
+                  ast.Constant(value=brk_name)],
+            keywords=[])
+        assign = ast.Assign(targets=[_bind_target(names + [target])],
+                            value=call)
+        self.counter += 1
+        return [body_def, assign]
 
     def visit_While(self, node):
         self.generic_visit(node)
@@ -398,9 +837,26 @@ def ast_transform(fn):
         if isinstance(n, ast.Name) and _mangled(n.id):
             return None
 
+    # 1) early-return canonicalization (best-effort: unsupported patterns
+    #    keep their returns, and the If transformer leaves those alone)
+    if any(isinstance(s, ast.If) and _contains_return([s])
+           for s in ast.walk(fdef)):
+        try:
+            body = list(fdef.body)
+            if not _always_returns(body):
+                body = body + [ast.Return(value=ast.Constant(value=None))]
+            fdef.body = _canonicalize_returns(body)
+        except _Unsupported:
+            pass
+
+    # 2) break/continue -> carried flags + guard ifs
+    escape = _LoopEscapeTransformer()
+    tree = escape.visit(tree)
+
+    # 3) if/while/for -> runtime converter calls
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)
-    if transformer.counter == 0:
+    if transformer.counter == 0 and not escape.changed:
         return None
     ast.fix_missing_locations(new_tree)
 
@@ -415,6 +871,11 @@ def ast_transform(fn):
     glb = fn.__globals__
     glb["__d2s_convert_ifelse"] = convert_ifelse
     glb["__d2s_convert_while"] = convert_while
+    glb["__d2s_convert_for"] = convert_for
+    glb["__d2s_convert_ifelse_ret"] = convert_ifelse_ret
+    glb["__d2s_not"] = d2s_not
+    glb["__d2s_or"] = d2s_or
+    glb["__d2s_and"] = d2s_and_lazy
     glb["__d2s_get"] = _frame_get
     loc = {}
     try:

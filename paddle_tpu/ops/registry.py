@@ -10,10 +10,68 @@ paddle/phi/backends/xpu/xpu2_op_list.cc).
 """
 
 import functools
+import inspect
 
 from .dispatch import apply_op
 
 OPS = {}
+
+
+class OpSchemaError(TypeError):
+    """Raised when a registered op's signature contradicts the reference
+    YAML schema and no divergence is recorded in ops/schema_compat.py."""
+
+
+def _validate_schema(name, jfn):
+    """Validate ``jfn``'s signature against the reference YAML schema.
+
+    Returns a {param: default} dict of schema defaults to auto-fill for
+    params the implementation left default-less, or None.  Raises
+    OpSchemaError when a required schema arg is neither accepted by the
+    implementation nor covered by a documented divergence — this is what
+    makes the schema the single source the reference's yaml is
+    (paddle/phi/api/yaml/ops.yaml + api_gen.py role).
+    """
+    from .schema import get_schema
+    from .schema_compat import SCHEMA_DIVERGENCES
+
+    sch = get_schema(name)
+    if sch is None:
+        return None
+    try:
+        sig = inspect.signature(jfn)
+    except (TypeError, ValueError):
+        return None
+    params = sig.parameters
+    if any(p.kind in (p.VAR_KEYWORD, p.VAR_POSITIONAL)
+           for p in params.values()):
+        return None
+    div = SCHEMA_DIVERGENCES.get(name, {})
+    renames = div.get("renames", {})
+    dropped = set(div.get("dropped", ()))
+    missing = []
+    fill = {}
+    for entry in sch["args"]:
+        a_name, has_default = entry[1], entry[2]
+        default = entry[3] if len(entry) > 3 else None
+        impl_name = renames.get(a_name, a_name)
+        if impl_name not in params:
+            if not has_default and a_name not in dropped:
+                missing.append(a_name)
+            continue
+        p = params[impl_name]
+        if (has_default and default is not None
+                and p.default is inspect.Parameter.empty
+                and p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)):
+            fill[impl_name] = default
+    if missing:
+        raise OpSchemaError(
+            f"op '{name}': implementation signature {list(params)} is "
+            f"missing required schema arg(s) {missing} "
+            f"(reference paddle/phi/api/yaml). Rename the params to match, "
+            f"or record the deliberate divergence in "
+            f"paddle_tpu/ops/schema_compat.py")
+    return fill or None
 
 
 class OpDef:
@@ -37,10 +95,31 @@ def op(opname=None, tags=()):
     def deco(jfn):
         name = opname or jfn.__name__
 
-        @functools.wraps(jfn)
-        def user_fn(*args, **kwargs):
-            kwargs.pop("name", None)
-            return apply_op(name, jfn, args, kwargs)
+        # Schema validation on FIRST registration only (per-call closure
+        # re-registrations — dropout & friends — skip it: the import-time
+        # signature was already checked and the closure narrows it).
+        fill = None
+        if name not in OPS:
+            fill = _validate_schema(name, jfn)
+        if fill:
+            # schema-supplied defaults for params the impl left bare:
+            # positions precomputed so the hot path pays dict lookups only
+            positions = {k: i for i, k in
+                         enumerate(inspect.signature(jfn).parameters)}
+            fill_pos = [(k, positions[k], v) for k, v in fill.items()]
+
+            @functools.wraps(jfn)
+            def user_fn(*args, **kwargs):
+                kwargs.pop("name", None)
+                for k, idx, v in fill_pos:
+                    if len(args) <= idx and k not in kwargs:
+                        kwargs[k] = v
+                return apply_op(name, jfn, args, kwargs)
+        else:
+            @functools.wraps(jfn)
+            def user_fn(*args, **kwargs):
+                kwargs.pop("name", None)
+                return apply_op(name, jfn, args, kwargs)
 
         # First registration wins: several public ops register a
         # closure-capturing inner @op on every call (dropout, rrelu, …);

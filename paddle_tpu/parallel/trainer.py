@@ -38,15 +38,21 @@ def _spec_from_axes(mesh, axes, ndim):
     return P(*spec)
 
 
-def _shard_opt_state_spec(mesh, param_spec, ndim):
-    """ZeRO stage-1: optimizer state sharded over the 'sharding' axis on the
-    first dim not already sharded (falls back to the param's own spec)."""
-    if "sharding" not in mesh.axis_names or mesh.shape.get("sharding", 1) == 1:
+def _shard_opt_state_spec(mesh, param_spec, ndim, zero_axis="sharding"):
+    """ZeRO stage-1: optimizer state sharded over ``zero_axis`` on the
+    first dim not already sharded (falls back to the param's own spec).
+
+    ``zero_axis="dp"`` folds sharding into the data-parallel axis — the
+    reference's sharding group IS a subdivision of the dp replicas
+    (group_sharded stage-1 semantics) — for meshes without a dedicated
+    'sharding' axis."""
+    if not zero_axis or zero_axis not in mesh.axis_names or \
+            mesh.shape.get(zero_axis, 1) == 1:
         return param_spec
     spec = list(param_spec) + [None] * (ndim - len(param_spec))
     for i, s in enumerate(spec):
         if s is None:
-            spec[i] = "sharding"
+            spec[i] = zero_axis
             return P(*spec)
     return param_spec
 
@@ -62,7 +68,7 @@ class SpmdTrainStep:
 
     def __init__(self, model, optimizer, mesh, n_microbatches=1,
                  sequence_parallel=False, remat=False, zero_stage=1,
-                 virtual_pp=1, scaler=None):
+                 virtual_pp=1, scaler=None, zero_axis=None):
         self.model = model
         self.optimizer = optimizer
         self.mesh = mesh
@@ -70,6 +76,12 @@ class SpmdTrainStep:
         self.sequence_parallel = sequence_parallel
         self.remat = remat
         self.virtual_pp = virtual_pp
+        # ZeRO axis: a dedicated 'sharding' mesh axis when present, else
+        # opt-in folding into 'dp' (zero_axis="dp") — reference sharding
+        # groups subdivide the data-parallel replicas
+        if zero_axis is None:
+            zero_axis = "sharding"
+        self.zero_axis = zero_axis if zero_stage else None
         # loss scaling composed into the compiled hybrid step (the fleet
         # distributed_scaler role, fleet/scaler.py:28 — found-inf detection
         # is global automatically: grads are global arrays under GSPMD)
@@ -128,7 +140,7 @@ class SpmdTrainStep:
                     sv, NamedSharding(
                         mesh,
                         _shard_opt_state_spec(
-                            mesh, path_sh.spec, sv.ndim)
+                            mesh, path_sh.spec, sv.ndim, self.zero_axis)
                         if sv.ndim else P())),
                 state)
 
@@ -136,8 +148,14 @@ class SpmdTrainStep:
             opt_shard, self.param_shardings, self.opt_state,
             is_leaf=lambda x: isinstance(x, NamedSharding))
 
-        self.batch_sharding = NamedSharding(
-            mesh, P("dp" if "dp" in mesh.axis_names else None))
+        # batch parallelism rides dp AND a dedicated sharding axis — the
+        # sharding group is extra data parallelism (reference group_sharded)
+        self._batch_axes = tuple(
+            a for a in ("dp", "sharding")
+            if mesh.shape.get(a, 1) > 1) or None
+        if self._batch_axes is not None and len(self._batch_axes) == 1:
+            self._batch_axes = self._batch_axes[0]
+        self.batch_sharding = NamedSharding(mesh, P(self._batch_axes))
         self._step_count = 0
         self._compiled = None
 
@@ -148,9 +166,9 @@ class SpmdTrainStep:
         n_micro = self.n_microbatches
         optimizer = self.optimizer
         grad_clip = optimizer._grad_clip
-        seq_spec = P("dp", "mp", None) if (self.sequence_parallel and
-                                           "mp" in mesh.axis_names) \
-            else P("dp", None, None)
+        seq_spec = P(self._batch_axes, "mp", None) \
+            if (self.sequence_parallel and "mp" in mesh.axis_names) \
+            else P(self._batch_axes, None, None)
         blk = block_fn
         if self.remat:
             blk = jax.checkpoint(block_fn)

@@ -166,6 +166,62 @@ class TestOpSchema:
         missing = [n for n in OP_INVENTORY if n not in OP_SCHEMA]
         assert not missing, missing[:10]
 
+    def test_wrong_signature_rejected_at_registration(self):
+        """The schema is load-bearing: registering an op under a schema'd
+        name with a contradicting signature must fail (the reference's
+        yaml/api_gen single-source role)."""
+        from paddle_tpu.ops import registry
+        from paddle_tpu.ops.registry import OpSchemaError
+
+        saved = registry.OPS.pop("matmul")
+        try:
+            with pytest.raises(OpSchemaError, match="missing required"):
+                @registry.op("matmul")
+                def bad_matmul(a, b):  # schema says (x, y, ...)
+                    return a @ b
+        finally:
+            registry.OPS["matmul"] = saved
+
+    def test_every_registered_op_validates_or_is_documented(self):
+        """Sweep: all import-time registrations pass _validate_schema (a
+        mismatch would have raised at import, but assert explicitly so the
+        property is pinned) and every divergence entry names a real op."""
+        from paddle_tpu.ops import registry
+        from paddle_tpu.ops.schema import OP_SCHEMA
+        from paddle_tpu.ops.schema_compat import SCHEMA_DIVERGENCES
+
+        for name, od in registry.OPS.items():
+            if od.jax_fn is not None:
+                registry._validate_schema(name, od.jax_fn)  # must not raise
+        unknown = [n for n in SCHEMA_DIVERGENCES if n not in OP_SCHEMA]
+        assert not unknown, unknown
+
+    def test_schema_defaults_autofill(self):
+        """A schema default fills in for an impl param left default-less."""
+        from paddle_tpu.ops import registry
+
+        name = _unique("schema_fill")
+        # fabricate a schema entry with a defaulted arg the impl leaves bare
+        from paddle_tpu.ops.schema import OP_SCHEMA
+        OP_SCHEMA[name] = {
+            "group": "ops",
+            "args": [("Tensor", "x", False, None),
+                     ("float", "alpha", True, 2.5)],
+            "outputs": [("Tensor", "out")], "backward": None,
+            "inplace": None}
+        try:
+            @registry.op(name)
+            def f(x, alpha):  # no python default: schema supplies 2.5
+                return x * alpha
+
+            out = f(paddle.to_tensor(np.array([2.0], np.float32)))
+            np.testing.assert_allclose(out.numpy(), [5.0])
+            out = f(paddle.to_tensor(np.array([2.0], np.float32)), alpha=1.0)
+            np.testing.assert_allclose(out.numpy(), [2.0])
+        finally:
+            del OP_SCHEMA[name]
+            registry.OPS.pop(name, None)
+
 
 class TestAutotune:
     def test_pick_flag_off_returns_heuristic(self):

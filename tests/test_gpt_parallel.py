@@ -178,6 +178,47 @@ class TestHybridTrainer:
         m1 = trainer.opt_state["blocks"]["attn.qkv.weight"]["moment1"]
         assert len(m1.sharding.device_set) > 1
 
+    def test_zero_stage0_disables_opt_state_sharding(self):
+        """Review regression: zero_stage=0 must keep optimizer state
+        replicated even when the mesh has a sharding axis."""
+        mesh = build_mesh(dp=2, pp=1, sharding=2, mp=2)
+        paddle.seed(3)
+        model = gpt_tiny(num_layers=2)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        trainer = SpmdTrainStep(model, opt, mesh, zero_stage=0)
+        m1 = trainer.opt_state["blocks"]["attn.qkv.weight"]["moment1"]
+        # state mirrors the PARAM's tp/pp sharding but must NOT gain the
+        # ZeRO 'sharding' axis
+        flat = [ax for dim in m1.sharding.spec if dim
+                for ax in (dim if isinstance(dim, tuple) else (dim,))]
+        assert "sharding" not in flat, m1.sharding.spec
+
+    def test_zero_over_dp_matches_dedicated_sharding_axis(self):
+        """ZeRO folded into the dp axis (zero_axis="dp", reference
+        group_sharded semantics) must train identically to a dedicated
+        sharding axis AND actually shard the opt state."""
+        def train(mesh, zero_axis, seed=13):
+            paddle.seed(seed)
+            model = gpt_tiny(num_layers=2)
+            opt = optimizer.AdamW(
+                learning_rate=1e-3, parameters=model.parameters(),
+                grad_clip=optimizer.ClipGradByGlobalNorm(1.0))
+            tr = SpmdTrainStep(model, opt, mesh, zero_axis=zero_axis)
+            ids, labels = make_batch(batch=8)
+            losses = [float(tr.step(ids, labels).numpy())
+                      for _ in range(3)]
+            return losses, tr
+
+        mesh_dp = build_mesh(dp=4, pp=1, sharding=1, mp=2)
+        l_dp, tr_dp = train(mesh_dp, zero_axis="dp")
+        m1 = tr_dp.opt_state["blocks"]["attn.qkv.weight"]["moment1"]
+        assert not m1.sharding.is_fully_replicated
+        mesh_sh = build_mesh(dp=2, pp=1, sharding=2, mp=2)
+        l_sh, _ = train(mesh_sh, zero_axis=None)
+        np.testing.assert_allclose(l_dp, l_sh, rtol=2e-3)
+        assert all(np.isfinite(l) for l in l_dp)
+
 
 class TestGraftEntry:
     def test_entry_and_dryrun(self):
