@@ -71,6 +71,31 @@ void    pd_table_push_adagrad(void* table, const int64_t* keys,
                               float eps);
 int     pd_table_save(void* table, const char* path);
 int     pd_table_load(void* table, const char* path);
+/* CTR accessor + disk tier + GeoSGD (ctr_accessor.cc / ssd_sparse_table.h /
+   memory_sparse_geo_table.h roles) */
+int64_t pd_table_mem_rows(void* table);
+int64_t pd_table_disk_rows(void* table);
+int     pd_table_enable_disk(void* table, const char* path,
+                             int64_t max_mem_rows);
+void    pd_table_set_ctr(void* table, float nonclk_coeff, float click_coeff,
+                         float decay_rate, float delete_threshold,
+                         int delete_after_unseen_days);
+void    pd_table_push_delta(void* table, const int64_t* keys,
+                            const float* deltas, int64_t n);
+void    pd_table_push_show_click(void* table, const int64_t* keys,
+                                 const float* shows, const float* clicks,
+                                 int64_t n);
+void    pd_table_get_meta(void* table, const int64_t* keys, int64_t n,
+                          float* out);
+int64_t pd_table_shrink(void* table);
+int     pd_ps_client_push_delta(void* client, const int64_t* keys,
+                                const float* deltas, int64_t n);
+int     pd_ps_client_push_show_click(void* client, const int64_t* keys,
+                                     const float* shows, const float* clicks,
+                                     int64_t n);
+int64_t pd_ps_client_shrink(void* client);
+int     pd_ps_client_stats(void* client, int64_t* mem_rows,
+                           int64_t* disk_rows);
 
 // ------------------------------------------------------------- PS service --
 // Multi-host PS data plane (ps_service.cc): serve a table over TCP; clients

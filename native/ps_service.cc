@@ -45,6 +45,10 @@ enum PsOp : uint8_t {
   kLoad = 4,
   kSize = 5,
   kDim = 6,
+  kPushDelta = 7,   // GeoSGD: w += delta
+  kShowClick = 8,   // CTR accessor stats
+  kShrink = 9,      // decay + evict cycle; replies evicted count
+  kStats = 10,      // (mem_rows, disk_rows)
 };
 
 constexpr uint64_t kMaxPayload = 1ull << 32;  // 4 GiB per request
@@ -178,6 +182,54 @@ void handle_conn(PsServer* s, ConnRec* rec) try {
         else
           pd_table_push_adagrad(s->table, keys, grads, n, lr, eps);
         reply(fd, 0, nullptr, 0);
+        break;
+      }
+      case kPushDelta: {
+        if (plen < 8) { reply(fd, -3, nullptr, 0); break; }
+        int64_t n;
+        memcpy(&n, payload.data(), 8);
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 8 ||
+            static_cast<uint64_t>(n) * dim > kMaxRowFloats ||
+            plen != 8 + static_cast<uint64_t>(n) * 8 +
+                        static_cast<uint64_t>(n) * dim * 4) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* keys =
+            reinterpret_cast<const int64_t*>(payload.data() + 8);
+        const float* deltas =
+            reinterpret_cast<const float*>(payload.data() + 8 + n * 8);
+        pd_table_push_delta(s->table, keys, deltas, n);
+        reply(fd, 0, nullptr, 0);
+        break;
+      }
+      case kShowClick: {
+        if (plen < 8) { reply(fd, -3, nullptr, 0); break; }
+        int64_t n;
+        memcpy(&n, payload.data(), 8);
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 8 ||
+            plen != 8 + static_cast<uint64_t>(n) * 16) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* keys =
+            reinterpret_cast<const int64_t*>(payload.data() + 8);
+        const float* shows =
+            reinterpret_cast<const float*>(payload.data() + 8 + n * 8);
+        const float* clicks = shows + n;
+        pd_table_push_show_click(s->table, keys, shows, clicks, n);
+        reply(fd, 0, nullptr, 0);
+        break;
+      }
+      case kShrink: {
+        int64_t evicted = pd_table_shrink(s->table);
+        reply(fd, 0, &evicted, 8);
+        break;
+      }
+      case kStats: {
+        int64_t stats[2] = {pd_table_mem_rows(s->table),
+                            pd_table_disk_rows(s->table)};
+        reply(fd, 0, stats, 16);
         break;
       }
       case kSave: {
@@ -448,6 +500,59 @@ int pd_ps_client_push(void* client, int opt, const int64_t* keys,
   std::string data;
   if (!ps_request(c, kPush, payload, &rc, &data)) return -1;
   return rc;
+}
+
+int pd_ps_client_push_delta(void* client, const int64_t* keys,
+                            const float* deltas, int64_t n) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(keys), n * 8);
+  payload.append(reinterpret_cast<const char*>(deltas),
+                 static_cast<size_t>(n) * c->dim * 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kPushDelta, payload, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_push_show_click(void* client, const int64_t* keys,
+                                 const float* shows, const float* clicks,
+                                 int64_t n) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(keys), n * 8);
+  payload.append(reinterpret_cast<const char*>(shows), n * 4);
+  payload.append(reinterpret_cast<const char*>(clicks), n * 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kShowClick, payload, &rc, &data)) return -1;
+  return rc;
+}
+
+int64_t pd_ps_client_shrink(void* client) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kShrink, "", &rc, &data) || rc != 0 ||
+      data.size() != 8)
+    return -1;
+  int64_t evicted;
+  memcpy(&evicted, data.data(), 8);
+  return evicted;
+}
+
+int pd_ps_client_stats(void* client, int64_t* mem_rows, int64_t* disk_rows) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kStats, "", &rc, &data) || rc != 0 ||
+      data.size() != 16)
+    return -1;
+  memcpy(mem_rows, data.data(), 8);
+  memcpy(disk_rows, data.data() + 8, 8);
+  return 0;
 }
 
 int pd_ps_client_save(void* client, const char* path) {

@@ -2,18 +2,27 @@
 //
 // Role parity with the reference PS sparse tables
 // (paddle/fluid/distributed/ps/table/memory_sparse_table.cc — pull/push
-// with in-table optimizer accessors, save/load).  Design here is new:
+// with in-table optimizer accessors, save/load;
+// ctr_accessor.cc — show/click decay, ShowClickScore eviction;
+// ssd_sparse_table.h — memory tier + disk overflow;
+// memory_sparse_geo_table.h — async delta push).  Design here is new:
 // sharded open hash maps guarded by per-shard mutexes, rows initialized
-// deterministically from the key (splitmix64 -> uniform), and the optimizer
+// deterministically from the key (splitmix64 -> uniform), the optimizer
 // (SGD / Adagrad) applied inside the push so the host owns optimizer state
 // for 100B-feature-scale embeddings while the TPU only sees dense pulled
-// rows.
+// rows.  The disk tier is an append-only spill log + in-memory offset
+// index (RocksDB role, without the dependency): when a shard exceeds its
+// row budget the coldest rows (LRU tick) spill; pulls promote them back.
 #include "paddle_native.h"
 
+#include <fcntl.h>
 #include <math.h>
 #include <stdio.h>
 #include <string.h>
+#include <unistd.h>
 
+#include <algorithm>
+#include <atomic>
 #include <mutex>
 #include <string>
 #include <unordered_map>
@@ -26,6 +35,30 @@ constexpr int kNumShards = 16;
 struct Row {
   std::vector<float> w;    // embedding weights [dim]
   std::vector<float> g2;   // adagrad accumulator [dim] (lazily allocated)
+  float show = 0.0f;       // CTR accessor stats (ctr_accessor.h layout)
+  float click = 0.0f;
+  int32_t unseen = 0;      // shrink cycles since last access
+  uint64_t tick = 0;       // last-access counter (cold selection)
+};
+
+// disk-resident row: spill-log offset + the metadata shrink needs so
+// eviction decisions never touch the disk.  `bytes` lets eviction and
+// promotion account dead log space for compaction without a read.
+struct DiskEnt {
+  int64_t offset;
+  int32_t bytes;
+  float show;
+  float click;
+  int32_t unseen;
+};
+
+struct CtrParams {
+  bool enabled = false;
+  float nonclk_coeff = 0.1f;
+  float click_coeff = 1.0f;
+  float decay_rate = 0.98f;
+  float delete_threshold = 0.8f;
+  int32_t delete_after_unseen_days = 30;
 };
 
 struct Table {
@@ -33,7 +66,17 @@ struct Table {
   uint64_t seed;
   float init_range;
   std::unordered_map<int64_t, Row> shards[kNumShards];
+  std::unordered_map<int64_t, DiskEnt> disk_index[kNumShards];
   std::mutex locks[kNumShards];
+  std::atomic<uint64_t> clock{0};
+  CtrParams ctr;
+  // disk tier (0 = disabled)
+  int64_t max_mem_rows = 0;
+  int spill_fd = -1;
+  int64_t spill_end = 0;  // append offset
+  std::atomic<int64_t> spill_dead{0};  // bytes of superseded records
+  std::mutex spill_mu;    // serializes appends (preads are lock-free)
+  std::string spill_path;
 };
 
 inline int shard_of(int64_t key) {
@@ -58,14 +101,169 @@ void init_row(Table* t, int64_t key, Row* row) {
   }
 }
 
+// reference ctr_accessor.cc ShowClickScore
+inline float show_click_score(const CtrParams& p, float show, float click) {
+  return (show - click) * p.nonclk_coeff + click * p.click_coeff;
+}
+
+// ---- spill log ------------------------------------------------------------
+// record: f32 show | f32 click | i32 unseen | u8 has_g2 | f32 w[dim]
+//         | [f32 g2[dim]]   (key lives in the index)
+
+int64_t spill_append(Table* t, const Row& row) {
+  std::lock_guard<std::mutex> lk(t->spill_mu);
+  int64_t off = t->spill_end;
+  uint8_t has_g2 = row.g2.empty() ? 0 : 1;
+  std::vector<char> buf;
+  buf.reserve(13 + (1 + has_g2) * t->dim * 4);
+  auto put = [&buf](const void* p, size_t n) {
+    buf.insert(buf.end(), static_cast<const char*>(p),
+               static_cast<const char*>(p) + n);
+  };
+  put(&row.show, 4);
+  put(&row.click, 4);
+  put(&row.unseen, 4);
+  put(&has_g2, 1);
+  put(row.w.data(), t->dim * 4);
+  if (has_g2) put(row.g2.data(), t->dim * 4);
+  ssize_t n = pwrite(t->spill_fd, buf.data(), buf.size(), off);
+  if (n != static_cast<ssize_t>(buf.size())) return -1;
+  t->spill_end += n;
+  return off;
+}
+
+bool spill_read(Table* t, int64_t off, Row* row) {
+  char hdr[13];
+  if (pread(t->spill_fd, hdr, 13, off) != 13) return false;
+  memcpy(&row->show, hdr, 4);
+  memcpy(&row->click, hdr + 4, 4);
+  memcpy(&row->unseen, hdr + 8, 4);
+  uint8_t has_g2 = static_cast<uint8_t>(hdr[12]);
+  row->w.resize(t->dim);
+  if (pread(t->spill_fd, row->w.data(), t->dim * 4, off + 13) != t->dim * 4)
+    return false;
+  if (has_g2) {
+    row->g2.resize(t->dim);
+    if (pread(t->spill_fd, row->g2.data(), t->dim * 4,
+              off + 13 + t->dim * 4) != t->dim * 4)
+      return false;
+  } else {
+    row->g2.clear();
+  }
+  return true;
+}
+
+// caller holds shard lock s.  Spill the coldest half of the shard when it
+// exceeds its budget (ssd_sparse_table role: hot rows stay resident).
+void maybe_spill(Table* t, int s) {
+  if (t->spill_fd < 0 || t->max_mem_rows <= 0) return;
+  int64_t budget = std::max<int64_t>(1, t->max_mem_rows / kNumShards);
+  auto& m = t->shards[s];
+  if (static_cast<int64_t>(m.size()) <= budget) return;
+  std::vector<std::pair<uint64_t, int64_t>> order;  // (tick, key)
+  order.reserve(m.size());
+  for (auto& kv : m) order.emplace_back(kv.second.tick, kv.first);
+  size_t keep = static_cast<size_t>(budget) / 2 + 1;
+  size_t n_spill = order.size() > keep ? order.size() - keep : 0;
+  if (!n_spill) return;
+  std::nth_element(order.begin(), order.begin() + n_spill, order.end());
+  for (size_t i = 0; i < n_spill; ++i) {
+    int64_t key = order[i].second;
+    auto it = m.find(key);
+    if (it == m.end()) continue;
+    int64_t off = spill_append(t, it->second);
+    if (off < 0) return;  // disk full: stop spilling, keep rows in memory
+    int32_t bytes = 13 + (it->second.g2.empty() ? 1 : 2) * t->dim * 4;
+    t->disk_index[s][key] = DiskEnt{off, bytes, it->second.show,
+                                    it->second.click, it->second.unseen};
+    m.erase(it);
+  }
+}
+
+// Rewrite the spill log keeping only live (indexed) records.  Takes every
+// shard lock (ascending order — callers hold NO locks) + the spill mutex,
+// so offsets can be rewritten consistently.  Returns 0 / -1.
+int spill_compact(Table* t) {
+  std::unique_lock<std::mutex> shard_locks[kNumShards];
+  for (int s = 0; s < kNumShards; ++s)
+    shard_locks[s] = std::unique_lock<std::mutex>(t->locks[s]);
+  std::lock_guard<std::mutex> lk(t->spill_mu);
+  std::string tmp = t->spill_path + ".compact";
+  int nfd = open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (nfd < 0) return -1;
+  // stage new offsets; commit only after the file swap succeeds, so a
+  // mid-compaction I/O failure leaves the old log + index fully intact
+  std::vector<std::pair<DiskEnt*, int64_t>> staged;
+  int64_t new_end = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    for (auto& kv : t->disk_index[s]) {
+      std::vector<char> buf(kv.second.bytes);
+      if (pread(t->spill_fd, buf.data(), buf.size(),
+                kv.second.offset) != static_cast<ssize_t>(buf.size()) ||
+          pwrite(nfd, buf.data(), buf.size(), new_end) !=
+              static_cast<ssize_t>(buf.size())) {
+        close(nfd);
+        unlink(tmp.c_str());
+        return -1;
+      }
+      staged.emplace_back(&kv.second, new_end);
+      new_end += static_cast<int64_t>(buf.size());
+    }
+  }
+  if (rename(tmp.c_str(), t->spill_path.c_str()) != 0) {
+    close(nfd);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  for (auto& p : staged) p.first->offset = p.second;
+  close(t->spill_fd);
+  t->spill_fd = nfd;
+  t->spill_end = new_end;
+  t->spill_dead.store(0);
+  return 0;
+}
+
+// Opportunistic compaction trigger — called from public entry points
+// while NO shard lock is held.  Keeps the log under ~2x live size.
+void maybe_compact(Table* t) {
+  if (t->spill_fd < 0) return;
+  int64_t dead = t->spill_dead.load();
+  if (dead > (1 << 20) && dead * 2 > t->spill_end) spill_compact(t);
+}
+
+// caller holds shard lock; resident row, promoted from disk, or fresh
 Row* find_or_create(Table* t, int64_t key) {
   int s = shard_of(key);
   auto& m = t->shards[s];
   auto it = m.find(key);
   if (it == m.end()) {
     it = m.emplace(key, Row{}).first;
-    init_row(t, key, &it->second);
+    auto dit = t->disk_index[s].find(key);
+    bool promoted = false;
+    if (dit != t->disk_index[s].end()) {
+      promoted = spill_read(t, dit->second.offset, &it->second);
+      if (!promoted) {
+        // unreadable record (truncated/corrupt log): surface it — the
+        // entry is dropped either way (size stays consistent), but a
+        // silent re-init of trained weights must not pass unnoticed
+        fprintf(stderr,
+                "paddle_tpu sparse_table: spill record for key %lld "
+                "unreadable; row re-initialized\n",
+                static_cast<long long>(key));
+        it->second = Row{};  // clear any partially-read w/g2
+      }
+      t->spill_dead.fetch_add(dit->second.bytes);
+      t->disk_index[s].erase(dit);
+    }
+    if (!promoted) init_row(t, key, &it->second);
+    // stamp the tick BEFORE spilling so the just-touched row is the
+    // hottest and can't be selected as a spill victim
+    it->second.tick = t->clock.fetch_add(1) + 1;
+    maybe_spill(t, s);
+    it = m.find(key);  // maybe_spill may rehash iterators
   }
+  it->second.tick = t->clock.fetch_add(1) + 1;
+  it->second.unseen = 0;
   return &it->second;
 }
 
@@ -81,11 +279,26 @@ void* pd_table_create(int dim, float init_range, uint64_t seed) {
   return t;
 }
 
-void pd_table_destroy(void* table) { delete static_cast<Table*>(table); }
+void pd_table_destroy(void* table) {
+  auto* t = static_cast<Table*>(table);
+  if (t->spill_fd >= 0) close(t->spill_fd);
+  delete t;
+}
 
 int pd_table_dim(void* table) { return static_cast<Table*>(table)->dim; }
 
 int64_t pd_table_size(void* table) {
+  auto* t = static_cast<Table*>(table);
+  int64_t n = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    n += static_cast<int64_t>(t->shards[s].size()) +
+         static_cast<int64_t>(t->disk_index[s].size());
+  }
+  return n;
+}
+
+int64_t pd_table_mem_rows(void* table) {
   auto* t = static_cast<Table*>(table);
   int64_t n = 0;
   for (int s = 0; s < kNumShards; ++s) {
@@ -95,9 +308,52 @@ int64_t pd_table_size(void* table) {
   return n;
 }
 
+int64_t pd_table_disk_rows(void* table) {
+  auto* t = static_cast<Table*>(table);
+  int64_t n = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    n += static_cast<int64_t>(t->disk_index[s].size());
+  }
+  return n;
+}
+
+// Disk overflow tier (reference ssd_sparse_table.h role).  Must be called
+// before any rows spill; max_mem_rows bounds RESIDENT rows table-wide.
+int pd_table_enable_disk(void* table, const char* path,
+                         int64_t max_mem_rows) {
+  auto* t = static_cast<Table*>(table);
+  // re-enabling with live spilled rows would O_TRUNC the log their index
+  // offsets point into (or alias offsets in a new file) — refuse
+  if (pd_table_disk_rows(table) > 0) return -2;
+  int fd = open(path, O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return -1;
+  if (t->spill_fd >= 0) close(t->spill_fd);
+  t->spill_fd = fd;
+  t->spill_end = 0;
+  t->spill_dead.store(0);
+  t->spill_path = path;
+  t->max_mem_rows = max_mem_rows;
+  return 0;
+}
+
+// CTR accessor config (reference ctr_accessor.cc ctor params)
+void pd_table_set_ctr(void* table, float nonclk_coeff, float click_coeff,
+                      float decay_rate, float delete_threshold,
+                      int delete_after_unseen_days) {
+  auto* t = static_cast<Table*>(table);
+  t->ctr.enabled = true;
+  t->ctr.nonclk_coeff = nonclk_coeff;
+  t->ctr.click_coeff = click_coeff;
+  t->ctr.decay_rate = decay_rate;
+  t->ctr.delete_threshold = delete_threshold;
+  t->ctr.delete_after_unseen_days = delete_after_unseen_days;
+}
+
 // out: [n, dim] row-major
 void pd_table_pull(void* table, const int64_t* keys, int64_t n, float* out) {
   auto* t = static_cast<Table*>(table);
+  maybe_compact(t);
   for (int64_t i = 0; i < n; ++i) {
     int s = shard_of(keys[i]);
     std::lock_guard<std::mutex> lk(t->locks[s]);
@@ -111,6 +367,7 @@ void pd_table_pull(void* table, const int64_t* keys, int64_t n, float* out) {
 void pd_table_push_sgd(void* table, const int64_t* keys, const float* grads,
                        int64_t n, float lr) {
   auto* t = static_cast<Table*>(table);
+  maybe_compact(t);
   for (int64_t i = 0; i < n; ++i) {
     int s = shard_of(keys[i]);
     std::lock_guard<std::mutex> lk(t->locks[s]);
@@ -124,6 +381,7 @@ void pd_table_push_adagrad(void* table, const int64_t* keys,
                            const float* grads, int64_t n, float lr,
                            float eps) {
   auto* t = static_cast<Table*>(table);
+  maybe_compact(t);
   for (int64_t i = 0; i < n; ++i) {
     int s = shard_of(keys[i]);
     std::lock_guard<std::mutex> lk(t->locks[s]);
@@ -137,12 +395,119 @@ void pd_table_push_adagrad(void* table, const int64_t* keys,
   }
 }
 
-// Binary format: i32 dim | i64 count | repeated (i64 key | f32*dim w |
-// u8 has_g2 | [f32*dim g2])
+// GeoSGD async apply: w += delta (reference memory_sparse_geo_table's
+// PushSparse — trainers train local replicas and ship deltas)
+void pd_table_push_delta(void* table, const int64_t* keys,
+                         const float* deltas, int64_t n) {
+  auto* t = static_cast<Table*>(table);
+  maybe_compact(t);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(keys[i]);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    Row* r = find_or_create(t, keys[i]);
+    const float* d = deltas + i * t->dim;
+    for (int j = 0; j < t->dim; ++j) r->w[j] += d[j];
+  }
+}
+
+// CTR stats accumulation (reference CtrCommonPushValue show/click)
+void pd_table_push_show_click(void* table, const int64_t* keys,
+                              const float* shows, const float* clicks,
+                              int64_t n) {
+  auto* t = static_cast<Table*>(table);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(keys[i]);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    Row* r = find_or_create(t, keys[i]);
+    r->show += shows[i];
+    r->click += clicks[i];
+  }
+}
+
+// out: [n, 3] (show, click, unseen) — resident or disk metadata
+void pd_table_get_meta(void* table, const int64_t* keys, int64_t n,
+                       float* out) {
+  auto* t = static_cast<Table*>(table);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = shard_of(keys[i]);
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    auto it = t->shards[s].find(keys[i]);
+    if (it != t->shards[s].end()) {
+      out[i * 3] = it->second.show;
+      out[i * 3 + 1] = it->second.click;
+      out[i * 3 + 2] = static_cast<float>(it->second.unseen);
+      continue;
+    }
+    auto dit = t->disk_index[s].find(keys[i]);
+    if (dit != t->disk_index[s].end()) {
+      out[i * 3] = dit->second.show;
+      out[i * 3 + 1] = dit->second.click;
+      out[i * 3 + 2] = static_cast<float>(dit->second.unseen);
+    } else {
+      out[i * 3] = out[i * 3 + 1] = -1.0f;
+      out[i * 3 + 2] = -1.0f;
+    }
+  }
+}
+
+// One shrink cycle (reference ctr_accessor.cc Shrink, called by the PS
+// server's daily shrink): decay show/click, age unseen_days, evict rows
+// whose ShowClickScore fell under the threshold or that aged out.
+// Disk-tier rows evict by dropping their index entry (space reclaimed at
+// the next save/compaction).  Returns rows evicted.
+int64_t pd_table_shrink(void* table) {
+  auto* t = static_cast<Table*>(table);
+  if (!t->ctr.enabled) return 0;
+  const CtrParams& p = t->ctr;
+  int64_t evicted = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->locks[s]);
+    auto& m = t->shards[s];
+    for (auto it = m.begin(); it != m.end();) {
+      Row& r = it->second;
+      r.show *= p.decay_rate;
+      r.click *= p.decay_rate;
+      r.unseen += 1;
+      float score = show_click_score(p, r.show, r.click);
+      if (score < p.delete_threshold ||
+          r.unseen > p.delete_after_unseen_days) {
+        it = m.erase(it);
+        ++evicted;
+      } else {
+        ++it;
+      }
+    }
+    auto& di = t->disk_index[s];
+    for (auto it = di.begin(); it != di.end();) {
+      DiskEnt& e = it->second;
+      e.show *= p.decay_rate;
+      e.click *= p.decay_rate;
+      e.unseen += 1;
+      float score = show_click_score(p, e.show, e.click);
+      if (score < p.delete_threshold ||
+          e.unseen > p.delete_after_unseen_days) {
+        t->spill_dead.fetch_add(e.bytes);
+        it = di.erase(it);
+        ++evicted;
+      } else {
+        ++it;
+      }
+    }
+  }
+  return evicted;
+}
+
+// Binary format v2: magic "PDT2" | i32 dim | i64 count | repeated
+// (i64 key | f32 show | f32 click | i32 unseen | u8 has_g2 | f32*dim w |
+//  [f32*dim g2]).  v1 (no magic: i32 dim | i64 count | (key|w|has_g2|[g2]))
+// still loads — version detection peeks the first 4 bytes.  Saving walks
+// memory AND the disk tier (compaction: dead spill records drop out).
 int pd_table_save(void* table, const char* path) {
   auto* t = static_cast<Table*>(table);
   FILE* f = fopen(path, "wb");
   if (!f) return -1;
+  const char magic[4] = {'P', 'D', 'T', '2'};
+  fwrite(magic, 1, 4, f);
   // The row count cannot be snapshotted up front: a concurrent push may
   // insert keys while shards are written one lock at a time, making the
   // header disagree with the body (truncated/misaligned load).  Write a
@@ -151,16 +516,27 @@ int pd_table_save(void* table, const char* path) {
   fwrite(&t->dim, sizeof(int), 1, f);
   long count_pos = ftell(f);
   fwrite(&count, sizeof(int64_t), 1, f);
+  auto write_row = [&](int64_t key, const Row& row) {
+    fwrite(&key, sizeof(int64_t), 1, f);
+    fwrite(&row.show, sizeof(float), 1, f);
+    fwrite(&row.click, sizeof(float), 1, f);
+    fwrite(&row.unseen, sizeof(int32_t), 1, f);
+    uint8_t has_g2 = row.g2.empty() ? 0 : 1;
+    fwrite(&has_g2, 1, 1, f);
+    fwrite(row.w.data(), sizeof(float), t->dim, f);
+    if (has_g2) fwrite(row.g2.data(), sizeof(float), t->dim, f);
+    ++count;
+  };
   for (int s = 0; s < kNumShards; ++s) {
     std::lock_guard<std::mutex> lk(t->locks[s]);
-    for (auto& kv : t->shards[s]) {
-      fwrite(&kv.first, sizeof(int64_t), 1, f);
-      fwrite(kv.second.w.data(), sizeof(float), t->dim, f);
-      uint8_t has_g2 = kv.second.g2.empty() ? 0 : 1;
-      fwrite(&has_g2, 1, 1, f);
-      if (has_g2)
-        fwrite(kv.second.g2.data(), sizeof(float), t->dim, f);
-      ++count;
+    for (auto& kv : t->shards[s]) write_row(kv.first, kv.second);
+    for (auto& kv : t->disk_index[s]) {
+      Row row;
+      if (!spill_read(t, kv.second.offset, &row)) { fclose(f); return -6; }
+      row.show = kv.second.show;       // index metadata is authoritative
+      row.click = kv.second.click;     // (shrink decays it in place)
+      row.unseen = kv.second.unseen;
+      write_row(kv.first, row);
     }
   }
   if (fseek(f, count_pos, SEEK_SET) != 0) { fclose(f); return -4; }
@@ -176,10 +552,17 @@ int pd_table_load(void* table, const char* path) {
   auto* t = static_cast<Table*>(table);
   FILE* f = fopen(path, "rb");
   if (!f) return -1;
+  char magic[4];
+  if (fread(magic, 1, 4, f) != 4) { fclose(f); return -2; }
+  bool v2 = memcmp(magic, "PDT2", 4) == 0;
   int dim = 0;
   int64_t count = 0;
-  if (fread(&dim, sizeof(int), 1, f) != 1 || dim != t->dim ||
-      fread(&count, sizeof(int64_t), 1, f) != 1) {
+  if (v2) {
+    if (fread(&dim, sizeof(int), 1, f) != 1) { fclose(f); return -2; }
+  } else {
+    memcpy(&dim, magic, 4);  // v1: the first field IS the dim
+  }
+  if (dim != t->dim || fread(&count, sizeof(int64_t), 1, f) != 1) {
     fclose(f);
     return -2;
   }
@@ -187,11 +570,21 @@ int pd_table_load(void* table, const char* path) {
     int64_t key;
     if (fread(&key, sizeof(int64_t), 1, f) != 1) { fclose(f); return -3; }
     Row row;
+    if (v2) {
+      if (fread(&row.show, sizeof(float), 1, f) != 1 ||
+          fread(&row.click, sizeof(float), 1, f) != 1 ||
+          fread(&row.unseen, sizeof(int32_t), 1, f) != 1) {
+        fclose(f);
+        return -3;
+      }
+    }
+    // v2 stores has_g2 before w, v1 after — the w read is shared
+    uint8_t has_g2 = 0;
+    if (v2 && fread(&has_g2, 1, 1, f) != 1) { fclose(f); return -3; }
     row.w.resize(dim);
     if (fread(row.w.data(), sizeof(float), dim, f)
         != static_cast<size_t>(dim)) { fclose(f); return -3; }
-    uint8_t has_g2 = 0;
-    if (fread(&has_g2, 1, 1, f) != 1) { fclose(f); return -3; }
+    if (!v2 && fread(&has_g2, 1, 1, f) != 1) { fclose(f); return -3; }
     if (has_g2) {
       row.g2.resize(dim);
       if (fread(row.g2.data(), sizeof(float), dim, f)
@@ -200,6 +593,13 @@ int pd_table_load(void* table, const char* path) {
     int s = shard_of(key);
     std::lock_guard<std::mutex> lk(t->locks[s]);
     t->shards[s][key] = std::move(row);
+    auto dit = t->disk_index[s].find(key);
+    if (dit != t->disk_index[s].end()) {
+      // loaded copy supersedes the spilled one; its record is now dead
+      t->spill_dead.fetch_add(dit->second.bytes);
+      t->disk_index[s].erase(dit);
+    }
+    maybe_spill(t, s);
   }
   fclose(f);
   return 0;

@@ -53,6 +53,28 @@ def _lib():
         lib.pd_table_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.pd_table_load.restype = ctypes.c_int
         lib.pd_table_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_table_mem_rows.restype = ctypes.c_int64
+        lib.pd_table_mem_rows.argtypes = [ctypes.c_void_p]
+        lib.pd_table_disk_rows.restype = ctypes.c_int64
+        lib.pd_table_disk_rows.argtypes = [ctypes.c_void_p]
+        lib.pd_table_enable_disk.restype = ctypes.c_int
+        lib.pd_table_enable_disk.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64]
+        lib.pd_table_set_ctr.argtypes = [
+            ctypes.c_void_p, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int]
+        lib.pd_table_push_delta.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        lib.pd_table_push_show_click.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64]
+        lib.pd_table_get_meta.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_float)]
+        lib.pd_table_shrink.restype = ctypes.c_int64
+        lib.pd_table_shrink.argtypes = [ctypes.c_void_p]
         lib.pd_table_create._bound = True
     return lib
 
@@ -127,6 +149,68 @@ class SparseTable:
         if rc != 0:
             raise IOError(f"table load failed rc={rc}")
 
+    # ---- SSD tier / CTR accessor / GeoSGD depth --------------------------
+    # (reference ssd_sparse_table.h, ctr_accessor.cc,
+    #  memory_sparse_geo_table.h)
+
+    def enable_disk(self, path, max_mem_rows):
+        """Bound resident rows; cold rows spill to an append-only log at
+        ``path`` and promote back on access (SSD table role)."""
+        rc = self._lib.pd_table_enable_disk(self._h, str(path).encode(),
+                                            int(max_mem_rows))
+        if rc != 0:
+            raise IOError(f"enable_disk failed rc={rc}")
+
+    def set_ctr_accessor(self, nonclk_coeff=0.1, click_coeff=1.0,
+                         show_click_decay_rate=0.98, delete_threshold=0.8,
+                         delete_after_unseen_days=30):
+        """Enable CTR feature-value semantics: show/click stats with decay
+        and score/age-based eviction on :meth:`shrink` (ctr_accessor.cc
+        Shrink/ShowClickScore)."""
+        self._lib.pd_table_set_ctr(
+            self._h, float(nonclk_coeff), float(click_coeff),
+            float(show_click_decay_rate), float(delete_threshold),
+            int(delete_after_unseen_days))
+
+    def push_show_click(self, keys, shows, clicks):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        shows = np.ascontiguousarray(np.asarray(shows, np.float32)
+                                     .reshape(len(keys)))
+        clicks = np.ascontiguousarray(np.asarray(clicks, np.float32)
+                                      .reshape(len(keys)))
+        self._lib.pd_table_push_show_click(
+            self._h, _i64p(keys), _f32p(shows), _f32p(clicks), len(keys))
+
+    def push_delta(self, keys, deltas):
+        """GeoSGD apply: w += delta (no learning rate — trainers already
+        applied their local optimizer)."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(len(keys), self.dim))
+        self._lib.pd_table_push_delta(self._h, _i64p(keys), _f32p(deltas),
+                                      len(keys))
+
+    def get_meta(self, keys):
+        """(show, click, unseen_days) per key; -1 rows for absent keys."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        out = np.empty((len(keys), 3), np.float32)
+        self._lib.pd_table_get_meta(self._h, _i64p(keys), len(keys),
+                                    _f32p(out))
+        return out
+
+    def shrink(self):
+        """One decay+evict cycle; returns evicted row count."""
+        return int(self._lib.pd_table_shrink(self._h))
+
+    def mem_rows(self):
+        return int(self._lib.pd_table_mem_rows(self._h))
+
+    def disk_rows(self):
+        return int(self._lib.pd_table_disk_rows(self._h))
+
 
 class _EmbeddingPull(PyLayer):
     @staticmethod
@@ -175,6 +259,7 @@ class DistributedEmbedding(Layer):
 
 from .service import (  # noqa: E402,F401  (needs SparseTable above)
     DistributedSparseTable,
+    GeoSGDWorker,
     PsClient,
     PsServer,
     register_ps_server,

@@ -56,6 +56,21 @@ def _lib_ps():
         lib.pd_ps_client_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.pd_ps_client_load.restype = ctypes.c_int
         lib.pd_ps_client_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pd_ps_client_push_delta.restype = ctypes.c_int
+        lib.pd_ps_client_push_delta.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        lib.pd_ps_client_push_show_click.restype = ctypes.c_int
+        lib.pd_ps_client_push_show_click.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int64]
+        lib.pd_ps_client_shrink.restype = ctypes.c_int64
+        lib.pd_ps_client_shrink.argtypes = [ctypes.c_void_p]
+        lib.pd_ps_client_stats.restype = ctypes.c_int
+        lib.pd_ps_client_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64)]
         lib.pd_ps_server_start._bound = True
     return lib
 
@@ -146,6 +161,46 @@ class PsClient:
         if rc != 0:
             raise IOError(f"ps load failed rc={rc}")
 
+    def push_delta(self, keys, deltas):
+        """GeoSGD: apply pre-optimized deltas (w += delta) server-side."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(len(keys), self.dim))
+        rc = self._lib.pd_ps_client_push_delta(self._h, _i64p(keys),
+                                               _f32p(deltas), len(keys))
+        if rc != 0:
+            raise IOError(f"ps push_delta failed rc={rc}")
+
+    def push_show_click(self, keys, shows, clicks):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        shows = np.ascontiguousarray(np.asarray(shows, np.float32)
+                                     .reshape(len(keys)))
+        clicks = np.ascontiguousarray(np.asarray(clicks, np.float32)
+                                      .reshape(len(keys)))
+        rc = self._lib.pd_ps_client_push_show_click(
+            self._h, _i64p(keys), _f32p(shows), _f32p(clicks), len(keys))
+        if rc != 0:
+            raise IOError(f"ps push_show_click failed rc={rc}")
+
+    def shrink(self):
+        """Trigger one decay+evict cycle; returns evicted count."""
+        evicted = int(self._lib.pd_ps_client_shrink(self._h))
+        if evicted < 0:
+            raise IOError("ps shrink failed")
+        return evicted
+
+    def stats(self):
+        """(mem_rows, disk_rows) of the remote table."""
+        mem = ctypes.c_int64()
+        disk = ctypes.c_int64()
+        rc = self._lib.pd_ps_client_stats(self._h, ctypes.byref(mem),
+                                          ctypes.byref(disk))
+        if rc != 0:
+            raise IOError(f"ps stats failed rc={rc}")
+        return int(mem.value), int(disk.value)
+
 
 class DistributedSparseTable:
     """SparseTable-compatible facade over key-sharded remote tables.
@@ -222,6 +277,44 @@ class DistributedSparseTable:
 
         list(self._pool.map(one, range(self.num_servers)))
 
+    def push_delta(self, keys, deltas):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(len(keys), self.dim))
+        shards = self._shard(keys)
+
+        def one(i):
+            pos, sub = shards[i]
+            if len(sub):
+                self.clients[i].push_delta(sub, deltas[pos])
+
+        list(self._pool.map(one, range(self.num_servers)))
+
+    def push_show_click(self, keys, shows, clicks):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        shows = np.asarray(shows, np.float32).reshape(len(keys))
+        clicks = np.asarray(clicks, np.float32).reshape(len(keys))
+        shards = self._shard(keys)
+
+        def one(i):
+            pos, sub = shards[i]
+            if len(sub):
+                self.clients[i].push_show_click(sub, shows[pos],
+                                                clicks[pos])
+
+        list(self._pool.map(one, range(self.num_servers)))
+
+    def shrink(self):
+        # full-table scans: fan out so wall-clock is one server's scan
+        counts = list(self._pool.map(lambda c: c.shrink(), self.clients))
+        return sum(counts)
+
+    def stats(self):
+        pairs = list(self._pool.map(lambda c: c.stats(), self.clients))
+        return (sum(p[0] for p in pairs), sum(p[1] for p in pairs))
+
     def save(self, path_prefix):
         """Each server persists its own shard: ``{prefix}.shard{i}``."""
         for i, c in enumerate(self.clients):
@@ -235,6 +328,108 @@ class DistributedSparseTable:
         for c in self.clients:
             c.close()
         self._pool.shutdown(wait=False)
+
+
+class GeoSGDWorker:
+    """Trainer-side async-Geo embedding cache (reference GeoSGD mode:
+    memory_sparse_geo_table.h + the DistributedStrategy a_sync/geo config).
+
+    The trainer trains against a LOCAL replica (fast, no per-step RPC);
+    every ``geo_steps`` pushes the accumulated weight deltas for touched
+    keys to the server (``w_server += w_local - w_base``) on a background
+    thread and refreshes the local replica from the server — so trainers
+    exchange updates asynchronously through the table instead of
+    synchronizing gradients.
+
+    >>> geo = GeoSGDWorker(remote, dim=8, geo_steps=5)
+    >>> rows = geo.pull(keys); geo.push(keys, grads)   # local, fast
+    >>> geo.close()                                    # final flush
+    """
+
+    def __init__(self, remote, dim, geo_steps=10, optimizer="sgd",
+                 learning_rate=0.05):
+        self.remote = remote
+        self.dim = int(dim)
+        self.geo_steps = int(geo_steps)
+        self.local = SparseTable(dim, optimizer=optimizer,
+                                 learning_rate=learning_rate)
+        self._base = {}          # key -> row at last sync
+        self._touched = set()
+        self._steps = 0
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self._pending = None
+
+    def _ensure_local(self, keys):
+        missing = [k for k in np.unique(keys) if k not in self._base]
+        if not missing:
+            return
+        missing = np.asarray(missing, np.int64)
+        remote_rows = self.remote.pull(missing)
+        local_now = self.local.pull(missing)       # materializes init rows
+        self.local.push_delta(missing, remote_rows - local_now)
+        for k, row in zip(missing.tolist(), remote_rows):
+            self._base[k] = row.copy()
+
+    def pull(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        self._ensure_local(keys)
+        return self.local.pull(keys)
+
+    def push(self, keys, grads):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        self._ensure_local(keys)
+        self.local.push(keys, grads)
+        self._touched.update(keys.tolist())
+        self._steps += 1
+        if self._steps % self.geo_steps == 0:
+            self.sync()
+
+    def _drain(self):
+        """Wait out the in-flight sync.  The pending slot is cleared BEFORE
+        ``result()`` can raise, so one failed round-trip surfaces once
+        instead of wedging every later push/sync/close."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
+
+    def sync(self, wait=False):
+        """Push accumulated deltas async; refresh base from the server."""
+        self._drain()
+        if not self._touched:
+            return
+        keys = np.asarray(sorted(self._touched), np.int64)
+        self._touched.clear()
+        local_now = self.local.pull(keys)
+        base = np.stack([self._base[k] for k in keys.tolist()])
+        delta = local_now - base
+
+        def _roundtrip():
+            self.remote.push_delta(keys, delta)
+            # the server absorbed the delta: advance base NOW, so a
+            # failure in the refresh below can never re-push it
+            for k, d in zip(keys.tolist(), delta):
+                self._base[k] = self._base[k] + d
+            fresh = self.remote.pull(keys)
+            # fresh == local_now + other_trainers' updates, so adding
+            # (fresh - local_now) folds the others in WITHOUT clobbering
+            # any local steps taken during this round-trip (row adds are
+            # shard-locked in the C++ table, so this is race-safe)
+            self.local.push_delta(keys, fresh - local_now)
+            for k, row in zip(keys.tolist(), fresh):
+                self._base[k] = row.copy()
+
+        self._pending = self._pool.submit(_roundtrip)
+        if wait:
+            self._drain()
+
+    def close(self):
+        try:
+            self.sync(wait=True)
+            self._drain()
+        finally:
+            self._pool.shutdown(wait=True)
 
 
 # ------------------------------------------------------------- discovery ----
@@ -260,17 +455,24 @@ def wait_ps_endpoints(store, num_servers, timeout=60.0):
 
 def start_ps_server(dim, index, store, port=0, optimizer="adagrad",
                     learning_rate=0.05, init_range=0.01, epsilon=1e-8,
-                    seed=2023):
+                    seed=2023, disk_path=None, max_mem_rows=0,
+                    ctr_accessor=None):
     """Create a table shard + server and register it (server-role helper).
 
     Returns the PsServer; call ``.stop()`` (and destroy the table) on exit.
     Per-shard init seeds mix in the shard index so identical keys on
     different shards (impossible under key%n routing, but cheap insurance)
-    don't collide.
+    don't collide.  ``disk_path``+``max_mem_rows`` enable the SSD overflow
+    tier; ``ctr_accessor`` (a kwargs dict for
+    :meth:`SparseTable.set_ctr_accessor`) enables shrink/eviction.
     """
     table = SparseTable(dim, optimizer=optimizer,
                         learning_rate=learning_rate, init_range=init_range,
                         epsilon=epsilon, seed=seed + index)
+    if disk_path is not None:
+        table.enable_disk(f"{disk_path}.spill{index}", max_mem_rows)
+    if ctr_accessor is not None:
+        table.set_ctr_accessor(**ctr_accessor)
     srv = PsServer(table, port=port)
     register_ps_server(store, index, srv.port)
     return srv

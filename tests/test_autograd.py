@@ -259,11 +259,26 @@ class TestToStaticControlFlowGuard:
     def test_tensor_bool_under_trace_raises_clearly(self):
         from paddle_tpu.jit import to_static
 
+        # early returns in BOTH-return form now convert (round-3
+        # dy2static); the guard still fires for patterns conversion
+        # declines — here a branch that only SOMETIMES returns
         @to_static
-        def f(x):
+        def f(x, flag):
+            if paddle.sum(x) > 0:
+                if flag:
+                    return x * 2
+                x = x + 1
+            return x * 3
+
+        with pytest.raises(TypeError, match="Data-dependent control flow"):
+            f(paddle.to_tensor(np.ones(3, np.float32)), True)
+
+        # and the previously-guarded simple early return now compiles
+        @to_static
+        def g(x):
             if paddle.sum(x) > 0:
                 return x * 2
             return x * 3
 
-        with pytest.raises(TypeError, match="Data-dependent control flow"):
-            f(paddle.to_tensor(np.ones(3, np.float32)))
+        np.testing.assert_allclose(
+            g(paddle.to_tensor(np.ones(3, np.float32))).numpy(), 2.0)

@@ -110,6 +110,182 @@ class TestPsService:
             s.stop()
 
 
+class TestTableDepth:
+    """SSD tier + CTR accessor + GeoSGD (reference ssd_sparse_table.h,
+    ctr_accessor.cc, memory_sparse_geo_table.h)."""
+
+    def test_disk_tier_bounds_memory_on_big_key_stream(self, tmp_path):
+        t = SparseTable(dim=8, optimizer="sgd", learning_rate=0.5,
+                        init_range=0.0, seed=3)
+        t.enable_disk(str(tmp_path / "spill.bin"), max_mem_rows=64)
+        # a key stream far beyond the memory budget (the ">RAM" shape)
+        shadow = {}
+        for lo in range(0, 2000, 100):
+            keys = np.arange(lo, lo + 100, dtype=np.int64)
+            rows = t.pull(keys)
+            np.testing.assert_array_equal(rows, 0.0)  # init_range 0
+            t.push(keys, np.ones((100, 8), np.float32))
+            for k in keys:
+                shadow[k] = shadow.get(k, 0.0) - 0.5
+        assert len(t) == 2000
+        assert t.mem_rows() <= 96, t.mem_rows()   # bounded residency
+        assert t.disk_rows() >= 2000 - 96
+        # spilled rows must promote back with their trained values
+        probe = np.array([0, 500, 1500, 1999], np.int64)
+        got = t.pull(probe)
+        for i, k in enumerate(probe):
+            np.testing.assert_allclose(got[i], shadow[k], rtol=1e-6)
+
+    def test_disk_tier_save_load_roundtrip(self, tmp_path):
+        t = SparseTable(dim=4, optimizer="sgd", learning_rate=1.0,
+                        init_range=0.0, seed=5)
+        t.enable_disk(str(tmp_path / "s.bin"), max_mem_rows=16)
+        keys = np.arange(200, dtype=np.int64)
+        t.pull(keys)
+        t.push(keys, np.full((200, 4), 2.0, np.float32))
+        assert t.disk_rows() > 0
+        t.save(str(tmp_path / "table.bin"))
+        t2 = SparseTable(dim=4, optimizer="sgd", learning_rate=1.0,
+                         init_range=0.0, seed=5)
+        t2.load(str(tmp_path / "table.bin"))
+        assert len(t2) == 200
+        np.testing.assert_allclose(t2.pull(keys), -2.0, rtol=1e-6)
+
+    def test_v1_format_still_loads(self, tmp_path):
+        """Round-2 save files (no magic/metadata) must load under the v2
+        reader — the versioned-artifact compat promise."""
+        import struct
+
+        path = tmp_path / "v1.bin"
+        dim = 4
+        with open(path, "wb") as f:
+            f.write(struct.pack("<i", dim))
+            f.write(struct.pack("<q", 2))
+            for key, val in ((7, 1.5), (9, -2.0)):
+                f.write(struct.pack("<q", key))
+                f.write(struct.pack(f"<{dim}f", *([val] * dim)))
+                f.write(struct.pack("<B", 0))
+        t = SparseTable(dim=dim, init_range=0.0)
+        t.load(str(path))
+        np.testing.assert_allclose(t.pull([7])[0], 1.5)
+        np.testing.assert_allclose(t.pull([9])[0], -2.0)
+
+    def test_spill_log_compacts_instead_of_growing_unbounded(self, tmp_path):
+        """Review regression: thrashing rows between memory and disk must
+        not grow the spill log without bound — dead records trigger
+        compaction once they exceed half the log (and the 1 MiB floor)."""
+        t = SparseTable(dim=64, optimizer="sgd", learning_rate=0.1,
+                        init_range=0.0, seed=21)
+        spill = tmp_path / "thrash.bin"
+        t.enable_disk(str(spill), max_mem_rows=64)
+        keys_a = np.arange(0, 512, dtype=np.int64)
+        keys_b = np.arange(512, 1024, dtype=np.int64)
+        for _ in range(30):  # alternate working sets: constant thrash
+            t.pull(keys_a)
+            t.pull(keys_b)
+        live_bytes = t.disk_rows() * (13 + 64 * 4)
+        assert spill.stat().st_size <= max(3 * live_bytes, 4 << 20), \
+            (spill.stat().st_size, live_bytes)
+        # rows still correct after all that churn
+        np.testing.assert_array_equal(t.pull(np.array([5, 600], np.int64)),
+                                      0.0)
+
+    def test_enable_disk_refused_with_live_spilled_rows(self, tmp_path):
+        t = SparseTable(dim=4, init_range=0.0, seed=23)
+        t.enable_disk(str(tmp_path / "a.bin"), max_mem_rows=16)
+        t.pull(np.arange(100, dtype=np.int64))
+        assert t.disk_rows() > 0
+        with pytest.raises(IOError):
+            t.enable_disk(str(tmp_path / "b.bin"), max_mem_rows=32)
+
+    def test_ctr_accessor_shrink_evicts_by_score_and_age(self):
+        t = SparseTable(dim=4, init_range=0.0, seed=7)
+        t.set_ctr_accessor(nonclk_coeff=0.1, click_coeff=1.0,
+                           show_click_decay_rate=0.5,
+                           delete_threshold=0.4,
+                           delete_after_unseen_days=3)
+        t.pull([1, 2, 3])
+        # key 1: heavy clicks (hot); key 2: shows only (low score);
+        # key 3: nothing (ages out)
+        t.push_show_click([1], [10.0], [8.0])
+        t.push_show_click([2], [2.0], [0.0])
+        evicted = t.shrink()
+        # key2 score: (2*0.5 - 0)*0.1 = 0.1 < 0.4 -> evicted
+        # key3 score: 0 < 0.4 -> evicted; key1 survives
+        assert evicted == 2, evicted
+        meta = t.get_meta([1, 2, 3])
+        assert meta[0, 0] > 0 and meta[0, 2] == 1  # decayed, aged 1
+        assert meta[1, 0] == -1 and meta[2, 0] == -1  # gone
+        # touching key 1 resets its age; untouched it ages out at >3
+        for _ in range(3):
+            t.pull([1])
+            assert t.shrink() in (0, 1)
+        meta1 = t.get_meta([1])
+        if meta1[0, 0] >= 0:  # may have fallen under score threshold
+            assert meta1[0, 2] <= 1
+
+    def test_ctr_shrink_covers_disk_tier(self, tmp_path):
+        t = SparseTable(dim=4, init_range=0.0, seed=9)
+        t.enable_disk(str(tmp_path / "sp.bin"), max_mem_rows=16)
+        t.set_ctr_accessor(delete_threshold=0.5,
+                           delete_after_unseen_days=1000)
+        keys = np.arange(100, dtype=np.int64)
+        t.pull(keys)
+        assert t.disk_rows() > 0
+        # nobody has show/click: one shrink evicts everything, disk too
+        evicted = t.shrink()
+        assert evicted == 100
+        assert len(t) == 0 and t.disk_rows() == 0
+
+    def test_geo_sgd_workers_exchange_updates(self):
+        server = SparseTable(dim=4, optimizer="sgd", init_range=0.0,
+                             seed=13)
+        from paddle_tpu.distributed.ps import GeoSGDWorker
+
+        w1 = GeoSGDWorker(server, dim=4, geo_steps=2, learning_rate=0.5)
+        w2 = GeoSGDWorker(server, dim=4, geo_steps=2, learning_rate=0.5)
+        keys = np.array([42], np.int64)
+        # worker1 pushes grad -1 twice -> local delta +1.0; sync fires
+        w1.push(keys, -np.ones((1, 4), np.float32))
+        w1.push(keys, -np.ones((1, 4), np.float32))
+        w1.sync(wait=True)
+        np.testing.assert_allclose(server.pull(keys)[0], 1.0, rtol=1e-6)
+        # worker2 pulls AFTER worker1's sync: sees the merged value
+        np.testing.assert_allclose(w2.pull(keys)[0], 1.0, rtol=1e-6)
+        # worker2 trains on top and syncs; server accumulates both
+        w2.push(keys, -np.ones((1, 4), np.float32))
+        w2.sync(wait=True)
+        np.testing.assert_allclose(server.pull(keys)[0], 1.5, rtol=1e-6)
+        # worker1 refreshes on its next sync round-trip
+        w1.push(keys, np.zeros((1, 4), np.float32))
+        w1.sync(wait=True)
+        np.testing.assert_allclose(w1.pull(keys)[0], 1.5, rtol=1e-6)
+        w1.close()
+        w2.close()
+
+    def test_service_depth_verbs_roundtrip(self, tmp_path):
+        table = SparseTable(dim=4, optimizer="sgd", init_range=0.0, seed=17)
+        table.enable_disk(str(tmp_path / "srv.bin"), max_mem_rows=16)
+        table.set_ctr_accessor(delete_threshold=0.1,
+                               delete_after_unseen_days=1000)
+        srv = PsServer(table)
+        try:
+            c = PsClient("127.0.0.1", srv.port)
+            keys = np.arange(100, dtype=np.int64)
+            c.pull(keys)
+            mem, disk = c.stats()
+            assert mem + disk == 100 and disk > 0
+            c.push_show_click(keys[:10], np.full(10, 5.0),
+                              np.full(10, 5.0))
+            c.push_delta(keys[:2], np.full((2, 4), 3.0, np.float32))
+            np.testing.assert_allclose(c.pull(keys[:2]), 3.0, rtol=1e-6)
+            evicted = c.shrink()
+            assert evicted == 90  # only the 10 clicked rows survive
+            c.close()
+        finally:
+            srv.stop()
+
+
 def test_wide_deep_two_process_convergence(tmp_path):
     """Launcher-driven 2-rank Wide&Deep: each rank hosts one PS shard and
     trains against the sharded table; losses must drop on both ranks and
@@ -132,9 +308,16 @@ def test_wide_deep_two_process_convergence(tmp_path):
         store = TCPStore(host, int(port), is_master=False, world_size=world)
 
         # every rank hosts one deep shard (index rank) and one wide shard
-        # (index world+rank) — both embedding tables are truly multi-host
+        # (index world+rank) — both embedding tables are truly multi-host.
+        # The deep shard runs the FULL depth stack: disk overflow tier
+        # (tiny memory budget forces eviction mid-training) + CTR accessor.
         srv = start_ps_server(dim=4, index=rank, store=store,
-                              optimizer="adagrad", learning_rate=0.1)
+                              optimizer="adagrad", learning_rate=0.1,
+                              disk_path=os.path.join({str(tmp_path)!r},
+                                                     "deep"),
+                              max_mem_rows=160,
+                              ctr_accessor=dict(delete_threshold=0.0,
+                                                delete_after_unseen_days=99))
         srv_w = start_ps_server(dim=1, index=world + rank, store=store,
                                 optimizer="adagrad", learning_rate=0.1)
         eps = wait_ps_endpoints(store, 2 * world)
@@ -168,13 +351,30 @@ def test_wide_deep_two_process_convergence(tmp_path):
 
         store.barrier(tag="trained")
         if rank == 0:
+            # ~1000 distinct keys against a 160-row budget per shard:
+            # the disk tier must hold the overflow (evict + recover)
+            mem, disk = table.stats()
+            assert disk > 0, (mem, disk)
+            assert mem <= 2 * 160 + 64, (mem, disk)  # bounded residency
             keys = np.arange(50, dtype=np.int64)
-            before = table.pull(keys).copy()
+            before = table.pull(keys).copy()   # promotes any spilled rows
             prefix = os.path.join({str(tmp_path)!r}, "wd_table")
             table.save(prefix)
             table.load(prefix)
             np.testing.assert_allclose(table.pull(keys), before, rtol=1e-6)
         store.barrier(tag="saved")
+        # recovery: another epoch trains fine with rows coming off disk
+        for lo in range(0, 256, 64):
+            ids = paddle.to_tensor(ids_np[lo:lo+64])
+            y = paddle.to_tensor(y_np[lo:lo+64])
+            from paddle_tpu import nn as pnn2
+            logits = model(ids).reshape([-1])
+            loss = pnn2.functional.binary_cross_entropy_with_logits(
+                logits, y)
+            loss.backward()
+            opt.step(); opt.clear_grad()
+        assert float(loss.numpy()) < losses[0]
+        store.barrier(tag="recovered")
         table.close(); wide.close()
         srv.stop(); srv_w.stop()
         print("RANK", rank, "WD OK", losses[0], "->", losses[-1])
