@@ -48,6 +48,33 @@ def _to_host_shards(arr):
     return shards, arr.shape, str(arr.dtype)
 
 
+# On-disk format version (reference op_version_registry.h +
+# program_converter.cc role: old artifacts keep loading after the format
+# evolves).  v1 = round-2 files with no version stamp; v2 adds the stamp.
+# Bump this when the layout changes and register an upgrader for the old
+# version — load runs the chain oldest->current.
+CHECKPOINT_FORMAT_VERSION = 2
+
+_UPGRADERS = {}
+
+
+def register_checkpoint_upgrader(from_version):
+    """Decorator: ``fn(merged) -> merged`` migrating ``from_version`` to
+    ``from_version + 1`` (merged = key -> {shape, dtype, entries})."""
+
+    def deco(fn):
+        _UPGRADERS[int(from_version)] = fn
+        return fn
+
+    return deco
+
+
+@register_checkpoint_upgrader(1)
+def _upgrade_v1_to_v2(merged):
+    # v1 (round 2) has the identical shard layout, only the stamp is new
+    return merged
+
+
 def _serialize_shards(host_items):
     """host_items: dict key -> (shards, shape, dtype).  Returns (meta, blobs)
     — the single definition of the on-disk format."""
@@ -102,6 +129,7 @@ def _write_checkpoint(path, host_items, rank=None):
             if os.path.exists(lf):
                 os.remove(lf)
     meta, blobs = _serialize_shards(host_items)
+    meta["__format_version__"] = CHECKPOINT_FORMAT_VERSION
     if not explicit_rank:
         meta["__world_size__"] = world
     np.savez(os.path.join(path, f"data_rank{rank}.npz"), **blobs)
@@ -141,10 +169,21 @@ def _read_all_ranks(path):
             f"inconsistent checkpoint under {path}: found {len(metas)} rank "
             f"files but metadata declares world size(s) {sorted(worlds, key=str)} "
             "— files from different save epochs are mixed")
+    versions = {m.get("__format_version__", 1) for m, _ in metas}
+    if len(versions) > 1:
+        raise ValueError(
+            f"inconsistent checkpoint under {path}: rank files carry mixed "
+            f"format versions {sorted(versions)}")
+    version = versions.pop()
+    if version > CHECKPOINT_FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint under {path} has format v{version}, newer than "
+            f"this build's v{CHECKPOINT_FORMAT_VERSION} — upgrade "
+            "paddle_tpu to load it")
     merged = {}
     for meta, blobs in metas:
         for key, desc in meta.items():
-            if key == "__world_size__":
+            if key in ("__world_size__", "__format_version__"):
                 continue
             slot = merged.setdefault(
                 key, {"shape": desc["shape"], "dtype": desc["dtype"],
@@ -153,6 +192,13 @@ def _read_all_ranks(path):
                 idx = tuple(tuple(p) for p in entry["offsets"])
                 if idx not in slot["entries"]:  # replicated across ranks
                     slot["entries"][idx] = blobs[entry["file"]]
+    while version < CHECKPOINT_FORMAT_VERSION:
+        upgrader = _UPGRADERS.get(version)
+        if upgrader is None:
+            raise ValueError(
+                f"no upgrade path from checkpoint format v{version}")
+        merged = upgrader(merged)
+        version += 1
     return merged
 
 

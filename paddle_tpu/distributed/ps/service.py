@@ -358,13 +358,19 @@ class GeoSGDWorker:
         self._steps = 0
         self._pool = ThreadPoolExecutor(max_workers=1)
         self._pending = None
+        # the native PsClient matches responses by stream order with no
+        # internal mutex: the trainer thread (_ensure_local pull) and the
+        # background sync round-trip MUST NOT interleave on its socket
+        import threading
+        self._remote_mu = threading.Lock()
 
     def _ensure_local(self, keys):
         missing = [k for k in np.unique(keys) if k not in self._base]
         if not missing:
             return
         missing = np.asarray(missing, np.int64)
-        remote_rows = self.remote.pull(missing)
+        with self._remote_mu:
+            remote_rows = self.remote.pull(missing)
         local_now = self.local.pull(missing)       # materializes init rows
         self.local.push_delta(missing, remote_rows - local_now)
         for k, row in zip(missing.tolist(), remote_rows):
@@ -406,12 +412,14 @@ class GeoSGDWorker:
         delta = local_now - base
 
         def _roundtrip():
-            self.remote.push_delta(keys, delta)
+            with self._remote_mu:
+                self.remote.push_delta(keys, delta)
             # the server absorbed the delta: advance base NOW, so a
             # failure in the refresh below can never re-push it
             for k, d in zip(keys.tolist(), delta):
                 self._base[k] = self._base[k] + d
-            fresh = self.remote.pull(keys)
+            with self._remote_mu:
+                fresh = self.remote.pull(keys)
             # fresh == local_now + other_trainers' updates, so adding
             # (fresh - local_now) folds the others in WITHOUT clobbering
             # any local steps taken during this round-trip (row adds are

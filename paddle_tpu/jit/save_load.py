@@ -16,6 +16,17 @@ import numpy as np
 
 from ..nn.layer_base import Layer
 
+# Artifact format version (reference op_version_registry.h role).
+# v1 = round-2 artifacts: bare pickled state dict, no wrapper.
+# v2 wraps the params file in {"__format_version__", "state"}.
+# Bump + register an upgrader in _STATE_UPGRADERS when the layout changes.
+JIT_FORMAT_VERSION = 2
+
+_STATE_UPGRADERS = {
+    # v1 -> v2: same state-dict layout, only the wrapper is new
+    1: lambda state: state,
+}
+
 
 class TranslatedLayer(Layer):
     """A loaded inference/training layer (reference
@@ -68,7 +79,8 @@ def save(layer, path, input_spec=None, **configs):
     state = {k: np.asarray(v._data)
              for k, v in layer.state_dict().items()}
     with open(path + ".pdiparams", "wb") as f:
-        pickle.dump(state, f)
+        pickle.dump({"__format_version__": JIT_FORMAT_VERSION,
+                     "state": state}, f)
 
 
 def load(path, **configs):
@@ -78,6 +90,22 @@ def load(path, **configs):
     try:
         with open(path + ".pdiparams", "rb") as f:
             state = pickle.load(f)
+        if isinstance(state, dict) and "__format_version__" in state:
+            version = int(state["__format_version__"])
+            state = state["state"]
+        else:
+            version = 1  # round-2 artifact: bare state dict
+        if version > JIT_FORMAT_VERSION:
+            raise ValueError(
+                f"{path}.pdiparams has format v{version}, newer than this "
+                f"build's v{JIT_FORMAT_VERSION} — upgrade paddle_tpu")
+        while version < JIT_FORMAT_VERSION:
+            upgrader = _STATE_UPGRADERS.get(version)
+            if upgrader is None:
+                raise ValueError(
+                    f"no upgrade path from jit.save format v{version}")
+            state = upgrader(state)
+            version += 1
         inner.set_state_dict(state)
     except FileNotFoundError:
         pass

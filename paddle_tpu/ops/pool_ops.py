@@ -130,9 +130,17 @@ def _max_pool_with_index(x, ksize, strides, paddings, nd, adaptive):
 
     init = (jnp.asarray(-jnp.inf, jnp.float32),
             jnp.asarray(flat, jnp.int32))
-    vals, idxs = lax.reduce_window(
-        (x.astype(jnp.float32), idx.astype(jnp.int32)), init, reducer,
-        wdims, wstrides, wpads)
+    # Differentiable values come from a plain max reduce_window; the
+    # paired (value, index) window runs on a stop_gradient copy — the
+    # variadic reduce_window has no VJP rule for a mixed float/int pair
+    # (symbolic-Zero cotangent on the index output breaks its tree),
+    # caught by the round-3 grad sweep.
+    vals = lax.reduce_window(x.astype(jnp.float32),
+                             jnp.asarray(-jnp.inf, jnp.float32), lax.max,
+                             wdims, wstrides, wpads)
+    _, idxs = lax.reduce_window(
+        (lax.stop_gradient(x).astype(jnp.float32), idx.astype(jnp.int32)),
+        init, reducer, wdims, wstrides, wpads)
     return vals.astype(x.dtype), idxs
 
 

@@ -233,7 +233,10 @@ def viterbi_decode(potentials, transition_params, lengths,
 
         def back_step(tag, bp):
             prev = bp[tag]
-            return prev, tag
+            # emit PREV (the tag at step t-1), not the carried tag:
+            # emitting the carry drops path[0] and duplicates the final
+            # tag (caught by the round-3 numpy Viterbi reference)
+            return prev, prev
 
         _, path_rev = lax.scan(back_step, best_last,
                                jnp.flip(backptrs, 0))

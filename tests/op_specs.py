@@ -8,6 +8,7 @@ tested here are ``skip``-listed with the reason.
 
 import numpy as np
 
+import op_refs as R
 from op_sweep_harness import spec, skip
 
 F32 = np.float32
@@ -82,16 +83,17 @@ _unary("sign", np.sign, grad=False)
 import math as _math
 spec("erf", lambda rng: ((_u(rng, (3, 4)),), {}),
      ref=np.vectorize(_math.erf, otypes=[F32]), grad=(0,))
-_unary("digamma", None,
+_unary("digamma", R.digamma_ref,
        make=lambda rng: ((_pos(rng, (3, 4), 0.5, 3.0),), {}))
 _unary("lgamma", np.vectorize(_math.lgamma, otypes=[F32]),
        make=lambda rng: ((_pos(rng, (3, 4), 0.5, 3.0),), {}))
-_unary("erfinv", None, make=lambda rng: ((_u(rng, (3, 4), -0.7, 0.7),), {}))
+spec("erfinv", lambda rng: ((_u(rng, (3, 4), -0.7, 0.7),), {}),
+     check=R.erfinv_check, grad=(0,))
 _unary("i0", np.vectorize(lambda x: float(np.i0(x)), otypes=[F32]))
 _unary("i0e", np.vectorize(lambda x: float(np.i0(x) * np.exp(-abs(x))),
                            otypes=[F32]))
-_unary("i1", None)
-_unary("i1e", None)
+_unary("i1", R.i1_ref)
+_unary("i1e", R.i1e_ref)
 _unary("conj", np.conj, grad=False)
 _unary("angle", np.angle, grad=False)
 _unary("real", np.real, grad=False,
@@ -159,10 +161,10 @@ spec("thresholded_relu", lambda rng: ((_away(_u(rng, (3, 4), -2, 2),
 spec("maxout", lambda rng: ((_u(rng, (2, 4, 3, 3))
                              + np.arange(4, dtype=F32)[None, :, None, None]
                              * 3.0,), {"groups": 2}),
-     ref=None, grad=(0,))
+     ref=R.maxout_ref, grad=(0,))
 spec("prelu", lambda rng: ((_away(_u(rng, (2, 3, 4, 4)), [0.0]),
                             _pos(rng, (3,), 0.1, 0.4)), {}),
-     ref=None, grad=(0, 1))
+     ref=R.prelu_ref, grad=(0, 1))
 spec("logit", lambda rng: ((_u(rng, (3, 4), 0.2, 0.8),), {}),
      ref=lambda x: np.log(x / (1 - x)).astype(F32), grad=(0,))
 
@@ -369,7 +371,7 @@ spec("tril_triu", lambda rng: ((_u(rng, (3, 4)),), {"lower": True}),
 spec("diag", lambda rng: ((_u(rng, (4,)),), {}),
      ref=lambda x: np.diag(x), grad=(0,))
 spec("diag_embed", lambda rng: ((_u(rng, (2, 3)),), {}),
-     ref=None, grad=(0,))
+     ref=R.diag_embed_ref, grad=(0,))
 spec("diagonal", lambda rng: ((_u(rng, (3, 4)),), {}),
      ref=lambda x: np.diagonal(x), grad=(0,))
 spec("trace", lambda rng: ((_u(rng, (3, 4)),), {}),
@@ -454,10 +456,14 @@ spec("strided_slice",
 spec("crop", lambda rng: ((_u(rng, (4, 5)), [2, 3]), {"offsets": [1, 1]}),
      ref=lambda x, **kw: x[1:3, 1:4], grad=(0,))
 spec("pad", lambda rng: ((_u(rng, (1, 2, 3, 3)), [1, 1, 0, 2]), {}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.pad_ref(a[0], a[1]), rtol=1e-6),
+     grad=(0,))
 spec("pad3d", lambda rng: ((_u(rng, (1, 2, 3, 3, 3)),
                             [1, 1, 0, 2, 1, 0]), {}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.pad3d_ref(a[0], a[1]), rtol=1e-6),
+     grad=(0,))
 spec("shape", lambda rng: ((_u(rng, (3, 4)),), {}),
      ref=lambda x: np.array([3, 4]))
 spec("numel", None) if False else None
@@ -492,7 +498,8 @@ spec("one_hot", lambda rng: ((rng.randint(0, 5, (4,)).astype(np.int64), 5),
          r.numpy(), np.eye(5, dtype=F32)[a[0]]))
 spec("shard_index", lambda rng: ((np.array([[1], [6], [11]], np.int64),
                                   12, 3, 0), {}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_array_equal(
+         r.numpy(), R.shard_index_ref(a[0], a[1], a[2], a[3])))
 spec("repeat_interleave", lambda rng: ((_u(rng, (2, 3)), 2), {"axis": 1}),
      ref=lambda x, axis: np.repeat(x, 2, axis=axis), grad=(0,))
 spec("repeat_interleave_with_tensor_index",
@@ -601,9 +608,13 @@ spec("unique_consecutive", lambda rng: ((np.array([1, 1, 2, 2, 3, 1.], F32),),
          (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
          [1, 2, 3, 1], rtol=1e-6))
 spec("unfold", lambda rng: ((_u(rng, (1, 2, 4, 4)), [2, 2]), {}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.unfold_ref(a[0], a[1]), rtol=1e-5),
+     grad=(0,))
 spec("fold", lambda rng: ((_u(rng, (1, 8, 9)), [4, 4], [2, 2]), {}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.fold_ref(a[0], a[1], a[2], (1, 1)), rtol=1e-5),
+     grad=(0,))
 
 # ----------------------------------------------------------------- linalg --
 
@@ -705,14 +716,14 @@ spec("lu_unpack", _lu_unpack_make,
      check=lambda r, a, k: None)
 spec("renorm", lambda rng: ((_u(rng, (3, 4)),),
                             {"p": 2.0, "axis": 0, "max_norm": 1.0}),
-     ref=None, grad=(0,))
+     ref=R.renorm_ref, grad=(0,))
 spec("dist", lambda rng: ((_u(rng, (3, 4)), _u(rng, (3, 4))), {"p": 2.0}),
      ref=lambda x, y, p: np.array(np.linalg.norm((x - y).ravel(), ord=p),
                                   F32), grad=(0, 1))
 spec("spectral_norm",
      lambda rng: ((_u(rng, (4, 5)), _u(rng, (4,)), _u(rng, (5,))),
                   {"power_iters": 2}),
-     ref=None)
+     check=R.spectral_norm_check)
 
 # ------------------------------------------------------------------ losses --
 
@@ -720,9 +731,10 @@ spec("bce_loss", lambda rng: ((_u(rng, (3, 4), 0.1, 0.9),
                                rng.randint(0, 2, (3, 4)).astype(F32)), {}),
      ref=lambda x, y: (-(y * np.log(x) + (1 - y) * np.log(1 - x)))
      .astype(F32), grad=(0,), rtol=1e-4)
-spec("huber_loss", lambda rng: ((_u(rng, (3, 4)), _u(rng, (3, 4))),
+spec("huber_loss", lambda rng: ((_away(_u(rng, (3, 4)), [0.0]),
+                                np.zeros((3, 4), F32)),
                                 {"delta": 1.0}),
-     ref=None, grad=(0,))
+     ref=R.huber_loss_ref, grad=(0,))
 spec("kldiv_loss", lambda rng: ((_u(rng, (3, 4), -2, 0),
                                  _pos(rng, (3, 4), 0.1, 1.0)),
                                 {"reduction": "none"}),
@@ -778,25 +790,25 @@ spec("accuracy", lambda rng: ((_pos(rng, (4, 3)),
                                rng.randint(0, 3, (4, 1)).astype(np.int64),
                                rng.randint(0, 3, (4, 1)).astype(np.int64)),
                               {}),
-     ref=None)
+     check=R.accuracy_check)
 spec("auc", lambda rng: ((_u(rng, (6, 2), 0, 1),
                           rng.randint(0, 2, (6, 1)).astype(np.int64),
                           np.zeros((1, 4096), np.int64),
                           np.zeros((1, 4096), np.int64)), {}),
-     ref=None)
+     check=R.auc_check)
 spec("edit_distance",
      lambda rng: ((np.array([[1, 2, 3, 0]], np.int64),
                    np.array([[1, 3, 3, 2]], np.int64)), {}),
-     ref=None)
+     check=R.edit_distance_check)
 spec("viterbi_decode",
      lambda rng: ((_u(rng, (1, 3, 4)), _u(rng, (4, 4)),
                    np.array([3], np.int64)), {"include_bos_eos_tag": False}),
-     ref=None)
+     check=R.viterbi_decode_check)
 spec("warpctc",
      lambda rng: ((np.log(_pos(rng, (5, 1, 4), 0.1, 1.0)),
                    np.array([[1, 2]], np.int32),
                    np.array([5], np.int64), np.array([2], np.int64)), {}),
-     ref=None, check=None)
+     check=R.warpctc_check, grad=(0,))
 spec("warprnnt",
      lambda rng: ((np.log(_pos(rng, (1, 4, 3, 3), 0.1, 1.0)),
                    np.array([[1, 2]], np.int32),
@@ -821,11 +833,11 @@ spec("batch_norm",
 spec("batch_norm_",
      lambda rng: ((_u(rng, (2, 3, 4, 4)), np.zeros(3, F32), np.ones(3, F32),
                    _pos(rng, (3,)), _u(rng, (3,))), {"is_test": True}),
-     ref=None)
+     check=R.batch_norm_infer_check)
 spec("sync_batch_norm_",
      lambda rng: ((_u(rng, (2, 3, 4, 4)), np.zeros(3, F32), np.ones(3, F32),
                    _pos(rng, (3,)), _u(rng, (3,))), {"is_test": True}),
-     ref=None)
+     check=R.batch_norm_infer_check)
 spec("instance_norm", lambda rng: ((_u(rng, (2, 3, 4, 4)),), {}),
      check=lambda r, a, k: np.testing.assert_allclose(
          r.numpy(),
@@ -833,7 +845,7 @@ spec("instance_norm", lambda rng: ((_u(rng, (2, 3, 4, 4)),), {}),
          / np.sqrt(a[0].var((2, 3), keepdims=True) + 1e-5),
          rtol=1e-4, atol=1e-5))
 spec("group_norm", lambda rng: ((_u(rng, (2, 4, 3, 3)), 2), {}),
-     ref=None, grad=(0,))
+     check=R.group_norm_check, grad=(0,))
 
 # --------------------------------------------------------- optimizer (in-place)
 
@@ -864,16 +876,16 @@ spec("adamw_",
      lambda rng: ((_u(rng, (4,)), _u(rng, (4,)), np.array(0.1, F32),
                    np.zeros(4, F32), np.zeros(4, F32),
                    np.array([0.9], F32), np.array([0.999], F32)), {}),
-     ref=None)
+     check=R.adamw_check)
 spec("adamax_",
      lambda rng: ((_u(rng, (4,)), _u(rng, (4,)), np.array(0.1, F32),
                    np.zeros(4, F32), np.zeros(4, F32),
                    np.array([0.9], F32)), {}),
-     ref=None)
+     check=R.adamax_check)
 spec("adadelta_",
      lambda rng: ((_u(rng, (4,)), _u(rng, (4,)), np.zeros(4, F32),
                    np.zeros(4, F32)), {}),
-     ref=None)
+     check=R.adadelta_check)
 spec("adagrad_",
      lambda rng: ((_u(rng, (4,)), _u(rng, (4,)), np.zeros(4, F32),
                    np.array(0.1, F32)), {}),
@@ -883,31 +895,31 @@ spec("adagrad_",
 spec("rmsprop_",
      lambda rng: ((_u(rng, (4,)), np.zeros(4, F32), _u(rng, (4,)),
                    np.zeros(4, F32), np.array(0.1, F32)), {}),
-     ref=None)
+     check=R.rmsprop_check)
 spec("lamb_",
      lambda rng: ((_u(rng, (4,)), _u(rng, (4,)), np.array(0.1, F32),
                    np.zeros(4, F32), np.zeros(4, F32),
                    np.array([0.9], F32), np.array([0.999], F32)), {}),
-     ref=None)
+     check=R.lamb_check)
 spec("merged_adam_",
      lambda rng: (([_u(rng, (4,))], [_u(rng, (4,))], np.array(0.1, F32),
                    [np.zeros(4, F32)], [np.zeros(4, F32)],
                    [np.array([0.9], F32)], [np.array([0.999], F32)]), {}),
-     ref=None)
+     check=R.merged_adam_check)
 spec("merged_momentum_",
      lambda rng: (([_u(rng, (4,))], [_u(rng, (4,))], [np.zeros(4, F32)],
                    np.array(0.1, F32)), {}),
-     ref=None)
+     check=R.merged_momentum_check)
 spec("fused_adam_",
      lambda rng: (([_u(rng, (4,))], [_u(rng, (4,))], np.array(0.1, F32),
                    [np.zeros(4, F32)], [np.zeros(4, F32)],
                    [np.array([0.9], F32)], [np.array([0.999], F32)]), {}),
-     ref=None)
+     check=R.merged_adam_check)
 spec("average_accumulates_",
      lambda rng: ((_u(rng, (4,)), np.zeros(4, F32), np.zeros(4, F32),
                    np.zeros(4, F32), np.zeros(1, np.int64),
                    np.zeros(1, np.int64), np.zeros(1, np.int64)), {}),
-     ref=None)
+     check=R.average_accumulates_check)
 spec("check_finite_and_unscale_",
      lambda rng: (([_u(rng, (4,)), _u(rng, (3,))], np.array(2.0, F32)), {}),
      check=lambda r, a, k: (
@@ -918,7 +930,7 @@ spec("update_loss_scaling_",
      lambda rng: (([_u(rng, (4,))], np.array(False),
                    np.array(32768.0, F32), np.array([5], np.int32),
                    np.array([0], np.int32)), {}),
-     ref=None)
+     check=R.update_loss_scaling_check)
 spec("clip_by_norm_DUMMY", lambda rng: ((), {})) if False else None
 
 # ---------------------------------------------------------------- random --
@@ -1024,23 +1036,30 @@ spec("weighted_sample_neighbors",
 spec("gather_tree",
      lambda rng: ((rng.randint(0, 5, (3, 2, 2)).astype(np.int64),
                    rng.randint(0, 2, (3, 2, 2)).astype(np.int64)), {}),
-     ref=None)
+     check=R.gather_tree_check)
 
 # ----------------------------------------------------------------- sparse --
 
 spec("sparse_coo_tensor",
      lambda rng: ((np.array([1., 2.], F32),
                    np.array([[0, 1], [1, 0]], np.int64), [2, 2]), {}),
-     ref=None)
+     check=R.sparse_coo_tensor_check)
 spec("coalesce",
      lambda rng: ((np.array([[0, 0], [1, 1]], np.int64),
                    np.array([1., 2.], F32)), {"shape": [2, 2]}),
      ref=None)
 spec("to_sparse_coo", lambda rng: ((np.array([[1, 0], [0, 2.]], F32),),
                                    {"sparse_dim": 2}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         R._dense_from_coo(np.asarray(r[0].numpy()),
+                           np.asarray(r[1].numpy()), a[0].shape),
+         a[0], rtol=1e-6))
 spec("to_sparse_csr", lambda rng: ((np.array([[1, 0], [0, 2.]], F32),), {}),
-     ref=None)
+     check=lambda r, a, k: (
+         np.testing.assert_array_equal(np.asarray(r[0].numpy()), [0, 1, 2]),
+         np.testing.assert_array_equal(np.asarray(r[1].numpy()), [0, 1]),
+         np.testing.assert_allclose(np.asarray(r[2].numpy()), [1.0, 2.0],
+                                    rtol=1e-6))[0])
 spec("to_dense",
      lambda rng: ((np.array([[0, 1], [1, 0]], np.int64),
                    np.array([1., 2.], F32), [2, 2]), {}),
@@ -1049,14 +1068,17 @@ spec("to_dense",
 spec("values",
      lambda rng: ((np.array([[0, 1], [1, 0]], np.int64),
                    np.array([1., 2.], F32)), {}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         np.sort(np.asarray((r if not isinstance(r, (list, tuple))
+                             else r[0]).numpy()).reshape(-1)),
+         np.sort(a[1]), rtol=1e-6))
 spec("masked_matmul",
      lambda rng: ((_u(rng, (3, 4)), _u(rng, (4, 3)),
                    rng.randint(0, 2, (3, 3)).astype(F32)), {}),
-     ref=None)
+     check=R.masked_matmul_check)
 spec("merge_selected_rows",
      lambda rng: ((np.array([1, 1, 2], np.int64), _u(rng, (3, 4))), {}),
-     ref=None)
+     check=R.merge_selected_rows_check)
 
 # ------------------------------------------------------------- conv / pool --
 
@@ -1086,20 +1108,37 @@ spec("conv2d", lambda rng: ((_u(rng, (1, 2, 5, 5)), _u(rng, (3, 2, 3, 3))),
 spec("depthwise_conv2d",
      lambda rng: ((_u(rng, (1, 2, 5, 5)), _u(rng, (2, 1, 3, 3))),
                   {"stride": 1, "padding": 0, "groups": 2}),
-     ref=None, grad=(0, 1))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.depthwise_conv2d_ref(a[0], a[1]),
+         rtol=1e-4, atol=1e-5),
+     grad=(0, 1))
 spec("conv3d", lambda rng: ((_u(rng, (1, 2, 4, 4, 4)),
                              _u(rng, (3, 2, 2, 2, 2))), {}),
-     ref=None, grad=(0, 1))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.conv3d_ref(a[0], a[1]), rtol=1e-4, atol=1e-5),
+     grad=(0, 1))
 spec("conv2d_transpose",
      lambda rng: ((_u(rng, (1, 2, 4, 4)), _u(rng, (2, 3, 3, 3))), {}),
-     ref=None, grad=(0, 1))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.conv2d_transpose_ref(a[0], a[1]),
+         rtol=1e-4, atol=1e-5),
+     grad=(0, 1))
 spec("depthwise_conv2d_transpose",
      lambda rng: ((_u(rng, (1, 2, 4, 4)), _u(rng, (2, 1, 3, 3))),
                   {"groups": 2}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(),
+         np.stack([R.conv2d_transpose_ref(a[0][:, c:c + 1],
+                                          a[1][c:c + 1])[:, 0]
+                   for c in range(a[0].shape[1])], 1),
+         rtol=1e-4, atol=1e-5),
+     grad=(0,))
 spec("conv3d_transpose",
      lambda rng: ((_u(rng, (1, 2, 3, 3, 3)), _u(rng, (2, 2, 2, 2, 2))), {}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.conv3d_transpose_ref(a[0], a[1]),
+         rtol=1e-4, atol=1e-5),
+     grad=(0,))
 spec("deformable_conv",
      lambda rng: ((_u(rng, (1, 2, 5, 5)),
                    _u(rng, (1, 18, 5, 5), -0.1, 0.1),
@@ -1126,7 +1165,10 @@ spec("pool2d", lambda rng: ((_u(rng, (1, 2, 4, 4)), 2),
          r.numpy(), _pool2d_max_ref(a[0], 2, 2), rtol=1e-5), grad=(0,))
 spec("pool3d", lambda rng: ((_u(rng, (1, 2, 4, 4, 4)), 2),
                             {"strides": 2, "pooling_type": "avg"}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
+         R.pool3d_avg_ref(a[0], 2, 2), rtol=1e-5),
+     grad=(0,))
 spec("maxpool", lambda rng: ((_u(rng, (1, 2, 4, 4)), 2), {"strides": 2}),
      check=lambda r, a, k: np.testing.assert_allclose(
          (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
@@ -1138,16 +1180,16 @@ spec("max_pool2d_with_index",
 spec("max_pool3d_with_index",
      lambda rng: ((_u(rng, (1, 1, 4, 4, 4)), [2, 2, 2]),
                   {"strides": [2, 2, 2]}),
-     ref=None)
+     check=R.max_pool3d_with_index_check)
 spec("unpool", lambda rng: ((_u(rng, (1, 1, 2, 2)),
                              np.array([[[[0, 3], [8, 15]]]], np.int64)),
                             {"kernel_size": 2, "strides": 2}),
-     ref=None)
+     check=R.unpool_check)
 spec("unpool3d", lambda rng: ((_u(rng, (1, 1, 2, 2, 2)),
                                np.arange(8).reshape(1, 1, 2, 2, 2)
                                .astype(np.int64) * 8), {"kernel_size": 2,
                                                         "strides": 2}),
-     ref=None)
+     check=R.unpool_check)
 
 # ----------------------------------------------------------- interp / vision
 
@@ -1165,23 +1207,44 @@ spec("nearest_interp", lambda rng: ((_u(rng, (1, 2, 4, 4)),),
          r.numpy(), _nearest_ref(a[0], (8, 8)), rtol=1e-5))
 spec("bilinear_interp", lambda rng: ((_u(rng, (1, 2, 4, 4)),),
                                      {"size": [8, 8]}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.linear_interp_ref(a[0], [8, 8], [2, 3]),
+         rtol=1e-4, atol=1e-5),
+     grad=(0,))
 spec("bicubic_interp", lambda rng: ((_u(rng, (1, 2, 4, 4)),),
                                     {"size": [8, 8]}),
-     ref=None, grad=(0,))
+     # exact-kernel parity is jax-version-specific; pin the invariants:
+     # align_corners=True keeps the four corners exact, and cubic
+     # overshoot stays within Keys-kernel bounds of the input range
+     check=lambda r, a, k: (
+         np.testing.assert_allclose(r.numpy()[..., 0, 0],
+                                    a[0][..., 0, 0], rtol=1e-5),
+         np.testing.assert_allclose(r.numpy()[..., -1, -1],
+                                    a[0][..., -1, -1], rtol=1e-5),
+         np.testing.assert_array_less(np.abs(r.numpy()).max(),
+                                      np.abs(a[0]).max() * 1.6 + 1e-3))[0],
+     grad=(0,))
 spec("trilinear_interp", lambda rng: ((_u(rng, (1, 1, 3, 3, 3)),),
                                       {"size": [6, 6, 6],
                                        "data_format": "NCDHW"}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.linear_interp_ref(a[0], [6, 6, 6], [2, 3, 4]),
+         rtol=1e-4, atol=1e-5),
+     grad=(0,))
 spec("linear_interp", lambda rng: ((_u(rng, (1, 2, 4)),),
                                    {"size": [8], "data_format": "NCW"}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.linear_interp_ref(a[0], [8], [2]),
+         rtol=1e-4, atol=1e-5),
+     grad=(0,))
 spec("grid_sample", lambda rng: ((_u(rng, (1, 2, 4, 4)),
                                   _u(rng, (1, 3, 3, 2), -0.9, 0.9)), {}),
-     ref=None, grad=(0, 1))
+     ref=R.grid_sample_ref, rtol=1e-4, atol=1e-4, grad=(0, 1))
 spec("affine_grid", lambda rng: ((np.array([[[1, 0, 0], [0, 1, 0.]]], F32),
                                   [1, 1, 4, 4]), {}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.affine_grid_ref(a[0], a[1]), rtol=1e-5, atol=1e-6),
+     grad=(0,))
 spec("pixel_shuffle", lambda rng: ((_u(rng, (1, 4, 2, 2)), 2), {}),
      check=lambda r, a, k: list(r.numpy().shape) == [1, 1, 4, 4] and
      np.testing.assert_allclose(r.numpy().sum(), a[0].sum(), rtol=1e-5)
@@ -1224,8 +1287,9 @@ spec("multiclass_nms3",
 spec("box_coder",
      lambda rng: ((np.array([[0, 0, 2, 2.]], F32),
                    np.array([[0.1, 0.1, 0.2, 0.2]], F32),
-                   np.array([[1, 1, 3, 3.]], F32)), {}),
-     ref=None)
+                   np.array([[1, 1, 3, 3.]], F32)),
+                  {"code_type": "decode_center_size"}),
+     check=R.box_coder_decode_check)
 spec("prior_box",
      lambda rng: ((_u(rng, (1, 2, 4, 4)), _u(rng, (1, 3, 16, 16)),
                    [2.0]), {"max_sizes": [4.0]}),
@@ -1279,7 +1343,9 @@ spec("frame", lambda rng: ((_u(rng, (16,)), 4, 2), {}),
      check=lambda r, a, k: np.testing.assert_allclose(
          r.numpy()[:, 0], a[0][:4], rtol=1e-6))
 spec("overlap_add", lambda rng: ((_u(rng, (4, 7)), 2), {}),
-     ref=None, grad=(0,))
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(), R.overlap_add_ref(a[0], a[1]), rtol=1e-5),
+     grad=(0,))
 spec("flash_attn",
      lambda rng: ((_u(rng, (1, 8, 2, 4)), _u(rng, (1, 8, 2, 4)),
                    _u(rng, (1, 8, 2, 4))), {}),
@@ -1296,11 +1362,17 @@ spec("flash_attn_unpadded",
      lambda rng: ((_u(rng, (8, 2, 4)), _u(rng, (8, 2, 4)),
                    _u(rng, (8, 2, 4)), np.array([0, 8], np.int32),
                    np.array([0, 8], np.int32), 8, 8), {}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
+         R.attention_ref(a[0], a[1], a[2]), rtol=1e-3, atol=1e-4),
+     grad=(0, 1, 2))
 spec("memory_efficient_attention",
      lambda rng: ((_u(rng, (1, 8, 2, 4)), _u(rng, (1, 8, 2, 4)),
                    _u(rng, (1, 8, 2, 4))), {}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
+         R.attention_ref_b(a[0], a[1], a[2]), rtol=1e-3, atol=1e-4),
+     grad=(0, 1, 2))
 spec("fused_attention",
      lambda rng: ((_u(rng, (1, 4, 8)), _u(rng, (3, 2, 4, 8)),
                    np.zeros((3, 2, 4), F32), _u(rng, (8, 8)),
@@ -1366,3 +1438,91 @@ skip("npu_identity", "NPU layout passthrough: identity on TPU backend, "
      "no numeric contract beyond assign (tested)")
 skip("coalesce_tensor", "allocator-fusion op: returns fused storage views; "
      "covered structurally by tests/test_api_surfaces.py")
+
+
+# ---------------------------------------------------- grad-coverage pass --
+# Round-3 quality pass: flip analytic-vs-numeric grad checks on for
+# differentiable ops whose specs predate it (the sweep's check_grad runs
+# jax vjp against central differences; indices are the float-array args).
+from op_sweep_harness import SPECS as _SPECS
+
+_GRAD_UPGRADES = {
+    "bilinear": (0, 1, 2), "channel_shuffle": (0,), "cholesky": (0,),
+    "cholesky_solve": (0, 1), "cross_entropy_with_softmax": (0,),
+    "einsum": (1, 2), "embedding": (1,), "flash_attn": (0, 1, 2),
+    "frame": (0,), "gather": (0,), "gather_nd": (0,), "index_add": (0, 3),
+    "index_sample": (0,), "index_select": (0,), "instance_norm": (0,),
+    "kthvalue": (0,), "margin_cross_entropy": (0,),
+    "masked_matmul": (0, 1), "max_pool2d_with_index": (0,),
+    "maxpool": (0,), "nll_loss": (0,), "pixel_shuffle": (0,),
+    "put_along_axis": (0, 2), "repeat_interleave_with_tensor_index": (0,),
+    "scatter": (0, 2), "scatter_nd_add": (0, 2),
+    "send_u_recv": (0,), "send_uv": (0, 1), "slogdet": (0,),
+    "split": (0,), "split_with_num": (0,), "take_along_axis": (0,),
+    "temporal_shift": (0,), "topk": (0,), "triangular_solve": (0, 1),
+    "unbind": (0,), "unstack": (0,), "where": (1, 2),
+    "nearest_interp": (0,), "nanmedian": (0,),
+    "fill_diagonal": (0,), "index_put": (0,),
+    # NOT upgraded: mode (tie-order of equal-count elements makes the
+    # finite-difference probe jump picks), segment_pool (value-dependent
+    # segment count gives a different padded shape under the compile
+    # cache; eager forward ref-check covers the semantics)
+}
+for _n, _g in _GRAD_UPGRADES.items():
+    assert _n in _SPECS, _n
+    _SPECS[_n]["grad"] = _g
+
+
+# ------------------------------------------- finite-only justifications --
+# Specs with neither a numpy reference nor a custom check assert only
+# "runs and returns finite values" in the sweep.  Round-3 discipline:
+# every such op needs a WRITTEN justification here (semantic coverage
+# elsewhere, or an honest statement of what a reference would take).
+# test_op_sweep.test_finite_only_is_justified enforces the partition.
+JUSTIFIED_FINITE_ONLY = {
+    "class_center_sample": "random sampling op: output is a random class "
+        "subset; determinism checked via the rng-threading tests",
+    "coalesce": "exact dense round-trip covered by the sparse suite "
+        "(tests/test_sparse_geometric.py) over real COO inputs",
+    "deformable_conv": "zero-offset == plain conv2d identity asserted in "
+        "tests/test_ops_extended.py::test_deformable_conv_zero_offset_"
+        "equals_conv (the discriminating special case)",
+    "distribute_fpn_proposals": "pure routing op (area -> level binning); "
+        "level-assignment invariants asserted in the vision op tests",
+    "fused_attention": "parity vs the unfused composition asserted in "
+        "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
+    "fused_linear_param_grad_add": "accumulation identity dgrad+=x^T dy "
+        "is exercised end-to-end by the fused-pass training tests",
+    "generate_proposals": "composition of box_coder decode (ref-checked "
+        "above) + nms (exactness tested in test_ops_extended)",
+    "hsigmoid_loss": "path-code tree loss; a numpy ref needs the exact "
+        "default-tree layout — covered functionally by test_api_longtail "
+        "convergence on a small classification task",
+    "matrix_nms": "score-decay variant of nms; suppression ordering "
+        "asserted in the vision tests, exact decay table pending",
+    "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
+        "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
+    "prior_box": "anchor-grid generator; count/normalization invariants "
+        "asserted by the ssd-style vision tests",
+    "psroi_pool": "position-sensitive variant of roi_pool; channel-"
+        "routing invariant asserted in the vision tests",
+    "reindex_graph": "graph index compaction; inverse-mapping invariant "
+        "covered by tests/test_sparse_geometric.py graph suite",
+    "rnn": "multi-layer LSTM/GRU; parity vs the layer API asserted in "
+        "tests/test_models_zoo.py (deepspeech) and nn layer tests",
+    "roi_align": "exact whole-image-mean case asserted in "
+        "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
+    "roi_pool": "max-pool variant of roi_align; shares the box-clipping "
+        "path asserted there",
+    "send_ue_recv": "message-passing with edge weights; aggregation "
+        "parity vs segment_sum covered by the geometric tests",
+    "warprnnt": "RNN-T loss needs a lattice DP reference (heavier than "
+        "CTC's); numeric-range sanity only, flagged as the honest gap",
+    "weighted_sample_neighbors": "random graph sampling; degree/weight "
+        "invariants covered by the geometric sampling tests",
+    "yolo_box": "shape/layout asserted in test_ops_extended.py::"
+        "test_yolo_box_shapes; exact decode shares box_coder's ref-checked "
+        "formula",
+    "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
+        "finite-loss + decreasing-loss covered by the detection tests",
+}

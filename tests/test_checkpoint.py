@@ -38,6 +38,66 @@ def test_sharded_save_load_round_trip(tmp_path):
     np.testing.assert_array_equal(np.asarray(out["b"]), np.ones(3))
 
 
+def test_round2_unversioned_checkpoint_still_loads(tmp_path):
+    """Versioned artifacts (round-3): a round-2 checkpoint — rank files
+    with NO __format_version__ stamp — must load via the v1->v2 upgrade
+    chain, and a future version must be refused with a clear error."""
+    import json
+
+    import pytest
+
+    path = str(tmp_path / "old_ckpt")
+    os.makedirs(path)
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    # handcraft the round-2 layout: meta without a version stamp
+    np.savez(os.path.join(path, "data_rank0.npz"), shard_0=w)
+    with open(os.path.join(path, "meta_rank0.json"), "w") as f:
+        json.dump({"w": {"shape": [3, 4], "dtype": "float32",
+                         "shards": [{"offsets": [[0, 3], [0, 4]],
+                                     "file": "shard_0"}]},
+                   "__world_size__": 1}, f)
+    out = load_state_dict(path)
+    np.testing.assert_array_equal(np.asarray(out["w"]), w)
+
+    # new saves carry the stamp
+    path2 = str(tmp_path / "new_ckpt")
+    save_state_dict({"w": w}, path2)
+    with open(os.path.join(path2, "meta_rank0.json")) as f:
+        assert json.load(f)["__format_version__"] >= 2
+
+    # a checkpoint from the future is refused, not mis-parsed
+    with open(os.path.join(path2, "meta_rank0.json")) as f:
+        meta = json.load(f)
+    meta["__format_version__"] = 99
+    with open(os.path.join(path2, "meta_rank0.json"), "w") as f:
+        json.dump(meta, f)
+    with pytest.raises(ValueError, match="newer"):
+        load_state_dict(path2)
+
+
+def test_round2_jit_save_artifact_still_loads(tmp_path):
+    """jit.save params format v1 (bare pickled state dict) loads under the
+    v2 reader."""
+    import pickle
+
+    from paddle_tpu import jit, nn
+
+    paddle.seed(0)
+    model = nn.Linear(4, 2)
+    prefix = str(tmp_path / "m")
+    jit.save(model, prefix)
+    # rewrite the params file in the round-2 (v1) layout
+    with open(prefix + ".pdiparams", "rb") as f:
+        wrapped = pickle.load(f)
+    assert wrapped["__format_version__"] >= 2
+    with open(prefix + ".pdiparams", "wb") as f:
+        pickle.dump(wrapped["state"], f)
+    loaded = jit.load(prefix)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(),
+                               rtol=1e-6)
+
+
 def test_reshard_on_load(tmp_path):
     """Save row-sharded over 8; load column-sharded over 2x4 — Converter
     parity."""
