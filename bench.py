@@ -41,7 +41,20 @@ def _run_worker(timeout, cpu=False):
             [sys.executable, os.path.abspath(__file__), "--worker"],
             env=env, cwd=_REPO_DIR, timeout=timeout,
             capture_output=True, text=True)
-    except subprocess.TimeoutExpired:
+    except subprocess.TimeoutExpired as e:
+        # the worker prints the primary JSON line BEFORE the secondary
+        # llama config runs — salvage it if the hang came later
+        partial = (e.stdout or b"")
+        if isinstance(partial, bytes):
+            partial = partial.decode(errors="replace")
+        for line in reversed(partial.strip().splitlines()):
+            line = line.strip()
+            if line.startswith("{") and line.endswith("}"):
+                try:
+                    json.loads(line)
+                    return line, None
+                except ValueError:
+                    continue
         return None, f"worker timed out after {timeout}s (cpu={cpu})"
     for line in reversed(proc.stdout.strip().splitlines()):
         line = line.strip()
@@ -156,35 +169,7 @@ def main():
                          max_position_embeddings=128)
         steps, warmup = 5, 2
 
-    n_params = sum(p.size for p in model.parameters())
-    opt = optimizer.AdamW(learning_rate=1e-4,
-                          parameters=model.parameters())
-    step = TrainStep(model, lambda logits, labels: model.loss(logits, labels),
-                     opt)
-
-    rng = np.random.RandomState(0)
-    vocab = model.config.vocab_size
-    ids = paddle.to_tensor(
-        rng.randint(0, vocab, (cfg["batch"], cfg["seq"])).astype(np.int32))
-    labels = paddle.to_tensor(
-        rng.randint(0, vocab, (cfg["batch"], cfg["seq"])).astype(np.int32))
-
-    for _ in range(warmup):
-        loss = step(ids, labels)
-    float(loss.numpy())  # sync
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step(ids, labels)
-    final = float(loss.numpy())  # sync
-    dt = time.perf_counter() - t0
-
-    tokens_per_step = cfg["batch"] * cfg["seq"]
-    tok_s = tokens_per_step * steps / dt
-    flops_per_token = 6.0 * n_params
-    mfu = tok_s * flops_per_token / peak_flops_per_chip()
-
-    assert np.isfinite(final), "loss diverged during bench"
+    tok_s, mfu = _measure(model, cfg, steps, warmup, seed=0)
     out = {
         "metric": "gpt124m_train_tokens_per_sec_per_chip" if on_tpu
         else "gpt_tiny_cpu_tokens_per_sec",
@@ -194,7 +179,66 @@ def main():
     }
     if init_note:
         out["error"] = init_note
-    print(json.dumps(out))
+    # Print the primary result NOW: if the secondary llama config wedges
+    # past the worker timeout, the parent salvages this line instead of
+    # discarding the whole measurement.
+    print(json.dumps(out), flush=True)
+
+    # Second measured config: Llama-family decoder (RoPE/GQA/SwiGLU) —
+    # the parent takes the LAST valid JSON line, so re-emit the combined
+    # record (extra fields; the driver reads metric/value)
+    try:
+        from paddle_tpu.models.llama import llama_160m, llama_tiny
+
+        paddle.seed(1)
+        if on_tpu:
+            lmodel = paddle.amp.decorate(llama_160m(), level="O2",
+                                         dtype="bfloat16")
+            lcfg, lsteps, lwarm = dict(batch=8, seq=512), 10, 2
+        else:
+            lmodel = llama_tiny()
+            lcfg, lsteps, lwarm = dict(batch=4, seq=64), 3, 1
+        ltok_s, lmfu = _measure(lmodel, lcfg, lsteps, lwarm, seed=1)
+        out.update({
+            "llama_metric": "llama160m_train_tokens_per_sec_per_chip"
+            if on_tpu else "llama_tiny_cpu_tokens_per_sec",
+            "llama_value": round(ltok_s, 1),
+            "llama_vs_baseline": round(lmfu / 0.45, 4),
+        })
+    except Exception as e:  # secondary config must never kill the line
+        out["llama_error"] = str(e)[:200]
+    print(json.dumps(out), flush=True)
+
+
+def _measure(model, cfg, steps, warmup, seed):
+    """Shared measurement scaffold: warmup, synced timed loop, MFU."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.jit import TrainStep
+
+    n_params = sum(p.size for p in model.parameters())
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = TrainStep(model,
+                     lambda logits, labels: model.loss(logits, labels), opt)
+    rng = np.random.RandomState(seed)
+    vocab = model.config.vocab_size
+    ids = paddle.to_tensor(
+        rng.randint(0, vocab, (cfg["batch"], cfg["seq"])).astype(np.int32))
+    labels = paddle.to_tensor(
+        rng.randint(0, vocab, (cfg["batch"], cfg["seq"])).astype(np.int32))
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    float(loss.numpy())  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(ids, labels)
+    final = float(loss.numpy())  # sync
+    dt = time.perf_counter() - t0
+    assert np.isfinite(final), "loss diverged during bench"
+    tok_s = cfg["batch"] * cfg["seq"] * steps / dt
+    mfu = tok_s * 6.0 * n_params / peak_flops_per_chip()
+    return tok_s, mfu
 
 
 if __name__ == "__main__":

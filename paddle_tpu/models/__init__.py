@@ -10,6 +10,14 @@ from .gpt import (  # noqa: F401
     gpt_1_3b,
     gpt_6_7b,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama_tiny,
+    llama_160m,
+    llama_7b,
+)
 from .wide_deep import WideDeep  # noqa: F401
 from .deepfm import DeepFM  # noqa: F401
 from .deepspeech import DeepSpeech2, deepspeech2_tiny  # noqa: F401

@@ -1,0 +1,398 @@
+"""Llama family — RMSNorm + RoPE + GQA + SwiGLU decoder.
+
+Reference: the PaddleNLP-style llama modeling the reference ecosystem
+trains with fleet hybrid parallelism (same role as models/gpt.py's
+reference, test/collective/fleet hybrid models).  TPU-first details
+mirror gpt.py: attention runs the Pallas flash kernel in [B, T, N, H]
+layout (KV heads broadcast to query heads for training — XLA fuses the
+expand), TP comes from the mpu layers' sharding metadata, and
+``functional_decompose()`` produces the stacked-layer pure functions the
+pipelined SPMD trainer shards over 'pp'.  Single-token generation uses
+the ragged GQA decode kernel (ops/pallas/decode_attention_kernel.py)
+against a preallocated KV cache.
+"""
+
+import math
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+from ..distributed.fleet.meta_parallel.mp_layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from ..nn import functional as F
+from ..nn.initializer import Normal
+from ..nn.layer_base import ParamAttr
+from ..ops.registry import op
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=32000, hidden_size=768, num_layers=12,
+                 num_attention_heads=12, num_key_value_heads=None,
+                 intermediate_size=None, max_position_embeddings=2048,
+                 rope_theta=10000.0, rms_norm_eps=1e-6,
+                 initializer_range=0.02, sequence_parallel=False,
+                 tie_word_embeddings=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_attention_heads = num_attention_heads
+        self.num_key_value_heads = num_key_value_heads or num_attention_heads
+        # llama MLP sizing: 2/3 * 4h rounded to a multiple of 256
+        if intermediate_size is None:
+            intermediate_size = int(8 * hidden_size / 3)
+            intermediate_size = 256 * ((intermediate_size + 255) // 256)
+        self.intermediate_size = intermediate_size
+        self.max_position_embeddings = max_position_embeddings
+        self.rope_theta = rope_theta
+        self.rms_norm_eps = rms_norm_eps
+        self.initializer_range = initializer_range
+        self.sequence_parallel = sequence_parallel
+        self.tie_word_embeddings = tie_word_embeddings
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _rope_tables(head_dim, max_len, theta):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_len)
+    freqs = np.outer(t, inv)  # [T, D/2]
+    return (np.cos(freqs).astype(np.float32),
+            np.sin(freqs).astype(np.float32))
+
+
+def _apply_rope(x, cos, sin):
+    """x [B, T, N, D]; cos/sin [T, D/2] (llama half-split convention)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+@op("llama_rope")
+def _rope_op(q, k, cos, sin):
+    return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        proj_init = ParamAttr(initializer=Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.head_dim
+        kv_out = self.num_kv_heads * self.head_dim
+        # packed q + k + v projection (column-parallel over heads)
+        self.qkv = ColumnParallelLinear(h, h + 2 * kv_out, weight_attr=init,
+                                        has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(h, h, weight_attr=proj_init,
+                                        has_bias=False,
+                                        input_is_parallel=True)
+        cos, sin = _rope_tables(self.head_dim,
+                                config.max_position_embeddings,
+                                config.rope_theta)
+        self._cos, self._sin = jnp.asarray(cos), jnp.asarray(sin)
+
+    def forward(self, x):
+        b, t, _ = x.shape
+        nq, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        qkv = self.qkv(x)
+        q = qkv[:, :, :nq * hd].reshape([b, t, nq, hd])
+        k = qkv[:, :, nq * hd:(nq + nkv) * hd].reshape([b, t, nkv, hd])
+        v = qkv[:, :, (nq + nkv) * hd:].reshape([b, t, nkv, hd])
+        q, k = _rope_op(q, k, Tensor(self._cos[:t]),
+                        Tensor(self._sin[:t]))
+        if nkv != nq:
+            # GQA: broadcast kv heads to query heads for the training
+            # kernel (XLA fuses the expand; decode uses the native GQA
+            # kernel instead)
+            rep = nq // nkv
+            k = k.reshape([b, t, nkv, 1, hd]).expand(
+                [b, t, nkv, rep, hd]).reshape([b, t, nq, hd])
+            v = v.reshape([b, t, nkv, 1, hd]).expand(
+                [b, t, nkv, rep, hd]).reshape([b, t, nq, hd])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self.o_proj(out.reshape([b, t, nq * hd]))
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        h = config.hidden_size
+        init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        proj_init = ParamAttr(initializer=Normal(
+            0.0, config.initializer_range / math.sqrt(2 * config.num_layers)))
+        inter = config.intermediate_size
+        # packed gate+up (column-parallel), down (row-parallel)
+        self.gate_up = ColumnParallelLinear(h, 2 * inter, weight_attr=init,
+                                            has_bias=False,
+                                            gather_output=False)
+        self.down = RowParallelLinear(inter, h, weight_attr=proj_init,
+                                      has_bias=False,
+                                      input_is_parallel=True)
+        self._inter = inter
+
+    def forward(self, x):
+        gu = self.gate_up(x)
+        gate = gu[:, :, :self._inter]
+        up = gu[:, :, self._inter:]
+        from ..incubate.nn.functional import swiglu
+        return self.down(swiglu(gate, up))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_norm_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(
+            config.hidden_size, epsilon=config.rms_norm_eps)
+        self.mlp = LlamaMLP(config)
+        self.sequence_parallel = config.sequence_parallel
+
+    def forward(self, x):
+        if self.sequence_parallel:
+            from ..distributed.fleet.meta_parallel import \
+                mark_sequence_sharded
+            x._data = mark_sequence_sharded(x._data, axis="mp", seq_dim=1)
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        init = ParamAttr(initializer=Normal(0.0, config.initializer_range))
+        self.embed_tokens = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=init)
+        self.layers = nn.LayerList([LlamaBlock(config)
+                                    for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for blk in self.layers:
+            x = blk(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    """Llama with (by default untied) LM head; same decompose contract as
+    GPTForCausalLM so SpmdTrainStep/bench share one code path."""
+
+    def __init__(self, config):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            init = ParamAttr(initializer=Normal(
+                0.0, config.initializer_range))
+            self.lm_head = ColumnParallelLinear(
+                config.hidden_size, config.vocab_size, weight_attr=init,
+                has_bias=False, gather_output=True)
+
+    def forward(self, input_ids):
+        hidden = self.llama(input_ids)
+        if self.lm_head is None:
+            w = self.llama.embed_tokens.weight
+            return F.linear(hidden, w.T)
+        return self.lm_head(hidden)
+
+    def loss(self, logits, labels):
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            shift_logits.reshape([-1, logits.shape[-1]]),
+            shift_labels.reshape([-1]))
+
+    # ---- functional decomposition (SpmdTrainStep contract) ----
+    def functional_decompose(self):
+        from ..jit import functional_call
+
+        embed = self.llama.embed_tokens
+        blocks = list(self.llama.layers)
+        template = blocks[0]
+        norm = self.llama.norm
+
+        embed_params = {k: v._data for k, v in embed.state_dict().items()}
+        head_params = {"norm." + k: v._data
+                       for k, v in norm.state_dict().items()}
+        if self.lm_head is not None:
+            head_params.update({"lm_head." + k: v._data for k, v in
+                                self.lm_head.state_dict().items()})
+        names = list(template.state_dict().keys())
+        stacked = {n: jnp.stack([blk.state_dict()[n]._data
+                                 for blk in blocks]) for n in names}
+
+        def axes_of(sd, name):
+            return getattr(sd[name], "mesh_axes", None)
+
+        embed_specs = {k: axes_of(embed.state_dict(), k)
+                       for k in embed_params}
+        head_specs = {k: None for k in head_params}
+        if self.lm_head is not None:
+            lm_sd = self.lm_head.state_dict()
+            for k in lm_sd:
+                head_specs["lm_head." + k] = axes_of(lm_sd, k)
+        tsd = template.state_dict()
+        block_specs = {}
+        for n in names:
+            axes = getattr(tsd[n], "mesh_axes", None) or \
+                (None,) * len(tsd[n].shape)
+            block_specs[n] = ("pp",) + tuple(axes)
+
+        def embed_fn(p, input_ids):
+            return functional_call(embed, p, Tensor(input_ids))
+
+        def block_fn(p, hidden):
+            return functional_call(template, p, Tensor(hidden))
+
+        lm_head = self.lm_head
+
+        def head_fn(p, hidden, embed_p):
+            np_ = {k[len("norm."):]: v for k, v in p.items()
+                   if k.startswith("norm.")}
+            h = functional_call(norm, np_, Tensor(hidden))
+            if lm_head is None:
+                return h @ embed_p["weight"].T
+            hp = {k[len("lm_head."):]: v for k, v in p.items()
+                  if k.startswith("lm_head.")}
+            return functional_call(lm_head, hp, Tensor(h))
+
+        def loss_fn(logits, labels):
+            # same shifted-CE as GPTForCausalLM.functional_decompose —
+            # one cross_entropy implementation across the zoo
+            shift_logits = logits[:, :-1, :].reshape((-1, logits.shape[-1]))
+            shift_labels = labels[:, 1:].reshape((-1,))
+            loss = F.cross_entropy(Tensor(shift_logits),
+                                   Tensor(shift_labels))
+            return loss._data
+
+        return {
+            "params": {"embed": embed_params, "blocks": stacked,
+                       "head": head_params},
+            "specs": {"embed": embed_specs, "blocks": block_specs,
+                      "head": head_specs},
+            "fns": (embed_fn, block_fn, head_fn, loss_fn),
+            "num_layers": len(blocks),
+        }
+
+    # ---- KV-cache decode (exercises the ragged GQA decode kernel) ----
+    def init_cache(self, batch, max_len):
+        cfg = self.config
+        shape = (batch, max_len, cfg.num_key_value_heads, cfg.head_dim)
+        return {"k": [jnp.zeros(shape, jnp.float32)
+                      for _ in range(cfg.num_layers)],
+                "v": [jnp.zeros(shape, jnp.float32)
+                      for _ in range(cfg.num_layers)],
+                "lengths": jnp.zeros((batch,), jnp.int32)}
+
+    def decode_step(self, input_ids, cache, interpret=False):
+        """One-token decode using the ragged GQA decode kernel per layer.
+
+        input_ids [B, 1]; returns (logits [B, vocab], cache).  The dense
+        train path broadcasts KV heads; here the native GQA kernel reads
+        the compact [B, S, Nkv, D] cache directly.
+
+        The cache is updated IN PLACE (its k/v buffers and lengths) and
+        also returned — callers branching a decode (beam search) must
+        deep-copy it first.  Decoding past the cache's max_len or the
+        rope table would silently clamp/drop (jax scatter semantics), so
+        it raises instead.
+        """
+        from ..incubate.nn.functional import ragged_decode_attention
+
+        cfg = self.config
+        b = input_ids.shape[0]
+        pos = cache["lengths"]  # [B]
+        max_len = cache["k"][0].shape[1]
+        if not isinstance(pos, jax.core.Tracer):
+            hi = int(jnp.max(pos))
+            if hi >= max_len or hi >= cfg.max_position_embeddings:
+                raise ValueError(
+                    f"decode position {hi} exceeds cache max_len "
+                    f"{max_len} / max_position_embeddings "
+                    f"{cfg.max_position_embeddings} — grow init_cache")
+        x = self.llama.embed_tokens(input_ids)  # [B, 1, H]
+        for li, blk in enumerate(self.llama.layers):
+            attn = blk.self_attn
+            h_in = blk.input_layernorm(x)
+            nq, nkv, hd = attn.num_heads, attn.num_kv_heads, attn.head_dim
+            qkv = attn.qkv(h_in)
+            q = qkv[:, :, :nq * hd].reshape([b, 1, nq, hd])
+            k = qkv[:, :, nq * hd:(nq + nkv) * hd].reshape([b, 1, nkv, hd])
+            v = qkv[:, :, (nq + nkv) * hd:].reshape([b, 1, nkv, hd])
+            # rope at the current position (per-sequence)
+            cos = jnp.take(attn._cos, pos, axis=0)[:, None, None, :]
+            sin = jnp.take(attn._sin, pos, axis=0)[:, None, None, :]
+            d2 = hd // 2
+
+            def rope1(t_):
+                t1, t2 = t_[..., :d2], t_[..., d2:]
+                return jnp.concatenate(
+                    [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1)
+
+            qd = rope1(q._data)
+            kd = rope1(k._data)
+            kc = cache["k"][li]
+            vc = cache["v"][li]
+            idx = (jnp.arange(b), pos)
+            kc = kc.at[idx].set(kd[:, 0])
+            vc = vc.at[idx].set(v._data[:, 0])
+            cache["k"][li], cache["v"][li] = kc, vc
+            out = ragged_decode_attention(
+                Tensor(qd[:, 0]), Tensor(kc), Tensor(vc),
+                Tensor(pos + 1), interpret=interpret)  # [B, Nq, D]
+            attn_out = attn.o_proj(out.reshape([b, 1, nq * hd]))
+            x = x + attn_out
+            x = x + blk.mlp(blk.post_attention_layernorm(x))
+        h = self.llama.norm(x)
+        if self.lm_head is None:
+            w = self.llama.embed_tokens.weight
+            logits = F.linear(h, w.T)
+        else:
+            logits = self.lm_head(h)
+        cache["lengths"] = pos + 1
+        return logits[:, 0], cache
+
+
+def llama_tiny(**kw):
+    cfg = dict(vocab_size=128, hidden_size=64, num_layers=4,
+               num_attention_heads=4, num_key_value_heads=2,
+               max_position_embeddings=64)
+    cfg.update(kw)
+    return LlamaForCausalLM(LlamaConfig(**cfg))
+
+
+def llama_160m(**kw):
+    cfg = dict(vocab_size=32000, hidden_size=768, num_layers=12,
+               num_attention_heads=12, num_key_value_heads=4,
+               max_position_embeddings=2048)
+    cfg.update(kw)
+    return LlamaForCausalLM(LlamaConfig(**cfg))
+
+
+def llama_7b(**kw):
+    cfg = dict(vocab_size=32000, hidden_size=4096, num_layers=32,
+               num_attention_heads=32, num_key_value_heads=32,
+               max_position_embeddings=4096)
+    cfg.update(kw)
+    return LlamaForCausalLM(LlamaConfig(**cfg))
