@@ -1,0 +1,238 @@
+// Graph table — server-side graph storage + neighbor sampling.
+//
+// Role parity with the reference GraphPS
+// (paddle/fluid/distributed/ps/table/common_graph_table.h: add_graph_node /
+// add edges, random_sample_neighbors with optional edge weights, served
+// over brpc).  Design here is new: per-node adjacency vectors in sharded
+// hash maps (same sharding/locking scheme as sparse_table.cc), weighted
+// sampling without replacement via the exponential-sort trick
+// (key = -log(u)/w, take the k smallest), deterministic from a per-call
+// splitmix64 stream so distributed runs reproduce.
+#include "paddle_native.h"
+
+#include <math.h>
+#include <stdio.h>
+#include <string.h>
+
+#include <algorithm>
+#include <atomic>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kGraphShards = 16;
+
+struct Adj {
+  std::vector<int64_t> nbrs;
+  std::vector<float> weights;  // empty = unweighted
+};
+
+struct Graph {
+  uint64_t seed;
+  uint64_t sample_counter = 0;
+  // updated under DIFFERENT per-shard locks concurrently: must be atomic
+  std::atomic<int64_t> num_edges{0};
+  std::unordered_map<int64_t, Adj> shards[kGraphShards];
+  std::mutex locks[kGraphShards];
+};
+
+inline int gshard(int64_t key) {
+  return static_cast<uint64_t>(key) % kGraphShards;
+}
+
+inline uint64_t mix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+inline double u01(uint64_t state) {
+  return ((state >> 11) + 1.0) * (1.0 / 9007199254740993.0);  // (0,1)
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pd_graph_create(uint64_t seed) {
+  auto* g = new Graph;
+  g->seed = seed;
+  return g;
+}
+
+void pd_graph_destroy(void* graph) { delete static_cast<Graph*>(graph); }
+
+// directed edges src->dst; weights may be NULL (uniform sampling)
+void pd_graph_add_edges(void* graph, const int64_t* src, const int64_t* dst,
+                        const float* weights, int64_t n) {
+  auto* g = static_cast<Graph*>(graph);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = gshard(src[i]);
+    std::lock_guard<std::mutex> lk(g->locks[s]);
+    Adj& a = g->shards[s][src[i]];
+    a.nbrs.push_back(dst[i]);
+    if (weights) {
+      if (a.weights.size() != a.nbrs.size() - 1)
+        a.weights.resize(a.nbrs.size() - 1, 1.0f);  // mixed: backfill 1.0
+      a.weights.push_back(weights[i]);
+    } else if (!a.weights.empty()) {
+      a.weights.push_back(1.0f);
+    }
+    g->num_edges.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+int64_t pd_graph_num_nodes(void* graph) {
+  auto* g = static_cast<Graph*>(graph);
+  int64_t n = 0;
+  for (int s = 0; s < kGraphShards; ++s) {
+    std::lock_guard<std::mutex> lk(g->locks[s]);
+    n += static_cast<int64_t>(g->shards[s].size());
+  }
+  return n;
+}
+
+int64_t pd_graph_num_edges(void* graph) {
+  return static_cast<Graph*>(graph)->num_edges.load();
+}
+
+void pd_graph_degrees(void* graph, const int64_t* nodes, int64_t n,
+                      int64_t* out) {
+  auto* g = static_cast<Graph*>(graph);
+  for (int64_t i = 0; i < n; ++i) {
+    int s = gshard(nodes[i]);
+    std::lock_guard<std::mutex> lk(g->locks[s]);
+    auto it = g->shards[s].find(nodes[i]);
+    out[i] = it == g->shards[s].end()
+                 ? 0
+                 : static_cast<int64_t>(it->second.nbrs.size());
+  }
+}
+
+// Sample up to k neighbors per node WITHOUT replacement (weighted when
+// edge weights exist).  out_nbrs [n*k] padded with -1; out_counts [n].
+// Deterministic in (graph seed, per-table sample counter, node id).
+void pd_graph_sample_neighbors(void* graph, const int64_t* nodes, int64_t n,
+                               int k, int64_t* out_nbrs,
+                               int64_t* out_counts) {
+  auto* g = static_cast<Graph*>(graph);
+  uint64_t call = __atomic_fetch_add(&g->sample_counter, 1, __ATOMIC_RELAXED);
+  for (int64_t i = 0; i < n * k; ++i) out_nbrs[i] = -1;
+  for (int64_t i = 0; i < n; ++i) {
+    int s = gshard(nodes[i]);
+    std::lock_guard<std::mutex> lk(g->locks[s]);
+    auto it = g->shards[s].find(nodes[i]);
+    if (it == g->shards[s].end()) {
+      out_counts[i] = 0;
+      continue;
+    }
+    const Adj& a = it->second;
+    int64_t deg = static_cast<int64_t>(a.nbrs.size());
+    if (deg <= k) {
+      for (int64_t j = 0; j < deg; ++j) out_nbrs[i * k + j] = a.nbrs[j];
+      out_counts[i] = deg;
+      continue;
+    }
+    // exponential-sort weighted sampling without replacement:
+    // key_j = -log(u_j) / w_j; the k SMALLEST keys win
+    std::vector<std::pair<double, int64_t>> keys(deg);
+    uint64_t base = mix64(g->seed ^ mix64(call) ^
+                          static_cast<uint64_t>(nodes[i]));
+    for (int64_t j = 0; j < deg; ++j) {
+      base = mix64(base);
+      double w = a.weights.empty() ? 1.0
+                                   : std::max(1e-12f, a.weights[j]);
+      keys[j] = {-log(u01(base)) / w, a.nbrs[j]};
+    }
+    std::nth_element(keys.begin(), keys.begin() + k, keys.end());
+    for (int j = 0; j < k; ++j) out_nbrs[i * k + j] = keys[j].second;
+    out_counts[i] = k;
+  }
+}
+
+// Binary format: magic "PDG1" | i64 node_count | per node:
+//   i64 id | i64 degree | u8 weighted | i64 nbrs[deg] | [f32 w[deg]]
+int pd_graph_save(void* graph, const char* path) {
+  auto* g = static_cast<Graph*>(graph);
+  FILE* f = fopen(path, "wb");
+  if (!f) return -1;
+  const char magic[4] = {'P', 'D', 'G', '1'};
+  fwrite(magic, 1, 4, f);
+  int64_t count = 0;
+  long pos = ftell(f);
+  fwrite(&count, 8, 1, f);
+  for (int s = 0; s < kGraphShards; ++s) {
+    std::lock_guard<std::mutex> lk(g->locks[s]);
+    for (auto& kv : g->shards[s]) {
+      int64_t deg = static_cast<int64_t>(kv.second.nbrs.size());
+      uint8_t weighted = kv.second.weights.empty() ? 0 : 1;
+      fwrite(&kv.first, 8, 1, f);
+      fwrite(&deg, 8, 1, f);
+      fwrite(&weighted, 1, 1, f);
+      fwrite(kv.second.nbrs.data(), 8, deg, f);
+      if (weighted) fwrite(kv.second.weights.data(), 4, deg, f);
+      ++count;
+    }
+  }
+  if (fseek(f, pos, SEEK_SET) != 0 || fwrite(&count, 8, 1, f) != 1) {
+    fclose(f);
+    return -4;
+  }
+  if (fclose(f) != 0) return -5;
+  return 0;
+}
+
+int pd_graph_load(void* graph, const char* path) {
+  auto* g = static_cast<Graph*>(graph);
+  FILE* f = fopen(path, "rb");
+  if (!f) return -1;
+  char magic[4];
+  int64_t count;
+  if (fread(magic, 1, 4, f) != 4 || memcmp(magic, "PDG1", 4) != 0 ||
+      fread(&count, 8, 1, f) != 1) {
+    fclose(f);
+    return -2;
+  }
+  // degree sanity bound: a corrupt file must return rc=-3, not throw
+  // bad_alloc through the C ABI (which would terminate the process)
+  constexpr int64_t kMaxDegree = 1ll << 31;
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t id, deg;
+    uint8_t weighted;
+    if (fread(&id, 8, 1, f) != 1 || fread(&deg, 8, 1, f) != 1 ||
+        fread(&weighted, 1, 1, f) != 1 || deg < 0 || deg > kMaxDegree) {
+      fclose(f);
+      return -3;
+    }
+    Adj a;
+    try {
+      a.nbrs.resize(deg);
+      if (weighted) a.weights.resize(deg);
+    } catch (const std::exception&) {
+      fclose(f);
+      return -3;
+    }
+    if (fread(a.nbrs.data(), 8, deg, f) != static_cast<size_t>(deg)) {
+      fclose(f);
+      return -3;
+    }
+    if (weighted &&
+        fread(a.weights.data(), 4, deg, f) != static_cast<size_t>(deg)) {
+      fclose(f);
+      return -3;
+    }
+    int s = gshard(id);
+    std::lock_guard<std::mutex> lk(g->locks[s]);
+    g->num_edges.fetch_add(
+        deg - static_cast<int64_t>(g->shards[s][id].nbrs.size()),
+        std::memory_order_relaxed);
+    g->shards[s][id] = std::move(a);
+  }
+  fclose(f);
+  return 0;
+}
+
+}  // extern "C"

@@ -96,6 +96,34 @@ int     pd_ps_client_push_show_click(void* client, const int64_t* keys,
 int64_t pd_ps_client_shrink(void* client);
 int     pd_ps_client_stats(void* client, int64_t* mem_rows,
                            int64_t* disk_rows);
+/* Graph table (GraphPS role: common_graph_table.h + graph brpc service) */
+void*   pd_graph_create(uint64_t seed);
+void    pd_graph_destroy(void* graph);
+void    pd_graph_add_edges(void* graph, const int64_t* src,
+                           const int64_t* dst, const float* weights,
+                           int64_t n);
+int64_t pd_graph_num_nodes(void* graph);
+int64_t pd_graph_num_edges(void* graph);
+void    pd_graph_degrees(void* graph, const int64_t* nodes, int64_t n,
+                         int64_t* out);
+void    pd_graph_sample_neighbors(void* graph, const int64_t* nodes,
+                                  int64_t n, int k, int64_t* out_nbrs,
+                                  int64_t* out_counts);
+int     pd_graph_save(void* graph, const char* path);
+int     pd_graph_load(void* graph, const char* path);
+void*   pd_ps_graph_server_start(void* graph, int port);
+int     pd_ps_client_graph_add_edges(void* client, const int64_t* src,
+                                     const int64_t* dst,
+                                     const float* weights, int64_t n);
+int     pd_ps_client_graph_sample(void* client, const int64_t* nodes,
+                                  int64_t n, int k, int64_t* out_nbrs,
+                                  int64_t* out_counts);
+int     pd_ps_client_graph_degrees(void* client, const int64_t* nodes,
+                                   int64_t n, int64_t* out);
+int     pd_ps_client_graph_size(void* client, int64_t* num_nodes,
+                                int64_t* num_edges);
+int     pd_ps_client_graph_save(void* client, const char* path);
+int     pd_ps_client_graph_load(void* client, const char* path);
 
 // ------------------------------------------------------------- PS service --
 // Multi-host PS data plane (ps_service.cc): serve a table over TCP; clients

@@ -49,6 +49,13 @@ enum PsOp : uint8_t {
   kShowClick = 8,   // CTR accessor stats
   kShrink = 9,      // decay + evict cycle; replies evicted count
   kStats = 10,      // (mem_rows, disk_rows)
+  // graph table verbs (GraphPS role; server started with a graph handle)
+  kGraphAddEdges = 20,  // i64 n | u8 weighted | src[n] | dst[n] | [w[n]]
+  kGraphSample = 21,    // i64 n | i32 k | nodes[n] -> nbrs[n*k]|counts[n]
+  kGraphDegrees = 22,   // i64 n | nodes[n] -> degrees[n]
+  kGraphSize = 23,      // -> (num_nodes, num_edges)
+  kGraphSave = 24,
+  kGraphLoad = 25,
 };
 
 constexpr uint64_t kMaxPayload = 1ull << 32;  // 4 GiB per request
@@ -105,6 +112,7 @@ struct ConnRec {
 
 struct PsServer {
   void* table = nullptr;  // borrowed pd_table handle (not owned)
+  void* graph = nullptr;  // borrowed pd_graph handle (graph servers)
   int listen_fd = -1;
   int port = 0;
   std::atomic<bool> stopping{false};
@@ -123,7 +131,7 @@ void reply(int fd, int32_t rc, const void* data, uint64_t len) {
 
 void handle_conn(PsServer* s, ConnRec* rec) try {
   int fd = rec->fd;
-  int dim = pd_table_dim(s->table);
+  int dim = s->table ? pd_table_dim(s->table) : 0;
   // per-request row cap: keys fit the payload (plen/8) AND the pull reply
   // buffer stays under ~2 GiB of floats
   const uint64_t kMaxRowFloats = 1ull << 29;
@@ -137,6 +145,11 @@ void handle_conn(PsServer* s, ConnRec* rec) try {
     payload.resize(plen);
     if (plen && !io_recv_all(fd, payload.data(), plen, 0)) break;
 
+    if ((op >= kGraphAddEdges) ? (s->graph == nullptr)
+                               : (s->table == nullptr && op != kDim)) {
+      reply(fd, -6, nullptr, 0);  // verb not served by this endpoint
+      continue;
+    }
     switch (op) {
       case kPull: {
         if (plen < 8) { reply(fd, -3, nullptr, 0); break; }
@@ -254,6 +267,87 @@ void handle_conn(PsServer* s, ConnRec* rec) try {
         reply(fd, 0, &d, 4);
         break;
       }
+      case kGraphAddEdges: {
+        if (plen < 9) { reply(fd, -3, nullptr, 0); break; }
+        int64_t n;
+        uint8_t weighted;
+        memcpy(&n, payload.data(), 8);
+        weighted = static_cast<uint8_t>(payload[8]);
+        uint64_t want = 9 + static_cast<uint64_t>(n) * 16 +
+                        (weighted ? static_cast<uint64_t>(n) * 4 : 0);
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 16 ||
+            plen != want) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* src =
+            reinterpret_cast<const int64_t*>(payload.data() + 9);
+        const int64_t* dst = src + n;
+        const float* w = weighted
+            ? reinterpret_cast<const float*>(payload.data() + 9 + n * 16)
+            : nullptr;
+        pd_graph_add_edges(s->graph, src, dst, w, n);
+        reply(fd, 0, nullptr, 0);
+        break;
+      }
+      case kGraphSample: {
+        if (plen < 12) { reply(fd, -3, nullptr, 0); break; }
+        int64_t n;
+        int32_t kk;
+        memcpy(&n, payload.data(), 8);
+        memcpy(&kk, payload.data() + 8, 4);
+        if (n < 0 || kk <= 0 || kk > 4096 ||
+            static_cast<uint64_t>(n) > plen / 8 ||
+            plen != 12 + static_cast<uint64_t>(n) * 8 ||
+            static_cast<uint64_t>(n) * kk > (1ull << 27)) {
+          // reply cap ~1 GiB of i64s — the kPull kMaxRowFloats analog
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* nodes =
+            reinterpret_cast<const int64_t*>(payload.data() + 12);
+        std::vector<int64_t> nbrs(static_cast<size_t>(n) * kk);
+        std::vector<int64_t> counts(n);
+        pd_graph_sample_neighbors(s->graph, nodes, n, kk, nbrs.data(),
+                                  counts.data());
+        std::string data;
+        data.append(reinterpret_cast<char*>(nbrs.data()), nbrs.size() * 8);
+        data.append(reinterpret_cast<char*>(counts.data()), n * 8);
+        reply(fd, 0, data.data(), data.size());
+        break;
+      }
+      case kGraphDegrees: {
+        if (plen < 8) { reply(fd, -3, nullptr, 0); break; }
+        int64_t n;
+        memcpy(&n, payload.data(), 8);
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 8 ||
+            plen != 8 + static_cast<uint64_t>(n) * 8) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* nodes =
+            reinterpret_cast<const int64_t*>(payload.data() + 8);
+        std::vector<int64_t> degs(n);
+        pd_graph_degrees(s->graph, nodes, n, degs.data());
+        reply(fd, 0, degs.data(), static_cast<uint64_t>(n) * 8);
+        break;
+      }
+      case kGraphSize: {
+        int64_t sz[2] = {pd_graph_num_nodes(s->graph),
+                         pd_graph_num_edges(s->graph)};
+        reply(fd, 0, sz, 16);
+        break;
+      }
+      case kGraphSave: {
+        std::string path(payload.data(), plen);
+        reply(fd, pd_graph_save(s->graph, path.c_str()), nullptr, 0);
+        break;
+      }
+      case kGraphLoad: {
+        std::string path(payload.data(), plen);
+        reply(fd, pd_graph_load(s->graph, path.c_str()), nullptr, 0);
+        break;
+      }
       default:
         reply(fd, -2, nullptr, 0);
     }
@@ -346,7 +440,17 @@ bool ps_request(PsClient* c, uint8_t op, const std::string& payload,
 
 extern "C" {
 
+static void* ps_server_start_impl(void* table, void* graph, int port);
+
 void* pd_ps_server_start(void* table, int port) {
+  return ps_server_start_impl(table, nullptr, port);
+}
+
+void* pd_ps_graph_server_start(void* graph, int port) {
+  return ps_server_start_impl(nullptr, graph, port);
+}
+
+static void* ps_server_start_impl(void* table, void* graph, int port) {
   int fd = socket(AF_INET, SOCK_STREAM, 0);
   if (fd < 0) { ps_error("socket failed"); return nullptr; }
   int one = 1;
@@ -365,6 +469,7 @@ void* pd_ps_server_start(void* table, int port) {
   getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
   auto* s = new PsServer;
   s->table = table;
+  s->graph = graph;
   s->listen_fd = fd;
   s->port = ntohs(addr.sin_port);
   s->accept_thread = std::thread(accept_loop, s);
@@ -568,6 +673,86 @@ int pd_ps_client_load(void* client, const char* path) {
   int32_t rc;
   std::string data;
   if (!ps_request(c, kLoad, path, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_graph_add_edges(void* client, const int64_t* src,
+                                 const int64_t* dst, const float* weights,
+                                 int64_t n) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.push_back(weights ? 1 : 0);
+  payload.append(reinterpret_cast<const char*>(src), n * 8);
+  payload.append(reinterpret_cast<const char*>(dst), n * 8);
+  if (weights)
+    payload.append(reinterpret_cast<const char*>(weights), n * 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGraphAddEdges, payload, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_graph_sample(void* client, const int64_t* nodes, int64_t n,
+                              int k, int64_t* out_nbrs,
+                              int64_t* out_counts) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  int32_t kk = k;
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(&kk), 4);
+  payload.append(reinterpret_cast<const char*>(nodes), n * 8);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGraphSample, payload, &rc, &data)) return -1;
+  if (rc != 0) return rc;
+  if (data.size() != static_cast<size_t>(n) * (k + 1) * 8) return -4;
+  memcpy(out_nbrs, data.data(), static_cast<size_t>(n) * k * 8);
+  memcpy(out_counts, data.data() + static_cast<size_t>(n) * k * 8, n * 8);
+  return 0;
+}
+
+int pd_ps_client_graph_degrees(void* client, const int64_t* nodes,
+                               int64_t n, int64_t* out) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(nodes), n * 8);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGraphDegrees, payload, &rc, &data)) return -1;
+  if (rc != 0) return rc;
+  if (data.size() != static_cast<size_t>(n) * 8) return -4;
+  memcpy(out, data.data(), data.size());
+  return 0;
+}
+
+int pd_ps_client_graph_size(void* client, int64_t* num_nodes,
+                            int64_t* num_edges) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGraphSize, "", &rc, &data) || rc != 0 ||
+      data.size() != 16)
+    return -1;
+  memcpy(num_nodes, data.data(), 8);
+  memcpy(num_edges, data.data() + 8, 8);
+  return 0;
+}
+
+int pd_ps_client_graph_save(void* client, const char* path) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGraphSave, path, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_graph_load(void* client, const char* path) {
+  auto* c = static_cast<PsClient*>(client);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGraphLoad, path, &rc, &data)) return -1;
   return rc;
 }
 

@@ -266,3 +266,11 @@ from .service import (  # noqa: E402,F401  (needs SparseTable above)
     start_ps_server,
     wait_ps_endpoints,
 )
+from .graph import (  # noqa: E402,F401
+    DistributedGraphTable,
+    GraphPsClient,
+    GraphPsServer,
+    GraphTable,
+    start_graph_server,
+    wait_graph_endpoints,
+)

@@ -442,21 +442,24 @@ class GeoSGDWorker:
 
 # ------------------------------------------------------------- discovery ----
 
-def register_ps_server(store, index, port, host=None):
+def register_ps_server(store, index, port, host=None,
+                       key_prefix="ps/server"):
     """Publish this server's endpoint on the rendezvous store
-    (the_one_ps server registration parity)."""
+    (the_one_ps server registration parity).  ``key_prefix`` separates
+    endpoint namespaces (sparse tables vs graph servers)."""
     import socket
 
     host = host or os.environ.get("POD_IP") or socket.gethostbyname(
         socket.gethostname())
-    store.set(f"ps/server/{index}", f"{host}:{port}".encode())
+    store.set(f"{key_prefix}/{index}", f"{host}:{port}".encode())
 
 
-def wait_ps_endpoints(store, num_servers, timeout=60.0):
+def wait_ps_endpoints(store, num_servers, timeout=60.0,
+                      key_prefix="ps/server"):
     """Block until all PS servers have registered; return their endpoints."""
     eps = []
     for i in range(num_servers):
-        v = store.get(f"ps/server/{i}", timeout=timeout)  # blocking get
+        v = store.get(f"{key_prefix}/{i}", timeout=timeout)  # blocking get
         eps.append(v.decode() if isinstance(v, bytes) else str(v))
     return eps
 

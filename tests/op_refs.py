@@ -413,6 +413,36 @@ def warpctc_check(r, a, k):
     np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
 
 
+def rnnt_loss_ref(logits, labels, t_len, u_len, blank=0):
+    """RNN-T loss forward lattice (log domain), plain numpy loops.
+
+    logits [T, U+1, C] one sample; labels [U]."""
+    lp = logits - np.log(np.exp(
+        logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+        - logits.max(-1, keepdims=True)
+    T, U1 = int(t_len), int(u_len) + 1
+    alpha = np.full((T, U1), -np.inf)
+    alpha[0, 0] = 0.0
+    for u in range(1, U1):
+        alpha[0, u] = alpha[0, u - 1] + lp[0, u - 1, labels[u - 1]]
+    for t in range(1, T):
+        alpha[t, 0] = alpha[t - 1, 0] + lp[t - 1, 0, blank]
+        for u in range(1, U1):
+            stay = alpha[t - 1, u] + lp[t - 1, u, blank]
+            emit = alpha[t, u - 1] + lp[t, u - 1, labels[u - 1]]
+            alpha[t, u] = np.logaddexp(stay, emit)
+    return -(alpha[T - 1, U1 - 1] + lp[T - 1, U1 - 1, blank])
+
+
+def warprnnt_check(r, a, k):
+    logits, labels, t_len, u_len = a
+    expected = rnnt_loss_ref(logits[0], labels[0], int(t_len[0]),
+                             int(u_len[0]))
+    got = (r[0] if isinstance(r, (list, tuple)) else r)
+    got = float(np.asarray(got.numpy()).reshape(-1)[0])
+    np.testing.assert_allclose(got, expected, rtol=1e-3, atol=1e-4)
+
+
 def gather_tree_check(r, a, k):
     ids, parents = a
     T, B, W = ids.shape
@@ -584,6 +614,76 @@ def spectral_norm_check(r, a, k):
         un /= np.linalg.norm(un) + 1e-12
     sigma = un @ w @ vn
     np.testing.assert_allclose(got, w / sigma, rtol=1e-3, atol=1e-4)
+
+
+def prior_box_check(r, a, k):
+    """SSD anchor grid: recompute center/size boxes with plain loops
+    (reference phi prior_box kernel formulas)."""
+    feat, image, min_sizes = a
+    max_sizes = k.get("max_sizes")
+    fh, fw = feat.shape[-2], feat.shape[-1]
+    ih, iw = image.shape[-2], image.shape[-1]
+    step_w, step_h = iw / fw, ih / fh
+    wh = []
+    for ms in min_sizes:
+        wh.append((ms, ms))
+        for mx in (max_sizes or []):
+            s = math.sqrt(ms * mx)
+            wh.append((s, s))
+    boxes = np.zeros((fh, fw, len(wh), 4), F32)
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + 0.5) * step_w
+            cy = (i + 0.5) * step_h
+            for bidx, (w, h) in enumerate(wh):
+                boxes[i, j, bidx] = [(cx - w / 2) / iw, (cy - h / 2) / ih,
+                                     (cx + w / 2) / iw, (cy + h / 2) / ih]
+    got_boxes = np.asarray(r[0].numpy())
+    np.testing.assert_allclose(got_boxes, boxes, rtol=1e-4, atol=1e-5)
+    got_var = np.asarray(r[1].numpy())
+    np.testing.assert_allclose(got_var[0, 0, 0], [0.1, 0.1, 0.2, 0.2],
+                               rtol=1e-6)
+
+
+def yolo_box_check(r, a, k):
+    """Exact YOLOv3 box decode (reference phi yolo_box kernel):
+    bx = (sigmoid(tx) + col) / fw * img_w, bw = anchor_w * exp(tw)."""
+    x, img_size, anchors = a
+    class_num = k["class_num"]
+    downsample = k.get("downsample_ratio", 32)
+    conf_thresh = k.get("conf_thresh", 0.005)
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    xr = x.reshape(n, na, 5 + class_num, h, w)
+    img_h, img_w = float(img_size[0, 0]), float(img_size[0, 1])
+    boxes = np.zeros((n, na * h * w, 4), F32)
+    scores = np.zeros((n, na * h * w, class_num), F32)
+    idx = 0
+    for an in range(na):
+        aw, ah = anchors[2 * an], anchors[2 * an + 1]
+        for i in range(h):
+            for j in range(w):
+                tx, ty, tw, th, to = xr[0, an, :5, i, j]
+                cx = (sig(tx) + j) / w * img_w
+                cy = (sig(ty) + i) / h * img_h
+                bw = aw * np.exp(tw) * img_w / (downsample * w)
+                bh = ah * np.exp(th) * img_h / (downsample * h)
+                conf = sig(to)
+                if conf >= conf_thresh:
+                    box = np.array([cx - bw / 2, cy - bh / 2,
+                                    cx + bw / 2, cy + bh / 2])
+                    # clip_bbox=True default: clamp into the image
+                    box[0::2] = np.clip(box[0::2], 0, img_w - 1)
+                    box[1::2] = np.clip(box[1::2], 0, img_h - 1)
+                    boxes[0, idx] = box
+                    scores[0, idx] = conf * sig(
+                        xr[0, an, 5:, i, j].astype(np.float64))
+                idx += 1
+    got_boxes = np.asarray(r[0].numpy())
+    got_scores = np.asarray(r[1].numpy())
+    np.testing.assert_allclose(got_boxes, boxes, rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(got_scores, scores, rtol=1e-3, atol=1e-4)
 
 
 # ----------------------------------------------------------- sparse refs --

@@ -813,7 +813,7 @@ spec("warprnnt",
      lambda rng: ((np.log(_pos(rng, (1, 4, 3, 3), 0.1, 1.0)),
                    np.array([[1, 2]], np.int32),
                    np.array([4], np.int32), np.array([2], np.int32)), {}),
-     ref=None)
+     check=R.warprnnt_check, grad=(0,))
 
 # ------------------------------------------------------------- norm layers --
 
@@ -1293,12 +1293,12 @@ spec("box_coder",
 spec("prior_box",
      lambda rng: ((_u(rng, (1, 2, 4, 4)), _u(rng, (1, 3, 16, 16)),
                    [2.0]), {"max_sizes": [4.0]}),
-     ref=None)
+     check=R.prior_box_check)
 spec("yolo_box",
      lambda rng: ((_u(rng, (1, 14, 2, 2)), np.array([[16, 16]], np.int32),
                    [1, 2, 3, 4]), {"class_num": 2,
                                    "downsample_ratio": 8}),
-     ref=None)
+     check=R.yolo_box_check)
 spec("yolo_loss",
      lambda rng: ((_u(rng, (1, 14, 2, 2)), _u(rng, (1, 2, 4), 0.2, 0.8),
                    rng.randint(0, 2, (1, 2)).astype(np.int32)),
@@ -1502,8 +1502,6 @@ JUSTIFIED_FINITE_ONLY = {
         "asserted in the vision tests, exact decay table pending",
     "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
         "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
-    "prior_box": "anchor-grid generator; count/normalization invariants "
-        "asserted by the ssd-style vision tests",
     "psroi_pool": "position-sensitive variant of roi_pool; channel-"
         "routing invariant asserted in the vision tests",
     "reindex_graph": "graph index compaction; inverse-mapping invariant "
@@ -1516,13 +1514,8 @@ JUSTIFIED_FINITE_ONLY = {
         "path asserted there",
     "send_ue_recv": "message-passing with edge weights; aggregation "
         "parity vs segment_sum covered by the geometric tests",
-    "warprnnt": "RNN-T loss needs a lattice DP reference (heavier than "
-        "CTC's); numeric-range sanity only, flagged as the honest gap",
     "weighted_sample_neighbors": "random graph sampling; degree/weight "
         "invariants covered by the geometric sampling tests",
-    "yolo_box": "shape/layout asserted in test_ops_extended.py::"
-        "test_yolo_box_shapes; exact decode shares box_coder's ref-checked "
-        "formula",
     "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
         "finite-loss + decreasing-loss covered by the detection tests",
 }

@@ -392,3 +392,115 @@ def test_wide_deep_two_process_convergence(tmp_path):
     for r in range(2):
         with open(os.path.join(log_dir, f"workerlog.{r}")) as f:
             assert f"RANK {r} WD OK" in f.read()
+
+
+class TestGraphPs:
+    """Server-side graph storage + sampling (reference GraphPS:
+    common_graph_table.h + graph brpc service)."""
+
+    def _star(self, table, n=50, weighted=False):
+        src = np.zeros(n, np.int64)
+        dst = np.arange(1, n + 1, dtype=np.int64)
+        w = (np.linspace(0.1, 5.0, n).astype(np.float32)
+             if weighted else None)
+        table.add_edges(src, dst, w)
+        return dst, w
+
+    def test_local_table_sample_without_replacement(self):
+        from paddle_tpu.distributed.ps import GraphTable
+
+        g = GraphTable(seed=1)
+        dst, _ = self._star(g, n=50)
+        assert g.num_nodes() == 1 and g.num_edges() == 50
+        assert g.degrees([0, 7]).tolist() == [50, 0]
+        nbrs, counts = g.sample_neighbors([0, 123], k=8)
+        assert counts.tolist() == [8, 0]
+        row = nbrs[0]
+        assert len(set(row.tolist())) == 8          # no replacement
+        assert set(row.tolist()) <= set(dst.tolist())
+        assert (nbrs[1] == -1).all()                # absent node pads -1
+        # low-degree node returns its full neighbor set
+        g.add_edges([5, 5], [6, 7])
+        nb2, ct2 = g.sample_neighbors([5], k=8)
+        assert ct2[0] == 2 and set(nb2[0][:2].tolist()) == {6, 7}
+
+    def test_weighted_sampling_prefers_heavy_edges(self):
+        from paddle_tpu.distributed.ps import GraphTable
+
+        g = GraphTable(seed=3)
+        # two heavy edges among many feather-weight ones
+        src = np.zeros(40, np.int64)
+        dst = np.arange(1, 41, dtype=np.int64)
+        w = np.full(40, 1e-3, np.float32)
+        w[:2] = 100.0
+        g.add_edges(src, dst, w)
+        hits = 0
+        for _ in range(30):
+            nbrs, _ = g.sample_neighbors([0], k=2)
+            hits += len({1, 2} & set(nbrs[0].tolist()))
+        assert hits >= 50, hits  # heavy edges dominate the samples
+
+    def test_graph_save_load_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps import GraphTable
+
+        g = GraphTable(seed=5)
+        self._star(g, n=10, weighted=True)
+        g.save(str(tmp_path / "g.bin"))
+        g2 = GraphTable(seed=5)
+        g2.load(str(tmp_path / "g.bin"))
+        assert g2.num_nodes() == 1 and g2.num_edges() == 10
+        assert g2.degrees([0])[0] == 10
+
+    def test_graph_service_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.ps import (
+            GraphPsClient,
+            GraphPsServer,
+            GraphTable,
+        )
+
+        g = GraphTable(seed=7)
+        srv = GraphPsServer(g)
+        try:
+            c = GraphPsClient("127.0.0.1", srv.port)
+            c.add_edges(np.zeros(20, np.int64),
+                        np.arange(1, 21, dtype=np.int64))
+            assert c.size() == (1, 20)
+            assert c.degrees([0])[0] == 20
+            nbrs, counts = c.sample_neighbors([0], k=5)
+            assert counts[0] == 5 and len(set(nbrs[0].tolist())) == 5
+            c.save(str(tmp_path / "srv_g.bin"))
+            # a table verb against a graph endpoint is refused cleanly
+            with pytest.raises(IOError):
+                c.pull([1, 2])
+            c.close()
+        finally:
+            srv.stop()
+
+    def test_distributed_graph_routes_by_node(self):
+        from paddle_tpu.distributed.ps import (
+            DistributedGraphTable,
+            GraphPsServer,
+            GraphTable,
+        )
+
+        graphs = [GraphTable(seed=11), GraphTable(seed=12)]
+        servers = [GraphPsServer(g) for g in graphs]
+        try:
+            dist = DistributedGraphTable(
+                [f"127.0.0.1:{s.port}" for s in servers])
+            src = np.arange(10, dtype=np.int64)          # even+odd nodes
+            dst = src + 100
+            dist.add_edges(src, dst)
+            # each server holds only its residue class
+            assert graphs[0].num_nodes() == 5
+            assert graphs[1].num_nodes() == 5
+            assert dist.size() == (10, 10)
+            degs = dist.degrees(src)
+            assert degs.tolist() == [1] * 10
+            nbrs, counts = dist.sample_neighbors(src, k=2)
+            assert counts.tolist() == [1] * 10
+            np.testing.assert_array_equal(nbrs[:, 0], dst)
+            dist.close()
+        finally:
+            for s in servers:
+                s.stop()
