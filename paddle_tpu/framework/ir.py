@@ -1,0 +1,341 @@
+"""jaxpr pattern-rewrite passes — the small IR layer SURVEY §7.4 planned.
+
+Reference role: the inference/graph IR pass zoo
+(paddle/fluid/framework/ir/*_fuse_pass.cc — e.g.
+multihead_matmul_fuse_pass recognizes unfused attention subgraphs and
+swaps in the fused kernel).  TPU redesign: XLA already owns generic
+fusion, so the only passes worth keeping are the ones XLA can NOT do —
+replacing a mathematically-recognized subgraph with a DIFFERENT
+algorithm.  The flagship pass rewrites naive user-written attention
+(``softmax(q @ k.T / sqrt(d)) @ v``, which materializes the [T, S] score
+matrix) into the online-softmax flash kernel.
+
+Mechanics are jax-idiomatic: a pass is a jaxpr analysis that yields
+rewrite plans, applied by a replay interpreter (the "custom interpreter"
+pattern) — under ``jax.jit`` the replay traces once into the optimized
+program, so passes cost nothing at runtime.
+
+    fast = ir.optimize(naive_attention_fn)      # all registered passes
+    jax.jit(fast)(q, k, v)                      # flash kernel inside
+"""
+
+import functools
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+PASSES = OrderedDict()
+
+
+def register_pass(name):
+    def deco(fn):
+        PASSES[name] = fn
+        return fn
+
+    return deco
+
+
+class Rewrite:
+    """One planned substitution: consume ``eqn_indices``, bind the values
+    of ``in_vars`` to ``apply`` and write its result to ``out_var``."""
+
+    def __init__(self, eqn_indices, in_vars, out_var, apply):
+        self.eqn_indices = frozenset(eqn_indices)
+        self.in_vars = in_vars
+        self.out_var = out_var
+        self.apply = apply
+        self.anchor = max(eqn_indices)  # fires at the pattern's last eqn
+
+
+# ------------------------------------------------------------- matching ----
+
+def _producers(jaxpr):
+    prod = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            prod[v] = (i, eqn)
+    return prod
+
+
+def _unwrap(var, prod):
+    """Walk through shape/type-preserving wrappers back to the math."""
+    seen = []
+    while not isinstance(var, jcore.Literal) and var in prod:
+        i, eqn = prod[var]
+        name = eqn.primitive.name
+        if name in ("convert_element_type", "stop_gradient",
+                    "broadcast_in_dim", "copy"):
+            seen.append(i)
+            var = eqn.invars[0]
+        elif name == "max" and isinstance(eqn.invars[0], jcore.Literal):
+            # jax.nn.softmax guards the running max with max(-inf, .)
+            seen.append(i)
+            var = eqn.invars[1]
+        else:
+            break
+    return var, seen
+
+
+def _eqn_of(var, prod, prim_name):
+    if var not in prod:
+        return None
+    i, eqn = prod[var]
+    return (i, eqn) if eqn.primitive.name == prim_name else None
+
+
+@register_pass("fuse_attention")
+def fuse_attention(jaxpr):
+    """Find softmax(scale(q @ k^T)) @ v chains; plan flash-kernel swaps.
+
+    Matches the 2D single-head layout (q [T, D], k [S, D], v [S, D]) and
+    the batched-heads einsum layout (q [B, N, T, D] against k
+    [B, N, S, D]).  The score scaling may be ``/ c`` or ``* c`` by a
+    scalar, or absent.
+    """
+    prod = _producers(jaxpr)
+    rewrites = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        if eqn.primitive.name != "dot_general":
+            continue
+        # final dot: [.., T, S] @ v — LHS must be a softmax output
+        p_var, skip_a = _unwrap(eqn.invars[0], prod)
+        v_var = eqn.invars[1]
+        m = _eqn_of(p_var, prod, "div")
+        if m is None:
+            continue
+        div_i, div_eqn = m
+        num_var, skip_b = _unwrap(div_eqn.invars[0], prod)
+        den_var, skip_c = _unwrap(div_eqn.invars[1], prod)
+        m = _eqn_of(num_var, prod, "exp")
+        if m is None:
+            continue
+        exp_i, exp_eqn = m
+        m = _eqn_of(den_var, prod, "reduce_sum")
+        if m is None:
+            continue
+        sum_i, sum_eqn = m
+        sum_src, skip_d = _unwrap(sum_eqn.invars[0], prod)
+        if sum_src is not num_var:
+            continue
+        m = _eqn_of(_unwrap(exp_eqn.invars[0], prod)[0], prod, "sub")
+        if m is None:
+            continue
+        sub_i, sub_eqn = m
+        scores_var, skip_e = _unwrap(sub_eqn.invars[0], prod)
+        mx_var, skip_f = _unwrap(sub_eqn.invars[1], prod)
+        m = _eqn_of(mx_var, prod, "reduce_max")
+        if m is None:
+            continue
+        max_i, max_eqn = m
+        if _unwrap(max_eqn.invars[0], prod)[0] is not scores_var:
+            continue
+        # scores: optional scalar scale around the q@k dot
+        scale_mode, scale_val = None, None
+        sdot = _eqn_of(scores_var, prod, "dot_general")
+        skip_g = []
+        if sdot is None:
+            for op in ("div", "mul"):
+                m = _eqn_of(scores_var, prod, op)
+                if m is None:
+                    continue
+                op_i, op_eqn = m
+                cand, sk = _unwrap(op_eqn.invars[0], prod)
+                sdot = _eqn_of(cand, prod, "dot_general")
+                # the scale must be a SCALAR (literal or runtime) — a
+                # shaped operand here is a mask/bias, not a scale
+                if sdot is not None and not op_eqn.invars[1].aval.shape:
+                    scale_mode = op
+                    scale_val = op_eqn.invars[1]
+                    skip_g = [op_i] + sk
+                    break
+                sdot = None
+        if sdot is None:
+            continue
+        dot_i, dot_eqn = sdot
+        q_var, k_var = dot_eqn.invars
+        ((lc, rc), (lb, rb)) = dot_eqn.params["dimension_numbers"]
+        q_aval = q_var.aval
+        nd = len(q_aval.shape)
+        # layouts: 2D q[T,D]·k[S,D] (contract (1,1), or (1,0) through an
+        # explicit k.T transpose) or batched q[B,N,T,D]·k[B,N,S,D]
+        layout = None
+        skip_h = []
+        if nd == 2 and tuple(lc) == (1,) and not lb:
+            if tuple(rc) == (1,):
+                layout = "2d"
+            elif tuple(rc) == (0,):
+                kt = _eqn_of(k_var, prod, "transpose")
+                if kt is not None and tuple(
+                        kt[1].params["permutation"]) == (1, 0):
+                    layout = "2d"
+                    skip_h = [kt[0]]
+                    k_var = kt[1].invars[0]
+        elif nd == 4 and tuple(lc) == (3,) and tuple(rc) == (3,) \
+                and tuple(lb) == (0, 1) and tuple(rb) == (0, 1):
+            layout = "bhtd"
+        if layout is None:
+            continue
+        # the final dot must contract the softmax's last axis with v's
+        # matching axis, same batching as the scores
+        ((flc, frc), (flb, frb)) = eqn.params["dimension_numbers"]
+        if layout == "2d" and (tuple(flc), tuple(frc)) != ((1,), (0,)):
+            continue
+        if layout == "bhtd" and ((tuple(flc), tuple(frc)) != ((3,), (2,))
+                                 or tuple(flb) != (0, 1)
+                                 or tuple(frb) != (0, 1)):
+            continue
+
+        consumed = {i, div_i, exp_i, sum_i, sub_i, max_i, dot_i}
+        consumed.update(skip_a + skip_b + skip_c + skip_d + skip_e +
+                        skip_f + skip_g + skip_h)
+        # only safe if no OTHER eqn consumes the interior values
+        interior = set()
+        for j in consumed:
+            if j != i:
+                interior.update(jaxpr.eqns[j].outvars)
+        ok = True
+        for j, other in enumerate(jaxpr.eqns):
+            if j in consumed:
+                continue
+            if any(v in interior for v in other.invars
+                   if not isinstance(v, jcore.Literal)):
+                ok = False
+                break
+        if ok and any(v in interior for v in jaxpr.outvars
+                      if not isinstance(v, jcore.Literal)):
+            ok = False
+        if not ok:
+            continue
+
+        head_dim = q_aval.shape[-1]
+        s_literal = (scale_val.val if isinstance(scale_val, jcore.Literal)
+                     else None) if scale_mode else None
+
+        def apply(read, *, _layout=layout, _mode=scale_mode,
+                  _sval=scale_val, _slit=s_literal, _d=head_dim,
+                  _q=q_var, _k=k_var, _v=v_var):
+            from ..ops import pallas
+
+            q = read(_q)
+            k = read(_k)
+            v = read(_v)
+            # normalize the matched scale onto q so the kernel's own
+            # 1/sqrt(d) yields the user's exact scaling
+            scale = 1.0
+            if _mode == "div":
+                s = _slit if _slit is not None else read(_sval)
+                scale = 1.0 / s
+            elif _mode == "mul":
+                scale = _slit if _slit is not None else read(_sval)
+            q = q * (scale * jnp.sqrt(jnp.asarray(_d, q.dtype)))
+            if _layout == "2d":
+                out = pallas.flash_attention(
+                    q[None, :, None, :], k[None, :, None, :],
+                    v[None, :, None, :])
+                return out[0, :, 0, :]
+            # bhtd: [B, N, T, D] -> kernel layout [B, T, N, D]
+            out = pallas.flash_attention(
+                q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                v.transpose(0, 2, 1, 3))
+            return out.transpose(0, 2, 1, 3)
+
+        rewrites.append(Rewrite(consumed, (q_var, k_var, v_var),
+                                eqn.outvars[0], apply))
+    return rewrites
+
+
+# -------------------------------------------------------------- replay ----
+
+def _replay(closed, rewrites, args):
+    jaxpr = closed.jaxpr
+    by_anchor = {}
+    consumed = set()
+    for rw in rewrites:
+        by_anchor[rw.anchor] = rw
+        consumed |= rw.eqn_indices
+    env = {}
+
+    def read(var):
+        return var.val if isinstance(var, jcore.Literal) else env[var]
+
+    def write(var, val):
+        env[var] = val
+
+    for v, c in zip(jaxpr.constvars, closed.consts):
+        write(v, c)
+    flat = jax.tree_util.tree_leaves(args)
+    for v, a in zip(jaxpr.invars, flat):
+        write(v, a)
+    for i, eqn in enumerate(jaxpr.eqns):
+        rw = by_anchor.get(i)
+        if rw is not None:
+            write(rw.out_var, rw.apply(read))
+            continue
+        if i in consumed:
+            # interior eqns still execute if a LATER anchor needs their
+            # inputs?  No: consumed eqns feed only the anchor (checked
+            # during matching) — skip them entirely.
+            continue
+        subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+        invals = [read(x) for x in eqn.invars]
+        ans = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+        if eqn.primitive.multiple_results:
+            for v, a in zip(eqn.outvars, ans):
+                write(v, a)
+        else:
+            write(eqn.outvars[0], ans)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def optimize(fn, passes=None, static_argnums=()):
+    """Return ``fn`` with the registered jaxpr passes applied.
+
+    The trace + pattern match is cached per input structure
+    (shapes/dtypes/treedef + static-arg values), so eager loops pay it
+    once; under jit the optimized replay itself is traced once.
+    Functions where no pattern matches run unchanged.  The wrapper
+    exposes ``last_rewrite_count`` for tests/diagnostics.
+    """
+    names = list(PASSES) if passes is None else list(passes)
+    static = set(static_argnums)
+    cache = {}
+
+    @functools.wraps(fn)
+    def wrapped(*args):
+        dyn = [a for i, a in enumerate(args) if i not in static]
+        leaves, in_tree = jax.tree_util.tree_flatten(tuple(dyn))
+        try:
+            key = (in_tree,
+                   tuple((jnp.shape(x), jnp.result_type(x))
+                         for x in leaves),
+                   tuple(args[i] for i in sorted(static)))
+        except TypeError:
+            key = None
+        entry = cache.get(key) if key is not None else None
+        if entry is None:
+            closed, out_shape = jax.make_jaxpr(
+                fn, static_argnums=tuple(static_argnums),
+                return_shape=True)(*args)
+            out_tree = jax.tree_util.tree_structure(out_shape)
+            rewrites = []
+            taken = set()
+            for n in names:
+                for rw in PASSES[n](closed.jaxpr):
+                    if not (rw.eqn_indices & taken):
+                        rewrites.append(rw)
+                        taken |= rw.eqn_indices
+            entry = (closed, rewrites, out_tree)
+            if key is not None:
+                cache[key] = entry
+        closed, rewrites, out_tree = entry
+        wrapped.last_rewrite_count = len(rewrites)
+        if not rewrites:
+            return fn(*args)
+        # bind only the DYNAMIC leaves — static args never became invars
+        outs = _replay(closed, rewrites, dyn)
+        return jax.tree_util.tree_unflatten(out_tree, outs)
+
+    wrapped.last_rewrite_count = 0
+    return wrapped

@@ -1,0 +1,213 @@
+"""jaxpr pattern-rewrite passes (reference ir fuse-pass role:
+multihead_matmul_fuse_pass recognizing unfused attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (backend setup via conftest)
+from paddle_tpu.framework import ir
+
+RNG = np.random.RandomState(0)
+
+
+def _qkv(shape):
+    return tuple(jnp.asarray(RNG.rand(*shape).astype(np.float32))
+                 for _ in range(3))
+
+
+def naive2d(q, k, v):
+    s = q @ k.T / jnp.sqrt(q.shape[-1] * 1.0)
+    return jax.nn.softmax(s, axis=-1) @ v
+
+
+class TestFuseAttention:
+    def test_2d_rewrites_and_matches(self):
+        q, k, v = _qkv((16, 8))
+        opt = ir.optimize(naive2d)
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive2d(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batched_heads_rewrites_and_matches(self):
+        def naive(q, k, v):
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) \
+                * (1.0 / np.sqrt(q.shape[-1]))
+            return jnp.einsum("bnts,bnsd->bntd",
+                              jax.nn.softmax(s, -1), v)
+
+        q, k, v = _qkv((2, 3, 16, 8))
+        opt = ir.optimize(naive)
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unscaled_and_mul_scaled_variants(self):
+        def unscaled(q, k, v):
+            return jax.nn.softmax(q @ k.T, axis=-1) @ v
+
+        def mul_scaled(q, k, v):
+            return jax.nn.softmax((q @ k.T) * 0.25, axis=-1) @ v
+
+        q, k, v = _qkv((8, 4))
+        for fn in (unscaled, mul_scaled):
+            opt = ir.optimize(fn)
+            out = opt(q, k, v)
+            assert opt.last_rewrite_count == 1, fn.__name__
+            np.testing.assert_allclose(np.asarray(out),
+                                       np.asarray(fn(q, k, v)),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_under_jit_traces_once_and_matches(self):
+        q, k, v = _qkv((16, 8))
+        jitted = jax.jit(ir.optimize(naive2d))
+        np.testing.assert_allclose(np.asarray(jitted(q, k, v)),
+                                   np.asarray(naive2d(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_gradients_flow_through_rewrite(self):
+        q, k, v = _qkv((8, 4))
+
+        def loss_naive(q):
+            return naive2d(q, k, v).sum()
+
+        def loss_opt(q):
+            return ir.optimize(naive2d)(q, k, v).sum()
+
+        g_ref = jax.grad(loss_naive)(q)
+        g_opt = jax.grad(loss_opt)(q)
+        np.testing.assert_allclose(np.asarray(g_opt), np.asarray(g_ref),
+                                   rtol=1e-3, atol=1e-4)
+
+    def test_no_match_leaves_function_alone(self):
+        f = ir.optimize(lambda x: x * 2.0 + 1.0)
+        x = jnp.ones((4, 4))
+        np.testing.assert_allclose(np.asarray(f(x)), 3.0)
+        assert f.last_rewrite_count == 0
+
+    def test_interior_reuse_blocks_rewrite(self):
+        """If the score matrix escapes the pattern (user returns the
+        probabilities too), fusing would break the other consumer — the
+        pass must decline."""
+
+        def leaky(q, k, v):
+            p = jax.nn.softmax(q @ k.T, axis=-1)
+            return p @ v, p
+
+        q, k, v = _qkv((8, 4))
+        opt = ir.optimize(leaky)
+        out, probs = opt(q, k, v)
+        assert opt.last_rewrite_count == 0
+        ref_out, ref_p = leaky(q, k, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(probs), np.asarray(ref_p),
+                                   rtol=1e-5)
+
+    def test_non_attention_softmax_untouched(self):
+        """A softmax that is not followed by a value matmul (a classifier
+        head) must not rewrite."""
+
+        def head(x, w):
+            return jax.nn.softmax(x @ w.T, axis=-1)
+
+        x = jnp.asarray(RNG.rand(4, 8).astype(np.float32))
+        w = jnp.asarray(RNG.rand(10, 8).astype(np.float32))
+        opt = ir.optimize(head)
+        out = opt(x, w)
+        assert opt.last_rewrite_count == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(head(x, w)), rtol=1e-5)
+
+    def test_shaped_multiplier_is_not_a_scale(self):
+        """Review regression: softmax((q@k.T) * mask) with a SHAPED mask
+        must not be treated as a scalar scale — decline the rewrite."""
+
+        def masked(q, k, v, mask):
+            return jax.nn.softmax((q @ k.T) * mask, axis=-1) @ v
+
+        q, k, v = _qkv((8, 8))
+        mask = jnp.asarray((RNG.rand(8, 8) > 0.5).astype(np.float32))
+        opt = ir.optimize(masked)
+        out = opt(q, k, v, mask)
+        assert opt.last_rewrite_count == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(masked(q, k, v, mask)),
+                                   rtol=1e-5)
+
+    def test_runtime_scalar_scale_still_fuses(self):
+        def scaled(q, k, v, s):
+            return jax.nn.softmax((q @ k.T) * s, axis=-1) @ v
+
+        q, k, v = _qkv((8, 4))
+        s = jnp.float32(0.3)
+        opt = ir.optimize(scaled)
+        out = opt(q, k, v, s)
+        assert opt.last_rewrite_count == 1
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(scaled(q, k, v, s)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_static_argnums_alignment(self):
+        """Review regression: static args never become invars — replay
+        must bind only the dynamic leaves."""
+
+        def fn(mode, q, k, v):
+            out = naive2d(q, k, v)
+            return out * 2.0 if mode == "double" else out
+
+        q, k, v = _qkv((8, 4))
+        opt = ir.optimize(fn, static_argnums=(0,))
+        out = opt("double", q, k, v)
+        assert opt.last_rewrite_count == 1
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(fn("double", q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_output_pytree_structure_preserved(self):
+        """Review regression: a matched fn returning a dict must still
+        return a dict."""
+
+        def fn(q, k, v):
+            return {"out": naive2d(q, k, v), "n": q.sum()}
+
+        q, k, v = _qkv((8, 4))
+        opt = ir.optimize(fn)
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        assert set(out) == {"out", "n"}
+        np.testing.assert_allclose(np.asarray(out["out"]),
+                                   np.asarray(naive2d(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_trace_and_match_cached_per_shape(self):
+        """Review regression: eager loops must not re-trace per call."""
+        calls = []
+        real = jax.make_jaxpr
+
+        def counting(*a, **kw):
+            calls.append(1)
+            return real(*a, **kw)
+
+        q, k, v = _qkv((8, 4))
+        opt = ir.optimize(naive2d)
+        old = ir.jax.make_jaxpr
+        ir.jax.make_jaxpr = counting
+        try:
+            opt(q, k, v)
+            opt(q, k, v)
+            opt(q, k, v)
+        finally:
+            ir.jax.make_jaxpr = old
+        assert len(calls) == 1, len(calls)
+
+    def test_pass_registry(self):
+        assert "fuse_attention" in ir.PASSES
+        with pytest.raises(KeyError):
+            ir.optimize(naive2d, passes=("no_such_pass",))(
+                *_qkv((4, 4)))
