@@ -22,6 +22,8 @@ program, so passes cost nothing at runtime.
 import functools
 from collections import OrderedDict
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.extend import core as jcore
@@ -65,8 +67,16 @@ def _unwrap(var, prod):
     while not isinstance(var, jcore.Literal) and var in prod:
         i, eqn = prod[var]
         name = eqn.primitive.name
-        if name in ("convert_element_type", "stop_gradient",
-                    "broadcast_in_dim", "copy"):
+        if name in ("convert_element_type", "stop_gradient", "copy"):
+            seen.append(i)
+            var = eqn.invars[0]
+        elif name == "broadcast_in_dim":
+            # only TRIVIAL broadcasts (rank/keepdims plumbing) are
+            # transparent; a genuine size change is real math
+            src = eqn.invars[0].aval.shape
+            dst = eqn.outvars[0].aval.shape
+            if int(np.prod(src)) != int(np.prod(dst)):
+                break
             seen.append(i)
             var = eqn.invars[0]
         elif name == "max" and isinstance(eqn.invars[0], jcore.Literal):
@@ -116,6 +126,12 @@ def fuse_attention(jaxpr):
         if m is None:
             continue
         sum_i, sum_eqn = m
+        # the softmax must normalize over the score matrix's LAST axis
+        # (what the flash kernel computes); any other axis is a different
+        # function
+        s_nd = len(sum_eqn.invars[0].aval.shape)
+        if tuple(sum_eqn.params.get("axes", ())) != (s_nd - 1,):
+            continue
         sum_src, skip_d = _unwrap(sum_eqn.invars[0], prod)
         if sum_src is not num_var:
             continue
@@ -130,6 +146,9 @@ def fuse_attention(jaxpr):
             continue
         max_i, max_eqn = m
         if _unwrap(max_eqn.invars[0], prod)[0] is not scores_var:
+            continue
+        mx_nd = len(max_eqn.invars[0].aval.shape)
+        if tuple(max_eqn.params.get("axes", ())) != (mx_nd - 1,):
             continue
         # scores: optional scalar scale around the q@k dot
         scale_mode, scale_val = None, None

@@ -89,10 +89,13 @@ class StaticFunction:
     """Compiled forward wrapper (ConcreteProgram/PartialProgramLayer analog,
     reference python/paddle/jit/dy2static/program_translator.py)."""
 
-    def __init__(self, function, layer=None):
+    def __init__(self, function, layer=None, ir_passes=None):
         self._function = function
         self._layer = layer
         self._cache = {}
+        # jaxpr pattern-rewrite passes (framework/ir.py): None/False off,
+        # True = all registered, or an explicit sequence of pass names
+        self._ir_passes = ir_passes
 
     def __call__(self, *args, **kwargs):
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs),
@@ -125,6 +128,8 @@ class StaticFunction:
                                                    forward_fn=function, **k)
                     return out, buf
 
+                if self._ir_passes:
+                    compiled = self._wrap_ir(compiled)
                 self._cache[cache_key] = ("layer", compiled)
             else:
                 @jax.jit
@@ -139,6 +144,8 @@ class StaticFunction:
                         lambda t: t._data if isinstance(t, Tensor) else t, out,
                         is_leaf=_is_tensor)
 
+                if self._ir_passes:
+                    compiled = self._wrap_ir(compiled)
                 self._cache[cache_key] = ("fn", compiled)
 
         kind, compiled = self._cache[cache_key]
@@ -155,6 +162,15 @@ class StaticFunction:
             out = compiled(key, *datas)
         return jax.tree_util.tree_map(
             lambda d: Tensor(d) if isinstance(d, jax.Array) else d, out)
+
+    def _wrap_ir(self, compiled):
+        """Re-jit the cached callable with the IR passes applied to its
+        pure inner function (reference build_strategy fuse passes)."""
+        from ..framework import ir
+
+        inner = compiled.__wrapped__  # the function under @jax.jit
+        passes = None if self._ir_passes is True else list(self._ir_passes)
+        return jax.jit(ir.optimize(inner, passes=passes))
 
     @property
     def code(self):
@@ -181,10 +197,28 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     into lax control flow; statements the pass can't convert keep the
     explicit trace-guard behavior, and any conversion failure falls back
     to plain tracing.
+
+    ``ir_passes=True`` (or a sequence of pass names) runs the jaxpr
+    pattern-rewrite passes (framework/ir.py) over the traced program —
+    the reference's ``build_strategy`` fuse-pass role; a BuildStrategy
+    object with any truthy ``fuse_*`` attribute enables them too.
     """
     import types
 
     from .dy2static import ast_transform
+
+    ir_passes = kwargs.get("ir_passes")
+    if not ir_passes and build_strategy is not None:
+        # only GRAPH-fusion BuildStrategy flags opt in — comm-fusion
+        # flags (DistributedStrategy.fuse_all_reduce_ops etc.) are
+        # semantically unrelated and default True
+        _GRAPH_FUSE_FLAGS = ("fused_attention", "fuse_attention",
+                             "fuse_elewise_add_act_ops",
+                             "fuse_gemm_epilogue", "fuse_bn_act_ops",
+                             "fuse_bn_add_act_ops",
+                             "fuse_relu_depthwise_conv")
+        ir_passes = any(bool(getattr(build_strategy, a, False))
+                        for a in _GRAPH_FUSE_FLAGS)
 
     def decorate(fn):
         if isinstance(fn, Layer):
@@ -192,11 +226,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             conv = ast_transform(raw)
             fwd = types.MethodType(conv, fn) if conv is not None \
                 else fn.forward
-            static = StaticFunction(fwd, layer=fn)
+            static = StaticFunction(fwd, layer=fn, ir_passes=ir_passes)
             fn.forward = static
             return fn
         conv = ast_transform(fn)
-        return StaticFunction(conv if conv is not None else fn)
+        return StaticFunction(conv if conv is not None else fn,
+                              ir_passes=ir_passes)
 
     if function is not None:
         return decorate(function)

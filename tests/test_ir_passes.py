@@ -206,6 +206,104 @@ class TestFuseAttention:
             ir.jax.make_jaxpr = old
         assert len(calls) == 1, len(calls)
 
+    def test_non_last_axis_softmax_declines(self):
+        """Review regression (confirmed numerics bug): softmax over a
+        non-last axis is a different function — must not fuse."""
+
+        def fn(q, k, v):
+            return jax.nn.softmax(q @ k.T, axis=0) @ v
+
+        q, k, v = _qkv((8, 4))
+        opt = ir.optimize(fn)
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(fn(q, k, v)), rtol=1e-5)
+
+    def test_real_broadcast_between_softmax_and_matmul_declines(self):
+        """Review regression (confirmed shape bug): a genuine broadcast
+        is real math, not keepdims plumbing — must not be unwrapped."""
+
+        def fn(q, k, v):
+            p = jax.nn.softmax(q @ k.T, axis=-1)  # [1, 8]
+            return jnp.broadcast_to(p, (6, 8)) @ v
+
+        q = jnp.asarray(RNG.rand(1, 4).astype(np.float32))
+        k, v = _qkv((8, 4))[:2]
+        opt = ir.optimize(fn)
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 0
+        assert out.shape == (6, 4)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(fn(q, k, v)), rtol=1e-5)
+
+    def test_comm_fusion_strategy_does_not_enable_ir(self):
+        """Review regression: DistributedStrategy's comm-fusion flags
+        (fuse_all_reduce_ops defaults True) must not opt models into the
+        numerics-relevant graph rewrites."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import StaticFunction, to_static
+
+        class CommStrategy:
+            fuse_all_reduce_ops = True
+            fuse_grad_merge = True
+
+        @to_static(build_strategy=CommStrategy())
+        def f(x):
+            return x * 2.0
+
+        assert isinstance(f, StaticFunction)
+        assert not f._ir_passes
+
+        class GraphStrategy:
+            fuse_elewise_add_act_ops = True
+
+        @to_static(build_strategy=GraphStrategy())
+        def g(x):
+            return x * 2.0
+
+        assert g._ir_passes
+
+    def test_to_static_ir_passes_flag(self):
+        """The paddle-surface entry: to_static(ir_passes=True) routes the
+        traced program through the pass pipeline and the attention
+        pattern written with paddle ops fires."""
+        import paddle_tpu as paddle
+        from paddle_tpu.jit import to_static
+
+        fired = []
+        real = ir.optimize
+
+        def recording(fn, passes=None, **kw):
+            wrapped = real(fn, passes=passes, **kw)
+
+            def probe(*a):
+                out = wrapped(*a)
+                fired.append(wrapped.last_rewrite_count)
+                return out
+
+            return probe
+
+        old = ir.optimize
+        ir.optimize = recording
+        try:
+            @to_static(ir_passes=True)
+            def f(q, k, v):
+                s = q.matmul(k.T) / np.sqrt(8.0)
+                return paddle.nn.functional.softmax(s, axis=-1).matmul(v)
+
+            q = paddle.to_tensor(RNG.rand(16, 8).astype(np.float32))
+            k = paddle.to_tensor(RNG.rand(16, 8).astype(np.float32))
+            v = paddle.to_tensor(RNG.rand(16, 8).astype(np.float32))
+            out = f(q, k, v)
+        finally:
+            ir.optimize = old
+        assert fired and fired[0] >= 1, fired
+        s = q.numpy() @ k.numpy().T / np.sqrt(8.0)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        ref = (e / e.sum(-1, keepdims=True)) @ v.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
     def test_pass_registry(self):
         assert "fuse_attention" in ir.PASSES
         with pytest.raises(KeyError):
