@@ -208,7 +208,23 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     from .dy2static import ast_transform
 
     ir_passes = kwargs.get("ir_passes")
-    if not ir_passes and build_strategy is not None:
+    if ir_passes not in (None, True, False):
+        # validate early: a bare string would iterate per-character and a
+        # misspelled name would only KeyError deep inside the first trace
+        from ..framework import ir as _ir
+        if isinstance(ir_passes, str):
+            raise TypeError(
+                "ir_passes must be True/False or a SEQUENCE of pass "
+                f"names, got the string {ir_passes!r} — did you mean "
+                f"ir_passes=[{ir_passes!r}]?")
+        unknown = [n for n in ir_passes if n not in _ir.PASSES]
+        if unknown:
+            raise ValueError(
+                f"unknown ir pass(es) {unknown}; registered: "
+                f"{list(_ir.PASSES)}")
+    # explicit ir_passes=False is an OPT-OUT that build_strategy's fuse
+    # flags must not override
+    if "ir_passes" not in kwargs and build_strategy is not None:
         # only GRAPH-fusion BuildStrategy flags opt in — comm-fusion
         # flags (DistributedStrategy.fuse_all_reduce_ops etc.) are
         # semantically unrelated and default True

@@ -264,6 +264,26 @@ class TestFuseAttention:
 
         assert g._ir_passes
 
+    def test_explicit_false_overrides_strategy(self):
+        from paddle_tpu.jit import to_static
+
+        class GraphStrategy:
+            fuse_elewise_add_act_ops = True
+
+        @to_static(build_strategy=GraphStrategy(), ir_passes=False)
+        def f(x):
+            return x * 2.0
+
+        assert not f._ir_passes
+
+    def test_invalid_pass_names_rejected_early(self):
+        from paddle_tpu.jit import to_static
+
+        with pytest.raises(TypeError, match="SEQUENCE"):
+            to_static(ir_passes="fuse_attention")(lambda x: x)
+        with pytest.raises(ValueError, match="unknown ir pass"):
+            to_static(ir_passes=["nope"])(lambda x: x)
+
     def test_to_static_ir_passes_flag(self):
         """The paddle-surface entry: to_static(ir_passes=True) routes the
         traced program through the pass pipeline and the attention
