@@ -8,6 +8,15 @@
 // binary frames directly over the pd_table_* C ABI (sparse_table.cc), with
 // key->server sharding done by the client layer (key % num_servers).
 //
+// Scale ceiling (deliberate): one OS thread per trainer connection.
+// Linux handles thousands of mostly-idle threads fine, and each trainer
+// holds exactly ONE connection per server, so the ceiling is
+// ~trainer_count threads per server — comfortable to O(1k) trainers
+// (≈8 MB stack-reserve each, demand-paged).  The reference's brpc epoll
+// reactor exists to serve tens of thousands of mixed client types; if a
+// deployment needs that, put the shards behind more server PROCESSES
+// (key-sharding already spreads load) before reaching for epoll here.
+//
 // Wire format (little-endian):
 //   request : u8 opcode | u64 payload_len | payload
 //     PULL payload: i64 n | i64 keys[n]
