@@ -42,6 +42,11 @@ def _parse_args(argv=None):
     return p.parse_args(argv)
 
 
+def _coordinator_address(master):
+    from ..parallel import coordinator_address
+    return coordinator_address(master)
+
+
 def _rank_env(args, local_rank, world_size, master):
     env = dict(os.environ)
     rank = args.node_rank * args.nproc_per_node + local_rank
@@ -52,8 +57,9 @@ def _rank_env(args, local_rank, world_size, master):
         "PADDLE_NNODES": str(args.nnodes),
         "PADDLE_MASTER": master,
         "PADDLE_JOB_ID": args.job_id,
-        # jax multi-host bootstrap mirrors the same endpoint
-        "JAX_COORDINATOR_ADDRESS": master,
+        # jax's coordination service needs its OWN port — the TCPStore
+        # already owns the master port (convention: master port + 1)
+        "JAX_COORDINATOR_ADDRESS": _coordinator_address(master),
     })
     if args.devices is not None:
         env["CUDA_VISIBLE_DEVICES"] = args.devices

@@ -48,15 +48,33 @@ class ParallelEnv:
 _initialized = False
 
 
+def coordinator_address(master):
+    """The jax coordination endpoint derived from a ``host:port`` master
+    (TCPStore) endpoint — same host, port + 1 (the store owns its port).
+    Fails fast on a port-less endpoint instead of an opaque IndexError."""
+    host, sep, port = str(master).rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(
+            f"master endpoint must be host:port, got {master!r}")
+    return f"{host}:{int(port) + 1}"
+
+
 def init_parallel_env():
     """Bootstrap multi-host jax if configured; build the default group."""
     global _initialized
     if _initialized:
         return ParallelEnv()
-    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
-        "JAX_COORDINATOR_ADDRESS")
+    # the jax coordination service must NOT share the TCPStore's port:
+    # prefer the explicit JAX_COORDINATOR_ADDRESS (the launcher sets it
+    # to master_port + 1), else derive the same convention here
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coord and os.environ.get("PADDLE_MASTER"):
+        coord = coordinator_address(os.environ["PADDLE_MASTER"])
     nnodes = int(os.environ.get("PADDLE_NNODES", "1"))
-    if coord and nnodes > 1 and jax.process_count() == 1:
+    # NOTE: do not probe jax.process_count() here — it would initialize
+    # the XLA backend, after which jax.distributed.initialize refuses to
+    # run; is_initialized() only inspects the client state
+    if coord and nnodes > 1 and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=nnodes,

@@ -13,11 +13,18 @@ import jax
 
 
 class _GlobalRNG:
+    """Lazily materialized: creating a PRNGKey initializes the XLA
+    backend, and ``import paddle_tpu`` must not do that — multi-host
+    users call ``jax.distributed.initialize`` (via init_parallel_env)
+    AFTER import, which jax requires to happen before any backend use."""
+
     def __init__(self, seed_val=0):
-        self._key = jax.random.PRNGKey(seed_val)
+        self._key = None
         self.initial_seed = seed_val
 
     def split(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self.initial_seed)
         self._key, sub = jax.random.split(self._key)
         return sub
 
