@@ -71,6 +71,40 @@ WORKER = textwrap.dedent("""
 """)
 
 
+TRAINER_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import optimizer
+    from paddle_tpu.distributed.fleet.topology import build_mesh
+    from paddle_tpu.models.gpt import gpt_tiny
+    from paddle_tpu.parallel import SpmdTrainStep
+
+    env = dist.init_parallel_env()
+    assert jax.device_count() == 8  # 4 local devices x 2 processes
+
+    paddle.seed(0)                  # identical init on both hosts
+    model = gpt_tiny(num_layers=2)
+    opt = optimizer.AdamW(learning_rate=1e-3,
+                          parameters=model.parameters())
+    mesh = build_mesh(dp=4, pp=1, sharding=1, mp=2)
+    trainer = SpmdTrainStep(model, opt, mesh, zero_axis="dp")
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(0, 128, (8, 16)).astype(np.int32))
+    vals = []
+    for _ in range(2):
+        loss = trainer.step(ids, ids)
+        vals.append(float(np.asarray(loss._data.addressable_data(0))))
+    assert all(np.isfinite(v) for v in vals)
+    assert vals[1] < vals[0]
+    print("RANK", env.rank, "TRAINER", vals[0], vals[1], flush=True)
+""")
+
+
 def _free_port_pair():
     """A port where port+1 is also free (store + jax coordinator)."""
     for _ in range(50):
@@ -124,5 +158,36 @@ def test_two_process_bootstrap_and_collectives(tmp_path):
             assert f"RANK {r} MULTIHOST OK" in out
     finally:
         for p in procs:  # a bootstrap hang must not leak workers
+            if p.poll() is None:
+                p.kill()
+
+
+def test_spmd_trainer_spans_two_processes(tmp_path):
+    """The FULL hybrid trainer over a cross-process mesh: dp=4 x mp=2 on
+    8 global devices owned by two OS processes — the shape a real
+    multi-host TPU pod run takes.  Both ranks must see the identical
+    (global) loss, and it must decrease."""
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER_WORKER.format(repo=REPO))
+    port = _free_port_pair()
+    procs = [subprocess.Popen(
+        [sys.executable, str(script)], env=_cpu_env(r, port),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=200)
+            outs.append(out)
+        losses = {}
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+            line = [l for l in out.splitlines()
+                    if l.startswith(f"RANK {r} TRAINER")][0]
+            losses[r] = tuple(float(x) for x in line.split()[3:])
+        # the loss is a GLOBAL scalar: both hosts must agree exactly
+        assert losses[0] == losses[1], losses
+    finally:
+        for p in procs:
             if p.poll() is None:
                 p.kill()
