@@ -191,3 +191,28 @@ def test_spmd_trainer_spans_two_processes(tmp_path):
         for p in procs:
             if p.poll() is None:
                 p.kill()
+
+
+def test_longcontext_bench_harness():
+    """The long-context benchmark harness (benchmarks/bench_longcontext)
+    runs, emits parseable JSON, and its context-parallel modes match the
+    flash baseline numerically."""
+    import json
+
+    env = dict(os.environ)
+    for var in ("PALLAS_AXON_POOL_IPS", "AXON_POOL_SVC_OVERRIDE",
+                "AXON_LOOPBACK_RELAY"):
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks",
+                                      "bench_longcontext.py"),
+         "--cpu", "--seq", "256", "--heads", "4", "--head-dim", "32",
+         "--devices", "4", "--iters", "1"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert rc.returncode == 0, rc.stderr[-1500:]
+    rows = [json.loads(l) for l in rc.stdout.strip().splitlines()]
+    assert rows and rows[0]["flash_tokens_per_s"] > 0
+    assert rows[0]["ring_max_err"] < 1e-4
+    assert rows[0]["ulysses_max_err"] < 1e-4
