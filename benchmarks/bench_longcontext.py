@@ -33,10 +33,13 @@ def main():
     args = ap.parse_args()
 
     if args.cpu or os.environ.get("JAX_PLATFORMS") == "cpu":
-        os.environ.setdefault(
-            "XLA_FLAGS",
-            f"--xla_force_host_platform_device_count="
-            f"{max(args.devices, 4)}")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            # APPEND to any user flags (setdefault would silently drop
+            # the device count and shrink the mesh)
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count="
+                f"{max(args.devices, 4)}").strip()
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -49,6 +52,10 @@ def main():
     from paddle_tpu.ops import pallas
 
     n_dev = args.devices or len(jax.devices())
+    if n_dev > len(jax.devices()):
+        print(f"# only {len(jax.devices())} devices available "
+              f"(requested {n_dev})", file=sys.stderr)
+        n_dev = len(jax.devices())
     mesh = Mesh(np.array(jax.devices()[:n_dev]), ("sp",))
 
     def measure(fn, *xs):
@@ -76,7 +83,13 @@ def main():
                    "flash_tokens_per_s": round(args.batch * seq / t_flash)}
 
         for mode in ("ring", "ulysses"):
-            if seq % n_dev or args.heads % n_dev:
+            # ring only needs the SEQUENCE divisible by the sp degree;
+            # Ulysses additionally all-to-alls over heads
+            if seq % n_dev or (mode == "ulysses"
+                               and args.heads % n_dev):
+                print(f"# skip {mode} at seq={seq}: "
+                      f"seq/heads not divisible by {n_dev} devices",
+                      file=sys.stderr)
                 continue
             sharded = NamedSharding(mesh, P(None, "sp", None, None))
             qs, ks, vs = (jax.device_put(x, sharded) for x in (q, k, v))

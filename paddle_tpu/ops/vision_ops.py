@@ -304,12 +304,23 @@ def roi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
         ys = jnp.arange(h, dtype=jnp.float32)
         xs = jnp.arange(w, dtype=jnp.float32)
         feat = x[bidx]
-        py = jnp.clip(jnp.floor((ys - y1) / bin_h), -1, pooled_height)
-        px = jnp.clip(jnp.floor((xs - x1) / bin_w), -1, pooled_width)
-        out = jnp.full((c, pooled_height, pooled_width), -jnp.inf,
-                       jnp.float32)
-        ymask = (py[:, None] == jnp.arange(pooled_height)[None, :])  # [H,PH]
-        xmask = (px[:, None] == jnp.arange(pooled_width)[None, :])   # [W,PW]
+        # reference phi roi_pool bins OVERLAP: bin i spans
+        # [floor(i*bin), ceil((i+1)*bin)) — a pixel on a fractional
+        # boundary feeds BOTH neighbors (caught by the round-3 exact
+        # formula check; the old disjoint floor-assignment differed on
+        # rois whose size doesn't divide the pooled grid)
+        ph_idx = jnp.arange(pooled_height, dtype=jnp.float32)
+        pw_idx = jnp.arange(pooled_width, dtype=jnp.float32)
+        y_start = jnp.floor(ph_idx * bin_h)
+        y_end = jnp.ceil((ph_idx + 1) * bin_h)
+        x_start = jnp.floor(pw_idx * bin_w)
+        x_end = jnp.ceil((pw_idx + 1) * bin_w)
+        ry = ys[:, None] - y1                               # [H, 1]
+        rx = xs[:, None] - x1                               # [W, 1]
+        ymask = (ry >= y_start[None, :]) & (ry < y_end[None, :]) & \
+            (ry >= 0) & (ry < rh)                           # [H, PH]
+        xmask = (rx >= x_start[None, :]) & (rx < x_end[None, :]) & \
+            (rx >= 0) & (rx < rw)                           # [W, PW]
         big = feat[:, :, :, None, None].astype(jnp.float32)  # [C,H,W,1,1]
         m = ymask[None, :, None, :, None] & xmask[None, None, :, None, :]
         masked = jnp.where(m, big, -jnp.inf)

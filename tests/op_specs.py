@@ -976,9 +976,24 @@ spec("gumbel_softmax", lambda rng: ((_u(rng, (50, 4)),), {}),
 spec("rrelu", lambda rng: ((_pos(rng, (20,)),), {"training": False}),
      check=lambda r, a, k: np.testing.assert_allclose(
          r.numpy(), a[0], rtol=1e-6))
+def _ccs_check(r, a, k):
+    # remapped labels + sampled class set: with n_positives <= num_samples
+    # every positive class must be sampled, positives first, and remapped
+    # labels must point at their class's slot in the sampled list
+    label, num_classes, num_samples = a
+    remapped = np.asarray(r[0].numpy()).reshape(-1)
+    sampled = np.asarray(r[1].numpy()).reshape(-1)
+    pos = set(int(x) for x in label)
+    samp = [int(x) for x in sampled if x >= 0]
+    assert pos <= set(samp), (pos, samp)
+    lookup = {c: i for i, c in enumerate(samp)}
+    for lab, rm in zip(label, remapped):
+        assert int(rm) == lookup[int(lab)], (lab, rm, lookup)
+
+
 spec("class_center_sample",
-     lambda rng: ((rng.randint(0, 10, (8,)).astype(np.int64), 10, 4), {}),
-     ref=None)
+     lambda rng: ((rng.randint(0, 3, (8,)).astype(np.int64), 10, 5), {}),
+     check=_ccs_check)
 spec("dropout", lambda rng: ((_u(rng, (100,)),),
                              {"p": 0.5, "training": False}),
      check=lambda r, a, k: np.testing.assert_allclose(
@@ -1311,12 +1326,31 @@ spec("roi_align",
                   {"boxes_num": np.array([1], np.int32), "pooled_height": 2,
                    "pooled_width": 2}),
      ref=None, grad=(0,))
+def _roi_pool_check(r, a, k):
+    # reference phi roi_pool formula: inclusive rounded roi (w = x2-x1+1),
+    # bin [floor(i*h/P), ceil((i+1)*h/P)) windows, max-pooled
+    x = a[0]
+    x1, y1, x2, y2 = (int(round(v)) for v in a[1][0])
+    rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+    P = 2
+    exp = np.zeros((1, x.shape[1], P, P), F32)
+    for ph in range(P):
+        for pw in range(P):
+            hs = y1 + int(np.floor(ph * rh / P))
+            he = y1 + int(np.ceil((ph + 1) * rh / P))
+            ws = x1 + int(np.floor(pw * rw / P))
+            we = x1 + int(np.ceil((pw + 1) * rw / P))
+            exp[0, :, ph, pw] = x[0, :, hs:he, ws:we].max((1, 2))
+    got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-5)
+
+
 spec("roi_pool",
      lambda rng: ((_u(rng, (1, 2, 6, 6)),
                    np.array([[0, 0, 4, 4.]], F32)),
                   {"boxes_num": np.array([1], np.int32), "pooled_height": 2,
                    "pooled_width": 2}),
-     ref=None)
+     check=_roi_pool_check)
 spec("psroi_pool",
      lambda rng: ((_u(rng, (1, 8, 6, 6)),
                    np.array([[0, 0, 4, 4.]], F32)),
@@ -1331,10 +1365,29 @@ spec("generate_proposals",
                    np.full((9, 4), 0.1, F32)),
                   {"pre_nms_top_n": 5, "post_nms_top_n": 3}),
      ref=None)
+def _fpn_check(r, a, k):
+    # area 100 -> level 2 (clipped); area 4e4 -> level 3: the rois route
+    # to different static-padded level buckets, and the first
+    # sum(counts) restore slots invert the level concatenation
+    multi_rois, restore_idx, rois_nums = r[0], r[1], r[2]
+    counts = [int(np.asarray(n.numpy()).reshape(-1)[0] if hasattr(n, "numpy")
+                  else n) for n in rois_nums]
+    assert sum(counts) == 2, counts
+    assert counts[0] == 1 and counts[1] == 1, counts
+    # level 0 bucket holds roi 0, level 1 bucket holds roi 1 (padded)
+    np.testing.assert_allclose(multi_rois[0].numpy()[0], a[0][0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(multi_rois[1].numpy()[0], a[0][1],
+                               rtol=1e-6)
+    ri = np.asarray(restore_idx.numpy()).reshape(-1)
+    # valid entries (the real rois sort first in each padded bucket)
+    assert sorted(int(x) for x in ri[:2]) in ([0, 1], [0, 2])
+
+
 spec("distribute_fpn_proposals",
      lambda rng: ((np.array([[0, 0, 10, 10], [0, 0, 200, 200.]], F32),),
                   {"rois_num": np.array([2], np.int32)}),
-     ref=None)
+     check=_fpn_check)
 spec("box_clip_DUMMY", lambda rng: ((), {})) if False else None
 
 # -------------------------------------------------------------- sequence --
@@ -1480,15 +1533,11 @@ for _n, _g in _GRAD_UPGRADES.items():
 # elsewhere, or an honest statement of what a reference would take).
 # test_op_sweep.test_finite_only_is_justified enforces the partition.
 JUSTIFIED_FINITE_ONLY = {
-    "class_center_sample": "random sampling op: output is a random class "
-        "subset; determinism checked via the rng-threading tests",
     "coalesce": "exact dense round-trip covered by the sparse suite "
         "(tests/test_sparse_geometric.py) over real COO inputs",
     "deformable_conv": "zero-offset == plain conv2d identity asserted in "
         "tests/test_ops_extended.py::test_deformable_conv_zero_offset_"
         "equals_conv (the discriminating special case)",
-    "distribute_fpn_proposals": "pure routing op (area -> level binning); "
-        "level-assignment invariants asserted in the vision op tests",
     "fused_attention": "parity vs the unfused composition asserted in "
         "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
     "fused_linear_param_grad_add": "accumulation identity dgrad+=x^T dy "
@@ -1510,8 +1559,6 @@ JUSTIFIED_FINITE_ONLY = {
         "tests/test_models_zoo.py (deepspeech) and nn layer tests",
     "roi_align": "exact whole-image-mean case asserted in "
         "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
-    "roi_pool": "max-pool variant of roi_align; shares the box-clipping "
-        "path asserted there",
     "send_ue_recv": "message-passing with edge weights; aggregation "
         "parity vs segment_sum covered by the geometric tests",
     "weighted_sample_neighbors": "random graph sampling; degree/weight "
