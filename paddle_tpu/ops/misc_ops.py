@@ -162,32 +162,32 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
 @op()
 def hsigmoid_loss(x, label, weight, bias=None, num_classes=2,
                   path_table=None, path_code=None, is_sparse=False):
-    """Hierarchical sigmoid over a default complete binary tree."""
-    n, d = x.shape
-    code_len = int(np.ceil(np.log2(max(num_classes, 2))))
+    """Hierarchical sigmoid over the reference's default SimpleCode tree.
+
+    SimpleCode (paddle MatrixBitCodeFunctor): for class c let
+    u = c + num_classes; the path visits internal node (u >> (j+1)) - 1
+    with sigmoid target bit (u >> j) & 1, for j = 0..bitlen(u)-2.  Using
+    the exact reference layout keeps trained hsigmoid weights
+    checkpoint-compatible.
+    """
     lbl = jnp.asarray(label).reshape(-1)
-
-    def codes_of(l):
-        # node index path in complete binary tree (root=0)
-        node = l + num_classes - 1  # leaf position heuristic
-        idxs, bits = [], []
-        cur = node
-        for _ in range(code_len):
-            parent = (cur - 1) // 2
-            idxs.append(jnp.clip(parent, 0, num_classes - 2))
-            bits.append((cur % 2).astype(jnp.float32))
-            cur = parent
-        return jnp.stack(idxs, -1), jnp.stack(bits, -1)
-
-    idxs, bits = codes_of(lbl)
-    w = weight[idxs]  # [N, code_len, D]
+    u = lbl + num_classes
+    max_len = int(2 * num_classes - 1).bit_length() - 1
+    js = jnp.arange(max_len)
+    # valid while (u >> (j+1)) > 0 — INTEGER bit length; float32 log2 is
+    # off-by-one at powers of two and above 2^21 (caught in review)
+    valid = (u[:, None] >> (js[None, :] + 1)) > 0          # [N, L]
+    idxs = jnp.clip((u[:, None] >> (js[None, :] + 1)) - 1, 0,
+                    num_classes - 2)
+    bits = ((u[:, None] >> js[None, :]) & 1).astype(jnp.float32)
+    w = weight[idxs]  # [N, L, D]
     logit = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
                        w.astype(jnp.float32))
     if bias is not None:
         logit = logit + bias.reshape(-1)[idxs]
     loss = jnp.maximum(logit, 0) - logit * bits + \
         jnp.log1p(jnp.exp(-jnp.abs(logit)))
-    return loss.sum(-1, keepdims=True)
+    return jnp.where(valid, loss, 0.0).sum(-1, keepdims=True)
 
 
 # --------------------------------------------------------- normalization

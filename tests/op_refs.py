@@ -725,3 +725,26 @@ def masked_matmul_check(r, a, k):
     exp = (x @ y) * (mask != 0)
     got = r.to_dense().numpy() if hasattr(r, "to_dense") else r.numpy()
     np.testing.assert_allclose(np.asarray(got), exp, rtol=1e-5, atol=1e-6)
+
+
+def hsigmoid_loss_ref(x, label, weight, bias, num_classes):
+    """SimpleCode hierarchical sigmoid (reference MatrixBitCodeFunctor):
+    class c visits node (u >> (j+1)) - 1 with bit (u >> j) & 1 for
+    u = c + num_classes, j = 0..bitlen(u)-2."""
+    out = np.zeros((len(label), 1), F32)
+    for i, c in enumerate(label.reshape(-1)):
+        u = int(c) + num_classes
+        total = 0.0
+        j = 0
+        while (u >> (j + 1)) > 0:
+            idx = (u >> (j + 1)) - 1
+            bit = (u >> j) & 1
+            logit = float(x[i] @ weight[idx])
+            if bias is not None:
+                logit += float(bias.reshape(-1)[idx])
+            # stable BCE-with-logits, target = bit
+            total += max(logit, 0) - logit * bit + math.log1p(
+                math.exp(-abs(logit)))
+            j += 1
+        out[i, 0] = total
+    return out

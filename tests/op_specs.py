@@ -783,9 +783,14 @@ spec("margin_cross_entropy",
                   / np.exp(a[0][i] - a[0][i].max()).sum())
           for i in range(4)], rtol=1e-3, atol=1e-5))
 spec("hsigmoid_loss",
-     lambda rng: ((_u(rng, (3, 4)), rng.randint(0, 2, (3,)).astype(np.int64),
-                   _u(rng, (1, 4))), {"num_classes": 2}),
-     ref=None)
+     lambda rng: ((_u(rng, (3, 8)),
+                   rng.randint(0, 6, (3,)).astype(np.int64),
+                   _u(rng, (5, 8))), {"num_classes": 6}),
+     check=lambda r, a, k: np.testing.assert_allclose(
+         (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
+         R.hsigmoid_loss_ref(a[0], a[1], a[2], None, 6),
+         rtol=1e-4, atol=1e-5),
+     grad=(0, 2))
 spec("accuracy", lambda rng: ((_pos(rng, (4, 3)),
                                rng.randint(0, 3, (4, 1)).astype(np.int64),
                                rng.randint(0, 3, (4, 1)).astype(np.int64)),
@@ -1544,9 +1549,6 @@ JUSTIFIED_FINITE_ONLY = {
         "is exercised end-to-end by the fused-pass training tests",
     "generate_proposals": "composition of box_coder decode (ref-checked "
         "above) + nms (exactness tested in test_ops_extended)",
-    "hsigmoid_loss": "path-code tree loss; a numpy ref needs the exact "
-        "default-tree layout — covered functionally by test_api_longtail "
-        "convergence on a small classification task",
     "matrix_nms": "score-decay variant of nms; suppression ordering "
         "asserted in the vision tests, exact decay table pending",
     "multiclass_nms3": "per-class nms wrapper over the exactness-tested "

@@ -453,3 +453,26 @@ def test_grad_flows_through_new_ops():
     out.backward(t(np.ones((2, 3, 4, 4), np.float32)))
     assert x.grad is not None
     assert x.grad.shape == [2, 3, 8, 8]
+
+
+def test_hsigmoid_simplecode_bitlength_at_powers_of_two():
+    """Review regression: float32 log2 bit-length dropped/added path
+    terms when u = label + num_classes hit exact powers of two or
+    large-vocab (>2^20) ranges; the integer shift form must match the
+    SimpleCode reference everywhere."""
+    import os as _os
+    import sys as _sys
+
+    _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
+    import op_refs as R
+
+    rng = np.random.RandomState(1)
+    for C, lab in ((5000, 3192), (1 << 20, 12345), (2, 0), (17, 15)):
+        x = rng.rand(2, 4).astype(np.float32)
+        w = (rng.rand(max(C - 1, 1), 4).astype(np.float32) * 0.1)
+        labels = np.array([lab, min(lab + 1, C - 1)], np.int64)
+        out = OPS["hsigmoid_loss"].user_fn(
+            t(x), t(labels), t(w), num_classes=C)
+        got = (out[0] if isinstance(out, (list, tuple)) else out).numpy()
+        exp = R.hsigmoid_loss_ref(x, labels, w, None, C)
+        np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
