@@ -9,6 +9,10 @@ import numpy as np
 F32 = np.float32
 
 
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
 # --------------------------------------------------------- optimizer refs --
 # reference update rules: paddle/phi/kernels/cpu/{adamw,adam}_kernel.cc,
 # adadelta_kernel, rmsprop_kernel, adamax_kernel, lamb functors
@@ -654,7 +658,7 @@ def yolo_box_check(r, a, k):
     conf_thresh = k.get("conf_thresh", 0.005)
     n, c, h, w = x.shape
     na = len(anchors) // 2
-    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    sig = _sigmoid
     xr = x.reshape(n, na, 5 + class_num, h, w)
     img_h, img_w = float(img_size[0, 0]), float(img_size[0, 1])
     boxes = np.zeros((n, na * h * w, 4), F32)
@@ -748,3 +752,29 @@ def hsigmoid_loss_ref(x, label, weight, bias, num_classes):
             j += 1
         out[i, 0] = total
     return out
+
+
+def lstm_rnn_check(r, a, k):
+    """Single-layer LSTM forward, plain numpy loops (cuDNN flat-weight
+    layout: w_ih [4H, I], w_hh [4H, H], gate order i,f,g,o)."""
+    x, (h0, c0), (wi, wh, bi, bh) = a[0], a[1], a[2]
+    T, B, _ = x.shape
+    H = wh.shape[1]
+    sig = _sigmoid
+    h, c = h0[0].astype(np.float64), c0[0].astype(np.float64)
+    outs = []
+    for t_ in range(T):
+        g = x[t_] @ wi.T + h @ wh.T + bi + bh
+        i, f, gg, o = np.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * np.tanh(gg)
+        h = np.tanh(c) * sig(o)
+        outs.append(h)
+    out = np.stack(outs).astype(F32)
+    got_out = np.asarray(r[0].numpy())
+    got_h = np.asarray(r[1][0].numpy())
+    got_c = np.asarray(r[1][1].numpy())
+    np.testing.assert_allclose(got_out, out, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(got_h[0], h.astype(F32), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(got_c[0], c.astype(F32), rtol=1e-4,
+                               atol=1e-5)

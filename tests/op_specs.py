@@ -1448,11 +1448,11 @@ spec("fused_linear_param_grad_add",
      ref=None)
 spec("rnn",
      lambda rng: ((_u(rng, (3, 2, 4)),
-                   [np.zeros((1, 2, 8), F32), np.zeros((1, 2, 8), F32)],
+                   [_u(rng, (1, 2, 8)), _u(rng, (1, 2, 8))],
                    [_u(rng, (32, 4)), _u(rng, (32, 8)),
-                    np.zeros(32, F32), np.zeros(32, F32)]),
+                    _u(rng, (32,)), _u(rng, (32,))]),
                   {"hidden_size": 8, "mode": "LSTM", "is_test": True}),
-     ref=None)
+     check=R.lstm_rnn_check)
 spec("gumbel_softmax_DUMMY", lambda rng: ((), {})) if False else None
 def _jpeg_make(rng):
     import io as _io
@@ -1557,8 +1557,6 @@ JUSTIFIED_FINITE_ONLY = {
         "routing invariant asserted in the vision tests",
     "reindex_graph": "graph index compaction; inverse-mapping invariant "
         "covered by tests/test_sparse_geometric.py graph suite",
-    "rnn": "multi-layer LSTM/GRU; parity vs the layer API asserted in "
-        "tests/test_models_zoo.py (deepspeech) and nn layer tests",
     "roi_align": "exact whole-image-mean case asserted in "
         "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
     "send_ue_recv": "message-passing with edge weights; aggregation "
