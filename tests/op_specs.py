@@ -1030,7 +1030,12 @@ spec("send_ue_recv",
      lambda rng: ((_u(rng, (4, 3)), _u(rng, (3, 3)),
                    np.array([0, 1, 2], np.int32),
                    np.array([1, 2, 3], np.int32)), {}),
-     ref=None)
+     check=lambda r, a, k: np.testing.assert_allclose(
+         r.numpy(),
+         np.stack([np.zeros(3, np.float32)]
+                  + [a[0][i] + a[1][i] for i in range(3)]),
+         rtol=1e-5),
+     grad=(0, 1))
 spec("send_uv",
      lambda rng: ((_u(rng, (4, 3)), _u(rng, (4, 3)),
                    np.array([0, 1], np.int32),
@@ -1042,17 +1047,45 @@ spec("segment_pool",
      check=lambda r, a, k: np.testing.assert_allclose(
          (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
          np.stack([a[0][:2].sum(0), a[0][2:].sum(0)]), rtol=1e-5))
+
+
+def _reindex_check(r, a, k):
+    x, nbr, cnt = a
+    src, dst, out_nodes = (np.asarray(v.numpy()).reshape(-1) for v in r)
+    # compacted ids decode back to the ORIGINAL edge endpoints
+    np.testing.assert_array_equal(out_nodes[src], nbr)
+    centers = np.repeat(x[:len(cnt)], cnt)
+    np.testing.assert_array_equal(out_nodes[dst], centers)
+    np.testing.assert_array_equal(out_nodes[:len(x)], x)
+
+
 spec("reindex_graph",
      lambda rng: ((np.array([0, 5, 9], np.int64),
                    np.array([5, 9, 0], np.int64),
                    np.array([2, 1], np.int64)), {}),
-     ref=None)
+     check=_reindex_check)
+
+
+def _wsn_check(r, a, k):
+    row, colptr, w, nodes = a
+    out_nbrs, out_count = (np.asarray(v.numpy()).reshape(-1)
+                           for v in r[:2])
+    np.testing.assert_array_equal(out_count, [1, 1])
+    # each sampled neighbor must come from its node's CSC column
+    pos = 0
+    for i, nd in enumerate(nodes):
+        col = row[colptr[nd]:colptr[nd + 1]]
+        for _ in range(out_count[i]):
+            assert out_nbrs[pos] in col, (out_nbrs[pos], col)
+            pos += 1
+
+
 spec("weighted_sample_neighbors",
      lambda rng: ((np.array([1, 2, 0, 2], np.int64),
                    np.array([0, 2, 4], np.int64),
                    _pos(rng, (4,)), np.array([0, 1], np.int64)),
                   {"sample_size": 1}),
-     ref=None)
+     check=_wsn_check)
 spec("gather_tree",
      lambda rng: ((rng.randint(0, 5, (3, 2, 2)).astype(np.int64),
                    rng.randint(0, 2, (3, 2, 2)).astype(np.int64)), {}),
@@ -1065,9 +1098,12 @@ spec("sparse_coo_tensor",
                    np.array([[0, 1], [1, 0]], np.int64), [2, 2]), {}),
      check=R.sparse_coo_tensor_check)
 spec("coalesce",
-     lambda rng: ((np.array([[0, 0], [1, 1]], np.int64),
-                   np.array([1., 2.], F32)), {"shape": [2, 2]}),
-     ref=None)
+     lambda rng: ((np.array([[0, 0, 0], [1, 1, 0]], np.int64),
+                   np.array([1., 2., 4.], F32)), {"shape": [2, 2]}),
+     check=lambda r, a, k: np.testing.assert_allclose(
+         R._dense_from_coo(np.asarray(r[0].numpy()),
+                           np.asarray(r[1].numpy()), (2, 2)),
+         np.array([[4., 3.], [0., 0.]], F32), rtol=1e-6))
 spec("to_sparse_coo", lambda rng: ((np.array([[1, 0], [0, 2.]], F32),),
                                    {"sparse_dim": 2}),
      check=lambda r, a, k: np.testing.assert_allclose(
@@ -1445,7 +1481,11 @@ spec("fused_dropout_add",
          a[0] + a[1], rtol=1e-5))
 spec("fused_linear_param_grad_add",
      lambda rng: ((_u(rng, (4, 3)), _u(rng, (4, 5))), {}),
-     ref=None)
+     check=lambda r, a, k: (
+         np.testing.assert_allclose(r[0].numpy(), a[0].T @ a[1],
+                                    rtol=1e-5, atol=1e-6),
+         np.testing.assert_allclose(r[1].numpy(), a[1].sum(0),
+                                    rtol=1e-5, atol=1e-6))[0])
 spec("rnn",
      lambda rng: ((_u(rng, (3, 2, 4)),
                    [_u(rng, (1, 2, 8)), _u(rng, (1, 2, 8))],
@@ -1538,31 +1578,21 @@ for _n, _g in _GRAD_UPGRADES.items():
 # elsewhere, or an honest statement of what a reference would take).
 # test_op_sweep.test_finite_only_is_justified enforces the partition.
 JUSTIFIED_FINITE_ONLY = {
-    "coalesce": "exact dense round-trip covered by the sparse suite "
-        "(tests/test_sparse_geometric.py) over real COO inputs",
     "deformable_conv": "zero-offset == plain conv2d identity asserted in "
-        "tests/test_ops_extended.py::test_deformable_conv_zero_offset_"
-        "equals_conv (the discriminating special case)",
+    "tests/test_ops_extended.py::test_deformable_conv_zero_offset_"
+    "equals_conv (the discriminating special case)",
     "fused_attention": "parity vs the unfused composition asserted in "
-        "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
-    "fused_linear_param_grad_add": "accumulation identity dgrad+=x^T dy "
-        "is exercised end-to-end by the fused-pass training tests",
+    "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
     "generate_proposals": "composition of box_coder decode (ref-checked "
-        "above) + nms (exactness tested in test_ops_extended)",
+    "above) + nms (exactness tested in test_ops_extended)",
     "matrix_nms": "score-decay variant of nms; suppression ordering "
-        "asserted in the vision tests, exact decay table pending",
+    "asserted in the vision tests, exact decay table pending",
     "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
-        "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
+    "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
     "psroi_pool": "position-sensitive variant of roi_pool; channel-"
-        "routing invariant asserted in the vision tests",
-    "reindex_graph": "graph index compaction; inverse-mapping invariant "
-        "covered by tests/test_sparse_geometric.py graph suite",
+    "routing invariant asserted in the vision tests",
     "roi_align": "exact whole-image-mean case asserted in "
-        "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
-    "send_ue_recv": "message-passing with edge weights; aggregation "
-        "parity vs segment_sum covered by the geometric tests",
-    "weighted_sample_neighbors": "random graph sampling; degree/weight "
-        "invariants covered by the geometric sampling tests",
+    "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
     "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
-        "finite-loss + decreasing-loss covered by the detection tests",
+    "finite-loss + decreasing-loss covered by the detection tests",
 }
