@@ -778,3 +778,51 @@ def lstm_rnn_check(r, a, k):
                                atol=1e-5)
     np.testing.assert_allclose(got_c[0], c.astype(F32), rtol=1e-4,
                                atol=1e-5)
+
+
+def matrix_nms_check(r, a, k):
+    """SOLOv2 matrix-NMS decay table, plain numpy (linear decay):
+    decay_j = min_i (1 - iou_ij) / (1 - max_iou_i) over higher-scored i;
+    final score_j = score_j * decay_j."""
+    bboxes, scores = a
+    post = k.get("post_threshold", 0.0)
+
+    def iou(b1, b2):
+        x1 = max(b1[0], b2[0]); y1 = max(b1[1], b2[1])
+        x2 = min(b1[2], b2[2]); y2 = min(b1[3], b2[3])
+        inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+        a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+        a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+        return inter / max(a1 + a2 - inter, 1e-9)
+
+    expected = {}
+    cnum = scores.shape[1]
+    for ci in range(1, cnum):  # background_label 0 skipped
+        s = scores[0, ci]
+        order = np.argsort(-s)
+        ss, bs = s[order], bboxes[0][order]
+        m = len(ss)
+        ious = np.zeros((m, m))
+        for i in range(m):
+            for j in range(i + 1, m):
+                ious[i, j] = iou(bs[i], bs[j])
+        max_iou = ious.max(axis=0)
+        for j in range(m):
+            decay = 1.0
+            for i in range(j):
+                decay = min(decay, (1 - ious[i, j]) /
+                            max(1 - max_iou[i], 1e-9))
+            final = ss[j] * decay
+            if final > post:
+                key = (ci, round(float(bs[j][0]), 3),
+                       round(float(bs[j][1]), 3))
+                expected[key] = final
+    out = np.asarray(r[0].numpy())
+    got = {}
+    for row in out:
+        if row[1] > -1:  # padded slots carry score -1
+            got[(int(row[0]), round(float(row[2]), 3),
+                 round(float(row[3]), 3))] = float(row[1])
+    assert set(got) == set(expected), (got, expected)
+    for key in expected:
+        np.testing.assert_allclose(got[key], expected[key], rtol=1e-4)

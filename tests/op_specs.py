@@ -1328,12 +1328,13 @@ spec("nms", lambda rng: ((np.array([[0, 0, 1, 1], [0.01, 0, 1.01, 1],
      check=lambda r, a, k: len(np.asarray(
          (r[0] if isinstance(r, (list, tuple)) else r).numpy())) == 2)
 spec("matrix_nms",
-     lambda rng: ((np.array([[[0, 0, 1, 1], [2, 2, 3, 3.]]], F32),
-                   np.array([[[0.9, 0.8]]], F32) *
-                   np.ones((1, 2, 2), F32)), {"post_threshold": 0.1,
-                                              "nms_top_k": 5,
-                                              "keep_top_k": 5}),
-     ref=None)
+     lambda rng: ((np.array([[[0, 0, 2, 2], [1, 1, 3, 3],
+                              [5, 5, 6, 6.]]], F32),
+                   np.stack([np.zeros((1, 3), F32),
+                             np.array([[0.9, 0.8, 0.7]], F32)], 1)),
+                  {"post_threshold": 0.05, "nms_top_k": 5,
+                   "keep_top_k": 5}),
+     check=R.matrix_nms_check)
 spec("multiclass_nms3",
      lambda rng: ((np.array([[[0, 0, 1, 1], [2, 2, 3, 3.]]], F32),
                    np.array([[[0.9, 0.1], [0.2, 0.8]]], F32)),
@@ -1585,9 +1586,7 @@ JUSTIFIED_FINITE_ONLY = {
     "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
     "generate_proposals": "composition of box_coder decode (ref-checked "
     "above) + nms (exactness tested in test_ops_extended)",
-    "matrix_nms": "score-decay variant of nms; suppression ordering "
-    "asserted in the vision tests, exact decay table pending",
-    "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
+        "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
     "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
     "psroi_pool": "position-sensitive variant of roi_pool; channel-"
     "routing invariant asserted in the vision tests",
