@@ -345,31 +345,42 @@ def psroi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
     bx = boxes.astype(jnp.float32) * spatial_scale
 
     def one_roi(box, bidx):
-        x1, y1, x2, y2 = box
+        # reference phi psroi_pool (psroi_pool_kernel.cc): roi endpoints
+        # are round(x1)*scale .. (round(x2)+1)*scale; each bin AVERAGES
+        # the integer-pixel window [floor(ph*bin+y1), ceil((ph+1)*bin+y1))
+        # (empty bins zero), and the position-sensitive input channel is
+        # (oc*PH + ph)*PW + pw — oc-major.  (The old bilinear
+        # sub-sampling + transposed channel layout were divergences
+        # caught by the round-3 exact-reference pass.)
+        bx1, by1, bx2, by2 = box
+        x1 = jnp.round(bx1) * spatial_scale
+        y1 = jnp.round(by1) * spatial_scale
+        x2 = (jnp.round(bx2) + 1.0) * spatial_scale
+        y2 = (jnp.round(by2) + 1.0) * spatial_scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_h, bin_w = rh / pooled_height, rw / pooled_width
-        feat = x[bidx].astype(jnp.float32)
-        outs = []
-        sr = 2
-        py = jnp.arange(pooled_height, dtype=jnp.float32)
-        px = jnp.arange(pooled_width, dtype=jnp.float32)
-        sy = (jnp.arange(sr, dtype=jnp.float32) + 0.5) / sr
-        yy = jnp.clip(y1 + (py[:, None] + sy[None, :]) * bin_h, 0, h - 1)
-        xx = jnp.clip(x1 + (px[:, None] + sy[None, :]) * bin_w, 0, w - 1)
-        gy = jnp.repeat(yy.reshape(-1), xx.size)
-        gx = jnp.tile(xx.reshape(-1), yy.size)
-        vals = _roi_bilinear(feat, gy, gx).reshape(
-            c, pooled_height, sr, pooled_width, sr).mean(axis=(2, 4))
-        # position-sensitive: channel block (ph*PW+pw)*output_channels + oc
-        ph_idx = jnp.arange(pooled_height)
-        pw_idx = jnp.arange(pooled_width)
-        oc = jnp.arange(output_channels)
-        ch = (ph_idx[:, None, None] * pooled_width + pw_idx[None, :, None]) \
-            * output_channels + oc[None, None, :]
-        out = vals[ch, ph_idx[:, None, None],
-                   pw_idx[None, :, None]]  # [PH,PW,OC]
-        return jnp.transpose(out, (2, 0, 1))
+        # oc-major position-sensitive layout: [OC, PH, PW, H, W]
+        feat = x[bidx].astype(jnp.float32).reshape(
+            output_channels, pooled_height, pooled_width, h, w)
+        ph_idx = jnp.arange(pooled_height, dtype=jnp.float32)
+        pw_idx = jnp.arange(pooled_width, dtype=jnp.float32)
+        ys = jnp.arange(h, dtype=jnp.float32)[:, None]     # [H, 1]
+        xs = jnp.arange(w, dtype=jnp.float32)[:, None]     # [W, 1]
+        y_lo = jnp.clip(jnp.floor(ph_idx * bin_h + y1), 0, h)
+        y_hi = jnp.clip(jnp.ceil((ph_idx + 1) * bin_h + y1), 0, h)
+        x_lo = jnp.clip(jnp.floor(pw_idx * bin_w + x1), 0, w)
+        x_hi = jnp.clip(jnp.ceil((pw_idx + 1) * bin_w + x1), 0, w)
+        ymask = ((ys >= y_lo[None, :]) &
+                 (ys < y_hi[None, :])).astype(jnp.float32)  # [H, PH]
+        xmask = ((xs >= x_lo[None, :]) &
+                 (xs < x_hi[None, :])).astype(jnp.float32)  # [W, PW]
+        # contract each bin only with ITS OWN channel slice (PH*PW-fold
+        # less work than averaging every channel at every bin)
+        sums = jnp.einsum("oPQhw,hP,wQ->oPQ", feat, ymask, xmask)
+        counts = jnp.einsum("hP,wQ->PQ", ymask, xmask)
+        vals = sums / jnp.maximum(counts, 1.0)[None]
+        return jnp.where(counts[None] > 0, vals, 0.0)  # [OC, PH, PW]
 
     return jax.vmap(one_roi)(bx, batch_idx).astype(x.dtype)
 

@@ -826,3 +826,34 @@ def matrix_nms_check(r, a, k):
     assert set(got) == set(expected), (got, expected)
     for key in expected:
         np.testing.assert_allclose(got[key], expected[key], rtol=1e-4)
+
+
+def psroi_pool_check(r, a, k):
+    """phi psroi_pool (psroi_pool_kernel.cc): roi endpoints
+    round(x1)*scale .. (round(x2)+1)*scale; bin (ph,pw) averages input
+    channel (oc*PH+ph)*PW+pw (oc-major) over integer pixels
+    [floor(ph*bin+y1), ceil((ph+1)*bin+y1)); empty bins 0."""
+    x, boxes = a
+    PH, PW = k["pooled_height"], k["pooled_width"]
+    OC = k["output_channels"]
+    scale = k.get("spatial_scale", 1.0)
+    H, W = x.shape[2], x.shape[3]
+    x1 = round(float(boxes[0][0])) * scale
+    y1 = round(float(boxes[0][1])) * scale
+    x2 = (round(float(boxes[0][2])) + 1) * scale
+    y2 = (round(float(boxes[0][3])) + 1) * scale
+    bh = max(y2 - y1, 0.1) / PH
+    bw = max(x2 - x1, 0.1) / PW
+    exp = np.zeros((1, OC, PH, PW), F32)
+    for ph in range(PH):
+        for pw in range(PW):
+            hs = max(int(np.floor(ph * bh + y1)), 0)
+            he = min(int(np.ceil((ph + 1) * bh + y1)), H)
+            ws = max(int(np.floor(pw * bw + x1)), 0)
+            we = min(int(np.ceil((pw + 1) * bw + x1)), W)
+            for oc in range(OC):
+                cin = (oc * PH + ph) * PW + pw
+                window = x[0, cin, hs:he, ws:we]
+                exp[0, oc, ph, pw] = window.mean() if window.size else 0.0
+    got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)

@@ -1395,10 +1395,10 @@ spec("roi_pool",
      check=_roi_pool_check)
 spec("psroi_pool",
      lambda rng: ((_u(rng, (1, 8, 6, 6)),
-                   np.array([[0, 0, 4, 4.]], F32)),
+                   np.array([[0.5, 0.5, 4.5, 4.5]], F32)),
                   {"boxes_num": np.array([1], np.int32), "pooled_height": 2,
                    "pooled_width": 2, "output_channels": 2}),
-     ref=None)
+     check=R.psroi_pool_check)
 spec("generate_proposals",
      lambda rng: ((_pos(rng, (1, 2, 3, 3), 0.1, 0.9),
                    _u(rng, (1, 8, 3, 3), -0.1, 0.1),
@@ -1588,9 +1588,7 @@ JUSTIFIED_FINITE_ONLY = {
     "above) + nms (exactness tested in test_ops_extended)",
         "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
     "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
-    "psroi_pool": "position-sensitive variant of roi_pool; channel-"
-    "routing invariant asserted in the vision tests",
-    "roi_align": "exact whole-image-mean case asserted in "
+        "roi_align": "exact whole-image-mean case asserted in "
     "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
     "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
     "finite-loss + decreasing-loss covered by the detection tests",
