@@ -857,3 +857,86 @@ def psroi_pool_check(r, a, k):
                 exp[0, oc, ph, pw] = window.mean() if window.size else 0.0
     got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
     np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def _greedy_nms(boxes, scores, iou_thresh):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        ok = True
+        for j in keep:
+            b1, b2 = boxes[i], boxes[j]
+            xx1 = max(b1[0], b2[0]); yy1 = max(b1[1], b2[1])
+            xx2 = min(b1[2], b2[2]); yy2 = min(b1[3], b2[3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a1 = (b1[2] - b1[0]) * (b1[3] - b1[1])
+            a2 = (b2[2] - b2[0]) * (b2[3] - b2[1])
+            if inter / max(a1 + a2 - inter, 1e-9) > iou_thresh:
+                ok = False
+                break
+        if ok:
+            keep.append(i)
+    return keep
+
+
+def multiclass_nms3_check(r, a, k):
+    """Per-class greedy NMS then cross-class keep_top_k (phi
+    multiclass_nms3 kernel semantics)."""
+    bboxes, scores = a
+    st = k.get("score_threshold", 0.0)
+    nt = k.get("nms_threshold", 0.3)
+    bg = k.get("background_label", 0)
+    expected = []
+    for ci in range(scores.shape[1]):
+        if ci == bg:
+            continue
+        s = scores[0, ci]
+        valid = np.nonzero(s > st)[0]
+        keep = _greedy_nms(bboxes[0][valid], s[valid], nt)
+        for j in keep:
+            idx = valid[j]
+            expected.append((ci, round(float(s[idx]), 4),
+                             tuple(bboxes[0][idx])))
+    out = np.asarray(r[0].numpy())
+    got = [(int(row[0]), round(float(row[1]), 4),
+            tuple(row[2:6])) for row in out if row[1] > -1]
+    assert sorted(got) == sorted(expected), (got, expected)
+
+
+def roi_align_check(r, a, k):
+    """Exact roi_align (aligned=True, 2x2 sample grid — phi formula;
+    the spec's 2px bins make phi's adaptive ceil(bin) grid equal 2):
+    bilinear at y1 + (ph + (s+0.5)/2)*bin_h, averaged per bin."""
+    x, boxes = a
+    P = k["pooled_height"]
+    x1, y1, x2, y2 = (float(v) - 0.5 for v in boxes[0])
+    bh = max(y2 - y1, 1e-3) / P
+    bw = max(x2 - x1, 1e-3) / P
+    H, W = x.shape[2], x.shape[3]
+
+    def bil(c, yy, xx):
+        yy = min(max(yy, 0.0), H - 1)
+        xx = min(max(xx, 0.0), W - 1)
+        y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+        y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+        dy, dx = yy - y0, xx - x0
+        v = (x[0, c, y0, x0] * (1 - dy) * (1 - dx)
+             + x[0, c, y0, x1_] * (1 - dy) * dx
+             + x[0, c, y1_, x0] * dy * (1 - dx)
+             + x[0, c, y1_, x1_] * dy * dx)
+        return v
+
+    C = x.shape[1]
+    exp = np.zeros((1, C, P, P), F32)
+    for c in range(C):
+        for ph in range(P):
+            for pw in range(P):
+                acc = 0.0
+                for sy_ in range(2):
+                    for sx in range(2):
+                        yy = y1 + (ph + (sy_ + 0.5) / 2) * bh
+                        xx = x1 + (pw + (sx + 0.5) / 2) * bw
+                        acc += bil(c, yy, xx)
+                exp[0, c, ph, pw] = acc / 4
+    got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
+    np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)

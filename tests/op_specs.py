@@ -1336,11 +1336,12 @@ spec("matrix_nms",
                    "keep_top_k": 5}),
      check=R.matrix_nms_check)
 spec("multiclass_nms3",
-     lambda rng: ((np.array([[[0, 0, 1, 1], [2, 2, 3, 3.]]], F32),
-                   np.array([[[0.9, 0.1], [0.2, 0.8]]], F32)),
-                  {"score_threshold": 0.05, "nms_top_k": 5, "keep_top_k": 5,
-                   "background_label": -1}),
-     ref=None)
+     lambda rng: ((np.array([[[0, 0, 2, 2], [1, 1, 3, 3],
+                              [5, 5, 6, 6.]]], F32),
+                   np.array([[[0.9, 0.3, 0.6], [0.2, 0.8, 0.1]]], F32)),
+                  {"score_threshold": 0.05, "nms_top_k": 5, "keep_top_k": 9,
+                   "nms_threshold": 0.1, "background_label": -1}),
+     check=R.multiclass_nms3_check)
 spec("box_coder",
      lambda rng: ((np.array([[0, 0, 2, 2.]], F32),
                    np.array([[0.1, 0.1, 0.2, 0.2]], F32),
@@ -1367,7 +1368,7 @@ spec("roi_align",
                    np.array([[0, 0, 4, 4.]], F32)),
                   {"boxes_num": np.array([1], np.int32), "pooled_height": 2,
                    "pooled_width": 2}),
-     ref=None, grad=(0,))
+     check=R.roi_align_check, grad=(0,))
 def _roi_pool_check(r, a, k):
     # reference phi roi_pool formula: inclusive rounded roi (w = x2-x1+1),
     # bin [floor(i*h/P), ceil((i+1)*h/P)) windows, max-pooled
@@ -1586,10 +1587,6 @@ JUSTIFIED_FINITE_ONLY = {
     "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
     "generate_proposals": "composition of box_coder decode (ref-checked "
     "above) + nms (exactness tested in test_ops_extended)",
-        "multiclass_nms3": "per-class nms wrapper over the exactness-tested "
-    "nms core (test_ops_extended.py::test_nms_suppresses_overlap)",
-        "roi_align": "exact whole-image-mean case asserted in "
-    "tests/test_ops_extended.py::test_roi_align_whole_image_mean",
-    "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
+                    "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
     "finite-loss + decreasing-loss covered by the detection tests",
 }
