@@ -940,3 +940,40 @@ def roi_align_check(r, a, k):
                 exp[0, c, ph, pw] = acc / 4
     got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
     np.testing.assert_allclose(got, exp, rtol=1e-4, atol=1e-5)
+
+
+def fused_attention_check(r, a, k):
+    """Composed numpy transformer-attention reference:
+    LN(pre) -> qkv einsum -> softmax attention -> out-proj -> residual
+    [-> LN(post)] (fused_attention_op.cu composition)."""
+    x, qkv_w, qkv_b, lin_w, lin_b = a
+    nh = k["num_heads"]
+    pre = k.get("pre_layer_norm", False)
+    eps = k.get("epsilon", 1e-5)
+    B, T, C = x.shape
+    hd = C // nh
+
+    def ln(v, scale, bias):
+        mu = v.mean(-1, keepdims=True)
+        var = v.var(-1, keepdims=True)
+        out = (v - mu) / np.sqrt(var + eps)
+        if scale is not None:
+            out = out * scale
+        if bias is not None:
+            out = out + bias
+        return out
+
+    inp = ln(x, k.get("ln_scale"), k.get("ln_bias")) if pre else x
+    qkv = np.einsum("btc,khdc->btkhd", inp, qkv_w)
+    if qkv_b is not None:
+        qkv = qkv + qkv_b[None, None]
+    q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    ctx = attention_ref_b(q, kk, v)
+    out = ctx.reshape(B, T, C) @ lin_w
+    if lin_b is not None:
+        out = out + lin_b
+    out = x + out
+    if not pre:
+        out = ln(out, k.get("ln2_scale"), k.get("ln2_bias"))
+    got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
+    np.testing.assert_allclose(got, out, rtol=2e-3, atol=2e-4)

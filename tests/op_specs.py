@@ -1471,11 +1471,11 @@ spec("memory_efficient_attention",
      grad=(0, 1, 2))
 spec("fused_attention",
      lambda rng: ((_u(rng, (1, 4, 8)), _u(rng, (3, 2, 4, 8)),
-                   np.zeros((3, 2, 4), F32), _u(rng, (8, 8)),
-                   np.zeros(8, F32)),
-                  {"num_heads": 2, "ln2_scale": np.ones(8, F32),
-                   "ln2_bias": np.zeros(8, F32)}),
-     ref=None)
+                   _u(rng, (3, 2, 4)), _u(rng, (8, 8)),
+                   _u(rng, (8,))),
+                  {"num_heads": 2, "ln2_scale": _pos(rng, (8,)),
+                   "ln2_bias": _u(rng, (8,))}),
+     check=R.fused_attention_check)
 spec("fused_dropout_add",
      lambda rng: ((_u(rng, (3, 4)), _u(rng, (3, 4))), {"p": 0.0}),
      check=lambda r, a, k: np.testing.assert_allclose(
@@ -1583,9 +1583,7 @@ JUSTIFIED_FINITE_ONLY = {
     "deformable_conv": "zero-offset == plain conv2d identity asserted in "
     "tests/test_ops_extended.py::test_deformable_conv_zero_offset_"
     "equals_conv (the discriminating special case)",
-    "fused_attention": "parity vs the unfused composition asserted in "
-    "tests/test_ops_extended.py::test_fused_attention_matches_unfused",
-    "generate_proposals": "composition of box_coder decode (ref-checked "
+        "generate_proposals": "composition of box_coder decode (ref-checked "
     "above) + nms (exactness tested in test_ops_extended)",
                     "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
     "finite-loss + decreasing-loss covered by the detection tests",
