@@ -879,19 +879,30 @@ def deformable_conv(x, offset, weight, mask=None, strides=(1, 1),
         cols = []
         for dg in range(deformable_groups):
             feat = xi[dg * ch_per_dg:(dg + 1) * ch_per_dg].astype(jnp.float32)
-            ky = jnp.arange(kh)
-            kx = jnp.arange(kw)
             # sample coords [kh,kw,oh,ow]
             oy = offi[dg, :, 0].reshape(kh, kw, oh, ow)
             ox = offi[dg, :, 1].reshape(kh, kw, oh, ow)
             yy = base_y.T[:, None, :, None] + oy  # [kh,kw,oh,ow]
             xx = base_x.T[None, :, None, :] + ox
-            valid = (yy > -1) & (yy < h) & (xx > -1) & (xx < w)
-            yyc = jnp.clip(yy, 0, h - 1)
-            xxc = jnp.clip(xx, 0, w - 1)
-            v = _roi_bilinear(feat, yyc.reshape(-1), xxc.reshape(-1))
-            v = v.reshape(ch_per_dg, kh, kw, oh, ow)
-            v = jnp.where(valid[None], v, 0.0)
+            # reference dmc_im2col_bilinear: each of the four taps
+            # contributes ONLY if in-bounds (partial weights at the
+            # border) — clipping coords first would give the border
+            # pixel full weight (caught by the round-3 numpy reference)
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xx)
+            dy = yy - y0
+            dx = xx - x0
+            v = jnp.zeros((ch_per_dg,) + yy.shape, jnp.float32)
+            # NB: tap vars must not shadow per_image's `xi` image arg
+            # (the dg>0 iteration would slice a coordinate array)
+            for ty, wy in ((y0, 1 - dy), (y0 + 1, dy)):
+                for tx, wx in ((x0, 1 - dx), (x0 + 1, dx)):
+                    tap_ok = (ty >= 0) & (ty < h) & (tx >= 0) & (tx < w)
+                    yc = jnp.clip(ty, 0, h - 1).astype(jnp.int32)
+                    xc = jnp.clip(tx, 0, w - 1).astype(jnp.int32)
+                    tap = feat[:, yc, xc]  # [C, kh, kw, oh, ow]
+                    v = v + jnp.where(tap_ok[None],
+                                      (wy * wx)[None] * tap, 0.0)
             if mi is not None:
                 mm = mi[dg].reshape(kh, kw, oh, ow)
                 v = v * mm[None]

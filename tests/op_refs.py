@@ -977,3 +977,45 @@ def fused_attention_check(r, a, k):
         out = ln(out, k.get("ln2_scale"), k.get("ln2_bias"))
     got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
     np.testing.assert_allclose(got, out, rtol=2e-3, atol=2e-4)
+
+
+def deformable_conv_check(r, a, k):
+    """DCN v1 numpy loops: sample x at (oh*s - p + kh*d + offset_y, ...)
+    with bilinear interpolation (out-of-image samples zero), then the
+    conv contraction (deformable_conv_op semantics; offsets (y, x) per
+    kernel point)."""
+    x, offset, weight = a
+    ph, pw = k.get("paddings", (0, 0))
+    N, Cin, H, W = x.shape
+    Cout, _, KH, KW = weight.shape
+    OH = H + 2 * ph - KH + 1
+    OW = W + 2 * pw - KW + 1
+    off = offset.reshape(1, KH * KW, 2, OH, OW)
+
+    def bil(c, yy, xx):
+        if yy <= -1 or yy >= H or xx <= -1 or xx >= W:
+            return 0.0
+        y0, x0 = int(np.floor(yy)), int(np.floor(xx))
+        dy, dx = yy - y0, xx - x0
+        v = 0.0
+        for (yi, wy) in ((y0, 1 - dy), (y0 + 1, dy)):
+            for (xi, wx) in ((x0, 1 - dx), (x0 + 1, dx)):
+                if 0 <= yi < H and 0 <= xi < W:
+                    v += wy * wx * x[0, c, yi, xi]
+        return v
+
+    exp = np.zeros((1, Cout, OH, OW), F32)
+    for oc in range(Cout):
+        for oh_ in range(OH):
+            for ow_ in range(OW):
+                acc = 0.0
+                for c in range(Cin):
+                    for kh_ in range(KH):
+                        for kw_ in range(KW):
+                            kidx = kh_ * KW + kw_
+                            yy = oh_ - ph + kh_ + off[0, kidx, 0, oh_, ow_]
+                            xx = ow_ - pw + kw_ + off[0, kidx, 1, oh_, ow_]
+                            acc += weight[oc, c, kh_, kw_] * bil(c, yy, xx)
+                exp[0, oc, oh_, ow_] = acc
+    got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
+    np.testing.assert_allclose(got, exp, rtol=2e-3, atol=2e-4)

@@ -1200,7 +1200,7 @@ spec("deformable_conv",
                    _u(rng, (1, 18, 5, 5), -0.1, 0.1),
                    _u(rng, (3, 2, 3, 3))),
                   {"paddings": (1, 1)}),
-     ref=None, grad=(0, 2))
+     check=R.deformable_conv_check, grad=(0, 2))
 
 
 def _pool2d_max_ref(x, ks, stride):
@@ -1580,10 +1580,7 @@ for _n, _g in _GRAD_UPGRADES.items():
 # elsewhere, or an honest statement of what a reference would take).
 # test_op_sweep.test_finite_only_is_justified enforces the partition.
 JUSTIFIED_FINITE_ONLY = {
-    "deformable_conv": "zero-offset == plain conv2d identity asserted in "
-    "tests/test_ops_extended.py::test_deformable_conv_zero_offset_"
-    "equals_conv (the discriminating special case)",
-        "generate_proposals": "composition of box_coder decode (ref-checked "
+            "generate_proposals": "composition of box_coder decode (ref-checked "
     "above) + nms (exactness tested in test_ops_extended)",
                     "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
     "finite-loss + decreasing-loss covered by the detection tests",

@@ -476,3 +476,16 @@ def test_hsigmoid_simplecode_bitlength_at_powers_of_two():
         got = (out[0] if isinstance(out, (list, tuple)) else out).numpy()
         exp = R.hsigmoid_loss_ref(x, labels, w, None, C)
         np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_deformable_conv_groups2_zero_offset_equals_conv():
+    """Review regression: the tap-loop variable used to shadow the image
+    arg, corrupting every deformable group after the first."""
+    rng = np.random.RandomState(0)
+    x = t(rng.rand(1, 4, 5, 5).astype(np.float32))
+    off = t(np.zeros((1, 36, 3, 3), np.float32))
+    w = t(rng.rand(2, 4, 3, 3).astype(np.float32))
+    out = OPS["deformable_conv"].user_fn(x, off, w, deformable_groups=2)
+    ref = paddle.nn.functional.conv2d(x, w)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-3,
+                               atol=1e-4)
