@@ -1019,3 +1019,50 @@ def deformable_conv_check(r, a, k):
                 exp[0, oc, oh_, ow_] = acc
     got = (r[0] if isinstance(r, (list, tuple)) else r).numpy()
     np.testing.assert_allclose(got, exp, rtol=2e-3, atol=2e-4)
+
+
+def generate_proposals_check(r, a, k):
+    """RPN proposal composition in plain numpy: top-k scores -> anchor
+    decode (variance-scaled deltas, exp-clamped) -> image clip ->
+    min-size filter -> greedy NMS -> post top-k."""
+    scores, deltas, im_shape, anchors, variances = a
+    pre = k.get("pre_nms_top_n", 6000)
+    post = k.get("post_nms_top_n", 1000)
+    nt = k.get("nms_thresh", 0.5)
+    min_size = k.get("min_size", 0.1)
+    n, A, H, W = scores.shape
+    s = scores[0].transpose(1, 2, 0).reshape(-1)
+    d = deltas[0].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+    anc = anchors.reshape(-1, 4)
+    var = variances.reshape(-1, 4)
+    order = np.argsort(-s)[:pre]
+    props, kept_scores = [], []
+    for i in order:
+        aw = anc[i, 2] - anc[i, 0]
+        ah = anc[i, 3] - anc[i, 1]
+        acx = anc[i, 0] + aw / 2
+        acy = anc[i, 1] + ah / 2
+        cx = var[i, 0] * d[i, 0] * aw + acx
+        cy = var[i, 1] * d[i, 1] * ah + acy
+        bw = np.exp(min(var[i, 2] * d[i, 2], 10.0)) * aw
+        bh = np.exp(min(var[i, 3] * d[i, 3], 10.0)) * ah
+        box = np.array([cx - bw / 2, cy - bh / 2,
+                        cx + bw / 2, cy + bh / 2])
+        box[0::2] = np.clip(box[0::2], 0, im_shape[0][1] - 1)
+        box[1::2] = np.clip(box[1::2], 0, im_shape[0][0] - 1)
+        if (box[2] - box[0]) >= min_size and (box[3] - box[1]) >= min_size:
+            props.append(box)
+            kept_scores.append(s[i])
+    props = np.array(props)
+    kept_scores = np.array(kept_scores)
+    keep = _greedy_nms(props, kept_scores, nt)[:post]
+    exp_boxes = props[keep]
+    exp_scores = kept_scores[keep]
+    got_boxes = np.asarray(r[0].numpy())
+    got_scores = np.asarray(r[1].numpy()).reshape(-1)
+    n_valid = int(np.asarray(r[2].numpy()).reshape(-1)[0])
+    assert n_valid == len(exp_boxes), (n_valid, len(exp_boxes))
+    np.testing.assert_allclose(got_scores[:n_valid], exp_scores,
+                               rtol=1e-5)
+    np.testing.assert_allclose(got_boxes[:n_valid], exp_boxes,
+                               rtol=1e-4, atol=1e-4)

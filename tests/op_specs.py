@@ -1404,10 +1404,13 @@ spec("generate_proposals",
      lambda rng: ((_pos(rng, (1, 2, 3, 3), 0.1, 0.9),
                    _u(rng, (1, 8, 3, 3), -0.1, 0.1),
                    np.array([[24, 24]], F32),
-                   _u(rng, (9, 4), 0, 24).astype(F32),
-                   np.full((9, 4), 0.1, F32)),
+                   (lambda c: np.stack([c[:, 0, 0], c[:, 0, 1],
+                                        c[:, 1, 0], c[:, 1, 1]], 1))(
+                       np.sort(_u(rng, (18, 2, 2), 2, 22).astype(F32),
+                               axis=1)),
+                   np.full((18, 4), 0.1, F32)),
                   {"pre_nms_top_n": 5, "post_nms_top_n": 3}),
-     ref=None)
+     check=R.generate_proposals_check)
 def _fpn_check(r, a, k):
     # area 100 -> level 2 (clipped); area 4e4 -> level 3: the rois route
     # to different static-padded level buckets, and the first
@@ -1580,8 +1583,6 @@ for _n, _g in _GRAD_UPGRADES.items():
 # elsewhere, or an honest statement of what a reference would take).
 # test_op_sweep.test_finite_only_is_justified enforces the partition.
 JUSTIFIED_FINITE_ONLY = {
-            "generate_proposals": "composition of box_coder decode (ref-checked "
-    "above) + nms (exactness tested in test_ops_extended)",
-                    "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
+                                "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
     "finite-loss + decreasing-loss covered by the detection tests",
 }
