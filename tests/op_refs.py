@@ -1066,3 +1066,94 @@ def generate_proposals_check(r, a, k):
                                rtol=1e-5)
     np.testing.assert_allclose(got_boxes[:n_valid], exp_boxes,
                                rtol=1e-4, atol=1e-4)
+
+
+def yolo_loss_check(r, a, k):
+    """YOLOv3 loss in plain numpy loops (yolo_loss_kernel.cc structure):
+    per-gt responsible-anchor assignment by wh-IoU, xy/wh/obj/cls terms,
+    ignore mask from decoded-box IoU, label smoothing."""
+    x, gt_box, gt_label = a[0], a[1], a[2]
+    anchors = k["anchors"]
+    mask = k["anchor_mask"]
+    C = k["class_num"]
+    down = k.get("downsample_ratio", 32)
+    ig_t = k.get("ignore_thresh", 0.7)
+    smooth = 1.0 / C if k.get("use_label_smooth", True) else 0.0
+    N, _, H, W = x.shape
+    na = len(mask)
+    an_all = np.asarray(anchors, np.float64).reshape(-1, 2)
+    an = an_all[list(mask)]
+    pred = x.reshape(N, na, 5 + C, H, W).astype(np.float64)
+    inp = down * H
+
+    def bce(p, t):
+        p = np.clip(p, 1e-9, 1 - 1e-9)
+        return -(t * np.log(p) + (1 - t) * np.log(1 - p))
+
+    total = np.zeros(N)
+    for ni in range(N):
+        px = _sigmoid(pred[ni, :, 0])
+        py = _sigmoid(pred[ni, :, 1])
+        pw_, ph_ = pred[ni, :, 2], pred[ni, :, 3]
+        pobj = _sigmoid(pred[ni, :, 4])
+        obj_t = np.zeros((na, H, W))
+        obj_mask = np.zeros((na, H, W), bool)
+        loss = 0.0
+        for bi in range(gt_box.shape[1]):
+            cx, cy, gw, gh = (float(v) for v in gt_box[ni, bi])
+            if gw <= 0 or gh <= 0:
+                continue
+            gwpx, ghpx = gw * inp, gh * inp
+            ious = [min(gwpx, aw) * min(ghpx, ah) /
+                    max(gwpx * ghpx + aw * ah
+                        - min(gwpx, aw) * min(ghpx, ah), 1e-9)
+                    for aw, ah in an_all]
+            best = int(np.argmax(ious))
+            if best not in mask:
+                continue
+            ai = list(mask).index(best)
+            gi = min(int(cx * W), W - 1)
+            gj = min(int(cy * H), H - 1)
+            tx, ty = cx * W - gi, cy * H - gj
+            tw = np.log(max(gwpx / max(an[ai][0], 1e-9), 1e-9))
+            th = np.log(max(ghpx / max(an[ai][1], 1e-9), 1e-9))
+            tscale = 2.0 - gw * gh
+            loss += (bce(px[ai, gj, gi], tx)
+                     + bce(py[ai, gj, gi], ty)) * tscale
+            loss += (abs(pw_[ai, gj, gi] - tw)
+                     + abs(ph_[ai, gj, gi] - th)) * tscale
+            obj_t[ai, gj, gi] = 1.0
+            obj_mask[ai, gj, gi] = True
+            cls_t = np.full(C, smooth)
+            cls_t[min(max(int(gt_label[ni, bi]), 0), C - 1)] = 1 - smooth
+            pc = _sigmoid(pred[ni, ai, 5:, gj, gi])
+            loss += bce(pc, cls_t).sum()
+        # objectness with ignore mask
+        for ai in range(na):
+            for gj in range(H):
+                for gi in range(W):
+                    bx = (px[ai, gj, gi] + gi) / W
+                    by = (py[ai, gj, gi] + gj) / H
+                    bw = np.exp(np.clip(pw_[ai, gj, gi], -10, 10))                         * an[ai][0] / inp
+                    bh = np.exp(np.clip(ph_[ai, gj, gi], -10, 10))                         * an[ai][1] / inp
+                    best_iou = 0.0
+                    for bi in range(gt_box.shape[1]):
+                        cx, cy, gw, gh = (float(v)
+                                          for v in gt_box[ni, bi])
+                        if gw <= 0 or gh <= 0:
+                            continue
+                        iw = max(min(bx + bw / 2, cx + gw / 2)
+                                 - max(bx - bw / 2, cx - gw / 2), 0)
+                        ih = max(min(by + bh / 2, cy + gh / 2)
+                                 - max(by - bh / 2, cy - gh / 2), 0)
+                        inter = iw * ih
+                        u = bw * bh + gw * gh - inter
+                        best_iou = max(best_iou, inter / max(u, 1e-9))
+                    if obj_mask[ai, gj, gi]:
+                        loss += bce(pobj[ai, gj, gi], obj_t[ai, gj, gi])
+                    elif best_iou <= ig_t:
+                        loss += bce(pobj[ai, gj, gi], 0.0)
+        total[ni] = loss
+    got = np.asarray((r[0] if isinstance(r, (list, tuple)) else r)
+                     .numpy()).reshape(-1)
+    np.testing.assert_allclose(got, total, rtol=1e-3, atol=1e-3)

@@ -1362,7 +1362,7 @@ spec("yolo_loss",
                    rng.randint(0, 2, (1, 2)).astype(np.int32)),
                   {"anchors": [1, 2, 3, 4], "anchor_mask": [0, 1],
                    "class_num": 2, "downsample_ratio": 8}),
-     ref=None)
+     check=R.yolo_loss_check)
 spec("roi_align",
      lambda rng: ((_u(rng, (1, 2, 6, 6)),
                    np.array([[0, 0, 4, 4.]], F32)),
@@ -1583,6 +1583,4 @@ for _n, _g in _GRAD_UPGRADES.items():
 # elsewhere, or an honest statement of what a reference would take).
 # test_op_sweep.test_finite_only_is_justified enforces the partition.
 JUSTIFIED_FINITE_ONLY = {
-                                "yolo_loss": "composite objective over yolo_box geometry; end-to-end "
-    "finite-loss + decreasing-loss covered by the detection tests",
-}
+                                }
