@@ -93,31 +93,45 @@ class LocalSGDOptimizer(_OptimizerWrapper):
 
 class DGCMomentumOptimizer(_OptimizerWrapper):
     """Deep Gradient Compression (reference meta_optimizers/dgc_optimizer
-    .py): keep only the top-``(1-sparsity)`` fraction of each gradient by
-    magnitude; the residual feeds back into the next step so nothing is
-    lost, just delayed.  On TPU the win is the smaller allreduced payload
-    under sparsity-aware transports; numerically this reproduces the
-    reference's error-feedback schedule."""
+    .py, Lin et al. 2018): keep only the top-``(1-sparsity)`` fraction of
+    each gradient by magnitude, with the paper's MOMENTUM CORRECTION —
+    local momentum ``u = m*u + g`` accumulates into a velocity buffer
+    ``v += u``; the top-k of ``v`` is sent and both buffers are cleared
+    at sent positions (momentum factor masking), so DELAYED coordinates
+    carry their momentum history instead of a bare residual: constant
+    grad g delayed 3 steps accumulates (3 + 2m + m^2)g = 5.61g at m=0.9
+    where residual-only error feedback would send 3g.  Always-sent
+    coordinates restart u each step (paper Algorithm 1), so the dense
+    limit is plain SGD — the momentum lives in the correction of delayed
+    coordinates, not in the server update.  Use with a plain-SGD inner
+    optimizer — DGC owns the momentum (the reference
+    DGCMomentumOptimizer likewise replaces Momentum; the strategy
+    compiler enforces this)."""
 
-    def __init__(self, inner, sparsity=0.9):
+    def __init__(self, inner, sparsity=0.9, momentum=0.9):
         super().__init__(inner)
         self.sparsity = float(sparsity)
-        self._residual = {}
+        self.momentum = float(momentum)
+        self._u = {}  # local momentum
+        self._v = {}  # accumulated velocity (what gets sent)
 
     def step(self):
         for p in self._inner._parameters:
             if p.grad is None or p.stop_gradient:
                 continue
             g = p.grad._data
-            res = self._residual.get(id(p))
-            if res is not None:
-                g = g + res
-            flat = jnp.abs(g).reshape(-1)
+            u = self._u.get(id(p))
+            u = g if u is None else self.momentum * u + g
+            v = self._v.get(id(p))
+            v = u if v is None else v + u
+            flat = jnp.abs(v).reshape(-1)
             k = max(1, int(flat.size * (1.0 - self.sparsity)))
             thresh = jnp.sort(flat)[-k]
-            mask = jnp.abs(g) >= thresh
-            sent = jnp.where(mask, g, 0)
-            self._residual[id(p)] = g - sent
+            mask = jnp.abs(v) >= thresh
+            sent = jnp.where(mask, v, 0)
+            # momentum factor masking: sent coordinates restart history
+            self._u[id(p)] = jnp.where(mask, 0, u)
+            self._v[id(p)] = v - sent
             p.grad = Tensor(sent, stop_gradient=True)
         self._inner.step()
 
@@ -152,7 +166,22 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
                          parameters=optimizer._parameters,
                          grad_clip=optimizer._grad_clip, **kw)
     if getattr(strategy, "dgc", False):
-        optimizer = DGCMomentumOptimizer(optimizer)
+        cfg = getattr(strategy, "dgc_configs", None) or {}
+        momentum = cfg.get("momentum")
+        # reference pairing: DGC REPLACES Momentum (dgc_optimizer.py) —
+        # wrapping a Momentum inner would apply momentum twice, so swap
+        # it for SGD and inherit its momentum coefficient
+        if type(optimizer).__name__ == "Momentum":
+            from ...optimizer import SGD
+
+            if momentum is None:
+                momentum = float(getattr(optimizer, "_momentum", 0.9))
+            optimizer = SGD(learning_rate=optimizer._learning_rate,
+                            parameters=optimizer._parameters,
+                            grad_clip=optimizer._grad_clip)
+        optimizer = DGCMomentumOptimizer(
+            optimizer, sparsity=cfg.get("sparsity", 0.9),
+            momentum=0.9 if momentum is None else float(momentum))
     if getattr(strategy, "gradient_merge", False):
         cfg = strategy.gradient_merge_configs
         optimizer = GradientMergeOptimizer(

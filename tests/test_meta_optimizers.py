@@ -98,10 +98,91 @@ class TestMetaOptimizers:
         # at most ~25% of entries moved this step
         moved = (np.abs(delta) > 0).mean()
         assert moved <= 0.30, moved
-        # residual exists and feeds back
-        assert opt._residual and any(
-            np.abs(np.asarray(r)).sum() > 0
-            for r in opt._residual.values())
+        # unsent velocity exists and feeds back
+        assert opt._v and any(
+            np.abs(np.asarray(r)).sum() > 0 for r in opt._v.values())
+
+    def test_dgc_momentum_correction_delayed_coordinate_algebra(self):
+        """Lin et al. momentum correction (the property the residual-only
+        form lacked): a coordinate delayed n steps under constant grad g
+        accumulates v = sum of momentum-corrected u terms — for m=0.9,
+        3 steps: v = 3 + 2m + m^2 = 5.61g, not the residual form's 3g.
+        Sent coordinates restart (u cleared), so the hot coordinate
+        ships exactly g every step."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentumOptimizer,
+        )
+        import jax.numpy as jnp
+
+        paddle.seed(1)
+        m1 = nn.Linear(1, 2, bias_attr=False)  # weight [1, 2]
+        dgc = DGCMomentumOptimizer(
+            optimizer.SGD(learning_rate=1.0, parameters=m1.parameters()),
+            sparsity=0.5, momentum=0.9)
+        p = m1.parameters()[0]
+        w0 = p.numpy().copy()
+        g = np.array([[10.0, 1.0]], np.float32)
+        sent_hot = []
+        for _ in range(3):
+            p.grad = Tensor(jnp.asarray(g), stop_gradient=True)
+            dgc.step()
+            sent_hot.append(float(np.asarray(p.grad._data)[0, 0]))
+            dgc.clear_grad()
+        # hot coordinate restarts every send: ships exactly g each step
+        np.testing.assert_allclose(sent_hot, [10.0, 10.0, 10.0])
+        # delayed coordinate: v = (1) + (1 + (1+m)) + ... = 3 + 2m + m^2
+        m = 0.9
+        v_cold = float(np.asarray(dgc._v[id(p)])[0, 1])
+        np.testing.assert_allclose(v_cold, 3 + 2 * m + m ** 2, rtol=1e-5)
+        # cold coordinate untouched in the weights; hot moved 3*lr*g
+        delta = p.numpy() - w0
+        np.testing.assert_allclose(delta[0, 0], -30.0, rtol=1e-5)
+        np.testing.assert_allclose(delta[0, 1], 0.0, atol=1e-7)
+
+    def test_dgc_sent_positions_restart_momentum(self):
+        """Momentum factor masking: a coordinate that was just sent has
+        cleared u and v buffers."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentumOptimizer,
+        )
+
+        paddle.seed(2)
+        m = nn.Linear(6, 6, bias_attr=False)
+        opt = DGCMomentumOptimizer(
+            optimizer.SGD(learning_rate=0.5, parameters=m.parameters()),
+            sparsity=0.8, momentum=0.9)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .rand(3, 6).astype(np.float32))
+        (m(x) ** 2).mean().backward()
+        opt.step()
+        p = m.parameters()[0]
+        sent_mask = np.abs(np.asarray(p.grad._data)) > 0
+        u = np.asarray(opt._u[id(p)])
+        v = np.asarray(opt._v[id(p)])
+        assert (u[sent_mask] == 0).all()
+        assert (v[sent_mask] == 0).all()
+        assert (np.abs(v[~sent_mask]) > 0).any()  # delayed coords keep v
+
+    def test_strategy_dgc_replaces_momentum_inner(self):
+        """Review regression: wrapping a Momentum inner would apply
+        momentum twice — the compiler swaps it for SGD and inherits its
+        coefficient (reference dgc_optimizer replaces Momentum)."""
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            DGCMomentumOptimizer,
+            apply_strategy_to_optimizer,
+        )
+
+        m, _ = _model_and_data()
+        s = DistributedStrategy()
+        s.dgc = True
+        opt = apply_strategy_to_optimizer(
+            optimizer.Momentum(learning_rate=0.1, momentum=0.8,
+                               parameters=m.parameters()), s)
+        assert isinstance(opt, DGCMomentumOptimizer)
+        assert type(opt._inner).__name__ == "SGD"
+        assert opt.momentum == 0.8  # inherited from the swapped Momentum
 
     def test_strategy_compiler_stacks_wrappers(self):
         from paddle_tpu.distributed.fleet import DistributedStrategy
