@@ -7,6 +7,14 @@ optimizer wrapper (gradient merge, localsgd, DGC, LARS/LAMB swap) or a
 model wrapper (recompute) applied by ``fleet.distributed_optimizer`` /
 ``fleet.distributed_model`` from the same ``DistributedStrategy`` fields
 the reference reads.
+
+Strategies that dissolve into the compiler rather than a wrapper:
+``fp16_allreduce`` — under GSPMD the gradients ARE bf16 inside the
+compiled step when ``amp.decorate(O2)`` is on, so the reduced payload
+already rides the collectives; ``fuse_all_reduce_ops``/``fuse_grad_
+merge`` — XLA fuses and schedules collectives itself; ``pipeline``/
+``sharding``/``tensor_parallel`` — handled structurally by
+``parallel.SpmdTrainStep`` + mesh axes, not by optimizer rewrites.
 """
 
 import numpy as np
