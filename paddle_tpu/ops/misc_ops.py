@@ -169,17 +169,34 @@ def hsigmoid_loss(x, label, weight, bias=None, num_classes=2,
     with sigmoid target bit (u >> j) & 1, for j = 0..bitlen(u)-2.  Using
     the exact reference layout keeps trained hsigmoid weights
     checkpoint-compatible.
+
+    CustomCode (same functor, custom-tree branch): ``path_table[n, j]``
+    gives the internal-node row directly and ``path_code[n, j]`` the
+    target bit; entries < 0 pad the per-sample path
+    (reference: paddle/phi/kernels/funcs/matrix_bit_code.h CustomCode
+    calc_index/calc_bit, get_length counts non-negative entries).
     """
+    if (path_table is None) != (path_code is None):
+        raise ValueError(
+            "hsigmoid_loss: path_table and path_code must be given "
+            "together (custom tree) or both omitted (SimpleCode)")
     lbl = jnp.asarray(label).reshape(-1)
-    u = lbl + num_classes
-    max_len = int(2 * num_classes - 1).bit_length() - 1
-    js = jnp.arange(max_len)
-    # valid while (u >> (j+1)) > 0 — INTEGER bit length; float32 log2 is
-    # off-by-one at powers of two and above 2^21 (caught in review)
-    valid = (u[:, None] >> (js[None, :] + 1)) > 0          # [N, L]
-    idxs = jnp.clip((u[:, None] >> (js[None, :] + 1)) - 1, 0,
-                    num_classes - 2)
-    bits = ((u[:, None] >> js[None, :]) & 1).astype(jnp.float32)
+    if path_table is not None:
+        table = jnp.asarray(path_table)
+        code = jnp.asarray(path_code)
+        valid = table >= 0                                  # [N, L]
+        idxs = jnp.clip(table, 0, weight.shape[0] - 1)
+        bits = jnp.where(valid, code, 0).astype(jnp.float32)
+    else:
+        u = lbl + num_classes
+        max_len = int(2 * num_classes - 1).bit_length() - 1
+        js = jnp.arange(max_len)
+        # valid while (u >> (j+1)) > 0 — INTEGER bit length; float32 log2
+        # is off-by-one at powers of two and above 2^21 (caught in review)
+        valid = (u[:, None] >> (js[None, :] + 1)) > 0          # [N, L]
+        idxs = jnp.clip((u[:, None] >> (js[None, :] + 1)) - 1, 0,
+                        num_classes - 2)
+        bits = ((u[:, None] >> js[None, :]) & 1).astype(jnp.float32)
     w = weight[idxs]  # [N, L, D]
     logit = jnp.einsum("nd,nkd->nk", x.astype(jnp.float32),
                        w.astype(jnp.float32))

@@ -22,6 +22,12 @@ from .registry import op
 
 # ---------------------------------------------------------------- interpolate
 
+def _cround(x):
+    """C round(): half-away-from-zero — jnp.round is half-to-even, which
+    diverges from the phi roi kernels at half-integer box coordinates."""
+    return jnp.sign(x) * jnp.floor(jnp.abs(x) + 0.5)
+
+
 def _axis_coords(out_size, in_size, align_corners, align_mode=1):
     """Source coordinates for each output index along one axis (float32)."""
     o = jnp.arange(out_size, dtype=jnp.float32)
@@ -294,7 +300,7 @@ def roi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
         batch_idx = jnp.sum(
             jnp.arange(r)[:, None] >= jnp.cumsum(bn)[None, :], axis=1
         ).astype(jnp.int32)
-    bx = jnp.round(boxes.astype(jnp.float32) * spatial_scale)
+    bx = _cround(boxes.astype(jnp.float32) * spatial_scale)
 
     def one_roi(box, bidx):
         x1, y1, x2, y2 = box
@@ -342,21 +348,22 @@ def psroi_pool(x, boxes, boxes_num=None, pooled_height=1, pooled_width=1,
         batch_idx = jnp.sum(
             jnp.arange(r)[:, None] >= jnp.cumsum(bn)[None, :], axis=1
         ).astype(jnp.int32)
-    bx = boxes.astype(jnp.float32) * spatial_scale
+    bx = boxes.astype(jnp.float32)
 
     def one_roi(box, bidx):
         # reference phi psroi_pool (psroi_pool_kernel.cc): roi endpoints
-        # are round(x1)*scale .. (round(x2)+1)*scale; each bin AVERAGES
+        # are round(x1)*scale .. (round(x2)+1)*scale (rounding the RAW
+        # box coordinate, unlike roi_pool which rounds box*scale); each bin AVERAGES
         # the integer-pixel window [floor(ph*bin+y1), ceil((ph+1)*bin+y1))
         # (empty bins zero), and the position-sensitive input channel is
         # (oc*PH + ph)*PW + pw — oc-major.  (The old bilinear
         # sub-sampling + transposed channel layout were divergences
         # caught by the round-3 exact-reference pass.)
         bx1, by1, bx2, by2 = box
-        x1 = jnp.round(bx1) * spatial_scale
-        y1 = jnp.round(by1) * spatial_scale
-        x2 = (jnp.round(bx2) + 1.0) * spatial_scale
-        y2 = (jnp.round(by2) + 1.0) * spatial_scale
+        x1 = _cround(bx1) * spatial_scale
+        y1 = _cround(by1) * spatial_scale
+        x2 = (_cround(bx2) + 1.0) * spatial_scale
+        y2 = (_cround(by2) + 1.0) * spatial_scale
         rw = jnp.maximum(x2 - x1, 0.1)
         rh = jnp.maximum(y2 - y1, 0.1)
         bin_h, bin_w = rh / pooled_height, rw / pooled_width

@@ -838,10 +838,12 @@ def psroi_pool_check(r, a, k):
     OC = k["output_channels"]
     scale = k.get("spatial_scale", 1.0)
     H, W = x.shape[2], x.shape[3]
-    x1 = round(float(boxes[0][0])) * scale
-    y1 = round(float(boxes[0][1])) * scale
-    x2 = (round(float(boxes[0][2])) + 1) * scale
-    y2 = (round(float(boxes[0][3])) + 1) * scale
+    # C round() = half-away-from-zero (Python round is half-to-even)
+    cround = lambda v: math.floor(abs(v) + 0.5) * (1 if v >= 0 else -1)
+    x1 = cround(float(boxes[0][0])) * scale
+    y1 = cround(float(boxes[0][1])) * scale
+    x2 = (cround(float(boxes[0][2])) + 1) * scale
+    y2 = (cround(float(boxes[0][3])) + 1) * scale
     bh = max(y2 - y1, 0.1) / PH
     bw = max(x2 - x1, 0.1) / PW
     exp = np.zeros((1, OC, PH, PW), F32)

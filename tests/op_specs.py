@@ -1373,7 +1373,9 @@ def _roi_pool_check(r, a, k):
     # reference phi roi_pool formula: inclusive rounded roi (w = x2-x1+1),
     # bin [floor(i*h/P), ceil((i+1)*h/P)) windows, max-pooled
     x = a[0]
-    x1, y1, x2, y2 = (int(round(v)) for v in a[1][0])
+    # C round() = half-away-from-zero, not Python's half-to-even
+    x1, y1, x2, y2 = (int(np.floor(abs(v) + 0.5) * np.sign(v) if v else 0)
+                      for v in a[1][0])
     rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
     P = 2
     exp = np.zeros((1, x.shape[1], P, P), F32)

@@ -4,8 +4,14 @@ mirroring test/legacy_test/eager_op_test.py:377 in the reference)."""
 import numpy as np
 import pytest
 
+import op_refs as R
 import paddle_tpu as paddle
 from paddle_tpu.ops.registry import OPS
+
+
+def _cround(v):
+    """C round(): half-away-from-zero (Python round is half-to-even)."""
+    return int(np.floor(abs(v) + 0.5) * (1 if v >= 0 else -1))
 
 
 def t(x, **kw):
@@ -88,6 +94,47 @@ def test_roi_align_whole_image_mean():
                                    aligned=False)
     np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 7.0),
                                atol=1e-4)
+
+
+def test_roi_pool_spatial_scale_half():
+    """Reference phi roi_pool rounds box*scale: advisor found the sweep
+    only exercised scale=1.0, so scale handling had no coverage."""
+    rng = np.random.RandomState(3)
+    x = rng.rand(1, 2, 6, 6).astype(np.float32)
+    # 1.0*0.5 = 0.5 lands exactly on a half-integer: C round() gives 1
+    # where banker's rounding gives 0 — covers the rounding-rule choice
+    boxes = np.asarray([[1.3, 1.0, 9.6, 8.2]], np.float32)
+    scale = 0.5
+    out = OPS["roi_pool"].user_fn(
+        t(x), t(boxes), boxes_num=t(np.array([1], np.int32)),
+        pooled_height=2, pooled_width=2, spatial_scale=scale)
+    x1, y1, x2, y2 = (_cround(v * scale) for v in boxes[0])
+    rh, rw = max(y2 - y1 + 1, 1), max(x2 - x1 + 1, 1)
+    exp = np.zeros((1, 2, 2, 2), np.float32)
+    for ph in range(2):
+        for pw in range(2):
+            hs = y1 + int(np.floor(ph * rh / 2))
+            he = y1 + int(np.ceil((ph + 1) * rh / 2))
+            ws = x1 + int(np.floor(pw * rw / 2))
+            we = x1 + int(np.ceil((pw + 1) * rw / 2))
+            exp[0, :, ph, pw] = x[0, :, hs:he, ws:we].max((1, 2))
+    got = out[0] if isinstance(out, (list, tuple)) else out
+    np.testing.assert_allclose(got.numpy(), exp, rtol=1e-5)
+
+
+def test_psroi_pool_spatial_scale_half():
+    """Reference phi psroi_pool rounds the RAW box then scales
+    (round(b)*s, NOT round(b*s)): the advisor caught a double-scaling bug
+    that only scale=1.0 specs could not see."""
+    rng = np.random.RandomState(4)
+    x = rng.rand(1, 8, 6, 6).astype(np.float32)
+    # 2.5 and 0.5 are half-integers: C round() (3, 1) vs banker's (2, 0)
+    boxes = np.asarray([[2.5, 0.5, 8.7, 9.2]], np.float32)
+    k = dict(pooled_height=2, pooled_width=2, output_channels=2,
+             spatial_scale=0.5)
+    out = OPS["psroi_pool"].user_fn(
+        t(x), t(boxes), boxes_num=t(np.array([1], np.int32)), **k)
+    R.psroi_pool_check(out, (x, boxes), k)
 
 
 # ----------------------------------------------------------------- nms
@@ -460,12 +507,6 @@ def test_hsigmoid_simplecode_bitlength_at_powers_of_two():
     terms when u = label + num_classes hit exact powers of two or
     large-vocab (>2^20) ranges; the integer shift form must match the
     SimpleCode reference everywhere."""
-    import os as _os
-    import sys as _sys
-
-    _sys.path.insert(0, _os.path.dirname(_os.path.abspath(__file__)))
-    import op_refs as R
-
     rng = np.random.RandomState(1)
     for C, lab in ((5000, 3192), (1 << 20, 12345), (2, 0), (17, 15)):
         x = rng.rand(2, 4).astype(np.float32)
@@ -476,6 +517,38 @@ def test_hsigmoid_simplecode_bitlength_at_powers_of_two():
         got = (out[0] if isinstance(out, (list, tuple)) else out).numpy()
         exp = R.hsigmoid_loss_ref(x, labels, w, None, C)
         np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+
+def test_hsigmoid_custom_tree_matches_simplecode_encoding():
+    """CustomCode branch (path_table/path_code): encoding the SimpleCode
+    paths explicitly — including ragged -1 padding — must reproduce the
+    default branch exactly.  (Advisor: these args used to be silently
+    ignored, returning SimpleCode losses for any custom tree.)"""
+    rng = np.random.RandomState(7)
+    C, D, N = 6, 4, 3
+    x = rng.rand(N, D).astype(np.float32)
+    w = rng.rand(C - 1, D).astype(np.float32) * 0.1
+    labels = np.array([0, 3, 5], np.int64)
+    L = int(2 * C - 1).bit_length() - 1
+    table = np.full((N, L), -1, np.int64)
+    code = np.zeros((N, L), np.int64)
+    for n, c in enumerate(labels):
+        u = int(c) + C
+        for j in range(L):
+            if (u >> (j + 1)) <= 0:
+                break
+            table[n, j] = (u >> (j + 1)) - 1
+            code[n, j] = (u >> j) & 1
+    default = OPS["hsigmoid_loss"].user_fn(
+        t(x), t(labels), t(w), num_classes=C)
+    custom = OPS["hsigmoid_loss"].user_fn(
+        t(x), t(labels), t(w), num_classes=C,
+        path_table=t(table), path_code=t(code))
+    g = lambda o: (o[0] if isinstance(o, (list, tuple)) else o).numpy()
+    np.testing.assert_allclose(g(custom), g(default), rtol=1e-5)
+    with pytest.raises(ValueError):
+        OPS["hsigmoid_loss"].user_fn(t(x), t(labels), t(w), num_classes=C,
+                                     path_table=t(table))
 
 
 def test_deformable_conv_groups2_zero_offset_equals_conv():
