@@ -226,8 +226,9 @@ class _EmbeddingPull(PyLayer):
 
     @staticmethod
     def backward(ctx, grad_out):
-        g = np.asarray(grad_out._data if isinstance(grad_out, Tensor)
-                       else grad_out)
+        # hand the table the raw device array: a device-resident table
+        # (HotRowCache) keeps the whole push on-chip; host tables convert
+        g = grad_out._data if isinstance(grad_out, Tensor) else grad_out
         ctx.table.push(ctx.ids, g.reshape(len(ctx.ids), ctx.table.dim))
         anchor_grad = Tensor(jnp.zeros((1,), jnp.float32))
         return None, anchor_grad
@@ -274,3 +275,4 @@ from .graph import (  # noqa: E402,F401
     start_graph_server,
     wait_graph_endpoints,
 )
+from .heter import HotRowCache  # noqa: E402,F401

@@ -1,0 +1,310 @@
+"""Device-resident hot-row embedding cache — the HeterPS analog.
+
+Reference: paddle/fluid/framework/fleet/heter_ps/ — ps_gpu_wrapper.h
+builds per-pass GPU hashtables of hot feature rows (feature_value.h
+packs row + optimizer state), trains the pass device-side, and merges
+back into the host/SSD table at EndPass; ctr_accessor.cc ShowClickScore
+ranks rows for retention.
+
+TPU redesign: no hand-rolled device hashtable — the cache is a pair of
+fixed-capacity jnp arrays resident in HBM (rows + adagrad accumulators)
+updated by jitted scatter ops, with a host-side dict mapping key->slot.
+Batch key sets are small (1e3-1e5) so host hashing is never the
+bottleneck; what matters on TPU is that row payloads and gradient math
+stay on-device for cache hits (no host RTT, no H2D).  Write-back uses
+GeoSGD-style deltas (``w_server += w_local - w_base``, the existing
+``push_delta`` verb), so the host table's accessor depth — CTR stats,
+disk tier, shrink — keeps operating unchanged underneath the cache.
+
+Semantics (reference pass semantics, ps_gpu_wrapper BuildGPUTask/
+EndPass): cached rows see the local trainer's updates immediately and
+other trainers' updates at flush(refresh=True)/eviction boundaries.
+With a single trainer and the same optimizer formula the cached run is
+step-for-step identical to the uncached one — including duplicate keys
+within a batch (adagrad applies occurrences sequentially, matching the
+host loop) and eviction/re-admission cycles (the adagrad accumulator
+spills to host memory with the row).  The one documented exception:
+a key that overflowed capacity and was pushed through to the host keeps
+its optimizer history there; if later admitted, the cache restarts its
+local accumulator (no verb reads host g2 back).
+"""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+def _pad_len(n, floor=8):
+    """Round up to a power of two so jitted update shapes stay bucketed
+    (a fresh XLA compile per distinct batch-unique-count would dwarf the
+    RTT savings the cache exists to provide)."""
+    n = max(int(n), floor)
+    return 1 << (n - 1).bit_length()
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_apply(rows, slots, g, lr):
+    # out-of-range padding slots are dropped by XLA scatter semantics;
+    # donation makes the update in-place in HBM instead of a full copy
+    return rows.at[slots].add(-lr * g)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _adagrad_apply(rows, accum, slots, g, lr, eps):
+    accum = accum.at[slots].add(g * g)
+    denom = jnp.sqrt(accum[slots]) + eps
+    return rows.at[slots].add(-lr * g / denom), accum
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _dedup_grads(g, inv, upad):
+    out = jnp.zeros((upad, g.shape[1]), jnp.float32)
+    return out.at[inv].add(g)
+
+
+class HotRowCache:
+    """SparseTable-compatible facade: HBM cache over a remote PS table.
+
+    Drop-in for ``DistributedEmbedding(table=...)`` — pulls return jnp
+    arrays already on device; pushes apply the optimizer on device and
+    mark rows dirty for delta write-back.
+
+    >>> cache = HotRowCache(remote, capacity=4096, flush_interval=16)
+    >>> rows = cache.pull(ids)        # device gather on hit, RPC on miss
+    >>> cache.push(ids, grads)        # jitted scatter update, no RTT
+    >>> cache.flush(refresh=True)     # EndPass: write back + resync
+    """
+
+    def __init__(self, remote, capacity=4096, optimizer="sgd",
+                 learning_rate=0.05, epsilon=1e-8, flush_interval=0,
+                 score_decay=0.98):
+        self.remote = remote
+        self.dim = int(remote.dim)
+        self.capacity = int(capacity)
+        self.optimizer = optimizer
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError(f"unknown cache optimizer {optimizer!r}")
+        self.learning_rate = float(learning_rate)
+        self.epsilon = float(epsilon)
+        self.flush_interval = int(flush_interval)
+        self.score_decay = float(score_decay)
+        # host-side spill of evicted adagrad accumulators is bounded:
+        # beyond this, the oldest entries drop (their rows restart the
+        # accumulator on re-admission — same as the overflow path)
+        self.spill_capacity = 16 * self.capacity
+
+        self._rows = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        self._base = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        # adagrad state lives on-device beside the rows (feature_value.h
+        # packs optimizer state the same way); sgd never touches it, so
+        # don't spend the HBM.  Evicted accumulators spill to host memory
+        # and restore on re-admission, preserving single-trainer parity
+        # across capacity pressure.
+        self._accum = (jnp.zeros((self.capacity, self.dim), jnp.float32)
+                       if optimizer == "adagrad" else None)
+        self._accum_spill = {}
+        self._key_of = np.full((self.capacity,), -1, np.int64)
+        self._slot_of = {}
+        self._free = list(range(self.capacity - 1, -1, -1))
+        # retention score: decayed access frequency — the "show" half of
+        # ctr_accessor.cc ShowClickScore applied to cache residency
+        self._score = np.zeros((self.capacity,), np.float64)
+        self._dirty = np.zeros((self.capacity,), bool)
+        self._steps = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.rtts = {"pull": 0, "push": 0, "push_delta": 0}
+
+    # ------------------------------------------------------------ admit ----
+
+    def _writeback_slots(self, slots):
+        """Push w - w_base for the given dirty slots (one RTT)."""
+        slots = np.asarray(slots, np.int64)
+        d = slots[self._dirty[slots]]
+        if not len(d):
+            return
+        delta = np.asarray(self._rows[d] - self._base[d])
+        self.remote.push_delta(self._key_of[d], delta)
+        self.rtts["push_delta"] += 1
+        self._base = self._base.at[d].set(self._rows[d])
+        self._dirty[d] = False
+
+    def _admit(self, missing, pinned):
+        """Fetch ``missing`` keys from the remote table and cache as many
+        as fit; returns the list of keys that could NOT be cached (they
+        stay on the uncached pass-through path this batch)."""
+        rows_host = self.remote.pull(missing)
+        self.rtts["pull"] += 1
+        m = len(missing)
+        if len(self._free) < m:
+            need = m - len(self._free)
+            occupied = np.nonzero(self._key_of >= 0)[0]
+            evictable = occupied[~np.isin(
+                occupied, np.fromiter(pinned, np.int64, len(pinned)))] \
+                if pinned else occupied
+            if len(evictable):
+                order = np.argsort(self._score[evictable], kind="stable")
+                victims = evictable[order[:need]]
+                self._writeback_slots(victims)
+                if self._accum is not None and len(victims):
+                    acc_host = np.asarray(self._accum[victims])
+                    for s, a in zip(victims.tolist(), acc_host):
+                        self._accum_spill[int(self._key_of[s])] = a
+                    while len(self._accum_spill) > self.spill_capacity:
+                        self._accum_spill.pop(
+                            next(iter(self._accum_spill)))
+                for s in victims.tolist():
+                    del self._slot_of[int(self._key_of[s])]
+                    self._key_of[s] = -1
+                    self._score[s] = 0.0
+                    self._free.append(s)
+                self.evictions += len(victims)
+        n_fit = min(m, len(self._free))
+        slots = np.asarray([self._free.pop() for _ in range(n_fit)],
+                           np.int64)
+        if n_fit:
+            fit_keys = missing[:n_fit]
+            self._rows = self._rows.at[slots].set(
+                jnp.asarray(rows_host[:n_fit]))
+            self._base = self._base.at[slots].set(
+                jnp.asarray(rows_host[:n_fit]))
+            if self._accum is not None:
+                acc = np.stack([
+                    self._accum_spill.pop(int(k),
+                                          np.zeros((self.dim,), np.float32))
+                    for k in fit_keys])
+                self._accum = self._accum.at[slots].set(jnp.asarray(acc))
+            self._key_of[slots] = fit_keys
+            self._score[slots] = 1.0
+            for k, s in zip(fit_keys.tolist(), slots.tolist()):
+                self._slot_of[k] = s
+        overflow = missing[n_fit:]
+        return overflow, rows_host[n_fit:]
+
+    # ------------------------------------------------------- pull / push ----
+
+    def pull(self, keys):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        shape = keys.shape
+        uniq, inv = np.unique(keys, return_inverse=True)
+        slots = np.asarray([self._slot_of.get(int(k), -1) for k in uniq],
+                           np.int64)
+        cached = slots >= 0
+        self.hits += int(cached.sum())
+        self.misses += int((~cached).sum())
+        self._score[slots[cached]] += 1.0
+        overflow_rows = None
+        if not cached.all():
+            missing = uniq[~cached]
+            pinned = set(slots[cached].tolist())
+            _overflow, overflow_rows = self._admit(missing, pinned)
+            # refresh only the previously-missing entries (overflow keys
+            # stay -1; _admit preserves uniq order, so overflow_rows
+            # aligns with the tail of the missing positions)
+            for i in np.nonzero(~cached)[0]:
+                slots[i] = self._slot_of.get(int(uniq[i]), -1)
+        out = self._rows[jnp.asarray(np.clip(slots, 0, self.capacity - 1))]
+        still_missing = slots < 0
+        if still_missing.any():
+            # capacity overflow: serve those rows straight from the RPC
+            # reply (pass-through path; push() mirrors it)
+            out = out.at[jnp.asarray(np.nonzero(still_missing)[0])].set(
+                jnp.asarray(overflow_rows))
+        return out[jnp.asarray(inv)].reshape(shape + (self.dim,))
+
+    def push(self, keys, grads, learning_rate=None):
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        if not len(keys):
+            return
+        g = jnp.asarray(grads, jnp.float32).reshape(len(keys), self.dim)
+        lr = self.learning_rate if learning_rate is None else float(
+            learning_rate)
+        uniq, inv = np.unique(keys, return_inverse=True)
+        slots = np.asarray([self._slot_of.get(int(k), -1) for k in uniq],
+                           np.int64)
+        uncached = slots < 0
+        if uncached.any():
+            # push-before-pull or capacity overflow: the raw per-occurrence
+            # grads go straight to the remote table, which applies ITS
+            # optimizer sequentially in order, exactly as an uncached push
+            # would (matching config is the caller's contract, as with
+            # DistributedEmbedding)
+            pos = np.nonzero(uncached[inv])[0]
+            self.remote.push(keys[pos], np.asarray(g[jnp.asarray(pos)]),
+                             learning_rate=lr)
+            self.rtts["push"] += 1
+        cslots_u = np.where(uncached, self.capacity, slots)  # OOB -> drop
+        if self.optimizer == "sgd":
+            # sgd is linear in the gradient: summing duplicates in one
+            # scatter is exactly the sequential result
+            upad = _pad_len(len(uniq))
+            g_u = _dedup_grads(g, jnp.asarray(inv), upad)
+            pad = np.full((upad - len(uniq),), self.capacity, np.int64)
+            cslots = jnp.asarray(np.concatenate([cslots_u, pad]))
+            self._rows = _sgd_apply(self._rows, cslots, g_u, lr)
+        else:
+            # adagrad is NOT: the host table applies each occurrence
+            # sequentially (accum += g_i^2 per row).  Layer duplicate
+            # occurrences into rounds — round r scatters the r-th
+            # occurrence of every key, so within a round keys are unique
+            # and across rounds order matches the host loop.
+            order = np.argsort(inv, kind="stable")
+            sorted_inv = inv[order]
+            starts = np.searchsorted(sorted_inv, np.arange(len(uniq)))
+            rank_sorted = np.arange(len(keys)) - starts[sorted_inv]
+            occ = np.empty(len(keys), np.int64)
+            occ[order] = rank_sorted
+            for r in range(int(occ.max()) + 1):
+                pos = np.nonzero(occ == r)[0]
+                npad = _pad_len(len(pos))
+                slot_r = np.full((npad,), self.capacity, np.int64)
+                slot_r[:len(pos)] = cslots_u[inv[pos]]
+                pos_pad = np.zeros((npad,), np.int64)
+                pos_pad[:len(pos)] = pos
+                g_r = g[jnp.asarray(pos_pad)]  # padded rows are dropped
+                self._rows, self._accum = _adagrad_apply(
+                    self._rows, self._accum, jnp.asarray(slot_r), g_r, lr,
+                    self.epsilon)
+        self._dirty[slots[~uncached]] = True
+        self._steps += 1
+        if self.flush_interval and self._steps % self.flush_interval == 0:
+            self.flush(refresh=True)
+
+    # ----------------------------------------------------------- control ----
+
+    def flush(self, refresh=False):
+        """Write back all dirty rows (one RTT).  ``refresh=True`` then
+        re-pulls every cached key so other trainers' updates fold in —
+        the EndPass merge of ps_gpu_wrapper."""
+        dirty = np.nonzero(self._dirty)[0]
+        self._writeback_slots(dirty)
+        if refresh:
+            occ = np.nonzero(self._key_of >= 0)[0]
+            if len(occ):
+                fresh = self.remote.pull(self._key_of[occ])
+                self.rtts["pull"] += 1
+                fj = jnp.asarray(fresh)
+                oj = jnp.asarray(occ)
+                self._rows = self._rows.at[oj].set(fj)
+                self._base = self._base.at[oj].set(fj)
+        self._score *= self.score_decay
+
+    def stats(self):
+        total = self.hits + self.misses
+        return {
+            "hits": self.hits,
+            "misses": self.misses,
+            "hit_rate": self.hits / total if total else 0.0,
+            "evictions": self.evictions,
+            "cached_rows": int((self._key_of >= 0).sum()),
+            "rtts": dict(self.rtts),
+        }
+
+    def close(self):
+        self.flush()
