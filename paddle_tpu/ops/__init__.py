@@ -95,6 +95,8 @@ _METHODS = [
     "cond", "histogram", "bincount", "trace", "cast", "zeros_like",
     "ones_like",
 ]
+# to_sparse_coo / to_sparse_csr bind in paddle_tpu.sparse (they return
+# SparseTensor, which this layer doesn't know about)
 
 
 def _patch_tensor():

@@ -69,17 +69,34 @@ def to_sparse_coo(x, sparse_dim=None):
             tuple(arr.shape))
 
 
+def csr_crows(rows, nrows, batch=None, nbatch=None):
+    """Row pointers in the phi layout: [nrows+1], or for batched CSR the
+    per-batch pointers concatenated to [nbatch*(nrows+1)]
+    (phi sparse_csr_tensor.h) — the single source for this layout."""
+    if batch is None:
+        crows = np.zeros(nrows + 1, np.int64)
+        np.add.at(crows, np.asarray(rows) + 1, 1)
+        return np.cumsum(crows)
+    crows = np.zeros((nbatch, nrows + 1), np.int64)
+    np.add.at(crows, (np.asarray(batch), np.asarray(rows) + 1), 1)
+    return np.cumsum(crows, axis=1).reshape(-1)
+
+
 def to_sparse_csr(x):
-    """Dense 2-D → CSR (host op)."""
+    """Dense 2-D/3-D → CSR (host op).  3-D follows the reference's
+    batched-CSR layout (see :func:`csr_crows`)."""
     from ..core.tensor import Tensor
     arr = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
-    if arr.ndim != 2:
-        raise ValueError("to_sparse_csr expects a 2-D tensor")
-    rows, cols = np.nonzero(arr)
-    vals = arr[rows, cols]
-    crows = np.zeros(arr.shape[0] + 1, np.int64)
-    np.add.at(crows, rows + 1, 1)
-    crows = np.cumsum(crows)
+    if arr.ndim == 2:
+        rows, cols = np.nonzero(arr)
+        vals = arr[rows, cols]
+        crows = csr_crows(rows, arr.shape[0])
+    elif arr.ndim == 3:
+        b, rows, cols = np.nonzero(arr)
+        vals = arr[b, rows, cols]
+        crows = csr_crows(rows, arr.shape[1], batch=b, nbatch=arr.shape[0])
+    else:
+        raise ValueError("to_sparse_csr expects a 2-D or 3-D tensor")
     return (Tensor(jnp.asarray(crows)), Tensor(jnp.asarray(cols.astype(np.int64))),
             Tensor(jnp.asarray(vals)), tuple(arr.shape))
 

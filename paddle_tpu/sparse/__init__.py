@@ -25,6 +25,9 @@ class SparseTensor:
     def __init__(self, bcoo, fmt="coo"):
         self._bcoo = bcoo
         self._fmt = fmt
+        # when set, the autograd-connected values Tensor (threads the
+        # eager tape through sparse ops — see sparse/depth.py)
+        self._values_t = None
 
     # -------- reference accessors --------
     @property
@@ -43,6 +46,8 @@ class SparseTensor:
         return Tensor(self._bcoo.indices.T)
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return Tensor(self._bcoo.data)
 
     def to_dense(self):
@@ -55,20 +60,40 @@ class SparseTensor:
         return self._fmt == "csr"
 
     def coalesce(self):
-        return SparseTensor(self._bcoo.sum_duplicates(), self._fmt)
+        from ..ops.dispatch import apply_op
+        from .depth import _vals_tensor
+
+        idx = np.asarray(self._bcoo.indices)
+        dims = self._bcoo.shape[:idx.shape[1]]
+        lin = np.ravel_multi_index(tuple(idx.T), dims)
+        uniq, inv = np.unique(lin, return_inverse=True)
+        out_idx = np.stack(np.unravel_index(uniq, dims), 1)
+        inv_j = jnp.asarray(inv)
+        n_out = len(uniq)
+        vals = apply_op(
+            "sparse_coalesce",
+            lambda v: jax.ops.segment_sum(v, inv_j, n_out),
+            (_vals_tensor(self),), {})
+        out = SparseTensor(
+            jsparse.BCOO((vals._data, jnp.asarray(out_idx)),
+                         shape=self._bcoo.shape), self._fmt)
+        out._values_t = vals
+        return out
 
     # -------- csr view --------
     def crows(self):
+        from ..ops.sparse_ops import csr_crows
+
         indices = np.asarray(self._bcoo.indices)
-        rows = indices[:, 0]
-        nrows = self.shape[0]
-        crows = np.zeros(nrows + 1, dtype=np.int64)
-        for r in rows:
-            crows[r + 1] += 1
-        return Tensor(jnp.asarray(np.cumsum(crows)))
+        if indices.shape[1] == 3:   # batched CSR: concatenated pointers
+            out = csr_crows(indices[:, 1], self.shape[1],
+                            batch=indices[:, 0], nbatch=self.shape[0])
+        else:
+            out = csr_crows(indices[:, 0], self.shape[0])
+        return Tensor(jnp.asarray(out))
 
     def cols(self):
-        return Tensor(self._bcoo.indices[:, 1])
+        return Tensor(self._bcoo.indices[:, -1])
 
     def __repr__(self):
         return (f"SparseTensor(shape={self.shape}, nnz={self.nnz}, "
@@ -86,14 +111,39 @@ def sparse_coo_tensor(indices, values, shape=None, dtype=None,
         shape = tuple(int(m) + 1 for m in np.asarray(jnp.max(idx, axis=1)))
         shape = shape + val.shape[1:]
     bcoo = jsparse.BCOO((val, idx.T), shape=tuple(shape))
-    return SparseTensor(bcoo, "coo")
+    out = SparseTensor(bcoo, "coo")
+    if isinstance(values, Tensor) and not values.stop_gradient:
+        vt = values
+        if vt._data.dtype != val.dtype:
+            # cast through the op layer so the autograd thread and the
+            # BCOO payload agree in dtype (review regression)
+            from ..ops.registry import OPS
+            vt = OPS["cast"].user_fn(vt, val.dtype)
+        out._values_t = vt
+    return out
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """2-D CSR, or 3-D batched CSR with crows = concatenated per-batch
+    row pointers, shape [batch * (nrows + 1)] (phi sparse_csr_tensor.h)."""
     crows_np = np.asarray(crows._data if isinstance(crows, Tensor) else crows)
     cols_np = np.asarray(cols._data if isinstance(cols, Tensor) else cols)
-    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
-    indices = jnp.asarray(np.stack([rows, cols_np]))
+    if len(shape) == 3:
+        nb, nr = int(shape[0]), int(shape[1])
+        if crows_np.size != nb * (nr + 1):
+            raise ValueError(
+                f"batched CSR needs crows of size batch*(nrows+1) = "
+                f"{nb * (nr + 1)}, got {crows_np.size}")
+        per = crows_np.reshape(nb, nr + 1)
+        counts = np.diff(per, axis=1)                     # [B, nr]
+        rows = np.tile(np.arange(nr), nb)
+        batch = np.repeat(np.arange(nb), nr)
+        rows = np.repeat(rows, counts.reshape(-1))
+        batch = np.repeat(batch, counts.reshape(-1))
+        indices = jnp.asarray(np.stack([batch, rows, cols_np]))
+    else:
+        rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+        indices = jnp.asarray(np.stack([rows, cols_np]))
     t = sparse_coo_tensor(indices, values, shape, dtype=dtype)
     t._fmt = "csr"
     return t
@@ -101,11 +151,16 @@ def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
 
 def _unary(name, fn):
     def impl(x):
+        from ..ops.dispatch import apply_op
+
         if isinstance(x, SparseTensor):
-            b = x._bcoo
-            return SparseTensor(
-                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape), x._fmt)
-        return Tensor(fn(x._data if isinstance(x, Tensor) else x))
+            from .depth import _rebuild, _vals_tensor
+
+            out = apply_op(f"sparse_{name}", fn, (_vals_tensor(x),), {})
+            return _rebuild(x, out)
+        if isinstance(x, Tensor):
+            return apply_op(f"sparse_{name}", fn, (x,), {})
+        return Tensor(fn(x))
     impl.__name__ = name
     return impl
 
@@ -122,35 +177,78 @@ log1p = _unary("log1p", jnp.log1p)
 cast = lambda x, dtype: _unary("cast", lambda v: v.astype(dtype))(x)  # noqa: E731
 
 
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
 def matmul(a, b):
     """sparse @ dense (reference sparse.matmul)."""
-    bd = b._data if isinstance(b, Tensor) else b
+    from ..ops.dispatch import apply_op
+    from .depth import _vals_tensor
+
     if isinstance(a, SparseTensor):
-        return Tensor(a._bcoo @ bd)
-    ad = a._data if isinstance(a, Tensor) else a
-    return Tensor(ad @ b._bcoo.todense() if isinstance(b, SparseTensor)
-                  else ad @ bd)
+        idx, shape = a._bcoo.indices, a._bcoo.shape
+
+        def fn(v, bd):
+            return jsparse.BCOO((v, idx), shape=shape) @ bd
+
+        return apply_op("sparse_matmul", fn,
+                        (_vals_tensor(a), _as_tensor(b)), {})
+    if isinstance(b, SparseTensor):
+        idx, shape = b._bcoo.indices, b._bcoo.shape
+
+        def fn(ad, v):
+            return ad @ jsparse.BCOO((v, idx), shape=shape).todense()
+
+        return apply_op("sparse_matmul", fn,
+                        (_as_tensor(a), _vals_tensor(b)), {})
+    return apply_op("sparse_matmul", lambda x, y: x @ y,
+                    (_as_tensor(a), _as_tensor(b)), {})
 
 
 def masked_matmul(a, b, mask):
     """dense@dense evaluated only at mask's nonzeros (reference
     sparse.masked_matmul)."""
-    ad = a._data if isinstance(a, Tensor) else a
-    bd = b._data if isinstance(b, Tensor) else b
-    dense = ad @ bd
+    from ..ops.dispatch import apply_op
+    from .depth import _rebuild
+
     idx = mask._bcoo.indices
-    vals = dense[idx[:, 0], idx[:, 1]]
-    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape),
-                        "coo")
+
+    def fn(ad, bd):
+        return (ad @ bd)[idx[:, 0], idx[:, 1]]
+
+    vals = apply_op("sparse_masked_matmul", fn,
+                    (_as_tensor(a), _as_tensor(b)), {})
+    return _rebuild(mask, vals, fmt="coo")
 
 
 def add(a, b):
     if isinstance(a, SparseTensor) and isinstance(b, SparseTensor):
-        out = jsparse.BCOO(
-            (jnp.concatenate([a._bcoo.data, b._bcoo.data]),
-             jnp.concatenate([a._bcoo.indices, b._bcoo.indices])),
-            shape=a._bcoo.shape).sum_duplicates()
-        return SparseTensor(out, a._fmt)
+        from ..ops.dispatch import apply_op
+        from .depth import _vals_tensor
+
+        # output structure is data-independent: dedupe coordinates on
+        # host, then a differentiable segment-sum merges the values
+        idx_cat = np.concatenate([np.asarray(a._bcoo.indices),
+                                  np.asarray(b._bcoo.indices)])
+        dims = a._bcoo.shape[:idx_cat.shape[1]]
+        lin = np.ravel_multi_index(tuple(idx_cat.T), dims)
+        uniq, inv = np.unique(lin, return_inverse=True)
+        out_idx = np.stack(np.unravel_index(uniq, dims), 1)
+        inv_j = jnp.asarray(inv)
+        n_out = len(uniq)
+
+        def fn(va, vb):
+            return jax.ops.segment_sum(jnp.concatenate([va, vb]), inv_j,
+                                       n_out)
+
+        vals = apply_op("sparse_add", fn,
+                        (_vals_tensor(a), _vals_tensor(b)), {})
+        out = SparseTensor(
+            jsparse.BCOO((vals._data, jnp.asarray(out_idx)),
+                         shape=a._bcoo.shape), a._fmt)
+        out._values_t = vals
+        return out
     raise TypeError("sparse.add expects two sparse tensors")
 
 
@@ -159,21 +257,115 @@ def is_same_shape(a, b):
 
 
 class nn:
-    """paddle.sparse.nn: activation + sparse 3D conv/pool layers."""
+    """paddle.sparse.nn: activation/norm + sparse 3D conv/pool layers."""
 
     class ReLU:
         def __call__(self, x):
             return relu(x)
 
+    class Softmax:
+        def __init__(self, axis=-1):
+            self.axis = axis
 
-def _install_conv_layers():
-    # conv.py imports back from this module; bind after definitions
+        def __call__(self, x):
+            from .depth import softmax as _sm
+
+            return _sm(x, axis=self.axis)
+
+
+def _install_depth():
+    # conv.py / depth.py import back from this module; bind after defs
     from .conv import Conv3D, MaxPool3D, SubmConv3D, sparse_conv3d
+    from .depth import addmm, attention, max_pool3d, mv, softmax
+    from ..nn.norm import _BatchNormBase
+
+    class BatchNorm(_BatchNormBase):
+        """Sparse batch norm (sparse batch_norm_kernel.cc): the dense BN
+        runs over x.values() [nnz, C] — stats over the NONZERO sites per
+        channel, channels last (NDHWC) — and the sparsity is untouched."""
+
+        def __init__(self, num_features, momentum=0.9, epsilon=1e-05,
+                     weight_attr=None, bias_attr=None,
+                     data_format="NDHWC", use_global_stats=None,
+                     name=None):
+            if data_format != "NDHWC":
+                raise ValueError(
+                    "sparse BatchNorm only supports NDHWC (channels-last "
+                    "values layout, as in the reference)")
+            super().__init__(num_features, momentum=momentum,
+                             epsilon=epsilon, weight_attr=weight_attr,
+                             bias_attr=bias_attr, data_format="NHWC",
+                             use_global_stats=use_global_stats, name=name)
+
+        def forward(self, x):
+            from .depth import _rebuild, _vals_tensor
+
+            out_vals = super().forward(_vals_tensor(x))
+            return _rebuild(x, out_vals)
+
+    class SyncBatchNorm(BatchNorm):
+        """Sparse sync BN (sparse sync_batch_norm_kernel.h): on TPU the
+        cross-replica stats sync dissolves into SPMD — under pjit the
+        batch axis is global, eager single-chip equals BatchNorm."""
 
     nn.Conv3D = Conv3D
     nn.SubmConv3D = SubmConv3D
     nn.MaxPool3D = MaxPool3D
-    globals()["sparse_conv3d"] = sparse_conv3d
+    nn.BatchNorm = BatchNorm
+    nn.SyncBatchNorm = SyncBatchNorm
+
+    class functional:
+        pass
+
+    functional.relu = relu
+    functional.softmax = softmax
+    functional.attention = attention
+    functional.max_pool3d = max_pool3d
+    nn.functional = functional
+
+    g = globals()
+    g["sparse_conv3d"] = sparse_conv3d
+    g["softmax"] = softmax
+    g["addmm"] = addmm
+    g["mv"] = mv
+
+    # Tensor.to_sparse_coo()/to_sparse_csr() return SparseTensor (the
+    # reference Tensor-method surface); the values come from a
+    # differentiable gather so dense->sparse keeps the autograd chain.
+    from ..ops.dispatch import apply_op
+
+    def _sparse_from_idx(dense_t, idx_cols, shape, fmt):
+        gather = tuple(jnp.asarray(c) for c in idx_cols)
+        vals = apply_op("to_sparse_" + fmt, lambda d: d[gather],
+                        (dense_t,), {})
+        from jax.experimental import sparse as jsparse
+
+        out = SparseTensor(
+            jsparse.BCOO((vals._data,
+                          jnp.asarray(np.stack(idx_cols, 1).astype(
+                              np.int32))),
+                         shape=shape), fmt)
+        if not dense_t.stop_gradient:
+            out._values_t = vals
+        return out
+
+    def _to_sparse_coo(self, sparse_dim=None):
+        arr = np.asarray(self.numpy())
+        sd = sparse_dim or arr.ndim
+        flat_tail = arr.reshape(arr.shape[:sd] + (-1,))
+        mask = (flat_tail != 0).any(-1).reshape(arr.shape[:sd])
+        idx = np.nonzero(mask)
+        return _sparse_from_idx(self, idx, arr.shape, "coo")
+
+    def _to_sparse_csr(self):
+        arr = np.asarray(self.numpy())
+        if arr.ndim not in (2, 3):
+            raise ValueError("to_sparse_csr expects a 2-D or 3-D tensor")
+        idx = np.nonzero(arr)
+        return _sparse_from_idx(self, idx, arr.shape, "csr")
+
+    Tensor.to_sparse_coo = _to_sparse_coo
+    Tensor.to_sparse_csr = _to_sparse_csr
 
 
-_install_conv_layers()
+_install_depth()

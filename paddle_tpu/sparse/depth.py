@@ -1,0 +1,186 @@
+"""Sparse kernel depth: batch_norm, addmm, mv, softmax, fused attention.
+
+Reference kernel surface: paddle/phi/kernels/sparse/{batch_norm_kernel.h,
+addmm_kernel.h, mv_kernel.h, softmax_kernel.h, pool_kernel.h,
+fused_attention_kernel.h} and the python API at
+python/paddle/sparse/nn/functional/.
+
+TPU redesign: XLA has no sparse HLO, so every kernel lowers to
+gather + segment reductions over the static nonzero structure — the
+indices are host numpy (closed over as static), the VALUES are
+differentiable Tensor inputs routed through ``apply_op`` so the eager
+tape records an exact ``jax.vjp`` pullback.  This mirrors what the
+reference's GPU kernels do (cuSPARSE SDDMM/SpMM = gather-reduce), but
+lets XLA fuse the whole chain.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.dispatch import apply_op
+
+
+def _tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _vals_tensor(sp):
+    """The autograd-connected values Tensor of a SparseTensor."""
+    t = getattr(sp, "_values_t", None)
+    return t if t is not None else Tensor(sp._bcoo.data)
+
+
+def _rebuild(sp, vals_t, fmt=None):
+    """Same sparsity structure, new values (keeps the autograd chain)."""
+    from . import SparseTensor
+    from jax.experimental import sparse as jsparse
+
+    out = SparseTensor(
+        jsparse.BCOO((vals_t._data, sp._bcoo.indices), shape=sp._bcoo.shape),
+        fmt or sp._fmt)
+    out._values_t = vals_t
+    return out
+
+
+def _row_segments(sp):
+    """Linear row ids (all dims but the last) for each nonzero."""
+    idx = np.asarray(sp._bcoo.indices)          # [nnz, nd]
+    dims = sp.shape
+    nd = idx.shape[1]
+    rows = np.zeros(len(idx), np.int64)
+    stride = 1
+    for d in range(nd - 2, -1, -1):
+        rows += idx[:, d] * stride
+        stride *= dims[d]
+    n_rows = int(np.prod(dims[:-1])) if nd > 1 else 1
+    return rows, n_rows
+
+
+def softmax(x, axis=-1, name=None):
+    """Row-wise softmax over the nonzeros (sparse softmax_kernel.h).
+
+    Matches the reference restriction: only the last axis (CSR is
+    row-major; phi supports axis=-1 on CPU for COO too)."""
+    nd = len(x.shape)
+    if axis not in (-1, nd - 1):
+        raise ValueError(
+            f"sparse softmax supports only the last axis, got {axis} "
+            "(reference sparse softmax_kernel restriction)")
+    vals = _vals_tensor(x)
+    if vals._data.ndim != 1:
+        raise ValueError("sparse softmax expects scalar per-entry values")
+    rows, n_rows = _row_segments(x)
+    rows_j = jnp.asarray(rows)
+
+    def fn(v):
+        m = jax.ops.segment_max(v, rows_j, n_rows)
+        e = jnp.exp(v - jnp.where(jnp.isfinite(m), m, 0.0)[rows_j])
+        s = jax.ops.segment_sum(e, rows_j, n_rows)
+        return e / jnp.maximum(s, jnp.finfo(e.dtype).tiny)[rows_j]
+
+    out = apply_op("sparse_softmax", fn, (vals,), {})
+    return _rebuild(x, out)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """out = beta*input + alpha*(x @ y) — DENSE + COO/CSR @ DENSE -> DENSE
+    (sparse addmm_kernel.h AddmmCooDenseKernel/AddmmCsrDenseKernel)."""
+    if len(x.shape) != 2:
+        raise ValueError("sparse addmm expects a 2-D sparse x")
+    idx = np.asarray(x._bcoo.indices)
+    rows_j = jnp.asarray(idx[:, 0])
+    cols_j = jnp.asarray(idx[:, 1])
+    m = x.shape[0]
+    vals = _vals_tensor(x)
+
+    def fn(inp, xv, yd):
+        contrib = xv[:, None] * yd[cols_j]              # [nnz, n]
+        spmm = jax.ops.segment_sum(contrib, rows_j, m)  # [m, n]
+        return beta * inp + alpha * spmm
+
+    return apply_op("sparse_addmm", fn, (_tensor(input), vals, _tensor(y)),
+                    {})
+
+
+def mv(x, vec, name=None):
+    """COO/CSR @ dense vector -> dense vector (sparse mv_kernel.h)."""
+    if len(x.shape) != 2:
+        raise ValueError("sparse mv expects a 2-D sparse x")
+    idx = np.asarray(x._bcoo.indices)
+    rows_j = jnp.asarray(idx[:, 0])
+    cols_j = jnp.asarray(idx[:, 1])
+    m = x.shape[0]
+    vals = _vals_tensor(x)
+
+    def fn(xv, vd):
+        return jax.ops.segment_sum(xv * vd[cols_j], rows_j, m)
+
+    return apply_op("sparse_mv", fn, (vals, _tensor(vec)), {})
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """softmax(QK^T/sqrt(d)) @ V evaluated ONLY at sparse_mask's nonzeros
+    (sparse fused_attention_kernel.h; python
+    paddle/sparse/nn/functional/transformer.py attention).
+
+    query/key/value: [B, H, L, D]; sparse_mask: SparseTensor with shape
+    [B*H, L, L] (its values are layout-only, as in the reference);
+    key_padding_mask [B, L] and attn_mask [L, L] exclude positions where
+    the mask value is 0 (fused_attention_kernel.cu AttnSoftmaxGpuKernel).
+    """
+    q, k, v = _tensor(query), _tensor(key), _tensor(value)
+    B, H, L, D = q._data.shape
+    if list(sparse_mask.shape) != [B * H, L, L]:
+        raise ValueError(
+            f"sparse_mask dense shape must be [batch*heads, seq, seq] = "
+            f"[{B * H}, {L}, {L}], got {sparse_mask.shape}")
+    idx = np.asarray(sparse_mask._bcoo.indices)     # [nnz, 3]
+    b_j = jnp.asarray(idx[:, 0])
+    row_j = jnp.asarray(idx[:, 1])
+    col_j = jnp.asarray(idx[:, 2])
+    seg_j = jnp.asarray(idx[:, 0] * L + idx[:, 1])
+    n_seg = B * H * L
+    scale = 1.0 / float(np.sqrt(D))
+    neg = jnp.float32(-jnp.inf)
+
+    args = [q, k, v]
+    has_kp = key_padding_mask is not None
+    has_am = attn_mask is not None
+    if has_kp:
+        args.append(_tensor(key_padding_mask))
+    if has_am:
+        args.append(_tensor(attn_mask))
+
+    def fn(qd, kd, vd, *masks):
+        mi = iter(masks)
+        kp = next(mi) if has_kp else None
+        am = next(mi) if has_am else None
+        qf = qd.reshape(B * H, L, D)
+        kf = kd.reshape(B * H, L, D)
+        vf = vd.reshape(B * H, L, D)
+        s = (qf[b_j, row_j] * kf[b_j, col_j]).sum(-1) * scale   # [nnz]
+        if kp is not None:
+            s = jnp.where(kp[b_j // H, col_j] == 0, neg, s)
+        if am is not None:
+            s = jnp.where(am[row_j, col_j] == 0, neg, s)
+        m = jax.ops.segment_max(s, seg_j, n_seg)
+        e = jnp.where(jnp.isfinite(s),
+                      jnp.exp(s - jnp.where(jnp.isfinite(m), m, 0.0)[seg_j]),
+                      0.0)
+        denom = jax.ops.segment_sum(e, seg_j, n_seg)
+        p = e / jnp.maximum(denom, jnp.finfo(e.dtype).tiny)[seg_j]
+        out = jax.ops.segment_sum(p[:, None] * vf[b_j, col_j], seg_j, n_seg)
+        return out.reshape(B, H, L, D)
+
+    return apply_op("sparse_fused_attention", fn, tuple(args), {})
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, name=None):
+    """Functional sparse max pool (sparse pool_kernel.h MaxPoolCoo)."""
+    from .conv import MaxPool3D
+
+    return MaxPool3D(kernel_size, stride=stride, padding=padding)(x)

@@ -1,0 +1,401 @@
+"""Sparse kernel depth: batch_norm, addmm, mv, softmax, fused attention —
+numpy-referenced forward + finite-difference gradient checks.
+
+Reference surface: paddle/phi/kernels/sparse/{batch_norm_kernel.cc,
+addmm_kernel.h, mv_kernel.h, softmax_kernel.h, fused_attention_kernel.h}.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+from paddle_tpu.core.tensor import Tensor
+
+F32 = np.float32
+
+
+def _rand_coo(rng, shape, density=0.4, grad=False):
+    dense = np.where(rng.rand(*shape) < density,
+                     rng.randn(*shape), 0.0).astype(F32)
+    idx = np.stack(np.nonzero(dense))
+    vals = Tensor(dense[tuple(idx)], stop_gradient=not grad)
+    return sparse.sparse_coo_tensor(idx, vals, shape), dense, vals
+
+
+def _num_grad(f, x, eps=1e-3):
+    """Central-difference gradient of scalar f wrt numpy array x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp = x.copy(); xp[i] += eps
+        xm = x.copy(); xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+# ---------------------------------------------------------------- softmax --
+
+def test_softmax_matches_dense_rows():
+    rng = np.random.RandomState(0)
+    sp, dense, _ = _rand_coo(rng, (5, 7))
+    out = sparse.softmax(sp, axis=-1)
+    got = np.asarray(out.to_dense().numpy())
+    for r in range(5):
+        nz = dense[r] != 0
+        if not nz.any():
+            continue
+        e = np.exp(dense[r][nz] - dense[r][nz].max())
+        np.testing.assert_allclose(got[r][nz], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(got[r][~nz], 0.0)
+
+
+def test_softmax_batched_3d_and_csr():
+    rng = np.random.RandomState(1)
+    dense = np.where(rng.rand(2, 3, 4) < 0.6, rng.rand(2, 3, 4), 0.0)
+    dense = dense.astype(F32)
+    sp = paddle.to_tensor(dense).to_sparse_csr()
+    assert sp.is_sparse_csr()
+    # crows/cols round-trip through the explicit constructor too
+    sp2 = sparse.sparse_csr_tensor(sp.crows(), sp.cols(), sp.values(),
+                                   sp.shape)
+    np.testing.assert_allclose(np.asarray(sp2.to_dense().numpy()), dense)
+    got = np.asarray(sparse.softmax(sp).to_dense().numpy())
+    for b in range(2):
+        for r in range(3):
+            nz = dense[b, r] != 0
+            if not nz.any():
+                continue
+            e = np.exp(dense[b, r][nz] - dense[b, r][nz].max())
+            np.testing.assert_allclose(got[b, r][nz], e / e.sum(),
+                                       rtol=1e-5)
+
+
+def test_softmax_grad_matches_numeric():
+    rng = np.random.RandomState(2)
+    sp, dense, vals = _rand_coo(rng, (3, 5), grad=True)
+    cot = rng.rand(sp.nnz).astype(F32)
+    out = sparse.softmax(sp)
+    (out.values() * Tensor(cot)).sum().backward()
+    idx = tuple(np.stack(np.nonzero(dense)))
+
+    def f(v):
+        d = dense.copy(); d[idx] = v
+        tot = 0.0
+        for r in range(d.shape[0]):
+            nz = d[r] != 0
+            if not nz.any():
+                continue
+            e = np.exp(d[r][nz] - d[r][nz].max())
+            tot += ((e / e.sum()) *
+                    cot[_row_mask(idx, r)]).sum()
+        return tot
+
+    def _row_mask(idx, r):
+        return idx[0] == r
+
+    num = _num_grad(f, dense[idx].astype(np.float64).astype(F32))
+    np.testing.assert_allclose(np.asarray(vals.grad.numpy()), num,
+                               rtol=5e-2, atol=5e-3)
+
+
+def test_softmax_rejects_non_last_axis():
+    rng = np.random.RandomState(3)
+    sp, _, _ = _rand_coo(rng, (3, 3))
+    with pytest.raises(ValueError):
+        sparse.softmax(sp, axis=0)
+
+
+# ------------------------------------------------------------------ addmm --
+
+def test_addmm_matches_numpy():
+    rng = np.random.RandomState(4)
+    sp, dense, _ = _rand_coo(rng, (4, 6))
+    inp = rng.randn(4, 3).astype(F32)
+    y = rng.randn(6, 3).astype(F32)
+    out = sparse.addmm(Tensor(inp), sp, Tensor(y), beta=0.7, alpha=1.3)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               0.7 * inp + 1.3 * (dense @ y), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_addmm_grads_flow_to_all_inputs():
+    rng = np.random.RandomState(5)
+    sp, dense, vals = _rand_coo(rng, (3, 4), grad=True)
+    inp = Tensor(rng.randn(3, 2).astype(F32), stop_gradient=False)
+    y = Tensor(rng.randn(4, 2).astype(F32), stop_gradient=False)
+    out = sparse.addmm(inp, sp, y, beta=0.5, alpha=2.0)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(inp.grad.numpy()),
+                               np.full((3, 2), 0.5), rtol=1e-6)
+    # d/dy sum(0.5 inp + 2 A y) = 2 * A^T @ ones
+    np.testing.assert_allclose(np.asarray(y.grad.numpy()),
+                               2.0 * dense.T @ np.ones((3, 2), F32),
+                               rtol=1e-4, atol=1e-5)
+    # d/dvals = 2 * (ones @ y^T) at the nonzero sites
+    idx = np.stack(np.nonzero(dense))
+    full = 2.0 * np.ones((3, 2), F32) @ y.numpy().T
+    np.testing.assert_allclose(np.asarray(vals.grad.numpy()),
+                               full[tuple(idx)], rtol=1e-4, atol=1e-5)
+
+
+# --------------------------------------------------------------------- mv --
+
+def test_mv_matches_numpy_and_grads():
+    rng = np.random.RandomState(6)
+    sp, dense, vals = _rand_coo(rng, (5, 4), grad=True)
+    vec = Tensor(rng.randn(4).astype(F32), stop_gradient=False)
+    out = sparse.mv(sp, vec)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               dense @ vec.numpy(), rtol=1e-4, atol=1e-5)
+    cot = rng.rand(5).astype(F32)
+    (out * Tensor(cot)).sum().backward()
+    np.testing.assert_allclose(np.asarray(vec.grad.numpy()),
+                               dense.T @ cot, rtol=1e-4, atol=1e-5)
+    idx = np.stack(np.nonzero(dense))
+    full = np.outer(cot, vec.numpy())
+    np.testing.assert_allclose(np.asarray(vals.grad.numpy()),
+                               full[tuple(idx)], rtol=1e-4, atol=1e-5)
+
+
+# ------------------------------------------------------------- batch norm --
+
+def test_batch_norm_normalizes_values_channelwise():
+    rng = np.random.RandomState(7)
+    # COO sites with channel-last values [nnz, C]
+    idx = np.stack([np.zeros(20, np.int64),
+                    rng.permutation(20).astype(np.int64)])
+    vals = Tensor((rng.randn(20, 6) * 3 + 2).astype(F32))
+    sp = sparse.sparse_coo_tensor(idx, vals, (1, 20, 6))
+    bn = sparse.nn.BatchNorm(6)
+    out = bn(sp)
+    ov = np.asarray(out.values().numpy())
+    # stats over the NONZERO sites per channel (reference: dense BN over
+    # x.values())
+    np.testing.assert_allclose(ov.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(ov.std(0), 1.0, atol=1e-2)
+    assert out.nnz == sp.nnz
+    # eval mode uses running stats
+    bn.eval()
+    out2 = bn(sp)
+    assert np.isfinite(np.asarray(out2.values().numpy())).all()
+
+
+def test_sync_batch_norm_single_chip_equals_batch_norm():
+    rng = np.random.RandomState(8)
+    idx = np.stack([np.zeros(10, np.int64), np.arange(10, dtype=np.int64)])
+    vals_np = rng.randn(10, 3).astype(F32)
+    sp = sparse.sparse_coo_tensor(idx, Tensor(vals_np), (1, 10, 3))
+    paddle.seed(0)
+    a = sparse.nn.BatchNorm(3)
+    paddle.seed(0)
+    b = sparse.nn.SyncBatchNorm(3)
+    np.testing.assert_allclose(np.asarray(a(sp).values().numpy()),
+                               np.asarray(b(sp).values().numpy()),
+                               rtol=1e-6)
+
+
+def test_batch_norm_grad_flows_to_scale():
+    rng = np.random.RandomState(9)
+    idx = np.stack([np.zeros(8, np.int64), np.arange(8, dtype=np.int64)])
+    sp = sparse.sparse_coo_tensor(
+        idx, Tensor(rng.randn(8, 4).astype(F32)), (1, 8, 4))
+    bn = sparse.nn.BatchNorm(4)
+    out = bn(sp)
+    (out.values() ** 2).sum().backward()
+    assert bn.weight.grad is not None
+    assert np.isfinite(np.asarray(bn.weight.grad.numpy())).all()
+
+
+# -------------------------------------------------------- fused attention --
+
+def _dense_sparse_attention(q, k, v, mask_dense, kp=None, am=None):
+    """Numpy reference: softmax over mask nonzeros only, per (bh, row)."""
+    B, H, L, D = q.shape
+    out = np.zeros_like(q)
+    for b in range(B):
+        for h in range(H):
+            bh = b * H + h
+            s = (q[b, h] @ k[b, h].T) / np.sqrt(D)
+            allow = mask_dense[bh] != 0
+            if kp is not None:
+                allow = allow & (kp[b][None, :] != 0)
+            if am is not None:
+                allow = allow & (am != 0)
+            for i in range(L):
+                cols = np.nonzero(mask_dense[bh][i] != 0)[0]
+                ok = np.nonzero(allow[i])[0]
+                if len(ok) == 0:
+                    continue
+                e = np.exp(s[i][ok] - s[i][ok].max())
+                p = np.zeros(L)
+                p[ok] = e / e.sum()
+                out[b, h, i] = p @ v[b, h]
+    return out
+
+
+def test_attention_matches_dense_reference():
+    rng = np.random.RandomState(10)
+    B, H, L, D = 2, 2, 6, 4
+    q = rng.randn(B, H, L, D).astype(F32)
+    k = rng.randn(B, H, L, D).astype(F32)
+    v = rng.randn(B, H, L, D).astype(F32)
+    mask = (rng.rand(B * H, L, L) < 0.6).astype(F32)
+    mask[:, 0, :] = 1.0  # ensure no empty row ambiguity in this case
+    sp_mask = paddle.to_tensor(mask).to_sparse_csr()
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        sp_mask)
+    ref = _dense_sparse_attention(q, k, v, mask)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_attention_key_padding_and_attn_masks():
+    rng = np.random.RandomState(11)
+    B, H, L, D = 1, 2, 5, 3
+    q = rng.randn(B, H, L, D).astype(F32)
+    k = rng.randn(B, H, L, D).astype(F32)
+    v = rng.randn(B, H, L, D).astype(F32)
+    mask = np.ones((B * H, L, L), F32)
+    sp_mask = paddle.to_tensor(mask).to_sparse_csr()
+    kp = np.ones((B, L), F32); kp[0, -1] = 0.0       # pad out last key
+    am = np.tril(np.ones((L, L), F32))               # causal
+    out = sparse.nn.functional.attention(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        sp_mask, key_padding_mask=paddle.to_tensor(kp),
+        attn_mask=paddle.to_tensor(am))
+    ref = _dense_sparse_attention(q, k, v, mask, kp=kp, am=am)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_attention_grads_match_dense_softmax_attention():
+    """With a full mask, sparse attention == dense attention, so the
+    jax.vjp grads must match the dense formulation's."""
+    rng = np.random.RandomState(12)
+    B, H, L, D = 1, 1, 4, 3
+    qn = rng.randn(B, H, L, D).astype(F32)
+    kn = rng.randn(B, H, L, D).astype(F32)
+    vn = rng.randn(B, H, L, D).astype(F32)
+    mask = np.ones((B * H, L, L), F32)
+    sp_mask = paddle.to_tensor(mask).to_sparse_csr()
+
+    q = paddle.to_tensor(qn); q.stop_gradient = False
+    k = paddle.to_tensor(kn); k.stop_gradient = False
+    v = paddle.to_tensor(vn); v.stop_gradient = False
+    out = sparse.nn.functional.attention(q, k, v, sp_mask)
+    out.sum().backward()
+
+    qd = paddle.to_tensor(qn); qd.stop_gradient = False
+    kd = paddle.to_tensor(kn); kd.stop_gradient = False
+    vd = paddle.to_tensor(vn); vd.stop_gradient = False
+    import paddle_tpu.nn.functional as F
+    s = paddle.matmul(qd, kd, transpose_y=True) * (1.0 / np.sqrt(D))
+    p = F.softmax(s, axis=-1)
+    ref = paddle.matmul(p, vd)
+    ref.sum().backward()
+
+    for a, b in ((q, qd), (k, kd), (v, vd)):
+        np.testing.assert_allclose(np.asarray(a.grad.numpy()),
+                                   np.asarray(b.grad.numpy()), rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_attention_rejects_bad_mask_shape():
+    rng = np.random.RandomState(13)
+    q = paddle.to_tensor(rng.randn(1, 2, 4, 3).astype(F32))
+    mask = np.ones((3, 4, 4), F32)  # wrong batch*heads
+    sp_mask = paddle.to_tensor(mask).to_sparse_csr()
+    with pytest.raises(ValueError):
+        sparse.nn.functional.attention(q, q, q, sp_mask)
+
+
+# ----------------------------------------------- autograd chain (review) --
+
+def test_bn_relu_chain_keeps_gradients():
+    """Review regression: _unary ops used to rebuild from raw bcoo.data,
+    silently detaching the tape — BN -> ReLU left bn.weight.grad None."""
+    rng = np.random.RandomState(20)
+    idx = np.stack([np.zeros(8, np.int64), np.arange(8, dtype=np.int64)])
+    sp = sparse.sparse_coo_tensor(
+        idx, Tensor(rng.randn(8, 4).astype(F32)), (1, 8, 4))
+    bn = sparse.nn.BatchNorm(4)
+    out = sparse.nn.ReLU()(bn(sp))
+    out.values().sum().backward()
+    assert bn.weight.grad is not None
+    assert np.isfinite(np.asarray(bn.weight.grad.numpy())).all()
+
+
+def test_sparse_matmul_grad_flows_to_dense_operand():
+    rng = np.random.RandomState(21)
+    sp, dense, vals = _rand_coo(rng, (3, 4), grad=True)
+    b = Tensor(rng.randn(4, 2).astype(F32), stop_gradient=False)
+    out = sparse.matmul(sp, b)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               dense @ b.numpy(), rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    np.testing.assert_allclose(np.asarray(b.grad.numpy()),
+                               dense.T @ np.ones((3, 2), F32), rtol=1e-4,
+                               atol=1e-5)
+    assert vals.grad is not None
+
+
+def test_sparse_add_and_coalesce_keep_gradients():
+    rng = np.random.RandomState(22)
+    a_sp, a_dense, a_vals = _rand_coo(rng, (3, 3), grad=True)
+    b_sp, b_dense, b_vals = _rand_coo(rng, (3, 3), grad=True)
+    s = sparse.add(a_sp, b_sp)
+    np.testing.assert_allclose(np.asarray(s.to_dense().numpy()),
+                               a_dense + b_dense, rtol=1e-5)
+    s.values().sum().backward()
+    assert a_vals.grad is not None and b_vals.grad is not None
+    np.testing.assert_allclose(np.asarray(a_vals.grad.numpy()), 1.0)
+    # coalesce: duplicate coordinates sum, grads fan back out
+    idx = np.array([[0, 0], [0, 0], [1, 2]]).T
+    v = Tensor(np.array([1.0, 2.0, 3.0], F32), stop_gradient=False)
+    c = sparse.sparse_coo_tensor(idx, v, (2, 3)).coalesce()
+    assert c.nnz == 2
+    (c.values() * Tensor(np.array([10.0, 100.0], F32))).sum().backward()
+    np.testing.assert_allclose(np.asarray(v.grad.numpy()),
+                               [10.0, 10.0, 100.0])
+
+
+def test_masked_matmul_grads():
+    rng = np.random.RandomState(23)
+    a = Tensor(rng.randn(3, 4).astype(F32), stop_gradient=False)
+    b = Tensor(rng.randn(4, 3).astype(F32), stop_gradient=False)
+    mask, mask_dense, _ = _rand_coo(rng, (3, 3))
+    out = sparse.masked_matmul(a, b, mask)
+    full = a.numpy() @ b.numpy()
+    idx = np.stack(np.nonzero(mask_dense))
+    np.testing.assert_allclose(np.asarray(out.values().numpy()),
+                               full[tuple(idx)], rtol=1e-4, atol=1e-5)
+    out.values().sum().backward()
+    assert a.grad is not None and b.grad is not None
+
+
+def test_dtype_cast_keeps_values_t_consistent():
+    """Review regression: explicit dtype= cast used to leave _values_t in
+    the original dtype while the BCOO payload was cast."""
+    idx = np.array([[0, 1], [0, 1]])
+    v = Tensor(np.array([1.0, 2.0], F32), stop_gradient=False)
+    sp = sparse.sparse_coo_tensor(idx, v, (2, 2), dtype="float16")
+    assert str(sp.values().numpy().dtype) == "float16"
+    assert str(np.asarray(sp.to_dense().numpy()).dtype) == "float16"
+
+
+# ------------------------------------------------------------------- pool --
+
+def test_functional_max_pool3d():
+    rng = np.random.RandomState(14)
+    idx4 = np.array([[0, 0, 0, 0], [0, 1, 1, 1], [0, 2, 2, 2]], np.int64)
+    vals = Tensor(np.array([[1.0], [5.0], [2.0]], F32))
+    st = sparse.sparse_coo_tensor(idx4.T, vals, (1, 4, 4, 4, 1))
+    out = sparse.nn.functional.max_pool3d(st, kernel_size=2)
+    # sites (0,0,0) and (1,1,1) pool into cell (0,0,0) -> max 5
+    d = np.asarray(out.to_dense().numpy())
+    assert d[0, 0, 0, 0, 0] == 5.0
+    assert d[0, 1, 1, 1, 0] == 2.0
