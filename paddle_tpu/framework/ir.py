@@ -89,10 +89,76 @@ def _unwrap(var, prod):
 
 
 def _eqn_of(var, prod, prim_name):
-    if var not in prod:
+    if isinstance(var, jcore.Literal) or var not in prod:
         return None
     i, eqn = prod[var]
     return (i, eqn) if eqn.primitive.name == prim_name else None
+
+
+def _match_softmax(prod, p_var):
+    """Match ``p_var = softmax(src, axis=-1)`` (div(exp(sub(src, max)),
+    sum)); returns (src_var, consumed_indices) or None.  Shared by
+    fuse_attention and decode_attention so the chain-walk has exactly one
+    implementation."""
+    m = _eqn_of(p_var, prod, "div")
+    if m is None:
+        return None
+    div_i, div_eqn = m
+    num_var, skip_b = _unwrap(div_eqn.invars[0], prod)
+    den_var, skip_c = _unwrap(div_eqn.invars[1], prod)
+    m = _eqn_of(num_var, prod, "exp")
+    if m is None:
+        return None
+    exp_i, exp_eqn = m
+    m = _eqn_of(den_var, prod, "reduce_sum")
+    if m is None:
+        return None
+    sum_i, sum_eqn = m
+    s_nd = len(sum_eqn.invars[0].aval.shape)
+    if tuple(sum_eqn.params.get("axes", ())) != (s_nd - 1,):
+        return None
+    sum_src, skip_d = _unwrap(sum_eqn.invars[0], prod)
+    if sum_src is not num_var:
+        return None
+    m = _eqn_of(_unwrap(exp_eqn.invars[0], prod)[0], prod, "sub")
+    if m is None:
+        return None
+    sub_i, sub_eqn = m
+    src_var, skip_e = _unwrap(sub_eqn.invars[0], prod)
+    mx_var, skip_f = _unwrap(sub_eqn.invars[1], prod)
+    m = _eqn_of(mx_var, prod, "reduce_max")
+    if m is None:
+        return None
+    max_i, max_eqn = m
+    if _unwrap(max_eqn.invars[0], prod)[0] is not src_var:
+        return None
+    mx_nd = len(max_eqn.invars[0].aval.shape)
+    if tuple(max_eqn.params.get("axes", ())) != (mx_nd - 1,):
+        return None
+    consumed = {div_i, exp_i, sum_i, sub_i, max_i}
+    consumed.update(skip_b + skip_c + skip_d + skip_e + skip_f)
+    return src_var, consumed
+
+
+def _match_scaled_dot(prod, scores_var):
+    """Match an optional scalar ``* c`` / ``/ c`` around a dot_general;
+    returns (dot_i, dot_eqn, scale_mode, scale_val, consumed) or None."""
+    sdot = _eqn_of(scores_var, prod, "dot_general")
+    if sdot is not None:
+        return sdot[0], sdot[1], None, None, set()
+    for op in ("div", "mul"):
+        m = _eqn_of(scores_var, prod, op)
+        if m is None:
+            continue
+        op_i, op_eqn = m
+        cand, sk = _unwrap(op_eqn.invars[0], prod)
+        sdot = _eqn_of(cand, prod, "dot_general")
+        # the scale must be a SCALAR (literal or runtime) — a shaped
+        # operand here is a mask/bias, not a scale
+        if sdot is not None and not op_eqn.invars[1].aval.shape:
+            return (sdot[0], sdot[1], op, op_eqn.invars[1],
+                    {op_i} | set(sk))
+    return None
 
 
 @register_pass("fuse_attention")
@@ -112,67 +178,15 @@ def fuse_attention(jaxpr):
         # final dot: [.., T, S] @ v — LHS must be a softmax output
         p_var, skip_a = _unwrap(eqn.invars[0], prod)
         v_var = eqn.invars[1]
-        m = _eqn_of(p_var, prod, "div")
-        if m is None:
+        sm = _match_softmax(prod, p_var)
+        if sm is None:
             continue
-        div_i, div_eqn = m
-        num_var, skip_b = _unwrap(div_eqn.invars[0], prod)
-        den_var, skip_c = _unwrap(div_eqn.invars[1], prod)
-        m = _eqn_of(num_var, prod, "exp")
-        if m is None:
-            continue
-        exp_i, exp_eqn = m
-        m = _eqn_of(den_var, prod, "reduce_sum")
-        if m is None:
-            continue
-        sum_i, sum_eqn = m
-        # the softmax must normalize over the score matrix's LAST axis
-        # (what the flash kernel computes); any other axis is a different
-        # function
-        s_nd = len(sum_eqn.invars[0].aval.shape)
-        if tuple(sum_eqn.params.get("axes", ())) != (s_nd - 1,):
-            continue
-        sum_src, skip_d = _unwrap(sum_eqn.invars[0], prod)
-        if sum_src is not num_var:
-            continue
-        m = _eqn_of(_unwrap(exp_eqn.invars[0], prod)[0], prod, "sub")
-        if m is None:
-            continue
-        sub_i, sub_eqn = m
-        scores_var, skip_e = _unwrap(sub_eqn.invars[0], prod)
-        mx_var, skip_f = _unwrap(sub_eqn.invars[1], prod)
-        m = _eqn_of(mx_var, prod, "reduce_max")
-        if m is None:
-            continue
-        max_i, max_eqn = m
-        if _unwrap(max_eqn.invars[0], prod)[0] is not scores_var:
-            continue
-        mx_nd = len(max_eqn.invars[0].aval.shape)
-        if tuple(max_eqn.params.get("axes", ())) != (mx_nd - 1,):
-            continue
+        scores_var, sm_consumed = sm
         # scores: optional scalar scale around the q@k dot
-        scale_mode, scale_val = None, None
-        sdot = _eqn_of(scores_var, prod, "dot_general")
-        skip_g = []
-        if sdot is None:
-            for op in ("div", "mul"):
-                m = _eqn_of(scores_var, prod, op)
-                if m is None:
-                    continue
-                op_i, op_eqn = m
-                cand, sk = _unwrap(op_eqn.invars[0], prod)
-                sdot = _eqn_of(cand, prod, "dot_general")
-                # the scale must be a SCALAR (literal or runtime) — a
-                # shaped operand here is a mask/bias, not a scale
-                if sdot is not None and not op_eqn.invars[1].aval.shape:
-                    scale_mode = op
-                    scale_val = op_eqn.invars[1]
-                    skip_g = [op_i] + sk
-                    break
-                sdot = None
-        if sdot is None:
+        sd = _match_scaled_dot(prod, scores_var)
+        if sd is None:
             continue
-        dot_i, dot_eqn = sdot
+        dot_i, dot_eqn, scale_mode, scale_val, sd_consumed = sd
         q_var, k_var = dot_eqn.invars
         ((lc, rc), (lb, rb)) = dot_eqn.params["dimension_numbers"]
         q_aval = q_var.aval
@@ -206,26 +220,9 @@ def fuse_attention(jaxpr):
                                  or tuple(frb) != (0, 1)):
             continue
 
-        consumed = {i, div_i, exp_i, sum_i, sub_i, max_i, dot_i}
-        consumed.update(skip_a + skip_b + skip_c + skip_d + skip_e +
-                        skip_f + skip_g + skip_h)
-        # only safe if no OTHER eqn consumes the interior values
-        interior = set()
-        for j in consumed:
-            if j != i:
-                interior.update(jaxpr.eqns[j].outvars)
-        ok = True
-        for j, other in enumerate(jaxpr.eqns):
-            if j in consumed:
-                continue
-            if any(v in interior for v in other.invars
-                   if not isinstance(v, jcore.Literal)):
-                ok = False
-                break
-        if ok and any(v in interior for v in jaxpr.outvars
-                      if not isinstance(v, jcore.Literal)):
-            ok = False
-        if not ok:
+        consumed = {i, dot_i} | sm_consumed | sd_consumed
+        consumed.update(skip_a + skip_h)
+        if not _interior_ok(jaxpr, consumed, i):
             continue
 
         head_dim = q_aval.shape[-1]
@@ -261,6 +258,423 @@ def fuse_attention(jaxpr):
             return out.transpose(0, 2, 1, 3)
 
         rewrites.append(Rewrite(consumed, (q_var, k_var, v_var),
+                                eqn.outvars[0], apply))
+    return rewrites
+
+
+def _pjit_name(eqn):
+    """Named-subcall eqns (jnp.where / log_softmax / take_along_axis trace
+    as `jit` eqns carrying the traced function's name)."""
+    if eqn.primitive.name not in ("jit", "pjit"):
+        return None
+    return eqn.params.get("name")
+
+
+def _interior_ok(jaxpr, consumed, anchor_idx):
+    """True iff no eqn outside ``consumed`` (and no jaxpr output) reads a
+    value produced inside the pattern (other than the anchor's output)."""
+    interior = set()
+    for j in consumed:
+        if j != anchor_idx:
+            interior.update(jaxpr.eqns[j].outvars)
+    for j, other in enumerate(jaxpr.eqns):
+        if j in consumed:
+            continue
+        if any(v in interior for v in other.invars
+               if not isinstance(v, jcore.Literal)):
+            return False
+    return not any(v in interior for v in jaxpr.outvars
+                   if not isinstance(v, jcore.Literal))
+
+
+@register_pass("decode_attention")
+def decode_attention(jaxpr):
+    """Single-token masked decode attention -> ragged GQA decode kernel.
+
+    Matches the canonical KV-cache decode chain (the shape
+    FusedMultiTransformer emits at T=1):
+
+        logits = einsum('bqnd,bknd->bnqk', q, cache_k) * scale
+        logits = where(iota_S <= pos, logits, -big)      # prefix mask
+        att    = softmax(logits, axis=-1)                # f32
+        out    = einsum('bnqk,bknd->bqnd', att, cache_v)
+
+    and swaps in ``ragged_decode_attention`` (Pallas on TPU, dense-masked
+    XLA elsewhere — same semantics), which reads only ``lengths`` cache
+    rows per head instead of S_max.  The prefix mask is PROVEN at match
+    time (the predicate must be ``le``/``lt`` of an iota over the score
+    axis), then measured at run time (lengths = per-row popcount).
+    Reference role: the decode path of
+    fused_multi_transformer_op + multihead_matmul_fuse_pass.cc.
+    """
+    prod = _producers(jaxpr)
+    rewrites = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        # final dot: v-first (einsum puts the cache on the left) with a
+        # following transpose, or att-first
+        if eqn.primitive.name != "dot_general":
+            continue
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        v_first = None
+        if (tuple(lc), tuple(rc)) == ((1,), (3,)) and \
+                (tuple(lb), tuple(rb)) == ((0, 2), (0, 1)):
+            v_first = True          # [B,S,N,D] x [B,N,1,S] -> [B,N,D,1]
+        elif (tuple(lc), tuple(rc)) == ((3,), (1,)) and \
+                (tuple(lb), tuple(rb)) == ((0, 1), (0, 2)):
+            v_first = False         # [B,N,1,S] x [B,S,N,D] -> [B,N,1,D]
+        else:
+            continue
+        att_raw = eqn.invars[1] if v_first else eqn.invars[0]
+        v_var = eqn.invars[0] if v_first else eqn.invars[1]
+        p_var, skip_a = _unwrap(att_raw, prod)
+        sm = _match_softmax(prod, p_var)
+        if sm is None:
+            continue
+        masked_var, sm_consumed = sm
+        # the masked logits: where(pred, scaled_scores, -big)
+        if isinstance(masked_var, jcore.Literal) or masked_var not in prod:
+            continue
+        wh_i, wh_eqn = prod[masked_var]
+        if _pjit_name(wh_eqn) != "_where":
+            continue
+        pred_var, scores_raw, fill = wh_eqn.invars
+        if not jnp.issubdtype(pred_var.aval.dtype, jnp.bool_):
+            continue
+        fill_neg = (isinstance(fill, jcore.Literal)
+                    and np.ndim(fill.val) == 0 and fill.val <= -1e20)
+        if not fill_neg:
+            continue
+        s_max = wh_eqn.outvars[0].aval.shape[-1]
+        # pred must be a PREFIX mask over the score axis, uniform across
+        # heads.  Three proofs (review-hardened — an le/lt+iota match
+        # alone admits per-head cutoffs and per-position vectors):
+        #  (a) pred's last dim is S and every other dim is 1, or only
+        #      the leading (batch) dim is >1 — so lengths don't secretly
+        #      vary across heads;
+        #  (b) the iota side varies ONLY along that last axis (its aval
+        #      is [*, S] with all other dims 1);
+        #  (c) the comparand is constant along S (its last dim is 1).
+        ps = pred_var.aval.shape
+        if not ps or ps[-1] != s_max:
+            continue
+        mid_one = all(d == 1 for d in ps[1:-1])
+        if not (all(d == 1 for d in ps[:-1]) or
+                (len(ps) == 4 and mid_one)):
+            continue
+        pm_var, _skg = _unwrap(pred_var, prod)
+        cmp = _eqn_of(pm_var, prod, "le") or _eqn_of(pm_var, prod, "lt")
+        if cmp is None:
+            continue
+        cmp_i, cmp_eqn = cmp
+        lhs_shape = cmp_eqn.invars[0].aval.shape
+        rhs_shape = cmp_eqn.invars[1].aval.shape
+        if not lhs_shape or lhs_shape[-1] != s_max or \
+                any(d != 1 for d in lhs_shape[:-1]):
+            continue
+        if rhs_shape and rhs_shape[-1] != 1:
+            continue
+        iota_var, _skh = _unwrap(cmp_eqn.invars[0], prod)
+        if _eqn_of(iota_var, prod, "iota") is None:
+            continue
+        # the scores: optional scalar mul/div around the q@k dot
+        scores_var, skip_i = _unwrap(scores_raw, prod)
+        sd = _match_scaled_dot(prod, scores_var)
+        if sd is None:
+            continue
+        dot_i, dot_eqn, scale_mode, scale_val, sd_consumed = sd
+        ((qlc, qrc), (qlb, qrb)) = dot_eqn.params["dimension_numbers"]
+        if (tuple(qlc), tuple(qrc)) != ((3,), (3,)) or \
+                (tuple(qlb), tuple(qrb)) != ((0, 2), (0, 2)):
+            continue
+        q_var = dot_eqn.invars[0]
+        k_var, skip_k = _unwrap(dot_eqn.invars[1], prod)
+        if len(q_var.aval.shape) != 4 or q_var.aval.shape[1] != 1:
+            continue        # decode only: a single query token
+        v_real, skip_l = _unwrap(v_var, prod)
+
+        del cmp_i  # prefix-ness proven; the mask chain stays live in
+        # the replay because apply() reads the predicate value
+        consumed = {i, wh_i, dot_i} | sm_consumed | sd_consumed
+        consumed.update(skip_a + skip_i + skip_k + skip_l)
+        # the optional transpose right after a v-first dot belongs to the
+        # pattern (it restores [B,1,N,D])
+        out_var = eqn.outvars[0]
+        tr = None
+        for j, other in enumerate(jaxpr.eqns):
+            if other.primitive.name == "transpose" and \
+                    other.invars[0] is out_var and \
+                    tuple(other.params["permutation"]) == (
+                        (0, 3, 1, 2) if v_first else (0, 2, 1, 3)):
+                tr = (j, other)
+                break
+        if tr is not None:
+            consumed.add(tr[0])
+            out_var = tr[1].outvars[0]
+        anchor = max(consumed)
+        if not _interior_ok(jaxpr, consumed, anchor):
+            continue
+
+        head_dim = q_var.aval.shape[-1]
+        s_lit = (scale_val.val if isinstance(scale_val, jcore.Literal)
+                 else None) if scale_mode else None
+
+        out_dtype = out_var.aval.dtype
+
+        def apply(read, *, _mode=scale_mode, _sval=scale_val, _slit=s_lit,
+                  _d=head_dim, _q=q_var, _k=k_var, _v=v_real,
+                  _pred=pred_var, _vfirst=v_first, _tr=tr is not None,
+                  _dt=out_dtype):
+            from ..ops.pallas import decode_attention_kernel as dk
+
+            q = read(_q)            # [B, 1, N, D]
+            k = read(_k)            # [B, S, N, D]
+            v = read(_v)
+            pred = read(_pred)      # prefix mask, proven at match time
+            scale = 1.0
+            if _mode == "div":
+                s = _slit if _slit is not None else read(_sval)
+                scale = 1.0 / s
+            elif _mode == "mul":
+                scale = _slit if _slit is not None else read(_sval)
+            q = q * (scale * jnp.sqrt(jnp.asarray(_d, q.dtype)))
+            b, s_max = k.shape[0], k.shape[1]
+            # pred is proven [1,..,1,S] or [B,1,1,S] at match time
+            lsum = pred.sum(-1).astype(jnp.int32)
+            if len(pred.shape) == 4 and pred.shape[0] == b:
+                lengths = lsum.reshape(b)              # per-batch mask
+            else:
+                lengths = jnp.broadcast_to(lsum.reshape(-1)[0], (b,))
+            if dk.supports(s_max, _d, q.shape[2], k.shape[2]) and \
+                    jax.default_backend() == "tpu":
+                out = dk.decode_attention_pallas(q[:, 0], k, v, lengths)
+            else:
+                out = dk.decode_attention_xla(q[:, 0], k, v, lengths)
+            out = out.astype(_dt)           # [B, N, D]
+            if _tr:
+                return out[:, None]         # [B, 1, N, D]
+            if not _vfirst:
+                return out[:, :, None]      # att-first raw: [B, N, 1, D]
+            return out[..., None]           # v-first raw: [B, N, D, 1]
+        rewrites.append(Rewrite(consumed, (q_var, k_var, v_real, pred_var),
+                                out_var, apply))
+    return rewrites
+
+
+@register_pass("fuse_layernorm")
+def fuse_layernorm(jaxpr):
+    """Hand-written layernorm -> one fused normalization in f32.
+
+    Matches ``(x - mean(x)) * rsqrt(var(x) + eps) * w + b`` (reduce over
+    the last axis) and replaces the 10-eqn chain with a single fused
+    computation whose statistics run in float32 — for bf16 activations
+    this is a numerics upgrade the unfused bf16 chain doesn't have.
+    Reference role: the layer_norm fuse passes
+    (paddle/fluid/framework/ir/ layer-norm fuse family).
+    """
+    prod = _producers(jaxpr)
+    rewrites = []
+
+    def _bcast_1d(var):
+        """var (through a trivial broadcast) of a 1-D vector; returns the
+        source var or None."""
+        if isinstance(var, jcore.Literal):
+            return None, []
+        v, sk = _unwrap(var, prod)
+        if isinstance(v, jcore.Literal):
+            return None, []
+        if len(v.aval.shape) == 1:
+            return v, sk
+        if var in prod:
+            j, e = prod[var]
+            if e.primitive.name == "broadcast_in_dim" and \
+                    len(e.invars[0].aval.shape) == 1:
+                return e.invars[0], [j]
+        return None, []
+
+    def _mean_of(var):
+        """div(reduce_sum(src), n) behind a trivial broadcast."""
+        v, sk = _unwrap(var, prod)
+        if isinstance(v, jcore.Literal):
+            return None
+        m = _eqn_of(v, prod, "div")
+        if m is None:
+            return None
+        div_i, div_eqn = m
+        if not isinstance(div_eqn.invars[1], jcore.Literal):
+            return None
+        divisor = float(np.asarray(div_eqn.invars[1].val))
+        s, sk2 = _unwrap(div_eqn.invars[0], prod)
+        m2 = _eqn_of(s, prod, "reduce_sum")
+        if m2 is None:
+            return None
+        sum_i, sum_eqn = m2
+        nd = len(sum_eqn.invars[0].aval.shape)
+        if tuple(sum_eqn.params.get("axes", ())) != (nd - 1,):
+            return None
+        # a true mean divides by the reduced axis length — anything else
+        # (ddof=1 variance, arbitrary scaling) is a different function
+        # (review-hardened)
+        if divisor != float(sum_eqn.invars[0].aval.shape[-1]):
+            return None
+        src, sk3 = _unwrap(sum_eqn.invars[0], prod)
+        return (src,
+                {div_i, sum_i} | set(sk) | set(sk2) | set(sk3))
+
+    for i, eqn in enumerate(jaxpr.eqns):
+        if eqn.primitive.name != "add":
+            continue
+        b_var, skb = _bcast_1d(eqn.invars[1])
+        if b_var is None:
+            continue
+        core_var, sk0 = _unwrap(eqn.invars[0], prod)
+        m = _eqn_of(core_var, prod, "mul")
+        if m is None:
+            continue
+        mulw_i, mulw_eqn = m
+        w_var, skw = _bcast_1d(mulw_eqn.invars[1])
+        if w_var is None:
+            continue
+        norm_var, sk1 = _unwrap(mulw_eqn.invars[0], prod)
+        m = _eqn_of(norm_var, prod, "mul")
+        if m is None:
+            continue
+        muln_i, muln_eqn = m
+        sub_var, sk2 = _unwrap(muln_eqn.invars[0], prod)
+        rs_var, sk3 = _unwrap(muln_eqn.invars[1], prod)
+        m = _eqn_of(sub_var, prod, "sub")
+        rs = _eqn_of(rs_var, prod, "rsqrt")
+        if m is None or rs is None:
+            continue
+        sub_i, sub_eqn = m
+        rs_i, rs_eqn = rs
+        # mean: sub(x, mean(x)) — compare through dtype converts (the
+        # bf16 trace upcasts the reduction and converts back)
+        x_var, skx = _unwrap(sub_eqn.invars[0], prod)
+        mean = _mean_of(sub_eqn.invars[1])
+        if mean is None or mean[0] is not x_var:
+            continue
+        # rsqrt(var + eps)
+        va, sk4 = _unwrap(rs_eqn.invars[0], prod)
+        m = _eqn_of(va, prod, "add")
+        if m is None:
+            continue
+        eadd_i, eadd_eqn = m
+        if not isinstance(eadd_eqn.invars[1], jcore.Literal):
+            continue
+        eps = float(eadd_eqn.invars[1].val)
+        var_mean = _mean_of(eadd_eqn.invars[0])
+        if var_mean is None:
+            continue
+        sq_var, sk5 = _unwrap(var_mean[0], prod)
+        sq = _eqn_of(sq_var, prod, "integer_pow")
+        if sq is None or sq[1].params.get("y") != 2:
+            continue
+        sq_i, sq_eqn = sq
+        centered, sk6 = _unwrap(sq_eqn.invars[0], prod)
+        m = _eqn_of(centered, prod, "sub")
+        if m is None:
+            continue
+        sub2_i, sub2_eqn = m
+        x2_var, skx2 = _unwrap(sub2_eqn.invars[0], prod)
+        if x2_var is not x_var:
+            continue
+        mean2 = _mean_of(sub2_eqn.invars[1])
+        if mean2 is None or mean2[0] is not x_var:
+            continue
+
+        consumed = {i, mulw_i, muln_i, sub_i, rs_i, eadd_i, sq_i, sub2_i}
+        consumed |= mean[1] | var_mean[1] | mean2[1]
+        consumed.update(skb + sk0 + skw + sk1 + sk2 + sk3 + sk4 + sk5 +
+                        sk6 + skx + skx2)
+        anchor = max(consumed)
+        if not _interior_ok(jaxpr, consumed, anchor):
+            continue
+
+        def apply(read, *, _x=x_var, _w=w_var, _b=b_var, _eps=eps):
+            x = read(_x)
+            xf = x.astype(jnp.float32)
+            mu = xf.mean(-1, keepdims=True)
+            var = jnp.square(xf - mu).mean(-1, keepdims=True)
+            y = (xf - mu) * jax.lax.rsqrt(var + _eps)
+            y = y * read(_w).astype(jnp.float32) \
+                + read(_b).astype(jnp.float32)
+            return y.astype(x.dtype)
+
+        rewrites.append(Rewrite(consumed, (x_var, w_var, b_var),
+                                eqn.outvars[0], apply))
+    return rewrites
+
+
+@register_pass("chunk_cross_entropy")
+def chunk_cross_entropy(jaxpr):
+    """log_softmax + take_along_axis -> chunked softmax-xent.
+
+    The naive spelling materializes the full [N, V] log-probability
+    matrix; the rewrite swaps in ``_chunked_softmax_xent`` (lax.map over
+    row chunks with a custom VJP), keeping only [chunk, V] transient —
+    the HBM saver for LLM-scale vocabularies.  Reference role: the
+    softmax_with_cross_entropy fused op
+    (paddle/phi/kernels/softmax_with_cross_entropy_*).
+    """
+    prod = _producers(jaxpr)
+    rewrites = []
+    for i, eqn in enumerate(jaxpr.eqns):
+        if _pjit_name(eqn) != "take_along_axis":
+            continue
+        lp_var, sk0 = _unwrap(eqn.invars[0], prod)
+        if lp_var not in prod:
+            continue
+        ls_i, ls_eqn = prod[lp_var]
+        if _pjit_name(ls_eqn) != "log_softmax":
+            continue
+        logits_var = ls_eqn.invars[0]
+        if len(logits_var.aval.shape) != 2:
+            continue
+        # the softmax must reduce over the class axis
+        inner = ls_eqn.params["jaxpr"].jaxpr
+        nd = len(logits_var.aval.shape)
+        red_ok = any(e.primitive.name == "reduce_max"
+                     and tuple(e.params.get("axes", ())) == (nd - 1,)
+                     for e in inner.eqns)
+        if not red_ok:
+            continue
+        lbl_raw = eqn.invars[1]
+        if not jnp.issubdtype(lbl_raw.aval.dtype, jnp.integer):
+            continue
+        if tuple(lbl_raw.aval.shape) != (logits_var.aval.shape[0], 1):
+            continue
+        # the gather must be along the CLASS axis: picking one entry per
+        # row yields [N, 1] — an axis=0 gather yields [N, V]
+        # (review-hardened)
+        if tuple(eqn.outvars[0].aval.shape) != \
+                (logits_var.aval.shape[0], 1):
+            continue
+        lbl_var, sk1 = _unwrap(lbl_raw, prod)
+        sk2 = []
+        if len(lbl_var.aval.shape) == 2 and lbl_var in prod:
+            j, e = prod[lbl_var]
+            if e.primitive.name == "broadcast_in_dim" and \
+                    len(e.invars[0].aval.shape) == 1:
+                lbl_var = e.invars[0]
+                sk2 = [j]
+        consumed = {i, ls_i}
+        consumed.update(sk0 + sk1 + sk2)
+        anchor = max(consumed)
+        if not _interior_ok(jaxpr, consumed, anchor):
+            continue
+
+        out_dtype = eqn.outvars[0].aval.dtype
+
+        def apply(read, *, _logits=logits_var, _lbl=lbl_var,
+                  _dt=out_dtype):
+            from ..nn.functional import _chunked_softmax_xent
+
+            logits = read(_logits)
+            labels = read(_lbl).reshape(-1)
+            loss = _chunked_softmax_xent(logits, labels)   # = -picked
+            return (-loss).astype(_dt)[:, None]
+
+        rewrites.append(Rewrite(consumed, (logits_var, lbl_var),
                                 eqn.outvars[0], apply))
     return rewrites
 

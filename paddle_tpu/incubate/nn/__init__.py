@@ -107,11 +107,21 @@ class FusedMultiTransformer:
                 + emb["position_embeddings.weight"][pos][None]
             x = x.astype(self.dtype)
 
+            # the IR pass layer optimizes the BLOCK function (a scan
+            # body is traced as a function anyway): at T=1 the
+            # decode_attention pass swaps the masked dense attention for
+            # the ragged decode kernel — the round-3 "flip the decode
+            # kernel default under the pass" item (framework/ir.py)
+            from ...framework import ir as _ir
+
+            block = _ir.optimize(
+                lambda p_l, xx, ck_l, cv_l, off: _block_chunk(
+                    p_l, xx, ck_l, cv_l, off, nh, eps))
+
             def layer(carry, xs):
                 xx = carry
                 p_l, ck_l, cv_l = xs
-                xx, ck_l, cv_l = _block_chunk(p_l, xx, ck_l, cv_l, offset,
-                                              nh, eps)
+                xx, ck_l, cv_l = block(p_l, xx, ck_l, cv_l, offset)
                 return xx, (ck_l, cv_l)
 
             x, (ck, cv) = jax.lax.scan(layer, x,
