@@ -245,7 +245,15 @@ def to_static(function=None, input_spec=None, build_strategy=None,
             static = StaticFunction(fwd, layer=fn, ir_passes=ir_passes)
             fn.forward = static
             return fn
-        conv = ast_transform(fn)
+        # a BOUND method must keep its binding through conversion: the
+        # dy2static pass recompiles the underlying function, and calling
+        # that unbound would swallow the first argument as self
+        # (bug exposed by TranslatedLayer over Sequential, whose forward
+        # has a convertible for-loop)
+        self_obj = getattr(fn, "__self__", None)
+        conv = ast_transform(getattr(fn, "__func__", fn))
+        if conv is not None and self_obj is not None:
+            conv = types.MethodType(conv, self_obj)
         return StaticFunction(conv if conv is not None else fn,
                               ir_passes=ir_passes)
 

@@ -7,6 +7,8 @@ with-index variants reduce over (value, linear-index) pairs so the argmax
 comes out of one fused reduce_window.
 """
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -38,8 +40,10 @@ def _pool_nd(x, ksize, strides, paddings, pooling_type, exclusive,
         out = x
         for ax, osz in zip(spatial, out_sizes):
             isz = out.shape[ax]
-            starts = (jnp.arange(osz) * isz) // osz
-            ends = ((jnp.arange(osz) + 1) * isz + osz - 1) // osz
+            # bin boundaries are shape-derived (static) — numpy keeps
+            # the path jit-traceable
+            starts = (np.arange(osz) * isz) // osz
+            ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
             segs = []
             for i in range(osz):
                 s, e = int(starts[i]), int(ends[i])

@@ -1,0 +1,688 @@
+"""One-way ProgramDesc importer: run reference-format inference models.
+
+Closes the interop gap: the reference serializes inference programs as a
+``ProgramDesc`` protobuf (``model.pdmodel``) plus a combined parameter
+stream (``model.pdiparams``), loaded by
+python/paddle/static/io.py:727 ``load_inference_model`` and executed by
+an interpreter over OpDesc.  Here the program is TRANSLATED instead of
+interpreted: each OpDesc maps through a table onto pure jax ops,
+composing one function that jits into a single XLA executable — the
+TPU-native executor for legacy graphs.
+
+Format interfaces implemented against the published schemas (field
+numbers cited inline):
+- paddle/fluid/framework/framework.proto (ProgramDesc/BlockDesc/
+  OpDesc/VarDesc/VarType wire layout)
+- paddle/fluid/framework/tensor_util.cc TensorToStream +
+  lod_tensor.cc SerializeToStream (the .pdiparams per-tensor stream)
+- python/paddle/static/io.py:661 (combined params are concatenated in
+  sorted-variable-name order)
+"""
+
+import struct
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# ------------------------------------------------------------ wire reader --
+
+
+class _Reader:
+    def __init__(self, data, pos=0, end=None):
+        self.d = data
+        self.pos = pos
+        self.end = len(data) if end is None else end
+
+    def eof(self):
+        return self.pos >= self.end
+
+    def varint(self):
+        shift, out = 0, 0
+        while True:
+            b = self.d[self.pos]
+            self.pos += 1
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def skip(self, wire_type):
+        if wire_type == 0:
+            self.varint()
+        elif wire_type == 1:
+            self.pos += 8
+        elif wire_type == 2:
+            self.pos += self.varint()
+        elif wire_type == 5:
+            self.pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire_type}")
+
+    def bytes_(self):
+        n = self.varint()
+        out = self.d[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+
+def _zigzag64(v):
+    # proto2 int64/int32 fields are plain (non-zigzag) varints; negative
+    # values arrive as 2^64 complements
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _parse(data, schema, pos=0, end=None):
+    """Parse one message.  ``schema``: field_no -> (name, kind[, sub]).
+    kinds: int (varint, sign-corrected), bool, float, double, str,
+    bytes, msg (sub-schema dict), rep_* for repeated fields (repeated
+    varints accept both packed and unpacked encodings)."""
+    r = _Reader(data, pos, end)
+    out = {}
+    for no, (name, kind, *_s) in schema.items():
+        if kind.startswith("rep_"):
+            out[name] = []
+    while not r.eof():
+        key = r.varint()
+        no, wt = key >> 3, key & 7
+        if no not in schema:
+            r.skip(wt)
+            continue
+        name, kind, *sub = schema[no]
+        if kind in ("int", "bool"):
+            v = _zigzag64(r.varint())
+            out[name] = bool(v) if kind == "bool" else v
+        elif kind == "float":
+            (v,) = struct.unpack("<f", r.d[r.pos:r.pos + 4])
+            r.pos += 4
+            out[name] = v
+        elif kind == "double":
+            (v,) = struct.unpack("<d", r.d[r.pos:r.pos + 8])
+            r.pos += 8
+            out[name] = v
+        elif kind == "str":
+            out[name] = r.bytes_().decode("utf-8")
+        elif kind == "msg":
+            b = r.bytes_()
+            out[name] = _parse(b, sub[0])
+        elif kind == "rep_int":
+            if wt == 2:  # packed
+                b = r.bytes_()
+                rr = _Reader(b)
+                while not rr.eof():
+                    out[name].append(_zigzag64(rr.varint()))
+            else:
+                out[name].append(_zigzag64(r.varint()))
+        elif kind == "rep_float":
+            if wt == 2:
+                b = r.bytes_()
+                out[name].extend(
+                    struct.unpack(f"<{len(b) // 4}f", b))
+            else:
+                (v,) = struct.unpack("<f", r.d[r.pos:r.pos + 4])
+                r.pos += 4
+                out[name].append(v)
+        elif kind == "rep_str":
+            out[name].append(r.bytes_().decode("utf-8"))
+        elif kind == "rep_msg":
+            out[name].append(_parse(r.bytes_(), sub[0]))
+        else:
+            raise ValueError(f"unknown kind {kind}")
+    return out
+
+
+# ------------------------------------------- framework.proto field layout --
+# (field numbers cite framework.proto; only the inference-relevant subset)
+
+_TENSOR_DESC = {1: ("data_type", "int"), 2: ("dims", "rep_int")}
+_LOD_TENSOR_DESC = {1: ("tensor", "msg", _TENSOR_DESC),
+                    2: ("lod_level", "int")}
+_VAR_TYPE = {1: ("type", "int"),
+             3: ("lod_tensor", "msg", _LOD_TENSOR_DESC)}
+_VAR_DESC = {1: ("name", "str"), 2: ("type", "msg", _VAR_TYPE),
+             3: ("persistable", "bool")}
+_OP_VAR = {1: ("parameter", "str"), 2: ("arguments", "rep_str")}
+_OP_ATTR = {1: ("name", "str"), 2: ("type", "int"), 3: ("i", "int"),
+            4: ("f", "float"), 5: ("s", "str"), 6: ("ints", "rep_int"),
+            7: ("floats", "rep_float"), 8: ("strings", "rep_str"),
+            10: ("b", "bool"), 11: ("bools", "rep_int"),
+            13: ("l", "int"), 15: ("longs", "rep_int"),
+            19: ("float64", "double")}
+_OP_DESC = {3: ("type", "str"), 1: ("inputs", "rep_msg", _OP_VAR),
+            2: ("outputs", "rep_msg", _OP_VAR),
+            4: ("attrs", "rep_msg", _OP_ATTR)}
+_BLOCK_DESC = {1: ("idx", "int"), 2: ("parent_idx", "int"),
+               3: ("vars", "rep_msg", _VAR_DESC),
+               4: ("ops", "rep_msg", _OP_DESC)}
+_PROGRAM_DESC = {1: ("blocks", "rep_msg", _BLOCK_DESC)}
+
+# VarType.Type -> numpy dtype (framework.proto enum values)
+_DTYPES = {0: np.bool_, 1: np.int16, 2: np.int32, 3: np.int64,
+           4: np.float16, 5: np.float32, 6: np.float64,
+           20: np.uint8, 21: np.int8}
+try:
+    import ml_dtypes
+
+    _DTYPES[22] = ml_dtypes.bfloat16          # BF16
+except ImportError:                            # pragma: no cover
+    pass
+
+
+def _attr_value(a):
+    t = a.get("type")
+    # AttrType enum: INT FLOAT STRING INTS FLOATS STRINGS BOOLEAN
+    # BOOLEANS ... LONG ... LONGS ... FLOAT64
+    if t == 0:
+        return a.get("i", 0)
+    if t == 1:
+        return a.get("f", 0.0)
+    if t == 2:
+        return a.get("s", "")
+    if t == 3:
+        return list(a.get("ints", []))
+    if t == 4:
+        return list(a.get("floats", []))
+    if t == 5:
+        return list(a.get("strings", []))
+    if t == 6:
+        return bool(a.get("b", False))
+    if t == 7:
+        return [bool(x) for x in a.get("bools", [])]
+    if t == 9:
+        return a.get("l", 0)
+    if t == 11:
+        return list(a.get("longs", []))
+    if t == 15:
+        return a.get("float64", 0.0)
+    return None
+
+
+class OpDef:
+    __slots__ = ("type", "inputs", "outputs", "attrs")
+
+    def __init__(self, raw):
+        self.type = raw["type"]
+        self.inputs = {v["parameter"]: list(v.get("arguments", []))
+                       for v in raw.get("inputs", [])}
+        self.outputs = {v["parameter"]: list(v.get("arguments", []))
+                        for v in raw.get("outputs", [])}
+        self.attrs = {a["name"]: _attr_value(a)
+                      for a in raw.get("attrs", [])}
+
+
+def parse_program(data):
+    """bytes (a .pdmodel file) -> (ops, var_descs) of block 0."""
+    prog = _parse(data, _PROGRAM_DESC)
+    if not prog.get("blocks"):
+        raise ValueError("ProgramDesc has no blocks")
+    block = prog["blocks"][0]
+    ops = [OpDef(o) for o in block.get("ops", [])]
+    vars_ = {}
+    for v in block.get("vars", []):
+        vt = v.get("type", {})
+        lod = vt.get("lod_tensor") or {}
+        td = lod.get("tensor") or {}
+        vars_[v["name"]] = {
+            "persistable": v.get("persistable", False),
+            # VarType.Type — needed to EXCLUDE feed/fetch holders from
+            # the params stream (real exports mark them persistable,
+            # but io_utils.is_persistable drops non-LOD_TENSOR types)
+            "vtype": vt.get("type", 7),
+            "dtype": _DTYPES.get(td.get("data_type", 5), np.float32),
+            "shape": list(td.get("dims", [])),
+        }
+    return ops, vars_
+
+
+# ------------------------------------------------------- parameter stream --
+
+def read_lod_tensor(buf, pos):
+    """One LoDTensor record at ``pos`` (tensor_util.cc TensorToStream /
+    lod_tensor.cc SerializeToStream); returns (np_array, new_pos)."""
+    (ver,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    if ver != 0:
+        raise ValueError(f"unsupported tensor version {ver}")
+    (lod_level,) = struct.unpack_from("<Q", buf, pos)
+    pos += 8
+    for _ in range(lod_level):
+        (nbytes,) = struct.unpack_from("<Q", buf, pos)
+        pos += 8 + nbytes
+    (tver,) = struct.unpack_from("<I", buf, pos)
+    pos += 4
+    if tver != 0:
+        raise ValueError(f"unsupported tensor version {tver}")
+    (desc_size,) = struct.unpack_from("<i", buf, pos)
+    pos += 4
+    desc = _parse(buf, _TENSOR_DESC, pos, pos + desc_size)
+    pos += desc_size
+    dtype = _DTYPES.get(desc.get("data_type", 5), np.float32)
+    dims = [int(d) for d in desc.get("dims", [])]
+    count = int(np.prod(dims)) if dims else 1
+    nbytes = count * np.dtype(dtype).itemsize
+    arr = np.frombuffer(buf, dtype=dtype, count=count,
+                        offset=pos).reshape(dims)
+    return arr, pos + nbytes
+
+
+def load_combined_params(data, names_sorted):
+    """The .pdiparams stream: tensors concatenated in sorted-name order
+    (io.py:661)."""
+    out, pos = {}, 0
+    for name in names_sorted:
+        arr, pos = read_lod_tensor(data, pos)
+        out[name] = arr
+    if pos != len(data):
+        raise ValueError(
+            f"params stream has {len(data) - pos} trailing bytes — "
+            "persistable-name set mismatch")
+    return out
+
+
+# ---------------------------------------------------------- op translation --
+
+def _pad2d(x, paddings, value=0.0):
+    if len(paddings) == 2:
+        pt, pl = paddings
+        pb, pr = paddings
+    else:
+        pt, pb, pl, pr = paddings
+    return jnp.pad(x, ((0, 0), (0, 0), (pt, pb), (pl, pr)),
+                   constant_values=value)
+
+
+def _same_pads(in_size, stride, ksize):
+    out = -(-in_size // stride)
+    total = max((out - 1) * stride + ksize - in_size, 0)
+    return total // 2, total - total // 2
+
+
+def _conv2d(ins, attrs):
+    x, w = ins["Input"], ins["Filter"]
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "SAME":
+        # reference UpdatePaddingAndDilation: SAME forces dilation 1 and
+        # pads for the raw kernel (review regression)
+        dil = [1, 1]
+        ph = _same_pads(x.shape[2], strides[0], w.shape[2])
+        pw = _same_pads(x.shape[3], strides[1], w.shape[3])
+        padding = (ph, pw)
+    elif algo == "VALID":
+        padding = ((0, 0), (0, 0))
+    elif len(pads) == 2:
+        padding = ((pads[0], pads[0]), (pads[1], pads[1]))
+    else:
+        padding = ((pads[0], pads[1]), (pads[2], pads[3]))
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(strides), padding=padding,
+        rhs_dilation=tuple(dil), feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def _pool2d(ins, attrs):
+    """Delegates to the registered pool2d kernel (ops/pool_ops.py) —
+    one pooling implementation, including adaptive output sizes,
+    ceil_mode, and exclusive in-bounds averaging; the importer only
+    resolves the legacy padding_algorithm to explicit pads."""
+    from ..ops.registry import OPS
+
+    x = ins["X"]
+    ksize = attrs.get("ksize", [2, 2])
+    strides = attrs.get("strides", ksize)
+    pads = list(attrs.get("paddings", [0, 0]))
+    algo = attrs.get("padding_algorithm", "EXPLICIT")
+    if algo == "VALID":
+        pads = [0, 0]
+    elif algo == "SAME":
+        ph = _same_pads(x.shape[2], strides[0], ksize[0])
+        pw = _same_pads(x.shape[3], strides[1], ksize[1])
+        pads = [ph[0], ph[1], pw[0], pw[1]]
+    return OPS["pool2d"].jax_fn(
+        x, ksize, strides=strides, paddings=pads,
+        ceil_mode=attrs.get("ceil_mode", False),
+        exclusive=attrs.get("exclusive", True),
+        pooling_type=attrs.get("pooling_type", "max"),
+        global_pooling=attrs.get("global_pooling", False),
+        adaptive=attrs.get("adaptive", False))
+
+
+def _reshape2(ins, attrs):
+    if any(k in ins for k in ("Shape", "ShapeTensor")):
+        raise NotImplementedError(
+            "reshape2 with a tensor-valued shape is not translated — "
+            "the attr would be stale; re-export with a static shape")
+    shape = attrs.get("shape")
+    if shape is None:
+        raise NotImplementedError(
+            "reshape2 without a shape attr is not translated")
+    x = ins["X"]
+    if 0 in shape:   # 0 = copy the corresponding input dim
+        shape = [s if d == 0 else d
+                 for d, s in zip(shape, list(x.shape) + [1] * len(shape))]
+    return x.reshape(shape)
+
+
+def _cat(fn, ins, attrs):
+    if "AxisTensor" in ins:
+        raise NotImplementedError(
+            "concat/stack with a tensor-valued axis is not translated — "
+            "re-export with a static axis")
+    return fn(ins["__X_all__"], axis=attrs.get("axis", 0))
+
+
+def _argmax(ins, attrs):
+    x = ins["X"]
+    dt = _DTYPES.get(attrs.get("dtype", 3), np.int64)
+    if attrs.get("flatten", False):
+        # reference: flatten=True indexes into the flattened tensor
+        return jnp.argmax(x.reshape(-1)).astype(dt)
+    return jnp.argmax(x, axis=attrs.get("axis", -1),
+                      keepdims=attrs.get("keepdims", False)).astype(dt)
+
+
+def _eltwise(fn):
+    def run(ins, attrs):
+        x, y = ins["X"], ins["Y"]
+        axis = attrs.get("axis", -1)
+        if y.ndim < x.ndim:
+            if axis is None or axis == -1:
+                axis = x.ndim - y.ndim
+            y = y.reshape(y.shape + (1,) * (x.ndim - y.ndim - axis))
+        return fn(x, y)
+    return run
+
+
+def _reduce(fn):
+    def run(ins, attrs):
+        dims = attrs.get("dim", [0])
+        keep = attrs.get("keep_dim", False)
+        if attrs.get("reduce_all", False):
+            return fn(ins["X"], axis=None, keepdims=keep)
+        return fn(ins["X"], axis=tuple(dims), keepdims=keep)
+    return run
+
+
+def _act(fn):
+    return lambda ins, attrs: fn(ins["X"])
+
+
+def _matmul(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    if attrs.get("transpose_X", attrs.get("trans_x", False)):
+        x = jnp.swapaxes(x, -1, -2)
+    if attrs.get("transpose_Y", attrs.get("trans_y", False)):
+        y = jnp.swapaxes(y, -1, -2)
+    out = x @ y
+    alpha = attrs.get("alpha", 1.0)
+    return out * alpha if alpha != 1.0 else out
+
+
+def _mul(ins, attrs):
+    x, y = ins["X"], ins["Y"]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape(int(np.prod(xs[:xn])), -1)
+    y2 = y.reshape(int(np.prod(ys[:yn])), -1)
+    return (x2 @ y2).reshape(xs[:xn] + ys[yn:])
+
+
+def _batch_norm(ins, attrs):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    axis = 1 if attrs.get("data_layout", "NCHW") == "NCHW" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[axis] = -1
+    mean = ins["Mean"].reshape(shape)
+    var = ins["Variance"].reshape(shape)
+    scale = ins["Scale"].reshape(shape)
+    bias = ins["Bias"].reshape(shape)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _layer_norm(ins, attrs):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    ax = attrs.get("begin_norm_axis", 1)
+    red = tuple(range(ax, x.ndim))
+    mu = x.mean(red, keepdims=True)
+    var = jnp.square(x - mu).mean(red, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    tail = x.shape[ax:]
+    if "Scale" in ins:
+        y = y * ins["Scale"].reshape(tail)
+    if "Bias" in ins:
+        y = y + ins["Bias"].reshape(tail)
+    return y
+
+
+def _dropout(ins, attrs):
+    x = ins["X"]
+    if attrs.get("dropout_implementation",
+                 "downgrade_in_infer") == "upscale_in_train":
+        return x
+    return x * (1.0 - attrs.get("dropout_prob", 0.5))
+
+
+def _slice(ins, attrs):
+    x = ins["Input"]
+    if any(k in ins for k in ("StartsTensor", "EndsTensor",
+                              "StartsTensorList", "EndsTensorList")):
+        raise NotImplementedError(
+            "slice with tensor-valued starts/ends is not translated — "
+            "the attrs would be stale; re-export with static bounds")
+    axes = attrs.get("axes", [])
+    starts = attrs.get("starts", [])
+    ends = attrs.get("ends", [])
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        idx[a] = slice(s, min(e, x.shape[a]))
+    out = x[tuple(idx)]
+    dec = attrs.get("decrease_axis", [])
+    if dec:
+        out = out.reshape([d for i, d in enumerate(out.shape)
+                           if i not in dec])
+    return out
+
+
+def _interp(mode):
+    def run(ins, attrs):
+        if any(k in ins for k in ("OutSize", "SizeTensor", "Scale")):
+            raise NotImplementedError(
+                f"{mode}_interp with tensor-valued output size is not "
+                "translated — re-export with static out_h/out_w")
+        x = ins["X"]
+        oh = attrs.get("out_h", -1)
+        ow = attrs.get("out_w", -1)
+        scale = attrs.get("scale", [])
+        if oh <= 0 and scale:
+            s = scale if isinstance(scale, (list, tuple)) else [scale]
+            s = list(s) * 2 if len(s) == 1 else s
+            oh = int(x.shape[2] * s[0])
+            ow = int(x.shape[3] * s[1])
+        # our vision interp kernels carry the reference's
+        # align_corners/align_mode semantics exactly (vision_ops.py) —
+        # jax.image.resize is half-pixel-only and would silently shift
+        # align_corners=True models
+        from ..ops.vision_ops import _interp_impl
+
+        return _interp_impl(
+            x, mode, [oh, ow], None,
+            attrs.get("align_corners", False),
+            attrs.get("align_mode", 1), "NCHW")
+    return run
+
+
+_TRANSLATORS = {
+    "mul": _mul,
+    "matmul": _matmul,
+    "matmul_v2": _matmul,
+    "elementwise_add": _eltwise(jnp.add),
+    "elementwise_sub": _eltwise(jnp.subtract),
+    "elementwise_mul": _eltwise(jnp.multiply),
+    "elementwise_div": _eltwise(jnp.divide),
+    "elementwise_pow": _eltwise(jnp.power),
+    "elementwise_max": _eltwise(jnp.maximum),
+    "elementwise_min": _eltwise(jnp.minimum),
+    "relu": _act(jax.nn.relu),
+    "relu6": _act(lambda x: jnp.clip(x, 0, 6)),
+    "sigmoid": _act(jax.nn.sigmoid),
+    "tanh": _act(jnp.tanh),
+    "sqrt": _act(jnp.sqrt),
+    "exp": _act(jnp.exp),
+    "abs": _act(jnp.abs),
+    "log": _act(jnp.log),
+    "square": _act(jnp.square),
+    "erf": _act(jax.scipy.special.erf),
+    "gelu": lambda ins, attrs: jax.nn.gelu(
+        ins["X"], approximate=attrs.get("approximate", False)),
+    "leaky_relu": lambda ins, attrs: jax.nn.leaky_relu(
+        ins["X"], attrs.get("alpha", 0.02)),
+    "hard_sigmoid": lambda ins, attrs: jnp.clip(
+        attrs.get("slope", 0.2) * ins["X"] + attrs.get("offset", 0.5),
+        0.0, 1.0),
+    "hard_swish": lambda ins, attrs: ins["X"] * jnp.clip(
+        ins["X"] + attrs.get("offset", 3.0), 0.0,
+        attrs.get("threshold", 6.0)) / attrs.get("scale", 6.0),
+    "swish": lambda ins, attrs: ins["X"] * jax.nn.sigmoid(
+        attrs.get("beta", 1.0) * ins["X"]),
+    "pow": lambda ins, attrs: jnp.power(ins["X"],
+                                        attrs.get("factor", 1.0)),
+    "clip": lambda ins, attrs: jnp.clip(ins["X"], attrs.get("min", 0.0),
+                                        attrs.get("max", 1.0)),
+    "softmax": lambda ins, attrs: jax.nn.softmax(
+        ins["X"], axis=attrs.get("axis", -1)),
+    "scale": lambda ins, attrs: (
+        ins["X"] * attrs.get("scale", 1.0) + attrs.get("bias", 0.0)
+        if attrs.get("bias_after_scale", True)
+        else (ins["X"] + attrs.get("bias", 0.0)) * attrs.get("scale", 1.0)),
+    "conv2d": _conv2d,
+    "depthwise_conv2d": _conv2d,
+    "pool2d": _pool2d,
+    "batch_norm": _batch_norm,
+    "layer_norm": _layer_norm,
+    "dropout": _dropout,
+    "reshape2": lambda ins, attrs: _reshape2(ins, attrs),
+    "transpose2": lambda ins, attrs: jnp.transpose(ins["X"],
+                                                   attrs["axis"]),
+    "concat": lambda ins, attrs: _cat(jnp.concatenate, ins, attrs),
+    "stack": lambda ins, attrs: _cat(jnp.stack, ins, attrs),
+    "squeeze2": lambda ins, attrs: jnp.squeeze(
+        ins["X"], axis=tuple(attrs.get("axes", [])) or None),
+    "unsqueeze2": lambda ins, attrs: jnp.expand_dims(
+        ins["X"], tuple(attrs.get("axes", []))),
+    "flatten_contiguous_range": lambda ins, attrs: ins["X"].reshape(
+        ins["X"].shape[:attrs.get("start_axis", 1)]
+        + (-1,) + ins["X"].shape[attrs.get("stop_axis", -1) %
+                                 ins["X"].ndim + 1:]),
+    "flatten2": lambda ins, attrs: ins["X"].reshape(
+        int(np.prod(ins["X"].shape[:attrs.get("axis", 1)])), -1),
+    "slice": _slice,
+    "cast": lambda ins, attrs: ins["X"].astype(
+        _DTYPES.get(attrs.get("out_dtype", 5), np.float32)),
+    "shape": lambda ins, attrs: jnp.asarray(ins["Input"].shape,
+                                            jnp.int32),
+    "fill_constant": lambda ins, attrs: jnp.full(
+        attrs.get("shape", []),
+        attrs.get("value", 0.0),
+        _DTYPES.get(attrs.get("dtype", 5), np.float32)),
+    "assign": lambda ins, attrs: ins["X"],
+    "lookup_table_v2": lambda ins, attrs: ins["W"][ins["Ids"]],
+    "reduce_mean": _reduce(jnp.mean),
+    "reduce_sum": _reduce(jnp.sum),
+    "reduce_max": _reduce(jnp.max),
+    "arg_max": _argmax,
+    "nearest_interp_v2": _interp("nearest"),
+    "bilinear_interp_v2": _interp("bilinear"),
+    "equal": _eltwise(jnp.equal),
+    "greater_than": _eltwise(jnp.greater),
+}
+
+
+def supported_ops():
+    return sorted(_TRANSLATORS) + ["feed", "fetch"]
+
+
+class InferenceProgram:
+    """A translated block-0 inference program: callable over the feed
+    vars (positional, in feed-op ``col`` order) returning the fetch list.
+    Jit-compiled per input-shape signature."""
+
+    def __init__(self, ops, var_descs, params):
+        self.var_descs = var_descs
+        self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        self.feed_names = []
+        self.fetch_names = []
+        self.body = []
+        feeds, fetches = {}, {}
+        for op in ops:
+            if op.type == "feed":
+                feeds[op.attrs.get("col", 0)] = op.outputs["Out"][0]
+            elif op.type == "fetch":
+                fetches[op.attrs.get("col", 0)] = op.inputs["X"][0]
+            else:
+                if op.type not in _TRANSLATORS:
+                    raise NotImplementedError(
+                        f"ProgramDesc op '{op.type}' has no TPU "
+                        f"translation ({len(_TRANSLATORS)} ops "
+                        "supported — see static.program_import)")
+                self.body.append(op)
+        self.feed_names = [feeds[k] for k in sorted(feeds)]
+        self.fetch_names = [fetches[k] for k in sorted(fetches)]
+        self._jitted = jax.jit(self._run)
+
+    def _run(self, params, *feed_vals):
+        env = dict(params)
+        for name, val in zip(self.feed_names, feed_vals):
+            env[name] = val
+        for op in self.body:
+            ins = {}
+            for param, args in op.inputs.items():
+                if not args:
+                    continue
+                ins[param] = env[args[0]]
+                if param == "X" and (len(args) > 1 or
+                                     op.type in ("concat", "stack")):
+                    ins["__X_all__"] = [env[a] for a in args]
+            out = _TRANSLATORS[op.type](ins, op.attrs)
+            outs = out if isinstance(out, tuple) else (out,)
+            # the primary output parameter varies by legacy op family
+            # (Out / Output / Y); secondary outputs like XShape are
+            # trace metadata and stay unbound
+            names = (op.outputs.get("Out") or op.outputs.get("Output")
+                     or op.outputs.get("Y") or [])
+            for name, val in zip(names, outs):
+                env[name] = val
+        return [env[n] for n in self.fetch_names]
+
+    def __call__(self, *feeds):
+        from ..core.tensor import Tensor
+
+        vals = [f._data if isinstance(f, Tensor) else jnp.asarray(f)
+                for f in feeds]
+        outs = self._jitted(self.params, *vals)
+        return [Tensor(o) for o in outs]
+
+
+def load_reference_inference_model(path_prefix):
+    """(program, feed_names, fetch_names) from model.pdmodel +
+    model.pdiparams (io.py:727 parity)."""
+    with open(f"{path_prefix}.pdmodel", "rb") as f:
+        ops, var_descs = parse_program(f.read())
+    # only LOD_TENSOR (7) vars live in the params stream; feed/fetch
+    # holders (FEED_MINIBATCH=9 / FETCH_LIST=10) and RAW vars are
+    # persistable in real exports but never serialized
+    # (python/paddle/static/io.py is_persistable semantics)
+    persist = sorted(n for n, d in var_descs.items()
+                     if d["persistable"] and d["vtype"] == 7)
+    params = {}
+    if persist:
+        with open(f"{path_prefix}.pdiparams", "rb") as f:
+            params = load_combined_params(f.read(), persist)
+    prog = InferenceProgram(ops, var_descs, params)
+    return prog, prog.feed_names, prog.fetch_names

@@ -205,3 +205,38 @@ class TestRecomputeAPI:
         paddle.sum(out).backward()
         np.testing.assert_allclose(x.grad.numpy(), 2 * np.ones((2, 4)),
                                    rtol=1e-6)
+
+
+def test_to_static_bound_method_with_converted_loop_keeps_binding():
+    """Regression: to_static over a BOUND forward whose body triggers a
+    dy2static conversion (Sequential's for-loop) used to lose the self
+    binding — jit.load of any Sequential crashed with 'missing x'."""
+    import numpy as np
+
+    from paddle_tpu import jit, nn
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(6, 4), nn.ReLU(), nn.Linear(4, 2))
+    m.eval()
+    f = jit.to_static(m.forward)       # bound method, not the Layer
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(3, 6).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(f(x).numpy()),
+                               np.asarray(m(x).numpy()), rtol=1e-5)
+
+
+def test_jit_save_load_sequential_roundtrip(tmp_path):
+    import numpy as np
+
+    from paddle_tpu import jit, nn
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(1)
+    m = nn.Sequential(nn.Linear(5, 3), nn.ReLU(), nn.Linear(3, 2))
+    m.eval()
+    jit.save(m, str(tmp_path / "seq"), input_spec=[InputSpec([None, 5])])
+    t = jit.load(str(tmp_path / "seq"))
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 5).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(t(x).numpy()),
+                               np.asarray(m(x).numpy()), rtol=1e-5)

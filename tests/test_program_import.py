@@ -1,0 +1,501 @@
+"""ProgramDesc importer: reference-format inference models (.pdmodel
+protobuf + .pdiparams stream) load and run on jax.
+
+The test ENCODES real wire-format files from the published schemas
+(framework.proto field numbers; tensor_util.cc TensorToStream), so a
+genuine Paddle artifact exercises byte-identical paths."""
+
+import struct
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static.program_import import (InferenceProgram,
+                                              load_combined_params,
+                                              parse_program,
+                                              supported_ops)
+
+F32 = np.float32
+
+
+# ------------------------------------------------- minimal proto ENCODER --
+
+def _vint(v):
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _field(no, wire, payload):
+    return _vint(no << 3 | wire) + payload
+
+
+def _fbytes(no, data):
+    return _field(no, 2, _vint(len(data)) + data)
+
+
+def _fstr(no, s):
+    return _fbytes(no, s.encode())
+
+
+def _fint(no, v):
+    return _field(no, 0, _vint(v))
+
+
+def _ffloat(no, v):
+    return _field(no, 5, struct.pack("<f", v))
+
+
+def attr(name, type_, **kw):
+    out = _fstr(1, name) + _fint(2, type_)
+    for k, v in kw.items():
+        if k == "i":
+            out += _fint(3, v)
+        elif k == "f":
+            out += _ffloat(4, v)
+        elif k == "s":
+            out += _fstr(5, v)
+        elif k == "ints":
+            for x in v:
+                out += _fint(6, x)
+        elif k == "b":
+            out += _fint(10, int(v))
+        elif k == "l":
+            out += _fint(13, v)
+        elif k == "longs":
+            for x in v:
+                out += _fint(15, x)
+    return out
+
+
+def op_var(param, args):
+    out = _fstr(1, param)
+    for a in args:
+        out += _fstr(2, a)
+    return out
+
+
+def op(type_, inputs, outputs, attrs=()):
+    out = b""
+    for p, args in inputs.items():
+        out += _fbytes(1, op_var(p, args))
+    for p, args in outputs.items():
+        out += _fbytes(2, op_var(p, args))
+    out += _fstr(3, type_)
+    for a in attrs:
+        out += _fbytes(4, a)
+    return out
+
+
+def var(name, dims, dtype=5, persistable=False, vtype=7):
+    if vtype == 7:                          # LOD_TENSOR
+        tensor = _fint(1, dtype)
+        for d in dims:
+            tensor += _fint(2, d)
+        lod = _fbytes(1, tensor)
+        body = _fint(1, 7) + _fbytes(3, lod)
+    else:                                   # FEED_MINIBATCH/FETCH_LIST/...
+        body = _fint(1, vtype)
+    out = _fstr(1, name) + _fbytes(2, body)
+    if persistable:
+        out += _fint(3, 1)
+    return out
+
+
+def program(ops, vars_):
+    block = _fint(1, 0) + _fint(2, -1)
+    for v in vars_:
+        block += _fbytes(3, v)
+    for o in ops:
+        block += _fbytes(4, o)
+    return _fbytes(1, block)
+
+
+def lod_tensor_bytes(arr):
+    """tensor_util.cc TensorToStream + lod_tensor.cc stream layout."""
+    dtype_map = {np.dtype(np.float32): 5, np.dtype(np.int64): 3,
+                 np.dtype(np.float64): 6, np.dtype(np.int32): 2}
+    desc = _fint(1, dtype_map[arr.dtype])
+    for d in arr.shape:
+        desc += _fint(2, d)
+    out = struct.pack("<I", 0)           # LoDTensor version
+    out += struct.pack("<Q", 0)          # lod_level = 0
+    out += struct.pack("<I", 0)          # tensor version
+    out += struct.pack("<i", len(desc)) + desc
+    return out + arr.tobytes()
+
+
+def write_model(tmp_path, prefix, ops, vars_, params):
+    (tmp_path / f"{prefix}.pdmodel").write_bytes(program(ops, vars_))
+    blob = b"".join(lod_tensor_bytes(params[k]) for k in sorted(params))
+    (tmp_path / f"{prefix}.pdiparams").write_bytes(blob)
+    return str(tmp_path / prefix)
+
+
+def feed_fetch(feed_names, fetch_names):
+    ops = []
+    for i, n in enumerate(feed_names):
+        ops.append(op("feed", {"X": ["feed"]}, {"Out": [n]},
+                      [attr("col", 0, i=i)]))
+    fetch = []
+    for i, n in enumerate(fetch_names):
+        fetch.append(op("fetch", {"X": [n]}, {"Out": ["fetch"]},
+                        [attr("col", 0, i=i)]))
+    return ops, fetch
+
+
+# ------------------------------------------------------------------ tests --
+
+class TestWireFormat:
+    def test_parse_program_roundtrip(self):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("relu", {"X": ["x"]}, {"Out": ["y"]})] + fetches
+        data = program(ops, [var("x", [-1, 4]), var("w", [4, 3], persistable=True)])
+        parsed_ops, vars_ = parse_program(data)
+        assert [o.type for o in parsed_ops] == ["feed", "relu", "fetch"]
+        assert vars_["w"]["persistable"] is True
+        assert vars_["w"]["shape"] == [4, 3]
+        assert vars_["x"]["shape"] == [-1, 4]
+
+    def test_params_stream_roundtrip(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(4, 3).astype(F32)
+        b = rng.randn(3).astype(F32)
+        ids = np.arange(6, dtype=np.int64).reshape(2, 3)
+        blob = b"".join(lod_tensor_bytes(x)
+                        for x in (a, b, ids))  # sorted: a, b, ids
+        got = load_combined_params(blob, ["a", "b", "ids"])
+        np.testing.assert_array_equal(got["a"], a)
+        np.testing.assert_array_equal(got["b"], b)
+        np.testing.assert_array_equal(got["ids"], ids)
+
+    def test_trailing_bytes_rejected(self):
+        a = np.zeros((2, 2), F32)
+        blob = lod_tensor_bytes(a) + lod_tensor_bytes(a)
+        with pytest.raises(ValueError, match="trailing"):
+            load_combined_params(blob, ["a"])
+
+
+class TestEndToEnd:
+    def test_mlp_matches_numpy(self, tmp_path):
+        """feed -> mul -> elementwise_add -> relu -> softmax -> fetch."""
+        rng = np.random.RandomState(1)
+        w = rng.randn(4, 3).astype(F32)
+        b = rng.randn(3).astype(F32)
+        feeds, fetches = feed_fetch(["x"], ["out"])
+        ops = feeds + [
+            op("mul", {"X": ["x"], "Y": ["w"]}, {"Out": ["h0"]},
+               [attr("x_num_col_dims", 0, i=1),
+                attr("y_num_col_dims", 0, i=1)]),
+            op("elementwise_add", {"X": ["h0"], "Y": ["b"]},
+               {"Out": ["h1"]}, [attr("axis", 0, i=-1)]),
+            op("relu", {"X": ["h1"]}, {"Out": ["h2"]}),
+            op("softmax", {"X": ["h2"]}, {"Out": ["out"]},
+               [attr("axis", 0, i=-1)]),
+        ] + fetches
+        vars_ = [var("x", [-1, 4]), var("w", [4, 3], persistable=True),
+                 var("b", [3], persistable=True)]
+        prefix = write_model(tmp_path, "mlp", ops, vars_,
+                             {"w": w, "b": b})
+
+        prog, feed_names, fetch_names = paddle.static.load_inference_model(
+            prefix)
+        assert feed_names == ["x"]
+        assert fetch_names == ["out"]
+        x = rng.randn(5, 4).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        h = np.maximum(x @ w + b, 0)
+        e = np.exp(h - h.max(-1, keepdims=True))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_conv_bn_pool_matches_numpy(self, tmp_path):
+        rng = np.random.RandomState(2)
+        w = rng.randn(2, 1, 3, 3).astype(F32)
+        scale = rng.rand(2).astype(F32) + 0.5
+        bias = rng.randn(2).astype(F32)
+        mean = rng.randn(2).astype(F32)
+        variance = rng.rand(2).astype(F32) + 0.5
+        feeds, fetches = feed_fetch(["x"], ["out"])
+        ops = feeds + [
+            op("conv2d", {"Input": ["x"], "Filter": ["cw"]},
+               {"Output": ["c"]},
+               [attr("strides", 3, ints=[1, 1]),
+                attr("paddings", 3, ints=[1, 1]),
+                attr("dilations", 3, ints=[1, 1]),
+                attr("groups", 0, i=1)]),
+            op("batch_norm", {"X": ["c"], "Scale": ["bns"],
+                              "Bias": ["bnb"], "Mean": ["bnm"],
+                              "Variance": ["bnv"]},
+               {"Y": ["n"]}, [attr("epsilon", 1, f=1e-5)]),
+            op("relu", {"X": ["n"]}, {"Out": ["r"]}),
+            op("pool2d", {"X": ["r"]}, {"Out": ["p"]},
+               [attr("pooling_type", 2, s="max"),
+                attr("ksize", 3, ints=[2, 2]),
+                attr("strides", 3, ints=[2, 2]),
+                attr("paddings", 3, ints=[0, 0])]),
+            op("flatten_contiguous_range", {"X": ["p"]}, {"Out": ["out"]},
+               [attr("start_axis", 0, i=1), attr("stop_axis", 0, i=-1)]),
+        ] + fetches
+        vars_ = [var("x", [-1, 1, 4, 4]),
+                 var("cw", [2, 1, 3, 3], persistable=True),
+                 var("bns", [2], persistable=True),
+                 var("bnb", [2], persistable=True),
+                 var("bnm", [2], persistable=True),
+                 var("bnv", [2], persistable=True)]
+        prefix = write_model(
+            tmp_path, "cnn", ops, vars_,
+            {"cw": w, "bns": scale, "bnb": bias, "bnm": mean,
+             "bnv": variance})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = rng.randn(2, 1, 4, 4).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+
+        # independent numpy reference
+        xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+        conv = np.zeros((2, 2, 4, 4), F32)
+        for n in range(2):
+            for o in range(2):
+                for i_ in range(4):
+                    for j in range(4):
+                        conv[n, o, i_, j] = (
+                            xp[n, 0, i_:i_ + 3, j:j + 3] * w[o, 0]).sum()
+        bn = (conv - mean[None, :, None, None]) / np.sqrt(
+            variance[None, :, None, None] + 1e-5) \
+            * scale[None, :, None, None] + bias[None, :, None, None]
+        r = np.maximum(bn, 0)
+        pooled = r.reshape(2, 2, 2, 2, 2, 2).max((3, 5))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   pooled.reshape(2, -1), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_embedding_reduce_matches_numpy(self, tmp_path):
+        rng = np.random.RandomState(3)
+        table = rng.randn(10, 4).astype(F32)
+        feeds, fetches = feed_fetch(["ids"], ["out"])
+        ops = feeds + [
+            op("lookup_table_v2", {"W": ["emb"], "Ids": ["ids"]},
+               {"Out": ["e"]}),
+            op("reduce_mean", {"X": ["e"]}, {"Out": ["out"]},
+               [attr("dim", 11, longs=[1]), attr("keep_dim", 6, b=False)]),
+        ] + fetches
+        vars_ = [var("ids", [-1, 3], dtype=3),
+                 var("emb", [10, 4], persistable=True)]
+        prefix = write_model(tmp_path, "emb", ops, vars_, {"emb": table})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        ids = rng.randint(0, 10, (5, 3)).astype(np.int64)
+        (out,) = prog(paddle.to_tensor(ids))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   table[ids].mean(1), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_unsupported_op_raises_actionably(self, tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("some_exotic_op", {"X": ["x"]},
+                          {"Out": ["y"]})] + fetches
+        prefix = write_model(tmp_path, "bad", ops, [var("x", [2])], {})
+        with pytest.raises(NotImplementedError, match="some_exotic_op"):
+            paddle.static.load_inference_model(prefix)
+
+    def test_executor_binds_multi_feed_by_name(self, tmp_path):
+        """The reference run() API accepts the feed dict in ANY key
+        order — binding must go by feed name, not dict order."""
+        rng = np.random.RandomState(6)
+        feeds, fetches = feed_fetch(["a", "b"], ["y"])
+        ops = feeds + [op("elementwise_sub", {"X": ["a"], "Y": ["b"]},
+                          {"Out": ["y"]}, [attr("axis", 0, i=-1)])
+                       ] + fetches
+        prefix = write_model(tmp_path, "mf", ops,
+                             [var("a", [-1, 3]), var("b", [-1, 3])], {})
+        prog, feed_names, fetch_names = \
+            paddle.static.load_inference_model(prefix)
+        assert feed_names == ["a", "b"]
+        a = rng.randn(2, 3).astype(F32)
+        b = rng.randn(2, 3).astype(F32)
+        exe = paddle.static.Executor()
+        # reversed key order on purpose
+        outs = exe.run(prog, feed={"b": b, "a": a},
+                       fetch_list=fetch_names)
+        np.testing.assert_allclose(outs[0], a - b, rtol=1e-6)
+
+    def test_executor_runs_imported_program(self, tmp_path):
+        rng = np.random.RandomState(4)
+        w = rng.randn(3, 2).astype(F32)
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [
+            op("matmul_v2", {"X": ["x"], "Y": ["w"]}, {"Out": ["y"]}),
+        ] + fetches
+        prefix = write_model(tmp_path, "exe", ops,
+                             [var("x", [-1, 3]),
+                              var("w", [3, 2], persistable=True)],
+                             {"w": w})
+        prog, feed_names, fetch_names = \
+            paddle.static.load_inference_model(prefix)
+        exe = paddle.static.Executor()
+        x = rng.randn(4, 3).astype(F32)
+        outs = exe.run(prog, feed={"x": x}, fetch_list=fetch_names)
+        np.testing.assert_allclose(outs[0], x @ w, rtol=1e-5, atol=1e-6)
+
+    def test_own_jit_save_format_still_loads(self, tmp_path):
+        """The content sniff must not break this framework's own
+        artifacts (both use the .pdmodel suffix)."""
+        from paddle_tpu import nn
+
+        paddle.seed(0)
+        m = nn.Linear(4, 2)
+        m.eval()
+        from paddle_tpu.jit import save as jit_save
+        from paddle_tpu.static import InputSpec
+
+        jit_save(m, str(tmp_path / "own"),
+                 input_spec=[InputSpec([None, 4])])
+        prog, _, _ = paddle.static.load_inference_model(
+            str(tmp_path / "own"))
+        x = np.random.randn(3, 4).astype(F32)
+        out = prog(paddle.to_tensor(x))
+        out = out[0] if isinstance(out, (list, tuple)) else out
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()),
+            np.asarray(m(paddle.to_tensor(x)).numpy()), rtol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_persistable_feed_fetch_vars_excluded_from_params(self,
+                                                              tmp_path):
+        """Real exports mark the feed/fetch HOLDER vars persistable but
+        never serialize them — loading must filter by var type."""
+        rng = np.random.RandomState(5)
+        w = rng.randn(3, 2).astype(F32)
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("matmul_v2", {"X": ["x"], "Y": ["w"]},
+                          {"Out": ["y"]})] + fetches
+        vars_ = [
+            # alphabetically before 'w': would corrupt the stream if
+            # counted ('feed' < 'w', 'fetch' < 'w')
+            var("feed", [], persistable=True, vtype=9),
+            var("fetch", [], persistable=True, vtype=10),
+            var("x", [-1, 3]),
+            var("w", [3, 2], persistable=True),
+        ]
+        prefix = write_model(tmp_path, "ff", ops, vars_, {"w": w})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = rng.randn(2, 3).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), x @ w,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_exclusive_avg_pool_divides_by_inbounds_count(self,
+                                                          tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("pool2d", {"X": ["x"]}, {"Out": ["y"]},
+                          [attr("pooling_type", 2, s="avg"),
+                           attr("ksize", 3, ints=[2, 2]),
+                           attr("strides", 3, ints=[2, 2]),
+                           attr("paddings", 3, ints=[1, 1]),
+                           attr("exclusive", 6, b=True)])] + fetches
+        prefix = write_model(tmp_path, "ap", ops, [var("x", [-1, 1, 2, 2])],
+                             {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = np.asarray([[[[2.0, 4.0], [6.0, 8.0]]]], F32)
+        (out,) = prog(paddle.to_tensor(x))
+        # padded 2x2 -> windows at corners see exactly ONE real pixel
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   [[2.0, 4.0], [6.0, 8.0]], rtol=1e-6)
+
+    def test_adaptive_pool_translates_via_pool_ops(self, tmp_path):
+        """Adaptive pooling delegates to the registered pool2d kernel
+        (one implementation) — ResNet-family adaptive heads load."""
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("pool2d", {"X": ["x"]}, {"Out": ["y"]},
+                          [attr("pooling_type", 2, s="avg"),
+                           attr("adaptive", 6, b=True),
+                           attr("ksize", 3, ints=[2, 2])])] + fetches
+        prefix = write_model(tmp_path, "apool", ops,
+                             [var("x", [-1, 1, 4, 4])], {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = np.arange(16, dtype=F32).reshape(1, 1, 4, 4)
+        (out,) = prog(paddle.to_tensor(x))
+        exp = x.reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+        np.testing.assert_allclose(np.asarray(out.numpy()), exp,
+                                   rtol=1e-6)
+
+    def test_dynamic_axis_and_shape_inputs_refused(self, tmp_path):
+        cases = [
+            op("concat", {"X": ["x", "x"], "AxisTensor": ["ax"]},
+               {"Out": ["y"]}, [attr("axis", 0, i=0)]),
+            op("reshape2", {"X": ["x"], "ShapeTensor": ["ax"]},
+               {"Out": ["y"]}, [attr("shape", 3, ints=[4])]),
+        ]
+        for i, bad in enumerate(cases):
+            feeds, fetches = feed_fetch(["x"], ["y"])
+            ops = feeds + [bad] + fetches
+            prefix = write_model(
+                tmp_path, f"dyn{i}", ops,
+                [var("x", [2, 2]),
+                 var("ax", [1], dtype=3, persistable=True)],
+                {"ax": np.zeros(1, np.int64)})
+            prog, _, _ = paddle.static.load_inference_model(prefix)
+            with pytest.raises(NotImplementedError):
+                prog(paddle.to_tensor(np.zeros((2, 2), F32)))
+
+    def test_argmax_flatten(self, tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("arg_max", {"X": ["x"]}, {"Out": ["y"]},
+                          [attr("flatten", 6, b=True),
+                           attr("axis", 0, i=0)])] + fetches
+        prefix = write_model(tmp_path, "am", ops, [var("x", [2, 3])], {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = np.asarray([[1.0, 9.0, 2.0], [3.0, 4.0, 5.0]], F32)
+        (out,) = prog(paddle.to_tensor(x))
+        assert int(np.asarray(out.numpy())) == 1  # flattened index
+
+    def test_bilinear_align_corners_true_preserves_corners(self,
+                                                           tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("bilinear_interp_v2", {"X": ["x"]},
+                          {"Out": ["y"]},
+                          [attr("out_h", 0, i=4), attr("out_w", 0, i=4),
+                           attr("align_corners", 6, b=True)])] + fetches
+        prefix = write_model(tmp_path, "interp", ops,
+                             [var("x", [-1, 1, 2, 2])], {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = np.asarray([[[[0.0, 3.0], [6.0, 9.0]]]], F32)
+        (out,) = prog(paddle.to_tensor(x))
+        o = np.asarray(out.numpy())[0, 0]
+        # align_corners=True: the four corners map exactly (half-pixel
+        # resize — the review-flagged wrong path — shifts them)
+        np.testing.assert_allclose(
+            [o[0, 0], o[0, -1], o[-1, 0], o[-1, -1]],
+            [0.0, 3.0, 6.0, 9.0], atol=1e-5)
+
+    def test_slice_with_tensor_bounds_raises(self, tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("slice", {"Input": ["x"],
+                                    "StartsTensor": ["s"]},
+                          {"Out": ["y"]},
+                          [attr("axes", 3, ints=[0]),
+                           attr("starts", 3, ints=[0]),
+                           attr("ends", 3, ints=[1])])] + fetches
+        prefix = write_model(tmp_path, "dynslice", ops,
+                             [var("x", [4, 2]),
+                              var("s", [1], dtype=3, persistable=True)],
+                             {"s": np.zeros(1, np.int64)})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        with pytest.raises(NotImplementedError, match="slice"):
+            prog(paddle.to_tensor(np.zeros((4, 2), F32)))
+
+
+def test_supported_op_inventory():
+    ops = supported_ops()
+    assert len(ops) >= 45, len(ops)
+    for must in ("conv2d", "batch_norm", "matmul_v2", "softmax",
+                 "lookup_table_v2", "feed", "fetch"):
+        assert must in ops
