@@ -36,21 +36,31 @@ return_transformer.py, break_continue_transformer.py semantics):
   canonicalized into if/else tail form (statements after a returning
   ``if`` move into its else-continuation), then both-return ifs lower
   to a value-returning ``lax.cond``.  A ``return`` whose branch only
-  *sometimes* returns, or inside a loop body, is left for the trace
-  guard.
+  *sometimes* returns is left for the trace guard.
+- ``return`` under a loop: rewritten into a carried (flag, value) pair
+  + break, with a post-loop ``if flag: return value`` that the
+  canonicalizer folds.  Exact on python-native loops; a
+  tensor-converted loop raises an actionable error (the return value
+  has no statically-shaped pre-loop form).  Returns under With/Try
+  decline (unwind semantics).
 - ``break``/``continue`` in ``while``/``for``: eliminated into flag
   variables + guard-ifs (the reference's break_continue_transformer
   rewrite); the loop test conjoins ``not brk``, so the flag rides the
   compiled ``lax.while_loop`` carry.
 - ``for x in tensor``: lowered to an index-carried ``while_loop`` over
-  the leading axis (python iterables keep the native loop).  Only
-  simple ``for NAME in ...`` targets convert; the loop variable's
-  post-loop value is carried (python scoping parity).
+  the leading axis (python iterables keep the native loop); the loop
+  variable's post-loop value is carried (python scoping parity).
+- tuple for-targets (``for a, b in ...``, nesting included): the
+  element names join the carried set and bind by unpacking each
+  element; flat tuples also convert on the tensor path (seeded from the
+  first row), nested patterns stay native-only.
+- closures with free variables: the converted clone's code re-binds to
+  the ORIGINAL cells, so nonlocal reads and writes stay live in both
+  directions.
 
 Out of scope (left untransformed; the trace guard reports them if a
 tensor condition reaches one): ``yield``, ``while ... else`` /
-``for ... else``, tuple for-targets, ``return`` under a loop, closures
-with free variables.  Conversion failure of any kind falls back to the
+``for ... else``.  Conversion failure of any kind falls back to the
 original function.
 """
 
@@ -83,6 +93,13 @@ class _Undefined:
 
 
 _UNDEF = _Undefined()
+
+
+def _noret():
+    """Pre-loop seed for a loop-carried return value: the poison makes a
+    traced-loop conversion fail with the actionable _undef_loop_msg
+    instead of a shape error, and is never read on the python path."""
+    return _UNDEF
 
 
 def _select_outputs(fn, values, keep):
@@ -159,13 +176,22 @@ def convert_while(test_fn, body_fn, names, values):
 
     for name, v in zip(names, values):
         if v is _UNDEF:
-            raise NameError(
-                f"loop variable {name!r} is used in a compiled (tensor-"
-                "condition) while before assignment; initialize it before "
-                "the loop")
+            raise NameError(_undef_loop_msg(name, "while"))
     return tuple(static_nn.while_loop(
         lambda *vs: test_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
         list(values)))
+
+
+def _undef_loop_msg(name, kind):
+    if name.startswith("_d2s_retv"):
+        return (
+            f"`return` inside a tensor-converted {kind} loop cannot be "
+            "compiled: the return value has no statically-shaped "
+            "pre-loop form.  Assign a result variable in the loop and "
+            "return it after the loop instead.")
+    return (f"loop variable {name!r} is used in a compiled (tensor-"
+            f"{'condition' if kind == 'while' else 'iterable'}) {kind} "
+            "before assignment; initialize it before the loop")
 
 
 def convert_ifelse_ret(pred, true_fn, false_fn, values):
@@ -234,16 +260,20 @@ def d2s_and_lazy(a, b_thunk):
     return Tensor(jnp.logical_and(da, db))
 
 
-def convert_for(it, body_fn, names, values, brk_name=None):
-    """Runtime dispatch for a rewritten ``for NAME in it``.
+def convert_for(it, body_fn, names, values, brk_name=None, elt_spec=()):
+    """Runtime dispatch for a rewritten ``for TARGET in it``.
 
     ``body_fn(x, *values) -> (x, *values)`` (the loop variable is carried
-    so its post-loop value matches python scoping).  Python iterables run
-    the native loop (honoring a break flag with a REAL break);
-    tensor/array iterables lower to an index-carried while_loop over the
-    leading axis — ragged early exit rides the ``brk`` flag in the test.
-    Returns ``(*values, x_last)``; ``x_last`` is ``_UNDEF`` for an empty
-    python iterable (python's unbound-after-empty-loop parity).
+    so its post-loop value matches python scoping; tuple targets carry
+    their element NAMES inside ``values`` and bind them by unpacking x at
+    body start).  Python iterables run the native loop (honoring a break
+    flag with a REAL break); tensor/array iterables lower to an
+    index-carried while_loop over the leading axis — ragged early exit
+    rides the ``brk`` flag in the test.  ``elt_spec`` maps flat tuple-
+    target names to element positions so the traced path can seed their
+    carried slots from the first row.  Returns ``(*values, x_last)``;
+    ``x_last`` is ``_UNDEF`` for an empty python iterable (python's
+    unbound-after-empty-loop parity).
     """
     brk_idx = names.index(brk_name) if brk_name else None
     if not _is_tensorish(it):
@@ -258,13 +288,18 @@ def convert_for(it, body_fn, names, values, brk_name=None):
     from ..core.tensor import Tensor
     from ..static import nn as static_nn
 
+    tens_seed = it if isinstance(it, Tensor) else Tensor(it)
+    elt_names = {n for n, _ in elt_spec}
+    values = list(values)
+    if len(tens_seed.shape) and int(tens_seed.shape[0]) > 0:
+        for n, i in elt_spec:
+            if values[names.index(n)] is _UNDEF:
+                values[names.index(n)] = tens_seed[0][i]
     for name, v in zip(names, values):
-        if v is _UNDEF:
-            raise NameError(
-                f"loop variable {name!r} is used in a compiled (tensor-"
-                "iterable) for before assignment; initialize it before "
-                "the loop")
-    tens = it if isinstance(it, Tensor) else Tensor(it)
+        if v is _UNDEF and name not in elt_names:
+            raise NameError(_undef_loop_msg(name, "for"))
+    values = tuple(values)
+    tens = tens_seed
     n = int(tens.shape[0])  # static leading axis (XLA requirement)
     if n == 0:
         return (*values, _UNDEF)
@@ -359,6 +394,84 @@ def _canonicalize_returns(stmts):
             return out
         out.append(s)
     return out
+
+
+# ---------------------------------------------------- return under loop ----
+
+def _returns_convertible(stmts):
+    """Pre-scan: False when any this-level return sits under With/Try
+    (unwind semantics the flag rewrite can't model).  MUST run before
+    any mutation — a partial rewrite that then declines would leave a
+    return silently turned into a bare break (review regression)."""
+    for s in stmts:
+        if isinstance(s, (ast.With, ast.Try)) and _contains_return([s]):
+            return False
+        if isinstance(s, ast.If):
+            if not _returns_convertible(s.body) or \
+                    not _returns_convertible(s.orelse):
+                return False
+    return True
+
+
+def _replace_returns(stmts, flag, val):
+    """Rewrite this-level ``return X`` into ``val = X; flag = True;
+    break`` (the break rides the existing flag machinery).  Recurses into
+    If branches only — nested loops were already cleansed by the
+    post-order visit, and nested defs keep their own returns.  Callers
+    gate on :func:`_returns_convertible` first."""
+    out = []
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            v = s.value if s.value is not None else ast.Constant(value=None)
+            out.append(ast.Assign(
+                targets=[ast.Name(id=val, ctx=ast.Store())], value=v))
+            out.append(_assign_flag(flag, True))
+            out.append(ast.Break())
+            break  # anything after a return is unreachable
+        if isinstance(s, ast.If):
+            s.body = _replace_returns(s.body, flag, val)
+            s.orelse = _replace_returns(s.orelse, flag, val)
+        out.append(s)
+    return out
+
+
+class _ReturnInLoopTransformer(ast.NodeTransformer):
+    """``return`` under a loop -> carried (flag, value) + break + a
+    post-loop ``if flag: return value`` that the canonicalizer then
+    folds (the reference return_transformer's loop case).  Post-order,
+    so inner loops hand their returns outward level by level."""
+
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _handle(self, node):
+        self.generic_visit(node)
+        if node.orelse or not _contains_return(node.body):
+            return node
+        if not _returns_convertible(node.body):
+            return node
+        self.counter += 1
+        flag = f"_d2s_retf{self.counter}"
+        val = f"_d2s_retv{self.counter}"
+        node.body = _replace_returns(node.body, flag, val)
+        self.changed = True
+        return [
+            _assign_flag(flag, False),
+            ast.Assign(targets=[ast.Name(id=val, ctx=ast.Store())],
+                       value=ast.Call(
+                           func=ast.Name(id="__d2s_noret", ctx=ast.Load()),
+                           args=[], keywords=[])),
+            node,
+            ast.If(test=ast.Name(id=flag, ctx=ast.Load()),
+                   body=[ast.Return(value=ast.Name(id=val,
+                                                   ctx=ast.Load()))],
+                   orelse=[]),
+        ]
+
+    visit_For = visit_While = _handle
+    # nested defs are visited too: each def's loop-returns resolve to a
+    # post-loop if-return INSIDE that def — independent and correct
 
 
 # ------------------------------------------------- break/continue flags ----
@@ -464,7 +577,7 @@ class _LoopEscapeTransformer(ast.NodeTransformer):
         # keep its real break/continue for native semantics.
         if _has_escape_sans_bc(node.body):
             return node
-        if is_for and not isinstance(node.target, ast.Name):
+        if is_for and not _for_target_names(node.target):
             return node
         if not is_for and any(isinstance(n, ast.NamedExpr)
                               for n in ast.walk(node.test)):
@@ -643,6 +756,22 @@ def _has_escape_sans_bc(stmts):
     return v.found
 
 
+def _for_target_names(target):
+    """Names bound by a for target: a Name, or a (possibly nested) tuple
+    of Names; None for anything else (starred/attribute/subscript)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out = []
+        for e in target.elts:
+            sub = _for_target_names(e)
+            if sub is None:
+                return None
+            out.extend(sub)
+        return out
+    return None
+
+
 def _args(names):
     return ast.arguments(posonlyargs=[], args=[ast.arg(arg=n)
                                                for n in names],
@@ -749,19 +878,40 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse or _has_escape(node.body):
             return node
-        if not isinstance(node.target, ast.Name):
-            return node  # tuple targets: python scoping can't be carried
-        target = node.target.id
-        names = sorted(n for n in set(_assigned(node.body))
-                       if not n.startswith("__d2s") and n != target)
+        tnames = _for_target_names(node.target)
+        if tnames is None:
+            return node  # starred/attribute targets: can't be carried
+        is_tuple = not isinstance(node.target, ast.Name)
+        if is_tuple:
+            # element names join the carried set: python scoping
+            # (rebinding, post-loop values, unbound-after-empty) falls
+            # out of the ordinary carry rules
+            names = sorted(n for n in
+                           set(_assigned(node.body)) | set(tnames)
+                           if not n.startswith("__d2s"))
+            target_carry = self._fresh("xlast")  # raw element, discarded
+        else:
+            names = sorted(n for n in set(_assigned(node.body))
+                           if not n.startswith("__d2s")
+                           and n != node.target.id)
+            target_carry = node.target.id
+        # flat (name, position) pairs let the traced path seed elements
+        # from the first row; nested patterns stay native-only
+        elt_spec = []
+        if is_tuple and all(isinstance(e, ast.Name)
+                            for e in node.target.elts):
+            elt_spec = [(e.id, i) for i, e in enumerate(node.target.elts)]
         brk_name = getattr(node, "_d2s_brk", None)
         if brk_name is not None and brk_name not in names:
             brk_name = None  # defensive: flag must be carried to matter
         body_name = self._fresh("forbody")
         x_arg = "__d2s_x"
-        body = [ast.Assign(targets=[ast.Name(id=target, ctx=ast.Store())],
+        # the element binds through the ORIGINAL target node (a tuple
+        # target unpacks naturally)
+        body = [ast.Assign(targets=[node.target],
                            value=ast.Name(id=x_arg, ctx=ast.Load()))] \
-            + list(node.body) + [_ret_tuple([target] + names)]
+            + list(node.body) \
+            + [_ret_tuple([x_arg if is_tuple else target_carry] + names)]
         body_def = ast.FunctionDef(name=body_name,
                                    args=_args([x_arg] + names),
                                    body=body, decorator_list=[])
@@ -772,9 +922,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                   ast.Tuple(elts=[ast.Constant(value=n) for n in names],
                             ctx=ast.Load()),
                   _seed_tuple(names),
-                  ast.Constant(value=brk_name)],
+                  ast.Constant(value=brk_name),
+                  ast.Tuple(elts=[
+                      ast.Tuple(elts=[ast.Constant(value=n),
+                                      ast.Constant(value=i)],
+                                ctx=ast.Load())
+                      for n, i in elt_spec], ctx=ast.Load())],
             keywords=[])
-        assign = ast.Assign(targets=[_bind_target(names + [target])],
+        assign = ast.Assign(targets=[_bind_target(names + [target_carry])],
                             value=call)
         self.counter += 1
         return [body_def, assign]
@@ -812,10 +967,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
 def ast_transform(fn):
     """Control-flow-converted clone of ``fn``, or None when conversion
-    isn't possible (no source, closures, nothing to convert, exec
-    failure).  Identical behavior for python-bool conditions."""
-    if getattr(fn, "__closure__", None):
-        return None  # free variables would need cell surgery
+    isn't possible (no source, nothing to convert, exec failure).
+    Identical behavior for python-bool conditions.  Closures convert via
+    an outer wrapper whose compiled code is re-bound to the ORIGINAL
+    cells, so nonlocal reads/writes stay live."""
+    closure_cells = getattr(fn, "__closure__", None) or ()
+    freevars = fn.__code__.co_freevars if closure_cells else ()
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
@@ -837,6 +994,12 @@ def ast_transform(fn):
         if isinstance(n, ast.Name) and _mangled(n.id):
             return None
 
+    # 0) returns under loops -> carried (flag, value) + break + a
+    #    post-loop if-return (feeds the canonicalizer below)
+    ret_loop = _ReturnInLoopTransformer()
+    tree = ret_loop.visit(tree)
+    fdef = tree.body[0]
+
     # 1) early-return canonicalization (best-effort: unsupported patterns
     #    keep their returns, and the If transformer leaves those alone)
     if any(isinstance(s, ast.If) and _contains_return([s])
@@ -856,8 +1019,19 @@ def ast_transform(fn):
     # 3) if/while/for -> runtime converter calls
     transformer = _ControlFlowTransformer()
     new_tree = transformer.visit(tree)
-    if transformer.counter == 0 and not escape.changed:
+    if transformer.counter == 0 and not escape.changed \
+            and not ret_loop.changed:
         return None
+    if freevars:
+        # compile the converted def inside a wrapper that declares the
+        # free names, so the inner code object carries real freevars
+        fdef = new_tree.body[0]
+        outer = ast.FunctionDef(
+            name="__d2s_outer", args=_args(list(freevars)),
+            body=[fdef, ast.Return(value=ast.Name(id=fdef.name,
+                                                  ctx=ast.Load()))],
+            decorator_list=[])
+        new_tree = ast.Module(body=[outer], type_ignores=[])
     ast.fix_missing_locations(new_tree)
 
     try:
@@ -877,12 +1051,31 @@ def ast_transform(fn):
     glb["__d2s_or"] = d2s_or
     glb["__d2s_and"] = d2s_and_lazy
     glb["__d2s_get"] = _frame_get
+    glb["__d2s_noret"] = _noret
     loc = {}
     try:
         exec(code, glb, loc)
     except Exception:
         return None
-    converted = loc.get(fdef.name) or glb.get(fdef.name)
+    if freevars:
+        import types
+
+        outer_fn = loc.get("__d2s_outer") or glb.get("__d2s_outer")
+        if outer_fn is None:
+            return None
+        try:
+            # call with the LIVE contents to materialize the inner code
+            # object, then re-bind it to the ORIGINAL cells by name so
+            # later nonlocal mutations stay visible both ways
+            inner = outer_fn(*[c.cell_contents for c in closure_cells])
+            cellmap = dict(zip(fn.__code__.co_freevars, closure_cells))
+            cells = tuple(cellmap[n] for n in inner.__code__.co_freevars)
+            converted = types.FunctionType(
+                inner.__code__, glb, fdef.name, fn.__defaults__, cells)
+        except (ValueError, KeyError):
+            return None  # empty cell / freevar mismatch: decline
+    else:
+        converted = loc.get(fdef.name) or glb.get(fdef.name)
     if converted is None:
         return None
     converted.__defaults__ = fn.__defaults__
