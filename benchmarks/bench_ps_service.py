@@ -1,0 +1,68 @@
+"""PS service throughput vs concurrent client count.
+
+Measures the thread-per-connection design's actual ceiling (the design
+note in native/ps_service.cc cites these numbers).  Each client runs
+pull+push round-trips of a 256-key batch (dim 16) on its own key range.
+
+Usage: python benchmarks/bench_ps_service.py [--clients 1 8 32 64]
+"""
+import argparse
+import sys
+import threading
+import time
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+
+def run(n_clients, seconds=3.0, batch=256, dim=16):
+    from paddle_tpu.distributed.ps import PsClient, PsServer, SparseTable
+
+    table = SparseTable(dim=dim, optimizer="sgd", learning_rate=0.1,
+                        init_range=0.0)
+    srv = PsServer(table)
+    counts = [0] * n_clients
+    stop = threading.Event()
+
+    def worker(cid):
+        c = PsClient("127.0.0.1", srv.port)
+        keys = np.arange(cid * batch, (cid + 1) * batch, dtype=np.int64)
+        g = np.ones((batch, dim), np.float32)
+        while not stop.is_set():
+            c.pull(keys)
+            c.push(keys, g, optimizer="sgd", learning_rate=0.1)
+            counts[cid] += 2
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    dt = time.perf_counter() - t0
+    total = sum(counts)
+    rps = total / dt
+    rows_per_s = rps * batch
+    srv.stop()
+    return rps, rows_per_s
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, nargs="+",
+                    default=[1, 8, 32, 64])
+    ap.add_argument("--seconds", type=float, default=3.0)
+    args = ap.parse_args()
+    print(f"{'clients':>8} {'rpc/s':>12} {'rows/s':>14}")
+    for n in args.clients:
+        rps, rows = run(n, seconds=args.seconds)
+        print(f"{n:>8} {rps:>12.0f} {rows:>14.0f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -292,9 +292,12 @@ def convert_for(it, body_fn, names, values, brk_name=None, elt_spec=()):
     elt_names = {n for n, _ in elt_spec}
     values = list(values)
     if len(tens_seed.shape) and int(tens_seed.shape[0]) > 0:
+        # seed element slots UNCONDITIONALLY: the unpack assign is the
+        # first body statement, so any pre-loop value is dead — but a
+        # differently-shaped one would poison the while carry structure
+        # (review regression)
         for n, i in elt_spec:
-            if values[names.index(n)] is _UNDEF:
-                values[names.index(n)] = tens_seed[0][i]
+            values[names.index(n)] = tens_seed[0][i]
     for name, v in zip(names, values):
         if v is _UNDEF and name not in elt_names:
             raise NameError(_undef_loop_msg(name, "for"))

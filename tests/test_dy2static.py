@@ -933,3 +933,20 @@ class TestRound4Residuals:
         if conv is not None:
             np.testing.assert_allclose(np.asarray(conv(x, t).numpy()),
                                        ref)
+
+    def test_tuple_target_tensor_rows_with_preassigned_element(self):
+        """Review regression: a pre-loop binding of an element name with
+        a DIFFERENT shape must not poison the traced carry (its value is
+        dead — the unpack assign is the first body statement)."""
+        def f(pairs):
+            b = paddle.to_tensor(np.ones(3, np.float32))  # wrong shape
+            acc = paddle.to_tensor(np.array(0.0, np.float32))
+            for a, b in pairs:
+                acc = acc + a * b
+            return acc
+
+        conv = ast_transform(f)
+        assert conv is not None
+        pairs = paddle.to_tensor(
+            np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        np.testing.assert_allclose(np.asarray(conv(pairs).numpy()), 14.0)

@@ -216,3 +216,48 @@ def test_longcontext_bench_harness():
     assert rows and rows[0]["flash_tokens_per_s"] > 0
     assert rows[0]["ring_max_err"] < 1e-4
     assert rows[0]["ulysses_max_err"] < 1e-4
+
+
+DCN_WORKER = textwrap.dedent("""
+    import sys
+    sys.path.insert(0, {repo!r})
+    import os
+    import paddle_tpu.distributed as dist
+
+    env = dist.init_parallel_env()
+    from paddle_tpu.distributed.auto_parallel import ClusterSpec
+
+    spec = ClusterSpec(calibrate=False)
+    default = spec.dcn_bandwidth
+    assert not spec.dcn_measured
+    bw = spec.calibrate_dcn(nbytes=1 << 20, iters=2)
+    assert bw is not None and bw > 0, bw
+    assert spec.dcn_measured
+    assert spec.dcn_bandwidth == bw != default
+    print("RANK", env.rank, "DCN", f"{{bw:.3e}}", "OK")
+""")
+
+
+def test_dcn_bandwidth_calibrates_across_processes(tmp_path):
+    """VERDICT r3 #9: the tuner's DCN number must be measurable, not
+    taken on faith — two processes time a real cross-process
+    all_gather and the measured figure replaces the cited default."""
+    script = tmp_path / "dcn_worker.py"
+    script.write_text(DCN_WORKER.format(repo=REPO))
+    port = _free_port_pair()
+    procs = [subprocess.Popen(
+        [sys.executable, str(script)], env=_cpu_env(r, port),
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=200)
+            outs.append(out)
+        for r, (p, out) in enumerate(zip(procs, outs)):
+            assert p.returncode == 0, f"rank {r} failed:\n{out[-2000:]}"
+            assert f"RANK {r} DCN" in out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()

@@ -504,3 +504,58 @@ class TestGraphPs:
         finally:
             for s in servers:
                 s.stop()
+
+
+def test_32_concurrent_clients_mixed_pull_push():
+    """VERDICT r3 #7: the thread-per-connection design claim needs
+    evidence.  32 clients hammer one shard with mixed pull/push on
+    disjoint AND shared keys; sgd is linear so every final value is
+    exact regardless of interleaving."""
+    import threading
+
+    from paddle_tpu.distributed.ps import PsClient, PsServer, SparseTable
+
+    lr = 0.5
+    table = SparseTable(dim=8, optimizer="sgd", learning_rate=lr,
+                        init_range=0.0, seed=1)
+    srv = PsServer(table)
+    n_clients, rounds = 32, 20
+    shared = np.arange(100000, 100016, dtype=np.int64)
+    errors = []
+
+    def worker(cid):
+        try:
+            c = PsClient("127.0.0.1", srv.port)
+            own = np.arange(cid * 100, cid * 100 + 8, dtype=np.int64)
+            g_own = np.ones((8, 8), np.float32)
+            g_shared = np.ones((16, 8), np.float32)
+            for r in range(rounds):
+                rows = c.pull(own)
+                # own keys: exactly r pushes so far -> -lr*r everywhere
+                np.testing.assert_allclose(rows, -lr * r, rtol=1e-6)
+                c.push(own, g_own, optimizer="sgd", learning_rate=lr)
+                c.push(shared, g_shared, optimizer="sgd",
+                       learning_rate=lr)
+                c.pull(shared)  # racy value; must not error/corrupt
+            c.close()
+        except Exception as e:  # pragma: no cover
+            errors.append((cid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors[:3]
+        # shared keys: 32 clients x 20 pushes of grad 1 -> exact value
+        final = table.pull(shared)
+        np.testing.assert_allclose(final, -lr * n_clients * rounds,
+                                   rtol=1e-5)
+        for cid in (0, 7, 31):
+            own = np.arange(cid * 100, cid * 100 + 8, dtype=np.int64)
+            np.testing.assert_allclose(table.pull(own), -lr * rounds,
+                                       rtol=1e-6)
+    finally:
+        srv.stop()
