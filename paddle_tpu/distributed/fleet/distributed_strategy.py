@@ -40,6 +40,10 @@ class DistributedStrategy:
         self.dgc = False
         self.localsgd = False
         self.localsgd_configs = {"k_steps": 4}
+        self.adaptive_localsgd = False
+        self.adaptive_localsgd_configs = {"init_k_steps": 1,
+                                          "begin_step": 1}
+        self.asp = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

@@ -25,8 +25,8 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 
 __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
-           "DGCMomentumOptimizer", "apply_strategy_to_optimizer",
-           "apply_recompute_to_model"]
+           "AdaptiveLocalSGDOptimizer", "DGCMomentumOptimizer",
+           "apply_strategy_to_optimizer", "apply_recompute_to_model"]
 
 
 class _OptimizerWrapper:
@@ -97,6 +97,82 @@ class LocalSGDOptimizer(_OptimizerWrapper):
             # AVG (pmean) does the reduce and the 1/world scaling in one
             # collective; all_reduce is in-place on Tensors
             dist.all_reduce(p, op=dist.ReduceOp.AVG, group=self.group)
+
+
+class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
+    """LocalSGD whose sync period adapts to training progress
+    (reference meta_optimizers/localsgd_optimizer.py
+    AdaptiveLocalSGDOptimizer, after Wang & Joshi's adaptive
+    communication schedule):
+
+        next_k = clip(ceil(sqrt(lr_0 * loss / (lr * loss_0)
+                                * init_k_steps)), 1, 16)
+
+    — early training (loss near loss_0) syncs often; as the loss drops
+    the sync period stretches, cutting communication exactly when the
+    replicas drift slowest.  Eager contract: pass the step's loss to
+    ``step(loss=...)``; the first call pins (lr_0, loss_0) and each sync
+    re-evaluates the period using the group-averaged loss.
+    """
+
+    def __init__(self, inner, init_k_steps=1, begin_step=1, group=None):
+        super().__init__(inner, k_steps=init_k_steps, group=group)
+        self.init_k_steps = max(1, int(init_k_steps))
+        self.begin_step = max(1, int(begin_step))
+        self._lr0 = None
+        self._loss0 = None
+        self._step_no = 0
+
+    def _lr_value(self):
+        lr = self._inner._learning_rate
+        return float(lr() if callable(lr) else lr)
+
+    def step(self, loss=None):
+        self._inner.step()
+        self._step_no += 1
+        if loss is None:
+            # without a loss signal behave like plain LocalSGD
+            self._local += 1
+            if self._local % self.k_steps == 0:
+                self._sync_params()
+            return
+        lval = float(loss.numpy() if hasattr(loss, "numpy") else loss)
+        if self._loss0 is None:
+            # pin against the GROUP-average loss: a single replica's
+            # shard loss would skew every later adaptation
+            self._loss0 = max(self._avg_loss(lval), 1e-12)
+            self._lr0 = max(self._lr_value(), 1e-12)
+        if self._step_no < self.begin_step:
+            # reference semantics: begin_step delays LOCAL sgd — the
+            # warm-up trains fully synchronously, syncing EVERY step
+            self._sync_params()
+            return
+        self._local += 1
+        if self._local % self.k_steps == 0:
+            self._sync_params()
+            lr = max(self._lr_value(), 1e-12)
+            nxt = int(np.ceil(np.sqrt(
+                self._lr0 * max(self._avg_loss(lval), 0.0)
+                / (lr * self._loss0) * self.init_k_steps)))
+            self.k_steps = min(max(nxt, 1), 16)
+
+    def _avg_loss(self, lval):
+        if self.group is None and _world_size() <= 1:
+            return lval
+        from .. import communication as dist
+
+        t = Tensor(jnp.asarray([lval], jnp.float32))
+        dist.all_reduce(t, op=dist.ReduceOp.AVG, group=self.group)
+        return float(t.numpy()[0])
+
+
+def _world_size():
+    try:
+        import jax
+
+        return jax.process_count()
+    except Exception:  # pragma: no cover
+        return 1
 
 
 class DGCMomentumOptimizer(_OptimizerWrapper):
@@ -195,7 +271,15 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
         optimizer = GradientMergeOptimizer(
             optimizer, k_steps=cfg.get("k_steps", 1),
             avg=cfg.get("avg", True))
-    if getattr(strategy, "localsgd", False):
+    if getattr(strategy, "adaptive_localsgd", False):
+        cfg = getattr(strategy, "adaptive_localsgd_configs", None) or {}
+        dp_group = None
+        if hcg is not None:
+            dp_group = hcg.get_data_parallel_group()
+        optimizer = AdaptiveLocalSGDOptimizer(
+            optimizer, init_k_steps=cfg.get("init_k_steps", 1),
+            begin_step=cfg.get("begin_step", 1), group=dp_group)
+    elif getattr(strategy, "localsgd", False):
         cfg = getattr(strategy, "localsgd_configs", None) or {}
         dp_group = None
         if hcg is not None:
@@ -205,6 +289,13 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
         optimizer = LocalSGDOptimizer(optimizer,
                                       k_steps=cfg.get("k_steps", 4),
                                       group=dp_group)
+    if getattr(strategy, "asp", False):
+        # reference asp_optimizer.py OptimizerWithSparsityGuarantee:
+        # 2:4 masks re-apply after every step so pruned weights never
+        # regrow (prune_model must have been called on the model)
+        from ...incubate.asp import decorate as _asp_decorate
+
+        optimizer = _asp_decorate(optimizer)
     return optimizer
 
 

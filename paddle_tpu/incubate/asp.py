@@ -97,8 +97,10 @@ class _ASPOptimizer:
     def __getattr__(self, name):
         return getattr(self._inner, name)
 
-    def step(self):
-        self._inner.step()
+    def step(self, **kwargs):
+        # kwargs pass through so wrapped optimizers with richer step
+        # contracts (AdaptiveLocalSGD's step(loss=...)) keep working
+        self._inner.step(**kwargs)
         # pruned weights stay pruned (reference OptimizerWithSparsityGuarantee)
         ASPHelper.reapply(self._inner._parameters)
 

@@ -49,6 +49,7 @@ from .norm import (  # noqa: F401
     InstanceNorm3D,
     LayerNorm,
     LocalResponseNorm,
+    SpectralNorm,
     RMSNorm,
     SyncBatchNorm,
 )

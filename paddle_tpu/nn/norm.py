@@ -1,5 +1,8 @@
 """Normalization layers (reference python/paddle/nn/layer/norm.py)."""
 
+import numpy as np
+
+import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
@@ -185,6 +188,65 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization: divide a weight by its largest singular
+    value, estimated by persistent power iteration
+    (reference python/paddle/nn/layer/norm.py SpectralNorm /
+    spectral_norm_hook.py; phi spectral_norm kernel).
+
+    ``forward(weight)`` reshapes the weight so ``dim`` leads ([H, W],
+    W = product of the rest), runs ``power_iters`` u/v updates against
+    the persistent buffers, and returns ``weight / sigma``.
+    """
+
     def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12):
         super().__init__()
-        raise NotImplementedError("SpectralNorm arrives with the GAN model zoo")
+        self.dim = int(dim)
+        self.power_iters = int(power_iters)
+        self.eps = float(eps)
+        self._shape = list(weight_shape)
+        h = int(weight_shape[self.dim])
+        w = int(np.prod([d for i, d in enumerate(weight_shape)
+                         if i != self.dim]))
+        from ..framework.random import get_rng_key
+
+        key = get_rng_key()
+        ku, kv = jax.random.split(key)
+        u = jax.random.normal(ku, (h,), jnp.float32)
+        v = jax.random.normal(kv, (w,), jnp.float32)
+        self.register_buffer(
+            "weight_u", Tensor(u / jnp.maximum(jnp.linalg.norm(u),
+                                               self.eps)))
+        self.register_buffer(
+            "weight_v", Tensor(v / jnp.maximum(jnp.linalg.norm(v),
+                                               self.eps)))
+
+    def forward(self, weight):
+        x = weight._data if isinstance(weight, Tensor) else \
+            jnp.asarray(weight)
+        perm = [self.dim] + [i for i in range(x.ndim) if i != self.dim]
+        mat = jnp.transpose(x, perm).reshape(x.shape[self.dim], -1)
+        matf = mat.astype(jnp.float32)
+        u = self._buffers["weight_u"]._data
+        v = self._buffers["weight_v"]._data
+        # power iteration runs OUTSIDE the autograd chain (the reference
+        # marks u/v stop_gradient and treats sigma's u/v as constants)
+        m_const = jax.lax.stop_gradient(matf)
+        for _ in range(self.power_iters):
+            v = m_const.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), self.eps)
+            u = m_const @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), self.eps)
+        self._buffers["weight_u"].set_value(u)
+        self._buffers["weight_v"].set_value(v)
+        from ..ops.dispatch import apply_op
+
+        w_t = weight if isinstance(weight, Tensor) else Tensor(x)
+
+        def fn(wd):
+            md = jnp.transpose(wd, perm).reshape(
+                wd.shape[self.dim], -1).astype(jnp.float32)
+            sigma = u @ md @ v
+            return (wd.astype(jnp.float32) /
+                    jnp.maximum(sigma, self.eps)).astype(wd.dtype)
+
+        return apply_op("spectral_norm", fn, (w_t,), {})

@@ -16,3 +16,26 @@ from .vit import (  # noqa: F401
     vit_large_patch16_224,
     vit_tiny,
 )
+from .densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+)
+from .squeezenet import (  # noqa: F401
+    SqueezeNet,
+    squeezenet1_0,
+    squeezenet1_1,
+)
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_33,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+)
+from .inception import InceptionV3, inception_v3  # noqa: F401

@@ -125,35 +125,37 @@ class TestMultiprocessDataLoader:
     def test_slow_dataset_overlaps_with_consumer(self):
         """Multiprocess fetches must actually run concurrently.
 
-        Asserted STRUCTURALLY (fetch intervals recorded inside the items
-        overlap in time) instead of racing wall clocks — sleeps need no
-        CPU, so suite-wide load can't flake this the way the old
-        parallel-vs-serial timing comparison did."""
-        import time
+        Proven by RENDEZVOUS, not clocks: items 0 and 2 (dispatched to
+        different round-robin workers) wait on a shared 2-party barrier
+        — it only releases if both fetches are in flight at once.
+        Blocked waiters need no CPU, so suite-wide load can't flake
+        this the way interval/wall-clock comparisons did (round-3 known
+        flake)."""
+        import multiprocessing as mp
+
+        barrier = mp.get_context("fork").Barrier(2)
 
         class Slow(io.Dataset):
             def __len__(self):
                 return 12
 
             def __getitem__(self, i):
-                # float32 canonicalization (TPU int/float widths) eats
-                # epoch-seconds precision — record modulo a small base so
-                # ~12ms resolution survives the dtype
-                t0 = time.time() % 100000.0
-                time.sleep(0.1)
-                return np.array([i, t0, time.time() % 100000.0],
-                                np.float64)
+                met = 0.0
+                if i in (0, 2):   # different workers under round-robin
+                    try:
+                        barrier.wait(timeout=60)
+                        met = 1.0
+                    except Exception:
+                        met = 0.0
+                return np.array([i, met], np.float64)
 
         loader = io.DataLoader(Slow(), batch_size=2, num_workers=4)
-        rows = np.concatenate([b.numpy().reshape(-1, 3) for b in loader])
+        rows = np.concatenate([b.numpy().reshape(-1, 2) for b in loader])
         assert len(rows) == 12
         assert sorted(rows[:, 0].astype(int)) == list(range(12))
-        intervals = sorted((float(r[1]), float(r[2])) for r in rows)
-        if any(e < s for s, e in intervals):
-            pytest.skip("timer wrapped the modulo base mid-test")
-        overlaps = sum(1 for (s1, e1), (s2, e2)
-                       in zip(intervals, intervals[1:]) if s2 < e1)
-        assert overlaps >= 1, intervals
+        met = {int(r[0]): r[1] for r in rows}
+        assert met[0] == 1.0 and met[2] == 1.0, \
+            "items 0 and 2 never overlapped: workers are serialized"
 
     def test_user_collate_type_consistent_across_num_workers(self):
         """Batch types must not depend on num_workers (Tensor round-trips

@@ -562,3 +562,106 @@ class TestParallelTuner:
         assert mesh.devices.shape == (2, 2, 2)
         with pytest.raises(ValueError):
             Mapper().build_mesh(dp=3, mp=1, pp=1)
+
+
+class TestRound4MetaOptimizers:
+    def test_adaptive_localsgd_schedule_follows_reference_formula(self):
+        """Reference adaptive schedule (localsgd_optimizer.py
+        AdaptiveLocalSGD): next_k = clip(ceil(sqrt(lr0*loss /
+        (lr*loss0) * init_k)), 1, 16).  With loss == loss0 at fixed lr
+        the first sync sets k = ceil(sqrt(init_k)); a 16x loss drop
+        then drives k to 1."""
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            AdaptiveLocalSGDOptimizer,
+        )
+
+        m, x = _model_and_data()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=m.parameters())
+        a = AdaptiveLocalSGDOptimizer(opt, init_k_steps=16, begin_step=1)
+
+        def run(lv, n):
+            for _ in range(n):
+                out = m(x)
+                loss = (out * 0.0).sum() + lv  # controlled loss value
+                loss.backward()
+                a.step(loss=loss)
+                a.clear_grad()
+
+        run(4.0, 16)       # pins loss0=4, lr0=0.1; sync at step 16
+        # ratio 1.0 -> k = ceil(sqrt(1 * 16)) = 4
+        assert a.k_steps == 4, a.k_steps
+        run(0.25, 4)       # next sync: ratio 1/16 -> ceil(sqrt(1)) = 1
+        assert a.k_steps == 1, a.k_steps
+        run(400.0, 1)      # loss blowup: ratio 100 -> sqrt(1600)=40,
+        assert a.k_steps == 16  # clipped to the max of 16
+
+    def test_adaptive_localsgd_strategy_wiring(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            AdaptiveLocalSGDOptimizer,
+            apply_strategy_to_optimizer,
+        )
+
+        m, _ = _model_and_data()
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=m.parameters())
+        s = DistributedStrategy()
+        s.adaptive_localsgd = True
+        s.adaptive_localsgd_configs = {"init_k_steps": 4}
+        wrapped = apply_strategy_to_optimizer(opt, s)
+        assert isinstance(wrapped, AdaptiveLocalSGDOptimizer)
+        assert wrapped.init_k_steps == 4
+
+    def test_asp_strategy_keeps_pruned_weights_pruned(self):
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            apply_strategy_to_optimizer,
+        )
+        from paddle_tpu.incubate.asp import calculate_density, prune_model
+
+        paddle.seed(0)
+        m = nn.Linear(8, 8)
+        prune_model(m)   # 2:4 masks
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=m.parameters())
+        s = DistributedStrategy()
+        s.asp = True
+        wrapped = apply_strategy_to_optimizer(opt, s)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .rand(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = (m(x) ** 2).sum()
+            loss.backward()
+            wrapped.step()
+            wrapped.clear_grad()
+        # density stays exactly 0.5: the strategy-wired optimizer
+        # re-applies the masks after every step
+        assert abs(calculate_density(m.weight.numpy()) - 0.5) < 1e-6
+
+    def test_asp_over_adaptive_localsgd_composes(self):
+        """Review regression: the ASP wrapper must pass step(loss=...)
+        through to AdaptiveLocalSGD underneath."""
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            apply_strategy_to_optimizer,
+        )
+        from paddle_tpu.incubate.asp import calculate_density, prune_model
+
+        paddle.seed(0)
+        m = nn.Linear(8, 8)
+        prune_model(m)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=m.parameters())
+        s = DistributedStrategy()
+        s.asp = True
+        s.adaptive_localsgd = True
+        wrapped = apply_strategy_to_optimizer(opt, s)
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .rand(4, 8).astype(np.float32))
+        for _ in range(3):
+            loss = (m(x) ** 2).sum()
+            loss.backward()
+            wrapped.step(loss=loss)   # must not TypeError
+            wrapped.clear_grad()
+        assert abs(calculate_density(m.weight.numpy()) - 0.5) < 1e-6

@@ -250,3 +250,51 @@ class TestReviewRegressions:
         out = F.gumbel_softmax(x, hard=True)
         np.testing.assert_allclose(out.numpy().sum(-1), np.ones(4), rtol=1e-5)
         assert ((out.numpy() == out.numpy().max(-1, keepdims=True)).sum(-1) == 1).all()
+
+
+class TestSpectralNorm:
+    def test_sigma_converges_to_largest_singular_value(self):
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(6, 4).astype(np.float32)
+        paddle.seed(3)
+        sn = nn.SpectralNorm(w.shape, dim=0, power_iters=30)
+        out = sn(paddle.to_tensor(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(np.asarray(out.numpy()), w / sigma,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_power_iteration_state_persists(self):
+        """One iteration per call converges over CALLS (the buffers are
+        persistent — reference spectral_norm semantics)."""
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(1)
+        w = rng.randn(5, 5).astype(np.float32)
+        paddle.seed(4)
+        sn = nn.SpectralNorm(w.shape, dim=0, power_iters=1)
+        for _ in range(40):
+            out = sn(paddle.to_tensor(w))
+        sigma = np.linalg.svd(w, compute_uv=False)[0]
+        np.testing.assert_allclose(np.asarray(out.numpy()), w / sigma,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv_weight_dim1_and_grads(self):
+        from paddle_tpu import nn
+
+        rng = np.random.RandomState(2)
+        w = paddle.to_tensor(rng.randn(3, 4, 2, 2).astype(np.float32))
+        w.stop_gradient = False
+        paddle.seed(5)
+        sn = nn.SpectralNorm([3, 4, 2, 2], dim=1, power_iters=10)
+        out = sn(w)
+        assert tuple(out.shape) == (3, 4, 2, 2)
+        out.sum().backward()
+        assert w.grad is not None
+        assert np.isfinite(np.asarray(w.grad.numpy())).all()
+        mat = np.transpose(w.numpy(), (1, 0, 2, 3)).reshape(4, -1)
+        sigma = np.linalg.svd(mat, compute_uv=False)[0]
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   w.numpy() / sigma, rtol=1e-3,
+                                   atol=1e-4)
