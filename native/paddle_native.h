@@ -82,6 +82,13 @@ void    pd_table_set_ctr(void* table, float nonclk_coeff, float click_coeff,
                          int delete_after_unseen_days);
 void    pd_table_push_delta(void* table, const int64_t* keys,
                             const float* deltas, int64_t n);
+int     pd_table_geo_init(void* table, int trainer_num);
+int     pd_table_geo_push(void* table, int trainer_id,
+                          const int64_t* keys, const float* deltas,
+                          int64_t n);
+int64_t pd_table_geo_pull_count(void* table, int trainer_id);
+int64_t pd_table_geo_pull(void* table, int trainer_id, int64_t* keys_out,
+                          float* vals_out, int64_t max_n);
 void    pd_table_push_show_click(void* table, const int64_t* keys,
                                  const float* shows, const float* clicks,
                                  int64_t n);
@@ -90,6 +97,14 @@ void    pd_table_get_meta(void* table, const int64_t* keys, int64_t n,
 int64_t pd_table_shrink(void* table);
 int     pd_ps_client_push_delta(void* client, const int64_t* keys,
                                 const float* deltas, int64_t n);
+int     pd_ps_client_geo_init(void* client, int32_t trainer_num);
+int     pd_ps_client_geo_push(void* client, int32_t trainer_id,
+                              const int64_t* keys, const float* deltas,
+                              int64_t n);
+int64_t pd_ps_client_geo_pull_count(void* client, int32_t trainer_id);
+int64_t pd_ps_client_geo_pull(void* client, int32_t trainer_id,
+                              int64_t* keys_out, float* vals_out,
+                              int64_t max_n);
 int     pd_ps_client_push_show_click(void* client, const int64_t* keys,
                                      const float* shows, const float* clicks,
                                      int64_t n);

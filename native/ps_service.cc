@@ -68,6 +68,11 @@ enum PsOp : uint8_t {
   kShowClick = 8,   // CTR accessor stats
   kShrink = 9,      // decay + evict cycle; replies evicted count
   kStats = 10,      // (mem_rows, disk_rows)
+  kGeoInit = 11,    // i32 trainer_num — enable per-trainer delta queues
+  kGeoPush = 12,    // i32 trainer_id | i64 n | keys[n] | deltas[n*dim]
+  kGeoPull = 13,    // i32 trainer_id | i64 max_n -> i64 n|keys|rows
+  kGeoPullCount = 14,  // i32 trainer_id -> i64 queued (client buffer
+                       // sizing: 12 bytes in must not buy GiB allocs)
   // graph table verbs (GraphPS role; server started with a graph handle)
   kGraphAddEdges = 20,  // i64 n | u8 weighted | src[n] | dst[n] | [w[n]]
   kGraphSample = 21,    // i64 n | i32 k | nodes[n] -> nbrs[n*k]|counts[n]
@@ -233,6 +238,72 @@ void handle_conn(PsServer* s, ConnRec* rec) try {
             reinterpret_cast<const float*>(payload.data() + 8 + n * 8);
         pd_table_push_delta(s->table, keys, deltas, n);
         reply(fd, 0, nullptr, 0);
+        break;
+      }
+      case kGeoInit: {
+        if (plen != 4) { reply(fd, -3, nullptr, 0); break; }
+        int32_t tn;
+        memcpy(&tn, payload.data(), 4);
+        int rc = pd_table_geo_init(s->table, tn);
+        reply(fd, rc, nullptr, 0);
+        break;
+      }
+      case kGeoPush: {
+        if (plen < 12) { reply(fd, -3, nullptr, 0); break; }
+        int32_t tid;
+        int64_t n;
+        memcpy(&tid, payload.data(), 4);
+        memcpy(&n, payload.data() + 4, 8);
+        if (n < 0 || static_cast<uint64_t>(n) > plen / 8 ||
+            static_cast<uint64_t>(n) * dim > kMaxRowFloats ||
+            plen != 12 + static_cast<uint64_t>(n) * 8 +
+                         static_cast<uint64_t>(n) * dim * 4) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        const int64_t* keys =
+            reinterpret_cast<const int64_t*>(payload.data() + 12);
+        const float* deltas =
+            reinterpret_cast<const float*>(payload.data() + 12 + n * 8);
+        int rc = pd_table_geo_push(s->table, tid, keys, deltas, n);
+        reply(fd, rc == 0 ? 0 : -4, nullptr, 0);
+        break;
+      }
+      case kGeoPull: {
+        if (plen != 12) { reply(fd, -3, nullptr, 0); break; }
+        int32_t tid;
+        int64_t max_n;
+        memcpy(&tid, payload.data(), 4);
+        memcpy(&max_n, payload.data() + 4, 8);
+        if (max_n < 0 || static_cast<uint64_t>(max_n) * dim >
+                             kMaxRowFloats) {
+          reply(fd, -3, nullptr, 0);
+          break;
+        }
+        // buffers size from the REAL queue, never the client's max_n:
+        // a 12-byte frame must not buy multi-GiB allocations
+        int64_t queued = pd_table_geo_pull_count(s->table, tid);
+        if (queued < 0) { reply(fd, -4, nullptr, 0); break; }
+        max_n = std::min(max_n, queued);
+        std::vector<int64_t> keys(max_n);
+        std::vector<float> vals(static_cast<size_t>(max_n) * dim);
+        int64_t got = pd_table_geo_pull(s->table, tid, keys.data(),
+                                        vals.data(), max_n);
+        if (got < 0) { reply(fd, -4, nullptr, 0); break; }
+        std::string out(8 + got * 8 + got * dim * 4, '\0');
+        memcpy(&out[0], &got, 8);
+        memcpy(&out[8], keys.data(), got * 8);
+        memcpy(&out[8 + got * 8], vals.data(), got * dim * 4);
+        reply(fd, 0, out.data(), out.size());
+        break;
+      }
+      case kGeoPullCount: {
+        if (plen != 4) { reply(fd, -3, nullptr, 0); break; }
+        int32_t tid;
+        memcpy(&tid, payload.data(), 4);
+        int64_t queued = pd_table_geo_pull_count(s->table, tid);
+        if (queued < 0) { reply(fd, -4, nullptr, 0); break; }
+        reply(fd, 0, &queued, 8);
         break;
       }
       case kShowClick: {
@@ -638,6 +709,66 @@ int pd_ps_client_push_delta(void* client, const int64_t* keys,
   std::string data;
   if (!ps_request(c, kPushDelta, payload, &rc, &data)) return -1;
   return rc;
+}
+
+int pd_ps_client_geo_init(void* client, int32_t trainer_num) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload(reinterpret_cast<const char*>(&trainer_num), 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGeoInit, payload, &rc, &data)) return -1;
+  return rc;
+}
+
+int pd_ps_client_geo_push(void* client, int32_t trainer_id,
+                          const int64_t* keys, const float* deltas,
+                          int64_t n) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&trainer_id), 4);
+  payload.append(reinterpret_cast<const char*>(&n), 8);
+  payload.append(reinterpret_cast<const char*>(keys), n * 8);
+  payload.append(reinterpret_cast<const char*>(deltas),
+                 static_cast<size_t>(n) * c->dim * 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGeoPush, payload, &rc, &data)) return -1;
+  return rc;
+}
+
+int64_t pd_ps_client_geo_pull_count(void* client, int32_t trainer_id) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload(reinterpret_cast<const char*>(&trainer_id), 4);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGeoPullCount, payload, &rc, &data) || rc != 0 ||
+      data.size() != 8)
+    return -1;
+  int64_t queued;
+  memcpy(&queued, data.data(), 8);
+  return queued;
+}
+
+int64_t pd_ps_client_geo_pull(void* client, int32_t trainer_id,
+                              int64_t* keys_out, float* vals_out,
+                              int64_t max_n) {
+  auto* c = static_cast<PsClient*>(client);
+  std::string payload;
+  payload.append(reinterpret_cast<const char*>(&trainer_id), 4);
+  payload.append(reinterpret_cast<const char*>(&max_n), 8);
+  int32_t rc;
+  std::string data;
+  if (!ps_request(c, kGeoPull, payload, &rc, &data) || rc != 0)
+    return -1;
+  if (data.size() < 8) return -1;
+  int64_t got;
+  memcpy(&got, data.data(), 8);
+  if (got < 0 || data.size() !=
+      8 + static_cast<size_t>(got) * (8 + c->dim * 4)) return -1;
+  memcpy(keys_out, data.data() + 8, got * 8);
+  memcpy(vals_out, data.data() + 8 + got * 8,
+         static_cast<size_t>(got) * c->dim * 4);
+  return got;
 }
 
 int pd_ps_client_push_show_click(void* client, const int64_t* keys,

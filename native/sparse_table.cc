@@ -26,6 +26,7 @@
 #include <mutex>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -77,6 +78,18 @@ struct Table {
   std::atomic<int64_t> spill_dead{0};  // bytes of superseded records
   std::mutex spill_mu;    // serializes appends (preads are lock-free)
   std::string spill_path;
+  // geo recorder (reference geo_recorder.h ConcurrentSet role): when a
+  // trainer ships deltas, the touched keys enter every OTHER trainer's
+  // dirty set; geo_pull drains a trainer's set as (key, current row)
+  // pairs — changed rows only, the server-initiated pull schedule.
+  // Dirty sets shard by key with per-shard mutexes so concurrent
+  // trainer pushes scale like the row store (review regression: one
+  // table-global mutex serialized the whole geo path).
+  int geo_trainers = 0;               // 0 = geo mode off
+  // geo_dirty[trainer][shard]
+  std::vector<std::vector<std::unordered_set<int64_t>>> geo_dirty;
+  std::mutex geo_locks[kNumShards];
+  std::mutex geo_mu;                  // guards init only
 };
 
 inline int shard_of(int64_t key) {
@@ -408,6 +421,76 @@ void pd_table_push_delta(void* table, const int64_t* keys,
     const float* d = deltas + i * t->dim;
     for (int j = 0; j < t->dim; ++j) r->w[j] += d[j];
   }
+}
+
+// Geo mode (reference memory_sparse_geo_table.h + geo_recorder.h):
+// per-trainer dirty-key queues so trainers pull CHANGED rows only.
+
+int pd_table_geo_init(void* table, int trainer_num) {
+  auto* t = static_cast<Table*>(table);
+  if (trainer_num <= 0) return -1;
+  std::lock_guard<std::mutex> lk(t->geo_mu);
+  if (t->geo_trainers == trainer_num) return 0;  // idempotent: every
+  // trainer calls this at startup; re-init must not drop queued deltas
+  if (t->geo_trainers != 0) return -2;           // conflicting world
+  t->geo_dirty.assign(
+      trainer_num, std::vector<std::unordered_set<int64_t>>(kNumShards));
+  t->geo_trainers = trainer_num;
+  return 0;
+}
+
+int pd_table_geo_push(void* table, int trainer_id, const int64_t* keys,
+                      const float* deltas, int64_t n) {
+  auto* t = static_cast<Table*>(table);
+  // invalid trainer ids must fail loudly BEFORE mutating anything: an
+  // out-of-range id would pollute every queue including the sender's
+  // (review regression)
+  if (trainer_id < 0 || trainer_id >= t->geo_trainers) return -1;
+  pd_table_push_delta(table, keys, deltas, n);
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->geo_locks[s]);
+    for (int64_t i = 0; i < n; ++i) {
+      if (shard_of(keys[i]) != s) continue;
+      for (int tr = 0; tr < t->geo_trainers; ++tr) {
+        if (tr != trainer_id) t->geo_dirty[tr][s].insert(keys[i]);
+      }
+    }
+  }
+  return 0;
+}
+
+int64_t pd_table_geo_pull_count(void* table, int trainer_id) {
+  auto* t = static_cast<Table*>(table);
+  if (trainer_id < 0 || trainer_id >= t->geo_trainers) return -1;
+  int64_t total = 0;
+  for (int s = 0; s < kNumShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->geo_locks[s]);
+    total += static_cast<int64_t>(t->geo_dirty[trainer_id][s].size());
+  }
+  return total;
+}
+
+int64_t pd_table_geo_pull(void* table, int trainer_id, int64_t* keys_out,
+                          float* vals_out, int64_t max_n) {
+  auto* t = static_cast<Table*>(table);
+  if (trainer_id < 0 || trainer_id >= t->geo_trainers) return -1;
+  std::vector<int64_t> keys;
+  for (int s = 0; s < kNumShards &&
+       static_cast<int64_t>(keys.size()) < max_n; ++s) {
+    std::lock_guard<std::mutex> lk(t->geo_locks[s]);
+    auto& set = t->geo_dirty[trainer_id][s];
+    for (auto it = set.begin();
+         it != set.end() && static_cast<int64_t>(keys.size()) < max_n;) {
+      keys.push_back(*it);
+      it = set.erase(it);
+    }
+  }
+  // rows are read AFTER the sets drain: a concurrent push between the
+  // drain and this read re-inserts the key, so no update is lost
+  pd_table_pull(table, keys.data(), static_cast<int64_t>(keys.size()),
+                vals_out);
+  memcpy(keys_out, keys.data(), keys.size() * sizeof(int64_t));
+  return static_cast<int64_t>(keys.size());
 }
 
 // CTR stats accumulation (reference CtrCommonPushValue show/click)

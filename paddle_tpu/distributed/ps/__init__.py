@@ -75,6 +75,21 @@ def _lib():
             ctypes.POINTER(ctypes.c_float)]
         lib.pd_table_shrink.restype = ctypes.c_int64
         lib.pd_table_shrink.argtypes = [ctypes.c_void_p]
+        lib.pd_table_geo_init.restype = ctypes.c_int
+        lib.pd_table_geo_init.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.pd_table_geo_push.restype = ctypes.c_int
+        lib.pd_table_geo_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        lib.pd_table_geo_pull.restype = ctypes.c_int64
+        lib.pd_table_geo_pull.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        lib.pd_table_geo_pull_count.restype = ctypes.c_int64
+        lib.pd_table_geo_pull_count.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int]
         lib.pd_table_create._bound = True
     return lib
 
@@ -191,6 +206,43 @@ class SparseTable:
             np.asarray(deltas, np.float32).reshape(len(keys), self.dim))
         self._lib.pd_table_push_delta(self._h, _i64p(keys), _f32p(deltas),
                                       len(keys))
+
+    def geo_init(self, trainer_num):
+        """Enable per-trainer delta queues (reference geo_recorder.h)."""
+        rc = self._lib.pd_table_geo_init(self._h, int(trainer_num))
+        if rc != 0:
+            raise ValueError(f"geo_init failed rc={rc}")
+
+    def geo_push(self, trainer_id, keys, deltas):
+        """Apply deltas AND record the keys into every other trainer's
+        dirty queue (memory_sparse_geo_table PushSparse)."""
+        keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
+                                    dtype=np.int64)
+        deltas = np.ascontiguousarray(
+            np.asarray(deltas, np.float32).reshape(len(keys), self.dim))
+        rc = self._lib.pd_table_geo_push(self._h, int(trainer_id),
+                                         _i64p(keys), _f32p(deltas),
+                                         len(keys))
+        if rc != 0:
+            raise ValueError(
+                f"geo_push: trainer_id {trainer_id} out of range "
+                "(geo_init first?)")
+
+    def geo_pull(self, trainer_id, max_n=1 << 20):
+        """Drain this trainer's dirty queue: (keys, current rows) for
+        CHANGED keys only (memory_sparse_geo_table PullGeoParam)."""
+        n = int(self._lib.pd_table_geo_pull_count(self._h,
+                                                  int(trainer_id)))
+        if n < 0:
+            raise ValueError("geo mode not initialized for this trainer")
+        n = min(n, int(max_n))
+        keys = np.empty((max(n, 1),), np.int64)
+        vals = np.empty((max(n, 1), self.dim), np.float32)
+        got = int(self._lib.pd_table_geo_pull(
+            self._h, int(trainer_id), _i64p(keys), _f32p(vals), n))
+        if got < 0:
+            raise ValueError("geo_pull failed")
+        return keys[:got], vals[:got]
 
     def get_meta(self, keys):
         """(show, click, unseen_days) per key; -1 rows for absent keys."""
