@@ -44,6 +44,7 @@ class DistributedStrategy:
         self.adaptive_localsgd_configs = {"init_k_steps": 1,
                                           "begin_step": 1}
         self.asp = False
+        self.fp16_allreduce = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
         self.nccl_comm_num = 1

@@ -3,18 +3,20 @@
 Reference: python/paddle/distributed/fleet/meta_optimizers/ (21 program
 -rewriting passes chosen by StrategyCompiler / meta_optimizer_factory).
 On TPU there is no Program to rewrite: each strategy becomes either an
-optimizer wrapper (gradient merge, localsgd, DGC, LARS/LAMB swap) or a
-model wrapper (recompute) applied by ``fleet.distributed_optimizer`` /
+optimizer wrapper (gradient merge, localsgd + adaptive localsgd, DGC,
+fp16 allreduce, ASP sparsity guarantee, LARS/LAMB swap) or a model
+wrapper (recompute) applied by ``fleet.distributed_optimizer`` /
 ``fleet.distributed_model`` from the same ``DistributedStrategy`` fields
 the reference reads.
 
 Strategies that dissolve into the compiler rather than a wrapper:
-``fp16_allreduce`` — under GSPMD the gradients ARE bf16 inside the
-compiled step when ``amp.decorate(O2)`` is on, so the reduced payload
-already rides the collectives; ``fuse_all_reduce_ops``/``fuse_grad_
-merge`` — XLA fuses and schedules collectives itself; ``pipeline``/
-``sharding``/``tensor_parallel`` — handled structurally by
-``parallel.SpmdTrainStep`` + mesh axes, not by optimizer rewrites.
+``fuse_all_reduce_ops``/``fuse_grad_merge`` — XLA fuses and schedules
+collectives itself; ``pipeline``/``sharding``/``tensor_parallel`` —
+handled structurally by ``parallel.SpmdTrainStep`` + mesh axes, not by
+optimizer rewrites.  (``fp16_allreduce`` is NOT a dissolution on the
+eager multi-process path — there the gradient bytes really cross DCN —
+so it gets a wrapper; under compiled SPMD with ``amp.decorate(O2)`` the
+collectives already carry bf16.)
 """
 
 import numpy as np
@@ -26,7 +28,8 @@ from ...core.tensor import Tensor
 
 __all__ = ["GradientMergeOptimizer", "LocalSGDOptimizer",
            "AdaptiveLocalSGDOptimizer", "DGCMomentumOptimizer",
-           "apply_strategy_to_optimizer", "apply_recompute_to_model"]
+           "FP16AllReduceOptimizer", "apply_strategy_to_optimizer",
+           "apply_recompute_to_model"]
 
 
 class _OptimizerWrapper:
@@ -97,6 +100,35 @@ class LocalSGDOptimizer(_OptimizerWrapper):
             # AVG (pmean) does the reduce and the 1/world scaling in one
             # collective; all_reduce is in-place on Tensors
             dist.all_reduce(p, op=dist.ReduceOp.AVG, group=self.group)
+
+
+class FP16AllReduceOptimizer(_OptimizerWrapper):
+    """Compress the gradient allreduce to fp16
+    (reference meta_optimizers/fp16_allreduce_optimizer.py): before the
+    inner step, each gradient is cast to fp16, averaged across the
+    data-parallel group, and cast back — halving cross-host gradient
+    traffic on the eager multi-process path.  (Under jit/SPMD the
+    gradient mean is an XLA collective and this wrapper is unnecessary;
+    it exists for eager loops over the gloo/DCN backend, where the wire
+    bytes are real.)"""
+
+    def __init__(self, inner, group=None):
+        super().__init__(inner)
+        self.group = group
+
+    def step(self, **kwargs):
+        from .. import communication as dist
+
+        for p in self._inner._parameters:
+            if p.grad is None or p.stop_gradient:
+                continue
+            orig_dtype = p.grad._data.dtype
+            g16 = Tensor(p.grad._data.astype(jnp.float16),
+                         stop_gradient=True)
+            dist.all_reduce(g16, op=dist.ReduceOp.AVG, group=self.group)
+            p.grad = Tensor(g16._data.astype(orig_dtype),
+                            stop_gradient=True)
+        self._inner.step(**kwargs)
 
 
 class AdaptiveLocalSGDOptimizer(LocalSGDOptimizer):
@@ -224,10 +256,22 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
     """StrategyCompiler parity: stack the wrappers the strategy asks for.
 
     Order mirrors the reference compiler: optimizer swap (lars/lamb) →
-    compression (dgc) → accumulation (gradient_merge) → comm reduction
-    (localsgd)."""
+    compression (dgc, fp16 allreduce) → accumulation (gradient_merge,
+    so the merged gradient is allreduced ONCE, not per micro-step) →
+    comm reduction (localsgd)."""
     if strategy is None:
         return optimizer
+    if getattr(strategy, "fp16_allreduce", False) and (
+            getattr(strategy, "localsgd", False)
+            or getattr(strategy, "adaptive_localsgd", False)):
+        # a localsgd program HAS no per-step grad allreduce to compress
+        # (reference fp16_allreduce only rewrites existing allreduce
+        # ops); stacking them would silently reintroduce per-step sync
+        raise ValueError(
+            "fp16_allreduce cannot combine with localsgd/"
+            "adaptive_localsgd: LocalSGD removes the per-step gradient "
+            "allreduce that fp16_allreduce compresses")
+    dp_group = hcg.get_data_parallel_group() if hcg is not None else None
 
     if getattr(strategy, "lamb", False) and \
             type(optimizer).__name__ not in ("Lamb",):
@@ -266,6 +310,12 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
         optimizer = DGCMomentumOptimizer(
             optimizer, sparsity=cfg.get("sparsity", 0.9),
             momentum=0.9 if momentum is None else float(momentum))
+    if getattr(strategy, "fp16_allreduce", False):
+        # BEFORE gradient_merge: the merge wrapper then gates this step,
+        # so the merged gradient crosses the wire once (review
+        # regression — outside-the-merge compounded fp16 quantization
+        # per micro-step and could overflow the unscaled sum)
+        optimizer = FP16AllReduceOptimizer(optimizer, group=dp_group)
     if getattr(strategy, "gradient_merge", False):
         cfg = strategy.gradient_merge_configs
         optimizer = GradientMergeOptimizer(
@@ -273,19 +323,13 @@ def apply_strategy_to_optimizer(optimizer, strategy, hcg=None):
             avg=cfg.get("avg", True))
     if getattr(strategy, "adaptive_localsgd", False):
         cfg = getattr(strategy, "adaptive_localsgd_configs", None) or {}
-        dp_group = None
-        if hcg is not None:
-            dp_group = hcg.get_data_parallel_group()
         optimizer = AdaptiveLocalSGDOptimizer(
             optimizer, init_k_steps=cfg.get("init_k_steps", 1),
             begin_step=cfg.get("begin_step", 1), group=dp_group)
     elif getattr(strategy, "localsgd", False):
+        # hybrid runs average over the DP axis only — the world group
+        # would mix mp/pp shards holding different tensors
         cfg = getattr(strategy, "localsgd_configs", None) or {}
-        dp_group = None
-        if hcg is not None:
-            # hybrid runs must average over the DP axis only — the world
-            # group would mix mp/pp shards holding different tensors
-            dp_group = hcg.get_data_parallel_group()
         optimizer = LocalSGDOptimizer(optimizer,
                                       k_steps=cfg.get("k_steps", 4),
                                       group=dp_group)

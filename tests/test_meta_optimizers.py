@@ -665,3 +665,61 @@ class TestRound4MetaOptimizers:
             wrapped.step(loss=loss)   # must not TypeError
             wrapped.clear_grad()
         assert abs(calculate_density(m.weight.numpy()) - 0.5) < 1e-6
+
+    def test_fp16_allreduce_quantizes_grads_before_step(self):
+        """The wrapper must round-trip gradients through fp16 (the wire
+        format): a value that fp16 can't represent exactly shows the
+        quantization, and the strategy compiler wires it."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            FP16AllReduceOptimizer,
+            apply_strategy_to_optimizer,
+        )
+        import jax.numpy as jnp
+
+        m, _ = _model_and_data()
+        s = DistributedStrategy()
+        s.fp16_allreduce = True
+        opt = apply_strategy_to_optimizer(
+            optimizer.SGD(learning_rate=1.0, parameters=m.parameters()),
+            s)
+        assert isinstance(opt, FP16AllReduceOptimizer)
+        p = m.parameters()[0]
+        w0 = p.numpy().copy()
+        g = np.full(p.shape, 0.1, np.float32)   # 0.1 is inexact in fp16
+        p.grad = Tensor(jnp.asarray(g), stop_gradient=True)
+        opt.step()
+        applied = w0 - p.numpy()                # = lr * g_after_roundtrip
+        fp16_g = np.float32(np.float16(0.1))
+        np.testing.assert_allclose(applied, fp16_g, rtol=1e-7)
+        assert not np.allclose(applied, 0.1)    # quantization is real
+
+    def test_fp16_allreduce_composition_rules(self):
+        """Review regressions: merge wraps fp16 (one quantized allreduce
+        per MERGED update, not per micro-step); localsgd + fp16 refused."""
+        from paddle_tpu.distributed.fleet import DistributedStrategy
+        from paddle_tpu.distributed.fleet.meta_optimizers import (
+            FP16AllReduceOptimizer,
+            GradientMergeOptimizer,
+            apply_strategy_to_optimizer,
+        )
+
+        m, _ = _model_and_data()
+        s = DistributedStrategy()
+        s.fp16_allreduce = True
+        s.gradient_merge = True
+        s.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        opt = apply_strategy_to_optimizer(
+            optimizer.SGD(learning_rate=1.0, parameters=m.parameters()),
+            s)
+        assert isinstance(opt, GradientMergeOptimizer)
+        assert isinstance(opt._inner, FP16AllReduceOptimizer)
+
+        s2 = DistributedStrategy()
+        s2.fp16_allreduce = True
+        s2.localsgd = True
+        with pytest.raises(ValueError, match="localsgd"):
+            apply_strategy_to_optimizer(
+                optimizer.SGD(learning_rate=1.0,
+                              parameters=m.parameters()), s2)
