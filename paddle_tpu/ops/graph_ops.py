@@ -80,12 +80,38 @@ def send_uv(x, y, src_index, dst_index, message_op="add"):
     return _combine(xe, ye, message_op)
 
 
-@op()
+def _segment_pool_pure(x, seg, num=0, pool="sum"):
+    return _segment_reduce(x, jnp.asarray(seg, jnp.int32), num, pool)
+
+
 def segment_pool(x, segment_ids, pooltype="SUM"):
-    seg = jnp.asarray(segment_ids, jnp.int32)
-    num = int(jnp.max(seg)) + 1 if not isinstance(seg, jax.core.Tracer) \
-        else x.shape[0]
-    return _segment_reduce(x, seg, num, pooltype.lower())
+    """Segment reduction with the reference's [max_id+1, ...] output
+    shape.  The segment count is data-dependent, so it resolves on the
+    HOST and rides the dispatch as a static kwarg — the output shape is
+    then identical eager, under vjp, and in the cached executable (the
+    old in-trace fallback to x.shape[0] silently changed the shape
+    whenever the op was traced, caught by the round-4 grad sweep).  The
+    module-level pure fn keeps the dispatch cache warm (a per-call
+    closure would retrace every step — review regression)."""
+    from ..core.tensor import Tensor
+    from .dispatch import apply_op
+
+    seg_like = segment_ids._data if isinstance(segment_ids, Tensor) \
+        else segment_ids
+    if isinstance(seg_like, jax.core.Tracer):
+        raise ValueError(
+            "segment_pool needs CONCRETE segment_ids (the output shape "
+            "is max_id+1, which tracing can't see); under to_static "
+            "pass the ids as a python/numpy constant, not a traced "
+            "tensor argument")
+    seg_np = np.asarray(seg_like).astype(np.int32)
+    num = int(seg_np.max()) + 1 if seg_np.size else 0
+    return apply_op("segment_pool", _segment_pool_pure,
+                    (x, segment_ids),
+                    {"num": num, "pool": pooltype.lower()})
+
+
+register_external("segment_pool", segment_pool)
 
 
 # ---- host-side (dynamic-output) graph sampling ops ----

@@ -357,6 +357,7 @@ spec("fill_diagonal", lambda rng: ((_u(rng, (3, 3)), 9.0), {}),
      ref=lambda x: (lambda c: (np.fill_diagonal(c, 9.0), c)[1])(x.copy()))
 spec("fill_diagonal_tensor",
      lambda rng: ((_u(rng, (3, 3)), _u(rng, (3,))), {}),
+     grad=(0, 1),
      ref=lambda x, y: (lambda c: (np.fill_diagonal(c, y), c)[1])(x.copy()))
 spec("tril_indices", lambda rng: ((3,), {"col": 3}),
      ref=lambda col: np.stack(np.tril_indices(3, 0, col)))
@@ -484,7 +485,8 @@ spec("clip", lambda rng: ((_away(_u(rng, (3, 4), -2, 2), [-0.5, 0.5]),),
                           {"min": -0.5, "max": 0.5}),
      ref=lambda x, min, max: np.clip(x, min, max), grad=(0,))
 spec("clip_by_norm", lambda rng: ((_u(rng, (3, 4)), 0.5), {}),
-     ref=lambda x: x * min(1.0, 0.5 / np.linalg.norm(x)), rtol=1e-5)
+     ref=lambda x: x * min(1.0, 0.5 / np.linalg.norm(x)), rtol=1e-5,
+     grad=(0,))
 spec("scale", lambda rng: ((_u(rng, (3, 4)),),
                            {"scale": 2.0, "bias": 1.0}),
      ref=lambda x, scale, bias: (x * scale + bias).astype(F32), grad=(0,))
@@ -723,6 +725,7 @@ spec("dist", lambda rng: ((_u(rng, (3, 4)), _u(rng, (3, 4))), {"p": 2.0}),
 spec("spectral_norm",
      lambda rng: ((_u(rng, (4, 5)), _u(rng, (4,)), _u(rng, (5,))),
                   {"power_iters": 2}),
+     grad=(0,),
      check=R.spectral_norm_check)
 
 # ------------------------------------------------------------------ losses --
@@ -1044,6 +1047,7 @@ spec("send_uv",
          r.numpy(), a[0][[0, 1]] + a[1][[1, 2]], rtol=1e-5))
 spec("segment_pool",
      lambda rng: ((_u(rng, (4, 3)), np.array([0, 0, 1, 1], np.int32)), {}),
+     grad=(0,),
      check=lambda r, a, k: np.testing.assert_allclose(
          (r[0] if isinstance(r, (list, tuple)) else r).numpy(),
          np.stack([a[0][:2].sum(0), a[0][2:].sum(0)]), rtol=1e-5))
@@ -1119,6 +1123,7 @@ spec("to_sparse_csr", lambda rng: ((np.array([[1, 0], [0, 2.]], F32),), {}),
 spec("to_dense",
      lambda rng: ((np.array([[0, 1], [1, 0]], np.int64),
                    np.array([1., 2.], F32), [2, 2]), {}),
+     grad=(1,),
      check=lambda r, a, k: np.testing.assert_allclose(
          r.numpy(), [[0, 1], [2, 0]], rtol=1e-6))
 spec("values",
@@ -1240,12 +1245,12 @@ spec("max_pool3d_with_index",
 spec("unpool", lambda rng: ((_u(rng, (1, 1, 2, 2)),
                              np.array([[[[0, 3], [8, 15]]]], np.int64)),
                             {"kernel_size": 2, "strides": 2}),
-     check=R.unpool_check)
+     grad=(0,), check=R.unpool_check)
 spec("unpool3d", lambda rng: ((_u(rng, (1, 1, 2, 2, 2)),
                                np.arange(8).reshape(1, 1, 2, 2, 2)
                                .astype(np.int64) * 8), {"kernel_size": 2,
                                                         "strides": 2}),
-     check=R.unpool_check)
+     grad=(0,), check=R.unpool_check)
 
 # ----------------------------------------------------------- interp / vision
 
@@ -1395,13 +1400,13 @@ spec("roi_pool",
                    np.array([[0, 0, 4, 4.]], F32)),
                   {"boxes_num": np.array([1], np.int32), "pooled_height": 2,
                    "pooled_width": 2}),
-     check=_roi_pool_check)
+     grad=(0,), check=_roi_pool_check)
 spec("psroi_pool",
      lambda rng: ((_u(rng, (1, 8, 6, 6)),
                    np.array([[0.5, 0.5, 4.5, 4.5]], F32)),
                   {"boxes_num": np.array([1], np.int32), "pooled_height": 2,
                    "pooled_width": 2, "output_channels": 2}),
-     check=R.psroi_pool_check)
+     grad=(0,), check=R.psroi_pool_check)
 spec("generate_proposals",
      lambda rng: ((_pos(rng, (1, 2, 3, 3), 0.1, 0.9),
                    _u(rng, (1, 8, 3, 3), -0.1, 0.1),
