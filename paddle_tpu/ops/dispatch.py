@@ -135,12 +135,17 @@ def _run_vjp(vjp_fn, cots):
     return vjp_fn(cots)
 
 
-def apply_op(name, fn, args, kwargs):
+def apply_op(name, fn, args, kwargs, cacheable=True):
     """Run ``fn`` (pure jax) over ``args``/``kwargs`` with Tensors substituted.
 
     Any ``Tensor`` found anywhere in the (args, kwargs) pytree becomes a
     differentiable input; everything else is closed over as a static attribute.
     Returns Tensor-wrapped outputs mirroring the output pytree of ``fn``.
+
+    ``cacheable=False`` skips the dispatch cache entirely — for callers
+    whose ``fn`` is a fresh per-call closure (sparse conv rulebooks):
+    their keys would never repeat, so caching only pins the closure's
+    captured arrays in the LRU until eviction.
     """
     leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
     t_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
@@ -174,7 +179,7 @@ def apply_op(name, fn, args, kwargs):
 
     entry = None
     ban_key = None
-    if (_dispatch_cache_enabled
+    if (cacheable and _dispatch_cache_enabled
             and not any(isinstance(d, jax.core.Tracer) for d in datas)):
         key, ban_key = _dispatch_key(name, fn, treedef, leaves, t_pos, datas,
                                      requires_grad)

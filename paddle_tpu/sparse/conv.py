@@ -109,27 +109,38 @@ def sparse_conv3d(indices, values, weight, kernel_size, stride=1,
     Returns (out_indices [M, 4], out_values [M, Cout]).
     """
     ks = _as_tuple3(kernel_size)
-    vals_arr = values._data if isinstance(values, Tensor) else values
+    vt = values if isinstance(values, Tensor) else Tensor(
+        jnp.asarray(values))
+    wt = weight if isinstance(weight, Tensor) else Tensor(
+        jnp.asarray(weight))
     if len(np.asarray(indices)) == 0:  # empty input -> empty output
-        cout = (weight._data if isinstance(weight, Tensor)
-                else np.asarray(weight)).shape[-1]
         return (np.zeros((0, 4), np.int64),
-                jnp.zeros((0, cout), np.asarray(vals_arr).dtype))
+                Tensor(jnp.zeros((0, wt._data.shape[-1]),
+                                 vt._data.dtype)))
     if spatial is None:
         c = np.asarray(indices, np.int64)
         spatial = tuple(int(c[:, i].max()) + 1 for i in (1, 2, 3))
     out_coords, pairs = _rulebook(indices, ks, _as_tuple3(stride),
                                   _as_tuple3(padding), submanifold, spatial)
     m = len(out_coords)
-    vals = values._data if isinstance(values, Tensor) else jnp.asarray(values)
-    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
-    out = jnp.zeros((m, w.shape[-1]), vals.dtype)
-    for k, (in_rows, out_rows) in enumerate(pairs):
-        if len(in_rows) == 0:
-            continue
-        contrib = vals[jnp.asarray(in_rows)] @ w[k]
-        out = out.at[jnp.asarray(out_rows)].add(contrib)
-    return out_coords, out
+    pairs_j = [(k, jnp.asarray(in_rows), jnp.asarray(out_rows))
+               for k, (in_rows, out_rows) in enumerate(pairs)
+               if len(in_rows)]
+
+    # the gather-matmul-scatter chain is a pure function of (values,
+    # weight) with the rulebook closed over as static — routing it
+    # through apply_op records an exact jax.vjp so conv weights train
+    # (they used to get NO gradients: raw-jnp math detached the tape)
+    def pure(vals_d, w_d):
+        out = jnp.zeros((m, w_d.shape[-1]), vals_d.dtype)
+        for k, ir, orw in pairs_j:
+            out = out.at[orw].add(vals_d[ir] @ w_d[k])
+        return out
+
+    from ..ops.dispatch import apply_op
+
+    return out_coords, apply_op("sparse_conv3d", pure, (vt, wt),
+                                {}, cacheable=False)
 
 
 class SubmConv3D(Layer):
@@ -174,15 +185,14 @@ class SubmConv3D(Layer):
             idx, vals, self.weight, self.kernel_size, self.stride,
             self.padding, submanifold=self.SUBM, spatial=spatial)
         if self.bias is not None:
-            out_vals = out_vals + self.bias._data
+            out_vals = out_vals + self.bias    # Tensor add: tape records
         if spatial is not None:
             out_sp = spatial if self.SUBM else _out_extent(
                 spatial, self.kernel_size, self.stride, self.padding)
             batch = int(np.asarray(idx)[:, 0].max()) + 1 if len(idx) else 1
             shape = (batch, *out_sp, out_vals.shape[-1])
-            return sparse_coo_tensor(out_coords.T, Tensor(out_vals),
-                                     shape=shape)
-        return sparse_coo_tensor(out_coords.T, Tensor(out_vals))
+            return sparse_coo_tensor(out_coords.T, out_vals, shape=shape)
+        return sparse_coo_tensor(out_coords.T, out_vals)
 
 
 class Conv3D(SubmConv3D):
@@ -209,13 +219,15 @@ class MaxPool3D(Layer):
         spatial = None
         if isinstance(x, SparseTensor):
             idx = np.asarray(x.indices().numpy()).T
-            vals = x.values()._data
+            vt = x.values()            # autograd-connected Tensor
             shp = list(x.shape)
             if len(shp) == 5:
                 spatial = tuple(shp[1:4])
         else:
-            idx, vals = x
-            vals = vals._data if isinstance(vals, Tensor) else vals
+            idx, vals_in = x
+            vt = vals_in if isinstance(vals_in, Tensor) else Tensor(
+                jnp.asarray(vals_in))
+        vals = vt._data
         idx = np.asarray(idx, np.int64)
         if len(idx) == 0:  # empty input -> empty output, shape preserved
             out_sp = (_out_extent(spatial, self.kernel_size, self.stride,
@@ -249,10 +261,21 @@ class MaxPool3D(Layer):
                         cells.append((c[0], oz, oy, ox))
         cells = np.asarray(cells, np.int64).reshape(-1, 4)
         uniq, inv = np.unique(cells, axis=0, return_inverse=True)
-        neg_inf = jnp.full((len(uniq), vals.shape[-1]), -jnp.inf,
-                           vals.dtype)
-        pooled = neg_inf.at[jnp.asarray(inv)].max(
-            vals[jnp.asarray(rows, dtype=jnp.int32)])
+        inv_j = jnp.asarray(inv)
+        rows_j = jnp.asarray(rows, dtype=jnp.int32)
+        n_out = len(uniq)
+
+        # segment max as a pure fn of the values: grads reach the
+        # winning sites (the raw-jnp form detached the tape)
+        def pure(vals_d):
+            neg_inf = jnp.full((n_out, vals_d.shape[-1]), -jnp.inf,
+                               vals_d.dtype)
+            return neg_inf.at[inv_j].max(vals_d[rows_j])
+
+        from ..ops.dispatch import apply_op
+
+        pooled = apply_op("sparse_max_pool3d", pure, (vt,), {},
+                          cacheable=False)
         batch = int(idx[:, 0].max()) + 1 if len(idx) else 1
-        return sparse_coo_tensor(uniq.T, Tensor(pooled),
+        return sparse_coo_tensor(uniq.T, pooled,
                                  shape=(batch, *ext, vals.shape[-1]))

@@ -399,3 +399,36 @@ def test_functional_max_pool3d():
     d = np.asarray(out.to_dense().numpy())
     assert d[0, 0, 0, 0, 0] == 5.0
     assert d[0, 1, 1, 1, 0] == 2.0
+
+
+def test_sparse_conv_and_pool_train():
+    """Round-4 regression: SubmConv3D/Conv3D/MaxPool3D used to compute
+    on raw jnp arrays, silently freezing conv weights (grad None)."""
+    paddle.seed(0)
+    conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3)
+    idx4 = np.array([[0, 1, 1, 1], [0, 2, 2, 2], [0, 3, 1, 2]], np.int64)
+    vals = Tensor(np.random.RandomState(0).rand(3, 2).astype(F32),
+                  stop_gradient=False)
+    st = sparse.sparse_coo_tensor(idx4.T, vals, (1, 4, 4, 4, 2))
+    out = conv(st)
+    pool = sparse.nn.MaxPool3D(2)
+    pooled = pool(out)
+    pooled.values().sum().backward()
+    assert conv.weight.grad is not None
+    assert conv.bias.grad is not None
+    assert vals.grad is not None
+    assert np.isfinite(np.asarray(conv.weight.grad.numpy())).all()
+
+    # a short training loop drives the loss down through the chain
+    from paddle_tpu import optimizer
+
+    opt = optimizer.SGD(learning_rate=0.1, parameters=conv.parameters())
+    losses = []
+    for _ in range(15):
+        out = conv(st)
+        loss = (out.values() ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
