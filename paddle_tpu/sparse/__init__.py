@@ -174,7 +174,24 @@ square = _unary("square", jnp.square)
 neg = _unary("neg", jnp.negative)
 expm1 = _unary("expm1", jnp.expm1)
 log1p = _unary("log1p", jnp.log1p)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
 cast = lambda x, dtype: _unary("cast", lambda v: v.astype(dtype))(x)  # noqa: E731
+
+
+def pow(x, factor, name=None):  # noqa: A001  (reference name)
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
 
 
 def _as_tensor(x):
@@ -222,34 +239,205 @@ def masked_matmul(a, b, mask):
     return _rebuild(mask, vals, fmt="coo")
 
 
-def add(a, b):
-    if isinstance(a, SparseTensor) and isinstance(b, SparseTensor):
+def _union_binary(name, fn):
+    """Elementwise sparse∘sparse on the UNION structure (reference phi
+    sparse elementwise kernels operate over the merged coordinate set;
+    implicit-zero positions on both sides stay unrepresented)."""
+
+    def impl(a, b):
+        if not (isinstance(a, SparseTensor) and isinstance(b,
+                                                           SparseTensor)):
+            raise TypeError(f"sparse.{name} expects two sparse tensors")
+        if tuple(a._bcoo.shape) != tuple(b._bcoo.shape):
+            raise ValueError(
+                f"sparse.{name}: shapes differ "
+                f"({a.shape} vs {b.shape}) — linearizing b's indices "
+                "with a's dims would corrupt the union structure")
         from ..ops.dispatch import apply_op
         from .depth import _vals_tensor
 
-        # output structure is data-independent: dedupe coordinates on
-        # host, then a differentiable segment-sum merges the values
-        idx_cat = np.concatenate([np.asarray(a._bcoo.indices),
-                                  np.asarray(b._bcoo.indices)])
-        dims = a._bcoo.shape[:idx_cat.shape[1]]
-        lin = np.ravel_multi_index(tuple(idx_cat.T), dims)
-        uniq, inv = np.unique(lin, return_inverse=True)
+        ia = np.asarray(a._bcoo.indices)
+        ib = np.asarray(b._bcoo.indices)
+        dims = a._bcoo.shape[:ia.shape[1]]
+        lin_a = np.ravel_multi_index(tuple(ia.T), dims)
+        lin_b = np.ravel_multi_index(tuple(ib.T), dims)
+        uniq = np.unique(np.concatenate([lin_a, lin_b]))
+        pos_a = jnp.asarray(np.searchsorted(uniq, lin_a))
+        pos_b = jnp.asarray(np.searchsorted(uniq, lin_b))
         out_idx = np.stack(np.unravel_index(uniq, dims), 1)
-        inv_j = jnp.asarray(inv)
         n_out = len(uniq)
 
-        def fn(va, vb):
-            return jax.ops.segment_sum(jnp.concatenate([va, vb]), inv_j,
-                                       n_out)
+        def pure(va, vb):
+            ea = jnp.zeros((n_out,) + va.shape[1:], va.dtype) \
+                .at[pos_a].add(va)
+            eb = jnp.zeros((n_out,) + vb.shape[1:], vb.dtype) \
+                .at[pos_b].add(vb)
+            return fn(ea, eb)
 
-        vals = apply_op("sparse_add", fn,
+        vals = apply_op(f"sparse_{name}", pure,
                         (_vals_tensor(a), _vals_tensor(b)), {})
         out = SparseTensor(
             jsparse.BCOO((vals._data, jnp.asarray(out_idx)),
                          shape=a._bcoo.shape), a._fmt)
         out._values_t = vals
         return out
-    raise TypeError("sparse.add expects two sparse tensors")
+
+    impl.__name__ = name
+    return impl
+
+
+add = _union_binary("add", jnp.add)
+subtract = _union_binary("subtract", jnp.subtract)
+multiply = _union_binary("multiply", jnp.multiply)
+divide = _union_binary("divide", jnp.divide)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """reference paddle.sparse.sum: axis=None -> dense scalar; an axis
+    reduces to a sparse tensor over the remaining coordinates."""
+    from ..ops.dispatch import apply_op
+    from .depth import _vals_tensor
+
+    vt = _vals_tensor(x)
+    if axis is None:
+        out = apply_op("sparse_sum", lambda v: jnp.sum(v), (vt,), {})
+        return out if dtype is None else out.cast(dtype)
+    nd = len(x.shape)
+    axis = axis % nd
+    idx = np.asarray(x._bcoo.indices)
+    n_sparse = idx.shape[1]
+    if axis >= n_sparse:
+        # dense-tail axis: reduce inside the values, structure unchanged
+        vax = axis - n_sparse + 1
+        out_v = apply_op(
+            "sparse_sum",
+            lambda v: jnp.sum(v, axis=vax, keepdims=keepdim), (vt,), {})
+        if dtype is not None:
+            out_v = out_v.cast(dtype)
+        new_shape = tuple(
+            (1 if i == axis else d) for i, d in enumerate(x._bcoo.shape)
+            if keepdim or i != axis)
+        out = SparseTensor(
+            jsparse.BCOO((out_v._data, x._bcoo.indices),
+                         shape=new_shape), "coo")
+        out._values_t = out_v
+        return out
+    keep_cols = [i for i in range(idx.shape[1]) if i != axis]
+    rem = idx[:, keep_cols]
+    dims = [x.shape[i] for i in keep_cols]
+    lin = np.ravel_multi_index(tuple(rem.T), dims) if keep_cols else \
+        np.zeros(len(idx), np.int64)
+    uniq, inv = np.unique(lin, return_inverse=True)
+    inv_j = jnp.asarray(inv)
+    n_out = len(uniq)
+
+    def pure(v):
+        return jax.ops.segment_sum(v, inv_j, n_out)
+
+    vals = apply_op("sparse_sum", pure, (vt,), {})
+    if dtype is not None:
+        vals = vals.cast(dtype)
+    dense_tail = tuple(x._bcoo.shape[idx.shape[1]:])
+    out_rem = np.stack(np.unravel_index(uniq, dims), 1) if keep_cols \
+        else np.zeros((n_out, 0), np.int64)
+    if keepdim:
+        out_idx = np.insert(out_rem, axis, 0, axis=1)
+        shape = tuple(1 if i == axis else d
+                      for i, d in enumerate(x._bcoo.shape[:idx.shape[1]])
+                      ) + dense_tail
+    else:
+        out_idx = out_rem
+        shape = tuple(dims) + dense_tail
+    out = SparseTensor(
+        jsparse.BCOO((vals._data, jnp.asarray(out_idx)), shape=shape),
+        "coo")
+    out._values_t = vals
+    return out
+
+
+def transpose(x, perm, name=None):
+    """Permute sparse dims: indices reorder, values untouched."""
+    from .depth import _vals_tensor
+
+    idx = np.asarray(x._bcoo.indices)
+    if len(perm) != idx.shape[1]:
+        raise ValueError(
+            f"sparse.transpose perm must cover the {idx.shape[1]} "
+            "sparse dims")
+    new_idx = idx[:, list(perm)]
+    new_shape = tuple(x._bcoo.shape[p] for p in perm) \
+        + tuple(x._bcoo.shape[idx.shape[1]:])
+    vals = _vals_tensor(x)
+    out = SparseTensor(
+        jsparse.BCOO((vals._data, jnp.asarray(new_idx)),
+                     shape=new_shape), x._fmt)
+    out._values_t = vals if not vals.stop_gradient else None
+    return out
+
+
+def reshape(x, shape, name=None):
+    """Relinearize coordinates into the new shape (same nnz/values)."""
+    from .depth import _vals_tensor
+
+    idx = np.asarray(x._bcoo.indices)
+    nd = idx.shape[1]
+    old_dims = x._bcoo.shape[:nd]
+    total = int(np.prod(old_dims))
+    shape = [int(s) for s in shape]
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if neg:
+        known = int(np.prod([s for s in shape if s != -1]))
+        shape[neg[0]] = total // known
+    if int(np.prod(shape)) != total:
+        raise ValueError(f"cannot reshape {old_dims} into {shape}")
+    lin = np.ravel_multi_index(tuple(idx.T), old_dims)
+    new_idx = np.stack(np.unravel_index(lin, shape), 1)
+    vals = _vals_tensor(x)
+    out = SparseTensor(
+        jsparse.BCOO((vals._data, jnp.asarray(new_idx)),
+                     shape=tuple(shape)
+                     + tuple(x._bcoo.shape[nd:])), "coo")
+    out._values_t = vals if not vals.stop_gradient else None
+    return out
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Keep nonzeros inside the window; coordinates shift to the new
+    origin (reference sparse slice kernel semantics)."""
+    from ..ops.dispatch import apply_op
+    from .depth import _vals_tensor
+
+    idx = np.asarray(x._bcoo.indices)
+    nd = idx.shape[1]
+    shape = list(x._bcoo.shape[:nd])
+    lo = [0] * nd
+    hi = list(shape)
+    full_nd = len(x.shape)
+    for a, st, e in zip(axes, starts, ends):
+        a = a % full_nd
+        if a >= nd:
+            raise NotImplementedError(
+                "sparse.slice over a dense-tail dim is not supported "
+                f"(axis {a}, {nd} sparse dims)")
+        st = st + shape[a] if st < 0 else st
+        e = e + shape[a] if e < 0 else e
+        lo[a] = min(max(0, int(st)), shape[a])
+        hi[a] = max(min(shape[a], int(e)), lo[a])  # empty, never negative
+    mask = np.ones(len(idx), bool)
+    for a in range(nd):
+        mask &= (idx[:, a] >= lo[a]) & (idx[:, a] < hi[a])
+    sel = np.nonzero(mask)[0]
+    new_idx = idx[sel] - np.asarray(lo)[None, :]
+    sel_j = jnp.asarray(sel)
+    vals = apply_op("sparse_slice", lambda v: v[sel_j],
+                    (_vals_tensor(x),), {})
+    new_shape = tuple(h - l for l, h in zip(lo, hi)) \
+        + tuple(x._bcoo.shape[nd:])
+    out = SparseTensor(
+        jsparse.BCOO((vals._data, jnp.asarray(new_idx)),
+                     shape=new_shape), "coo")
+    out._values_t = vals
+    return out
 
 
 def is_same_shape(a, b):
