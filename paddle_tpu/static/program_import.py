@@ -601,7 +601,164 @@ _TRANSLATORS = {
     "bilinear_interp_v2": _interp("bilinear"),
     "equal": _eltwise(jnp.equal),
     "greater_than": _eltwise(jnp.greater),
+    "silu": _act(jax.nn.silu),
+    "mish": lambda ins, attrs: ins["X"] * jnp.tanh(
+        jax.nn.softplus(ins["X"])),
+    "softplus": lambda ins, attrs: jax.nn.softplus(
+        attrs.get("beta", 1.0) * ins["X"]) / attrs.get("beta", 1.0),
+    "floor": _act(jnp.floor),
+    "rsqrt": _act(jax.lax.rsqrt),
+    "prelu": lambda ins, attrs: _prelu(ins, attrs),
+    "elementwise_mod": _eltwise(jnp.mod),
+    "elementwise_floordiv": _eltwise(jnp.floor_divide),
+    "reduce_min": _reduce(jnp.min),
+    "reduce_prod": _reduce(jnp.prod),
+    "logsumexp": lambda ins, attrs: jax.scipy.special.logsumexp(
+        ins["X"],
+        axis=(None if attrs.get("reduce_all", False)
+              else tuple(attrs.get("axis", [0]))),
+        keepdims=attrs.get("keepdim", False)),
+    "pad3d": lambda ins, attrs: _pad3d(ins, attrs),
+    "split": lambda ins, attrs: _split(ins, attrs),
+    "top_k_v2": lambda ins, attrs: _topk(ins, attrs),
+    "expand_v2": lambda ins, attrs: _expand_v2(ins, attrs),
+    "tile": lambda ins, attrs: _tile(ins, attrs),
+    "gather": lambda ins, attrs: _gather(ins, attrs),
+    "instance_norm": lambda ins, attrs: _instance_norm(ins, attrs),
+    "group_norm": lambda ins, attrs: _group_norm(ins, attrs),
 }
+
+
+def _prelu(ins, attrs):
+    # only the reference's 'channel' (and scalar 'all') modes on NCHW
+    # translate; element mode / NHWC would scale the wrong axis
+    if attrs.get("mode", "channel") not in ("channel", "all"):
+        raise NotImplementedError(
+            f"prelu mode {attrs.get('mode')!r} is not translated")
+    if attrs.get("data_format", "NCHW") != "NCHW":
+        raise NotImplementedError("prelu: only NCHW is translated")
+    x, alpha = ins["X"], ins["Alpha"]
+    shape = ((1, -1) + (1,) * (x.ndim - 2)) if alpha.size > 1         else alpha.shape
+    return jnp.where(x >= 0, x, x * alpha.reshape(shape))
+
+
+def _expand_v2(ins, attrs):
+    if any(k in ins for k in ("Shape", "expand_shapes_tensor")):
+        raise NotImplementedError(
+            "expand_v2 with a tensor-valued shape is not translated")
+    x = ins["X"]
+    tgt = attrs["shape"]
+    padded = (1,) * (len(tgt) - x.ndim) + x.shape
+    return jnp.broadcast_to(
+        x, [d if s == -1 else s for s, d in zip(tgt, padded)])
+
+
+def _tile(ins, attrs):
+    if any(k in ins for k in ("RepeatTimes", "repeat_times_tensor")):
+        raise NotImplementedError(
+            "tile with tensor-valued repeat_times is not translated")
+    return jnp.tile(ins["X"], attrs.get("repeat_times", [1]))
+
+
+def _gather(ins, attrs):
+    if "Axis" in ins:
+        raise NotImplementedError(
+            "gather with a tensor-valued axis is not translated")
+    return jnp.take(ins["X"], ins["Index"].reshape(-1),
+                    axis=attrs.get("axis", 0))
+
+
+def _pad3d(ins, attrs):
+    if "Paddings" in ins:
+        raise NotImplementedError(
+            "pad3d with tensor-valued paddings is not translated")
+    x = ins["X"]
+    p = attrs.get("paddings", [0] * 6)   # (l, r, t, b, f, bk) NCDHW
+    mode = attrs.get("mode", "constant")
+    if attrs.get("data_format", "NCDHW") != "NCDHW":
+        raise NotImplementedError("pad3d: only NCDHW is translated")
+    widths = ((0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1]))
+    if mode == "constant":
+        return jnp.pad(x, widths,
+                       constant_values=attrs.get("value", 0.0))
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}.get(mode)
+    if jmode is None:
+        raise NotImplementedError(f"pad3d mode {mode!r}")
+    return jnp.pad(x, widths, mode=jmode)
+
+
+def _split(ins, attrs):
+    if "AxisTensor" in ins or "SectionsTensorList" in ins:
+        raise NotImplementedError(
+            "split with tensor-valued axis/sections is not translated")
+    x = ins["X"]
+    axis = attrs.get("axis", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        # -1 means "the rest" (at most one, reference semantics)
+        total = x.shape[axis]
+        known = sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+        splits = np.cumsum(sections[:-1]).tolist()
+        return tuple(jnp.split(x, splits, axis=axis))
+    return tuple(jnp.split(x, attrs.get("num", 1), axis=axis))
+
+
+def _topk(ins, attrs):
+    if "K" in ins:
+        raise NotImplementedError(
+            "top_k_v2 with a tensor-valued k is not translated")
+    x = ins["X"]
+    k = attrs.get("k", 1)
+    axis = attrs.get("axis", -1)
+    largest = attrs.get("largest", True)
+    vals, idxs = jax.lax.top_k(
+        jnp.moveaxis(x if largest else -x, axis, -1), k)
+    vals = jnp.moveaxis(vals if largest else -vals, -1, axis)
+    idxs = jnp.moveaxis(idxs, -1, axis).astype(jnp.int64)
+    if attrs.get("sorted", True) is False and largest:
+        pass  # jax top_k always sorts; superset of unsorted contract
+    return vals, idxs
+
+
+def _instance_norm(ins, attrs):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    red = tuple(range(2, x.ndim))
+    mu = x.mean(red, keepdims=True)
+    var = jnp.square(x - mu).mean(red, keepdims=True)
+    y = (x - mu) * jax.lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"].reshape(shape)
+    if "Bias" in ins:
+        y = y + ins["Bias"].reshape(shape)
+    return y
+
+
+def _group_norm(ins, attrs):
+    x = ins["X"]
+    eps = attrs.get("epsilon", 1e-5)
+    g = attrs.get("groups", 1)
+    if attrs.get("data_layout", "NCHW") != "NCHW":
+        raise NotImplementedError("group_norm: only NCHW is translated")
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    red = tuple(range(2, xg.ndim))
+    mu = xg.mean(red, keepdims=True)
+    var = jnp.square(xg - mu).mean(red, keepdims=True)
+    y = ((xg - mu) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if "Scale" in ins:
+        y = y * ins["Scale"].reshape(shape)
+    if "Bias" in ins:
+        y = y + ins["Bias"].reshape(shape)
+    return y
+
+
+# ops whose outputs span several parameters, bound in this order
+_MULTI_OUT_PARAMS = {"top_k_v2": ("Out", "Indices")}
 
 
 def supported_ops():
@@ -652,10 +809,19 @@ class InferenceProgram:
             out = _TRANSLATORS[op.type](ins, op.attrs)
             outs = out if isinstance(out, tuple) else (out,)
             # the primary output parameter varies by legacy op family
-            # (Out / Output / Y); secondary outputs like XShape are
-            # trace metadata and stay unbound
-            names = (op.outputs.get("Out") or op.outputs.get("Output")
-                     or op.outputs.get("Y") or [])
+            # (Out / Output / Y); ops with several REAL output params
+            # (top_k's values + indices) list them in order here, while
+            # secondary outputs like XShape are trace metadata and stay
+            # unbound
+            multi = _MULTI_OUT_PARAMS.get(op.type)
+            if multi:
+                names = []
+                for param in multi:
+                    names.extend(op.outputs.get(param, []))
+            else:
+                names = (op.outputs.get("Out")
+                         or op.outputs.get("Output")
+                         or op.outputs.get("Y") or [])
             for name, val in zip(names, outs):
                 env[name] = val
         return [env[n] for n in self.fetch_names]

@@ -499,3 +499,92 @@ def test_supported_op_inventory():
     for must in ("conv2d", "batch_norm", "matmul_v2", "softmax",
                  "lookup_table_v2", "feed", "fetch"):
         assert must in ops
+
+
+class TestRound4OpTableGrowth:
+    def test_split_topk_pad3d(self, tmp_path):
+        rng = np.random.RandomState(7)
+        feeds, fetches = feed_fetch(["x"], ["a", "idx"])
+        ops = feeds + [
+            op("split", {"X": ["x"]}, {"Out": ["s0", "s1"]},
+               [attr("axis", 0, i=1), attr("sections", 3, ints=[2, 2])]),
+            op("elementwise_add", {"X": ["s0"], "Y": ["s1"]},
+               {"Out": ["m"]}, [attr("axis", 0, i=-1)]),
+            op("top_k_v2", {"X": ["m"]}, {"Out": ["a"],
+                                          "Indices": ["idx"]},
+               [attr("k", 0, i=2), attr("axis", 0, i=-1)]),
+        ] + fetches
+        prefix = write_model(tmp_path, "stk", ops, [var("x", [-1, 4])],
+                             {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = rng.randn(3, 4).astype(F32)
+        a, idx = prog(paddle.to_tensor(x))
+        m = x[:, :2] + x[:, 2:]
+        order = np.argsort(-m, axis=1)[:, :2]
+        np.testing.assert_allclose(np.asarray(a.numpy()),
+                                   np.take_along_axis(m, order, 1),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(idx.numpy()), order)
+
+    def test_group_and_instance_norm(self, tmp_path):
+        rng = np.random.RandomState(8)
+        scale = rng.rand(4).astype(F32) + 0.5
+        bias = rng.randn(4).astype(F32)
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [
+            op("group_norm", {"X": ["x"], "Scale": ["gs"],
+                              "Bias": ["gb"]}, {"Y": ["g"]},
+               [attr("groups", 0, i=2), attr("epsilon", 1, f=1e-5)]),
+            op("instance_norm", {"X": ["g"], "Scale": ["gs"],
+                                 "Bias": ["gb"]}, {"Y": ["y"]},
+               [attr("epsilon", 1, f=1e-5)]),
+        ] + fetches
+        vars_ = [var("x", [-1, 4, 3, 3]),
+                 var("gs", [4], persistable=True),
+                 var("gb", [4], persistable=True)]
+        prefix = write_model(tmp_path, "norms", ops, vars_,
+                             {"gb": bias, "gs": scale})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = rng.randn(2, 4, 3, 3).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+
+        def gn(v, g):
+            n, c = v.shape[:2]
+            vg = v.reshape(n, g, -1)
+            mu = vg.mean(-1, keepdims=True)
+            var_ = ((vg - mu) ** 2).mean(-1, keepdims=True)
+            y = ((vg - mu) / np.sqrt(var_ + 1e-5)).reshape(v.shape)
+            return y * scale[None, :, None, None] \
+                + bias[None, :, None, None]
+
+        def inorm(v):
+            mu = v.mean((2, 3), keepdims=True)
+            var_ = ((v - mu) ** 2).mean((2, 3), keepdims=True)
+            y = (v - mu) / np.sqrt(var_ + 1e-5)
+            return y * scale[None, :, None, None] \
+                + bias[None, :, None, None]
+
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   inorm(gn(x, 2)), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_activation_additions(self, tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [
+            op("silu", {"X": ["x"]}, {"Out": ["s"]}),
+            op("mish", {"X": ["s"]}, {"Out": ["m"]}),
+            op("prelu", {"X": ["m"], "Alpha": ["al"]}, {"Out": ["y"]}),
+        ] + fetches
+        vars_ = [var("x", [-1, 3, 2, 2]),
+                 var("al", [3], persistable=True)]
+        al = np.array([0.1, 0.2, 0.3], F32)
+        prefix = write_model(tmp_path, "acts", ops, vars_, {"al": al})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 3, 2, 2).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        s = x / (1 + np.exp(-x))
+        m = s * np.tanh(np.log1p(np.exp(s)))
+        exp = np.where(m >= 0, m, m * al[None, :, None, None])
+        np.testing.assert_allclose(np.asarray(out.numpy()), exp,
+                                   rtol=1e-4, atol=1e-5)
