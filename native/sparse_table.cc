@@ -447,12 +447,17 @@ int pd_table_geo_push(void* table, int trainer_id, const int64_t* keys,
   // (review regression)
   if (trainer_id < 0 || trainer_id >= t->geo_trainers) return -1;
   pd_table_push_delta(table, keys, deltas, n);
+  // bucket keys by shard in one O(n) pass, then take each shard lock
+  // once over its bucket — the per-shard full rescan was
+  // O(kNumShards * n) under locks (advisor finding, round 4)
+  std::vector<std::vector<int64_t>> buckets(kNumShards);
+  for (int64_t i = 0; i < n; ++i) buckets[shard_of(keys[i])].push_back(keys[i]);
   for (int s = 0; s < kNumShards; ++s) {
+    if (buckets[s].empty()) continue;
     std::lock_guard<std::mutex> lk(t->geo_locks[s]);
-    for (int64_t i = 0; i < n; ++i) {
-      if (shard_of(keys[i]) != s) continue;
+    for (int64_t k : buckets[s]) {
       for (int tr = 0; tr < t->geo_trainers; ++tr) {
-        if (tr != trainer_id) t->geo_dirty[tr][s].insert(keys[i]);
+        if (tr != trainer_id) t->geo_dirty[tr][s].insert(k);
       }
     }
   }

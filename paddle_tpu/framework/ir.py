@@ -140,6 +140,108 @@ def _match_softmax(prod, p_var):
     return src_var, consumed
 
 
+def _neg_fill(var, prod, threshold=-1e8):
+    """True if ``var`` is (a broadcast/convert of) a scalar <= threshold —
+    an 'effectively -inf' softmax fill (exp underflows to exactly 0.0 in
+    f32 for any realistic score magnitude).  The threshold admits the
+    bf16 rounding of the common -1e9 spelling (bf16(-1e9) ~= -9.98e8)."""
+    for _ in range(8):
+        if isinstance(var, jcore.Literal):
+            v = np.asarray(var.val)
+            return v.ndim == 0 and float(v) <= threshold
+        if var not in prod:
+            return False
+        _, eqn = prod[var]
+        if eqn.primitive.name in ("convert_element_type",
+                                  "broadcast_in_dim", "stop_gradient",
+                                  "copy"):
+            var = eqn.invars[0]
+        else:
+            return False
+    return False
+
+
+def _match_where_mask(prod, var):
+    """Match ``var = where(pred, scores, fill)`` with a boolean pred and a
+    large-negative scalar fill; returns (pred_var, scores_operand,
+    eqn_index) or None.  The where must not upsize the scores operand — a
+    broadcast here would change the batch layout downstream dot checks
+    were made against."""
+    if isinstance(var, jcore.Literal) or var not in prod:
+        return None
+    i, eqn = prod[var]
+    if len(eqn.invars) != 3:
+        return None     # multi-case select_n / hoisted-const _where
+    if _pjit_name(eqn) == "_where":
+        pred, scores, fill = eqn.invars
+    elif eqn.primitive.name == "select_n":
+        pred, fill, scores = eqn.invars
+    else:
+        return None
+    if not jnp.issubdtype(pred.aval.dtype, jnp.bool_):
+        return None
+    if not _neg_fill(fill, prod):
+        return None
+    if tuple(eqn.outvars[0].aval.shape) != tuple(scores.aval.shape):
+        return None
+    return pred, scores, i
+
+
+def _try_const_eval(var, jaxpr, consts, prod, max_elems=1 << 26,
+                    max_eqns=64):
+    """Numerically evaluate ``var`` if it depends only on literals,
+    constvars, and eqns — no jaxpr inputs.  Returns a numpy array or
+    None.  Used to prove mask structure (e.g. causal tril) at match
+    time; evaluation is eager and bounded."""
+    if isinstance(var, jcore.Literal):
+        return np.asarray(var.val)
+    if var.aval.shape and int(np.prod(var.aval.shape)) > max_elems:
+        return None
+    const_env = dict(zip(jaxpr.constvars, consts))
+    needed = set()
+    stack, visited = [var], set()
+    while stack:
+        v = stack.pop()
+        if isinstance(v, jcore.Literal) or v in const_env or v in visited:
+            continue
+        visited.add(v)
+        if v not in prod:
+            return None          # reaches a jaxpr input: runtime value
+        i, eqn = prod[v]
+        needed.add(i)
+        if len(needed) > max_eqns:
+            return None
+        # bound every INTERMEDIATE too — a small slice of a huge
+        # constant table would otherwise materialize the table eagerly
+        # at match time (review finding)
+        for ov in eqn.outvars:
+            if ov.aval.shape and int(np.prod(ov.aval.shape)) > max_elems:
+                return None
+        stack.extend(eqn.invars)
+    env = dict(const_env)
+
+    def read(v):
+        return v.val if isinstance(v, jcore.Literal) else env[v]
+
+    try:
+        with jax.ensure_compile_time_eval():
+            for i in sorted(needed):
+                eqn = jaxpr.eqns[i]
+                subfuns, bind_params = \
+                    eqn.primitive.get_bind_params(eqn.params)
+                ans = eqn.primitive.bind(
+                    *subfuns, *[read(x) for x in eqn.invars],
+                    **bind_params)
+                if eqn.primitive.multiple_results:
+                    for ov, a in zip(eqn.outvars, ans):
+                        env[ov] = a
+                else:
+                    env[eqn.outvars[0]] = ans
+        return np.asarray(env[var])
+    except Exception:
+        return None
+
+
 def _match_scaled_dot(prod, scores_var):
     """Match an optional scalar ``* c`` / ``/ c`` around a dot_general;
     returns (dot_i, dot_eqn, scale_mode, scale_val, consumed) or None."""
@@ -162,13 +264,29 @@ def _match_scaled_dot(prod, scores_var):
 
 
 @register_pass("fuse_attention")
-def fuse_attention(jaxpr):
-    """Find softmax(scale(q @ k^T)) @ v chains; plan flash-kernel swaps.
+def fuse_attention(jaxpr, consts=()):
+    """Find softmax(mask(scale(q @ k^T))) @ v chains; plan flash swaps.
 
     Matches the 2D single-head layout (q [T, D], k [S, D], v [S, D]) and
     the batched-heads einsum layout (q [B, N, T, D] against k
     [B, N, S, D]).  The score scaling may be ``/ c`` or ``* c`` by a
-    scalar, or absent.
+    scalar, or absent.  An optional mask between the scaled dot and the
+    softmax is matched in both spellings real transformer code uses:
+
+    - ``where(pred, scores, -big)``  (boolean mask, fill <= -1e9)
+    - ``scores + bias``              (additive mask)
+
+    If the mask is compile-time constant it is evaluated at match time;
+    a proven causal tril (T == S) routes to the flash kernel's
+    ``is_causal=True`` online-softmax path — the pattern every naive
+    causal GPT block writes.  Any other broadcast-compatible mask
+    (constant or runtime, e.g. padding masks) is routed through
+    ``flash_attention(attn_mask=...)``, whose fused path applies the
+    mask with f32 softmax.  Masks that upsize the scores or do not
+    right-align under broadcasting decline.
+    Reference role: multihead_matmul_fuse_pass +
+    python/paddle/nn/functional/flash_attention.py:53 (mask/causal
+    arguments of the fused op).
     """
     prod = _producers(jaxpr)
     rewrites = []
@@ -182,10 +300,39 @@ def fuse_attention(jaxpr):
         if sm is None:
             continue
         scores_var, sm_consumed = sm
-        # scores: optional scalar scale around the q@k dot
-        sd = _match_scaled_dot(prod, scores_var)
-        if sd is None:
-            continue
+        # optional mask between the softmax and the scaled dot
+        mask_var = None
+        mask_bool = False
+        mask_consumed = set()
+        sd = None
+        wh = _match_where_mask(prod, scores_var)
+        if wh is not None:
+            pred_var, inner_raw, wh_i = wh
+            inner, sk_m = _unwrap(inner_raw, prod)
+            sd = _match_scaled_dot(prod, inner)
+            if sd is None:
+                continue
+            mask_var, mask_bool = pred_var, True
+            mask_consumed = {wh_i} | set(sk_m)
+        else:
+            m = _eqn_of(scores_var, prod, "add")
+            if m is not None:
+                add_i, add_eqn = m
+                for a, b in ((0, 1), (1, 0)):
+                    inner, sk_m = _unwrap(add_eqn.invars[a], prod)
+                    sd_try = _match_scaled_dot(prod, inner)
+                    if sd_try is not None and not isinstance(
+                            add_eqn.invars[b], jcore.Literal):
+                        sd = sd_try
+                        mask_var = add_eqn.invars[b]
+                        mask_consumed = {add_i} | set(sk_m)
+                        break
+                if sd is None:
+                    continue
+            else:
+                sd = _match_scaled_dot(prod, scores_var)
+                if sd is None:
+                    continue
         dot_i, dot_eqn, scale_mode, scale_val, sd_consumed = sd
         q_var, k_var = dot_eqn.invars
         ((lc, rc), (lb, rb)) = dot_eqn.params["dimension_numbers"]
@@ -220,10 +367,60 @@ def fuse_attention(jaxpr):
                                  or tuple(frb) != (0, 1)):
             continue
 
-        consumed = {i, dot_i} | sm_consumed | sd_consumed
+        # mask validation: must right-align under numpy broadcasting with
+        # the [.., T, S] scores; a compile-time-constant causal tril
+        # upgrades to the kernel's is_causal path
+        causal = False
+        if mask_var is not None:
+            t_dim = q_var.aval.shape[-2]
+            k_aval = k_var.aval
+            s_dim = k_aval.shape[0] if layout == "2d" else k_aval.shape[-2]
+            score_shape = (t_dim, s_dim) if layout == "2d" else \
+                (q_aval.shape[0], q_aval.shape[1], t_dim, s_dim)
+            mshape = mask_var.aval.shape
+            if len(mshape) > len(score_shape):
+                continue
+            if any(md != 1 and md != sd_ for md, sd_ in
+                   zip(reversed(mshape), reversed(score_shape))):
+                continue
+            # mval is only consumed by the causal (square) check — skip
+            # the eager evaluation entirely for cross-attention shapes
+            mval = _try_const_eval(mask_var, jaxpr, consts, prod) \
+                if t_dim == s_dim else None
+            if mval is not None:
+                tril = np.tril(np.ones((t_dim, s_dim), bool))
+                if mask_bool:
+                    causal = bool(np.all((mval != 0) == tril))
+                else:
+                    # additive causal bias: exactly 0 where attended,
+                    # effectively -inf where masked (threshold matches
+                    # _neg_fill's bf16-rounding allowance)
+                    causal = bool(np.all(np.where(tril, mval == 0,
+                                                  mval <= -1e8)))
+
+        consumed = {i, dot_i} | sm_consumed | sd_consumed | mask_consumed
         consumed.update(skip_a + skip_h)
         if not _interior_ok(jaxpr, consumed, i):
             continue
+        if causal:
+            # the mask value is no longer read — consume its whole
+            # producer chain too so eager replay doesn't rebuild the
+            # tril every call (dead code; XLA would DCE it only under
+            # jit).  If the chain is shared with anything outside the
+            # pattern, keep the base set.
+            chain, stack, cseen = set(), [mask_var], set()
+            while stack:
+                v = stack.pop()
+                if isinstance(v, jcore.Literal) or v in cseen \
+                        or v not in prod:
+                    continue
+                cseen.add(v)
+                ci, ceqn = prod[v]
+                chain.add(ci)
+                stack.extend(ceqn.invars)
+            extended = consumed | chain
+            if _interior_ok(jaxpr, extended, i):
+                consumed = extended
 
         head_dim = q_aval.shape[-1]
         s_literal = (scale_val.val if isinstance(scale_val, jcore.Literal)
@@ -231,7 +428,8 @@ def fuse_attention(jaxpr):
 
         def apply(read, *, _layout=layout, _mode=scale_mode,
                   _sval=scale_val, _slit=s_literal, _d=head_dim,
-                  _q=q_var, _k=k_var, _v=v_var):
+                  _q=q_var, _k=k_var, _v=v_var, _mask=mask_var,
+                  _causal=causal):
             from ..ops import pallas
 
             q = read(_q)
@@ -246,18 +444,26 @@ def fuse_attention(jaxpr):
             elif _mode == "mul":
                 scale = _slit if _slit is not None else read(_sval)
             q = q * (scale * jnp.sqrt(jnp.asarray(_d, q.dtype)))
+            kw = {}
+            if _causal:
+                kw["is_causal"] = True
+            elif _mask is not None:
+                kw["attn_mask"] = read(_mask)
             if _layout == "2d":
                 out = pallas.flash_attention(
                     q[None, :, None, :], k[None, :, None, :],
-                    v[None, :, None, :])
+                    v[None, :, None, :], **kw)
                 return out[0, :, 0, :]
             # bhtd: [B, N, T, D] -> kernel layout [B, T, N, D]
             out = pallas.flash_attention(
                 q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
-                v.transpose(0, 2, 1, 3))
+                v.transpose(0, 2, 1, 3), **kw)
             return out.transpose(0, 2, 1, 3)
 
-        rewrites.append(Rewrite(consumed, (q_var, k_var, v_var),
+        in_vars = (q_var, k_var, v_var)
+        if mask_var is not None and not causal:
+            in_vars = in_vars + (mask_var,)
+        rewrites.append(Rewrite(consumed, in_vars,
                                 eqn.outvars[0], apply))
     return rewrites
 
@@ -288,7 +494,7 @@ def _interior_ok(jaxpr, consumed, anchor_idx):
 
 
 @register_pass("decode_attention")
-def decode_attention(jaxpr):
+def decode_attention(jaxpr, consts=()):
     """Single-token masked decode attention -> ragged GQA decode kernel.
 
     Matches the canonical KV-cache decode chain (the shape
@@ -461,7 +667,7 @@ def decode_attention(jaxpr):
 
 
 @register_pass("fuse_layernorm")
-def fuse_layernorm(jaxpr):
+def fuse_layernorm(jaxpr, consts=()):
     """Hand-written layernorm -> one fused normalization in f32.
 
     Matches ``(x - mean(x)) * rsqrt(var(x) + eps) * w + b`` (reduce over
@@ -488,6 +694,13 @@ def fuse_layernorm(jaxpr):
             j, e = prod[var]
             if e.primitive.name == "broadcast_in_dim" and \
                     len(e.invars[0].aval.shape) == 1:
+                # the vector must map onto the LAST axis — an explicit
+                # broadcast_in_dim to another axis of equal size is not
+                # last-axis scaling (advisor finding, round 4)
+                out_nd = len(e.outvars[0].aval.shape)
+                if tuple(e.params.get("broadcast_dimensions", ())) != \
+                        (out_nd - 1,):
+                    return None, []
                 return e.invars[0], [j]
         return None, []
 
@@ -606,7 +819,7 @@ def fuse_layernorm(jaxpr):
 
 
 @register_pass("chunk_cross_entropy")
-def chunk_cross_entropy(jaxpr):
+def chunk_cross_entropy(jaxpr, consts=()):
     """log_softmax + take_along_axis -> chunked softmax-xent.
 
     The naive spelling materializes the full [N, V] log-probability
@@ -755,7 +968,7 @@ def optimize(fn, passes=None, static_argnums=()):
             rewrites = []
             taken = set()
             for n in names:
-                for rw in PASSES[n](closed.jaxpr):
+                for rw in PASSES[n](closed.jaxpr, tuple(closed.consts)):
                     if not (rw.eqn_indices & taken):
                         rewrites.append(rw)
                         taken |= rw.eqn_indices

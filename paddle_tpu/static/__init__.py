@@ -104,7 +104,21 @@ class Executor:
         # in any key order (review regression — positional binding
         # silently swapped multi-input feeds)
         names = getattr(program, "feed_names", None)
-        if names and set(names) == set(feed):
+        if names:
+            missing = sorted(set(names) - set(feed))
+            if missing:
+                raise ValueError(
+                    f"feed is missing keys {missing} required by the "
+                    f"program's declared feeds {list(names)}")
+            unknown = sorted(set(feed) - set(names))
+            if unknown:
+                # reference Executor warns and ignores feed names the
+                # program doesn't consume (executor.py _check_feed) —
+                # superset feed dicts shared across programs are legal
+                import warnings
+                warnings.warn(
+                    f"feed keys {unknown} are not consumed by this "
+                    f"program (feeds: {list(names)}); ignoring them")
             vals = [feed[n] for n in names]
         else:
             vals = list(feed.values())

@@ -829,6 +829,10 @@ class InferenceProgram:
     def __call__(self, *feeds):
         from ..core.tensor import Tensor
 
+        if len(feeds) != len(self.feed_names):
+            raise ValueError(
+                f"program expects {len(self.feed_names)} feeds "
+                f"{self.feed_names}, got {len(feeds)}")
         vals = [f._data if isinstance(f, Tensor) else jnp.asarray(f)
                 for f in feeds]
         outs = self._jitted(self.params, *vals)

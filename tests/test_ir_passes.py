@@ -329,3 +329,290 @@ class TestFuseAttention:
         with pytest.raises(KeyError):
             ir.optimize(naive2d, passes=("no_such_pass",))(
                 *_qkv((4, 4)))
+
+
+# ------------------------------------------------- masked attention -------
+
+def naive_causal_bhtd(q, k, v):
+    """The way a naive causal GPT block writes training attention."""
+    d = q.shape[-1]
+    s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(jnp.float32(d))
+    t = s.shape[-1]
+    mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+    s = jnp.where(mask, s, jnp.float32(-1e9))
+    return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+
+class TestFuseAttentionMasks:
+    def _capture(self, monkeypatch):
+        """Record the kwargs fuse_attention hands to flash_attention."""
+        from paddle_tpu.ops import pallas
+        calls = []
+        real = pallas.flash_attention
+
+        def spy(q, k, v, **kw):
+            calls.append(kw)
+            return real(q, k, v, **kw)
+
+        monkeypatch.setattr(pallas, "flash_attention", spy)
+        return calls
+
+    def test_causal_where_tril_rewrites_to_is_causal(self, monkeypatch):
+        calls = self._capture(monkeypatch)
+        q, k, v = _qkv((2, 3, 16, 8))
+        opt = ir.optimize(naive_causal_bhtd, passes=("fuse_attention",))
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        assert calls and calls[-1].get("is_causal") is True
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive_causal_bhtd(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_causal_where_2d_layout(self, monkeypatch):
+        def naive(q, k, v):
+            s = q @ k.T / jnp.sqrt(q.shape[-1] * 1.0)
+            t = s.shape[0]
+            mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+            s = jnp.where(mask, s, jnp.float32(-1e30))
+            return jax.nn.softmax(s, axis=-1) @ v
+
+        calls = self._capture(monkeypatch)
+        q, k, v = _qkv((16, 8))
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        assert calls and calls[-1].get("is_causal") is True
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_additive_const_causal_bias_rewrites_to_is_causal(
+            self, monkeypatch):
+        def naive(q, k, v):
+            d = q.shape[-1]
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) * (1.0 / np.sqrt(d))
+            t = s.shape[-1]
+            bias = jnp.where(jnp.tril(jnp.ones((t, t), dtype=bool)),
+                             jnp.float32(0), jnp.float32(-1e9))
+            s = s + bias
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        calls = self._capture(monkeypatch)
+        q, k, v = _qkv((2, 2, 16, 8))
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        assert calls and calls[-1].get("is_causal") is True
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_runtime_bool_padding_mask_routes_attn_mask(self, monkeypatch):
+        def naive(q, k, v, pad):
+            d = q.shape[-1]
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.float32(d))
+            s = jnp.where(pad, s, jnp.float32(-1e9))
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        calls = self._capture(monkeypatch)
+        q, k, v = _qkv((2, 3, 16, 8))
+        pad = jnp.asarray(RNG.rand(2, 1, 1, 16) > 0.3)
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v, pad)
+        assert opt.last_rewrite_count == 1
+        assert calls and "attn_mask" in calls[-1] \
+            and not calls[-1].get("is_causal")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v, pad)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_runtime_additive_bias_routes_attn_mask(self, monkeypatch):
+        def naive(q, k, v, bias):
+            d = q.shape[-1]
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) * (1.0 / np.sqrt(d))
+            s = s + bias
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        calls = self._capture(monkeypatch)
+        q, k, v = _qkv((2, 2, 8, 8))
+        bias = jnp.asarray(RNG.randn(8, 8).astype(np.float32))
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v, bias)
+        assert opt.last_rewrite_count == 1
+        assert calls and "attn_mask" in calls[-1]
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v, bias)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_const_non_causal_mask_routes_attn_mask(self, monkeypatch):
+        blk = np.ones((16, 16), dtype=bool)
+        blk[:, 10:] = False          # block mask, not a tril
+
+        def naive(q, k, v):
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.float32(q.shape[-1]))
+            s = jnp.where(jnp.asarray(blk), s, jnp.float32(-1e9))
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        calls = self._capture(monkeypatch)
+        q, k, v = _qkv((2, 2, 16, 8))
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        assert calls and "attn_mask" in calls[-1] \
+            and not calls[-1].get("is_causal")
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_small_fill_is_not_a_mask_declines(self):
+        def naive(q, k, v):
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.float32(q.shape[-1]))
+            t = s.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+            s = jnp.where(mask, s, jnp.float32(-1.0))   # not -inf-like
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        q, k, v = _qkv((2, 2, 8, 8))
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v)), rtol=1e-5)
+
+    def test_upsizing_mask_declines(self):
+        def naive(q, k, v, pad):
+            # scores [T, S] upsized by the mask to [B, T, S]: the final
+            # dot is no longer the matched 2d layout
+            s = q @ k.T / jnp.sqrt(q.shape[-1] * 1.0)
+            s = jnp.where(pad, s, jnp.float32(-1e9))
+            return jax.nn.softmax(s, axis=-1) @ v
+
+        q, k, v = _qkv((8, 4))
+        pad = jnp.asarray(RNG.rand(3, 8, 8) > 0.3)
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v, pad)
+        assert opt.last_rewrite_count == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v, pad)),
+                                   rtol=1e-5)
+
+    def test_causal_gradients_match(self):
+        q, k, v = _qkv((2, 2, 16, 8))
+
+        def loss(f):
+            return lambda *a: (f(*a) ** 2).sum()
+
+        opt = ir.optimize(naive_causal_bhtd, passes=("fuse_attention",))
+        g_ref = jax.grad(loss(naive_causal_bhtd), argnums=(0, 1, 2))(
+            q, k, v)
+        g_opt = jax.grad(loss(opt), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_opt):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=1e-4)
+
+    def test_runtime_mask_gradients_match(self):
+        def naive(q, k, v, pad):
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.float32(q.shape[-1]))
+            s = jnp.where(pad, s, jnp.float32(-1e9))
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        q, k, v = _qkv((2, 2, 8, 8))
+        pad = jnp.asarray(RNG.rand(2, 1, 1, 8) > 0.3)
+
+        def loss(f):
+            return lambda *a: (f(*a) ** 2).sum()
+
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        g_ref = jax.grad(loss(naive), argnums=(0, 1, 2))(q, k, v, pad)
+        g_opt = jax.grad(loss(opt), argnums=(0, 1, 2))(q, k, v, pad)
+        for a, b in zip(g_ref, g_opt):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=1e-4)
+
+    def test_causal_under_jit(self):
+        q, k, v = _qkv((2, 2, 16, 8))
+        opt = jax.jit(ir.optimize(naive_causal_bhtd,
+                                  passes=("fuse_attention",)))
+        np.testing.assert_allclose(np.asarray(opt(q, k, v)),
+                                   np.asarray(naive_causal_bhtd(q, k, v)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_causal_gpt_block_composes_with_zoo(self):
+        """The Done criterion: a naive causal GPT block — hand-written
+        layernorm + causal masked attention — rewrites under the full
+        pass zoo and stays numerically exact."""
+        d_model, nh, t = 16, 2, 8
+        hd = d_model // nh
+        wq, wk, wv, wo = (jnp.asarray(
+            (RNG.rand(d_model, d_model) * 0.2 - 0.1).astype(np.float32))
+            for _ in range(4))
+        g = jnp.asarray(RNG.rand(d_model).astype(np.float32))
+        b = jnp.asarray(RNG.rand(d_model).astype(np.float32))
+
+        def block(x):
+            mu = x.mean(-1, keepdims=True)
+            var = ((x - mu) ** 2).mean(-1, keepdims=True)
+            h = (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+            B, T, _ = h.shape
+
+            def heads(w):
+                return (h @ w).reshape(B, T, nh, hd).transpose(0, 2, 1, 3)
+
+            q, k, v = heads(wq), heads(wk), heads(wv)
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.float32(hd))
+            mask = jnp.tril(jnp.ones((T, T), dtype=bool))
+            s = jnp.where(mask, s, jnp.float32(-1e9))
+            att = jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+            att = att.transpose(0, 2, 1, 3).reshape(B, T, d_model)
+            return x + att @ wo
+
+        x = jnp.asarray(RNG.rand(2, t, d_model).astype(np.float32))
+        opt = ir.optimize(block)
+        out = opt(x)
+        assert opt.last_rewrite_count >= 2   # layernorm + causal attention
+        np.testing.assert_allclose(np.asarray(out), np.asarray(block(x)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_bf16_fill_still_fuses_causal(self, monkeypatch):
+        """bf16(-1e9) rounds to ~-9.98e8; the fill threshold must admit
+        the bf16 spelling of the causal GPT pattern (review finding)."""
+        def naive(q, k, v):
+            s = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.asarray(q.shape[-1], q.dtype))
+            t = s.shape[-1]
+            mask = jnp.tril(jnp.ones((t, t), dtype=bool))
+            s = jnp.where(mask, s, jnp.asarray(-1e9, q.dtype))
+            return jnp.einsum("bnts,bnsd->bntd",
+                              jax.nn.softmax(s.astype(jnp.float32),
+                                             -1).astype(q.dtype), v)
+
+        calls = self._capture(monkeypatch)
+        q, k, v = (a.astype(jnp.bfloat16) for a in _qkv((2, 2, 16, 8)))
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v)
+        assert opt.last_rewrite_count == 1
+        assert calls and calls[-1].get("is_causal") is True
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32),
+            np.asarray(naive(q, k, v), np.float32), rtol=3e-2, atol=3e-2)
+
+    def test_multicase_select_n_declines_without_crash(self):
+        def naive(q, k, v, idx):
+            s0 = jnp.einsum("bntd,bnsd->bnts", q, k) / jnp.sqrt(
+                jnp.float32(q.shape[-1]))
+            s = jax.lax.select_n(idx, s0, s0 * 2, s0 * 3)
+            return jnp.einsum("bnts,bnsd->bntd", jax.nn.softmax(s, -1), v)
+
+        q, k, v = _qkv((1, 2, 8, 8))
+        idx = jnp.zeros((1, 2, 8, 8), jnp.int32)
+        opt = ir.optimize(naive, passes=("fuse_attention",))
+        out = opt(q, k, v, idx)   # must not crash
+        assert opt.last_rewrite_count == 0
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(naive(q, k, v, idx)),
+                                   rtol=1e-5)
