@@ -33,9 +33,23 @@ class InputSpec:
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    """Save a model for inference.  ``fetch_vars`` may be a Layer (the
-    TPU-native path) — serialized via jit.save and loadable by
-    paddle.inference.create_predictor."""
+    """Save a model for inference (reference:
+    python/paddle/static/io.py:442).
+
+    Two formats, selected by ``feed_vars``:
+
+    - ``feed_vars`` is a non-empty list of InputSpec: write the
+      REFERENCE wire format (``.pdmodel`` ProgramDesc +
+      ``.pdiparams`` combined stream) so the model can be handed to a
+      reference deployment.  The model's jaxpr must translate onto the
+      reference op set (see ``program_export``); otherwise this raises
+      NotImplementedError naming the untranslatable primitive.
+    - ``feed_vars`` empty/None: serialize the Layer via jit.save (the
+      TPU-native format; loadable by paddle.inference
+      create_predictor and static.load_inference_model).
+
+    ``fetch_vars`` carries the Layer in both cases.
+    """
     from ..jit import save as jit_save
     from ..nn.layer_base import Layer
 
@@ -52,6 +66,13 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             "save_inference_model on TPU serializes a Layer (pass the model "
             "as fetch_vars); ProgramDesc graphs do not exist here — build "
             "with paddle_tpu.jit.to_static instead.")
+    specs = [v for v in (feed_vars or [])] if isinstance(
+        feed_vars, (list, tuple)) else []
+    if specs and all(isinstance(s, InputSpec) for s in specs):
+        from .program_export import export_reference_inference_model
+
+        export_reference_inference_model(path_prefix, specs, target)
+        return
     jit_save(target, path_prefix)
 
 

@@ -1,0 +1,850 @@
+"""Reference-format inference model EXPORT: ``.pdmodel`` + ``.pdiparams``.
+
+Closes the other half of the interop gap (``program_import`` is the read
+side): a model trained here can be handed BACK to a reference deployment.
+``export_reference_inference_model`` traces the Layer's forward to a
+jaxpr at the declared InputSpec shapes, translates each jax primitive
+into a reference ``OpDesc``, and serializes the reference wire formats:
+
+- ``.pdmodel``: ProgramDesc protobuf, field numbers per
+  paddle/fluid/framework/framework.proto (same schema the importer
+  parses — the two sides are written independently so round-trip tests
+  cross-validate both).
+- ``.pdiparams``: the combined parameter stream (tensor_util.cc
+  ``TensorToStream`` records concatenated in sorted-variable-name order,
+  python/paddle/static/io.py:661).
+
+API match: python/paddle/static/io.py:442 ``save_inference_model``.
+
+Translation strategy (the inverse direction of ``program_import``): the
+jaxpr is flattened (pjit/custom_jvp/custom_vjp/remat sub-calls inlined),
+dead code eliminated, then each equation maps through ``_PRIM_TABLE``.
+Scalar literals fold into ``scale``/``pow``/``relu`` ops instead of
+materializing tensors; ``broadcast_in_dim`` that only inserts size-1
+axes becomes ``reshape2`` (reference elementwise ops broadcast
+numpy-style, so the expanded form is never needed for elementwise
+consumers — a real expansion for a non-elementwise consumer emits
+``expand_v2``).
+
+Dynamic batch: InputSpec dims of None/-1 trace under a placeholder
+extent (a prime, so accidental collisions with real sizes are
+implausible) and are re-encoded as -1 in VarDesc dims / 0 or -1 in
+``reshape2`` shape attrs.  A reshape that mixes the batch extent into a
+dim in a way the 0/-1 attr grammar cannot express refuses with guidance.
+
+Unsupported primitives refuse with an actionable NotImplementedError
+naming the primitive — the contract is an exact-or-refuse exporter, not
+a best-effort one (mirrors the importer's refusal style).
+"""
+
+import struct
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax._src.core import Literal
+
+_BATCH = 977  # prime placeholder extent for dynamic (None/-1) dims
+
+# VarType.Type enum (framework.proto)
+_VT = {np.dtype(np.bool_): 0, np.dtype(np.int16): 1,
+       np.dtype(np.int32): 2, np.dtype(np.int64): 3,
+       np.dtype(np.float16): 4, np.dtype(np.float32): 5,
+       np.dtype(np.float64): 6, np.dtype(np.uint8): 20,
+       np.dtype(np.int8): 21}
+_LOD_TENSOR, _FEED_MINIBATCH, _FETCH_LIST = 7, 9, 10
+
+
+# --------------------------------------------------------- wire ENCODER --
+# (independent of the importer's _Reader and of the test-suite encoder —
+# three implementations of one schema keep each other honest)
+
+def _vint(v):
+    out = b""
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _f(no, wire, payload):
+    return _vint(no << 3 | wire) + payload
+
+
+def _fbytes(no, data):
+    return _f(no, 2, _vint(len(data)) + data)
+
+
+def _fstr(no, s):
+    return _fbytes(no, s.encode())
+
+
+def _fint(no, v):
+    return _f(no, 0, _vint(int(v)))
+
+
+def _ffloat(no, v):
+    return _f(no, 5, struct.pack("<f", float(v)))
+
+
+def _enc_attr(name, kind, value):
+    """OpDesc.Attr: name(1), type(2), then the typed field."""
+    types = {"i": 0, "f": 1, "s": 2, "ints": 3, "b": 6, "l": 9,
+             "longs": 11}
+    out = _fstr(1, name) + _fint(2, types[kind])
+    if kind == "i":
+        out += _fint(3, value)
+    elif kind == "f":
+        out += _ffloat(4, value)
+    elif kind == "s":
+        out += _fstr(5, value)
+    elif kind == "ints":
+        for x in value:
+            out += _fint(6, x)
+    elif kind == "b":
+        out += _fint(10, int(bool(value)))
+    elif kind == "l":
+        out += _fint(13, value)
+    elif kind == "longs":
+        for x in value:
+            out += _fint(15, x)
+    return out
+
+
+def _enc_op(type_, inputs, outputs, attrs):
+    out = b""
+    for param, args in inputs.items():
+        body = _fstr(1, param)
+        for a in args:
+            body += _fstr(2, a)
+        out += _fbytes(1, body)
+    for param, args in outputs.items():
+        body = _fstr(1, param)
+        for a in args:
+            body += _fstr(2, a)
+        out += _fbytes(2, body)
+    out += _fstr(3, type_)
+    for name, kind, value in attrs:
+        out += _fbytes(4, _enc_attr(name, kind, value))
+    return out
+
+
+def _enc_var(name, dims, dtype_code, persistable, vtype=_LOD_TENSOR):
+    if vtype == _LOD_TENSOR:
+        tensor = _fint(1, dtype_code)
+        for d in dims:
+            tensor += _fint(2, d)
+        body = _fint(1, vtype) + _fbytes(3, _fbytes(1, tensor))
+    else:
+        body = _fint(1, vtype)
+    out = _fstr(1, name) + _fbytes(2, body)
+    if persistable:
+        out += _fint(3, 1)
+    return out
+
+
+def _enc_program(op_blobs, var_blobs):
+    block = _fint(1, 0) + _fint(2, -1)
+    for v in var_blobs:
+        block += _fbytes(3, v)
+    for o in op_blobs:
+        block += _fbytes(4, o)
+    return _fbytes(1, block)
+
+
+def _tensor_stream(arr):
+    """One LoDTensor record (tensor_util.cc TensorToStream)."""
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype not in _VT:
+        raise NotImplementedError(
+            f"parameter dtype {arr.dtype} has no VarType code; cast the "
+            "parameter to float32/float64/int32/int64 before export")
+    desc = _fint(1, _VT[arr.dtype])
+    for d in arr.shape:
+        desc += _fint(2, d)
+    out = struct.pack("<I", 0)            # LoDTensor version
+    out += struct.pack("<Q", 0)           # lod_level
+    out += struct.pack("<I", 0)           # tensor version
+    out += struct.pack("<i", len(desc)) + desc
+    return out + arr.tobytes()
+
+
+# ------------------------------------------------------ jaxpr flattening --
+
+class _Const:
+    """A closed-over constant entering the flat eqn list."""
+
+    __slots__ = ("val",)
+
+    def __init__(self, val):
+        self.val = val
+
+
+def _resolve(atom, sub):
+    if isinstance(atom, Literal):
+        return atom
+    return sub.get(atom, atom)
+
+
+def _inner_closed(eqn):
+    """The sub-jaxpr of a call-like eqn, as (jaxpr, consts)."""
+    p = eqn.params
+    inner = p.get("call_jaxpr") or p.get("jaxpr") or p.get("fun_jaxpr")
+    if inner is None:
+        return None
+    if hasattr(inner, "jaxpr"):           # ClosedJaxpr
+        return inner.jaxpr, list(inner.consts)
+    return inner, []
+
+
+_CALL_PRIMS = {"pjit", "jit", "closed_call", "core_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+               "checkpoint", "remat2", "custom_jvp_call_jaxpr"}
+
+
+def _flatten(jaxpr, consts, sub, eqns):
+    for cv, cval in zip(jaxpr.constvars, consts):
+        sub[cv] = _Const(cval)
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in _CALL_PRIMS:
+            got = _inner_closed(eqn)
+            if got is None:
+                raise NotImplementedError(
+                    f"call primitive {name!r} without an inlineable "
+                    "sub-jaxpr is not exportable")
+            inner, iconsts = got
+            isub = {}
+            for iv, a in zip(inner.invars, eqn.invars):
+                isub[iv] = _resolve(a, sub)
+            _flatten(inner, iconsts, isub, eqns)
+            for ov, iov in zip(eqn.outvars, inner.outvars):
+                sub[ov] = _resolve(iov, isub)
+        else:
+            ins = [_resolve(a, sub) for a in eqn.invars]
+            eqns.append((name, ins, eqn.outvars, eqn.params))
+    return sub
+
+
+def _dce(eqns, live):
+    keep = []
+    for name, ins, outs, params in reversed(eqns):
+        if any(o in live for o in outs):
+            keep.append((name, ins, outs, params))
+            for a in ins:
+                if not isinstance(a, (Literal, _Const)):
+                    live.add(a)
+    return keep[::-1]
+
+
+# ------------------------------------------------------------ translator --
+
+class _Ref:
+    """A value bound to a program variable."""
+
+    __slots__ = ("name", "shape", "dtype", "expand_to")
+
+    def __init__(self, name, shape, dtype, expand_to=None):
+        self.name = name
+        self.shape = tuple(int(d) for d in shape)
+        self.dtype = np.dtype(dtype)
+        # pending broadcast target (see broadcast_in_dim handler): the
+        # var holds the size-1-axes reshape; elementwise consumers use
+        # it directly, others force an expand_v2 first
+        self.expand_to = expand_to
+
+
+class _Lit:
+    """A scalar literal riding along unmaterialized."""
+
+    __slots__ = ("val", "dtype")
+
+    def __init__(self, val, dtype):
+        self.val = val
+        self.dtype = np.dtype(dtype)
+
+
+class _Exporter:
+    def __init__(self):
+        self.ops = []           # (type, ins, outs, attrs)
+        self.vars = {}          # name -> (dims, dtype_code, persistable)
+        self.params = {}        # name -> ndarray
+        self.env = {}           # jaxpr var -> _Ref | _Lit
+        self._const_names = {}  # id(arr) -> name
+        self._n = 0
+
+    # ---- naming / registration
+
+    def _fresh(self, prefix="t"):
+        self._n += 1
+        return f"{prefix}_{self._n:04d}"
+
+    def _declare(self, name, shape, dtype, persistable=False):
+        dims = [-1 if d == _BATCH else int(d) for d in shape]
+        self.vars[name] = (dims, _np_vt(dtype), persistable)
+
+    def _emit(self, op_type, ins, outs, attrs=()):
+        self.ops.append((op_type, ins, outs, list(attrs)))
+
+    def _new_out(self, shape, dtype, op_type, ins, attrs=(), prefix="t"):
+        name = self._fresh(prefix)
+        self._declare(name, shape, dtype)
+        self._emit(op_type, ins, {_OUT_PARAM.get(op_type, "Out"): [name]},
+                   attrs)
+        return _Ref(name, shape, dtype)
+
+    # ---- value access
+
+    def val(self, atom):
+        if isinstance(atom, (Literal, _Const)):
+            v = np.asarray(atom.val)
+            if v.ndim == 0:
+                return _Lit(v.item(), v.dtype)
+            # dedup on the SOURCE object (stable across uses), not the
+            # np.asarray copy freshly made per call — a tied weight
+            # consumed by two ops must serialize once
+            return self.const_ref(v, key=id(atom.val))
+        got = self.env.get(atom)
+        if got is None:
+            raise AssertionError(f"unbound jaxpr var {atom}")
+        return got
+
+    def const_ref(self, arr, key=None):
+        key = id(arr) if key is None else key
+        if key not in self._const_names:
+            name = f"p_{len(self.params):04d}"
+            self.params[name] = np.asarray(arr)
+            self._declare(name, arr.shape, arr.dtype, persistable=True)
+            self._const_names[key] = name
+        name = self._const_names[key]
+        return _Ref(name, arr.shape, arr.dtype)
+
+    def force(self, ref):
+        """Materialize a pending expand_v2 (non-elementwise consumer)."""
+        if isinstance(ref, _Ref) and ref.expand_to is not None:
+            if any(d == _BATCH for d in ref.expand_to):
+                # expand_v2's -1 means 'keep input dim' (which is 1
+                # here), so a dynamic-batch expansion is inexpressible
+                raise NotImplementedError(
+                    "broadcast to a dynamic batch extent feeds a "
+                    "non-broadcasting consumer; export with a concrete "
+                    "batch size in the InputSpec")
+            tgt = [int(d) for d in ref.expand_to]
+            out = self._new_out(ref.expand_to, ref.dtype, "expand_v2",
+                                {"X": [ref.name]},
+                                [("shape", "ints", tgt)])
+            return out
+        return ref
+
+    def materialize(self, lit, shape=(1,)):
+        """A scalar literal as a [1] tensor (numpy broadcast covers)."""
+        dt = lit.dtype
+        code = _np_vt(dt)
+        return self._new_out(
+            shape, dt, "fill_constant", {},
+            [("shape", "longs", list(shape)),
+             ("value", "f", float(lit.val)),
+             ("dtype", "i", code)])
+
+
+def _np_vt(dtype):
+    dt = np.dtype(dtype)
+    if dt == np.dtype(jnp.bfloat16):
+        raise NotImplementedError(
+            "bfloat16 vars have no stable reference wire dtype here; "
+            "cast the model to float32 before export "
+            "(paddle.amp.decorate is a training-time wrapper)")
+    if dt not in _VT:
+        raise NotImplementedError(f"dtype {dt} has no VarType code")
+    return _VT[dt]
+
+
+_OUT_PARAM = {"conv2d": "Output"}
+
+_UNARY = {"exp": "exp", "log": "log", "tanh": "tanh", "abs": "abs",
+          "sqrt": "sqrt", "rsqrt": "rsqrt", "floor": "floor",
+          "logistic": "sigmoid", "erf": "erf", "sign": "sign",
+          "log1p": "log1p", "sin": "sin", "cos": "cos"}
+
+_BINOP = {"add": "elementwise_add", "sub": "elementwise_sub",
+          "mul": "elementwise_mul", "div": "elementwise_div",
+          "max": "elementwise_max", "min": "elementwise_min",
+          "pow": "elementwise_pow", "rem": "elementwise_mod",
+          "eq": "equal", "gt": "greater_than", "lt": "less_than",
+          "ge": "greater_equal", "le": "less_equal", "ne": "not_equal",
+          "and": "logical_and", "or": "logical_or",
+          "xor": "logical_xor"}
+
+_REDUCE = {"reduce_sum": "reduce_sum", "reduce_max": "reduce_max",
+           "reduce_min": "reduce_min", "reduce_prod": "reduce_prod"}
+
+
+def _out_aval(outs):
+    return outs[0].aval
+
+
+def translate(exporter, name, ins, outs, params):
+    ex = exporter
+    aval = _out_aval(outs)
+
+    def bind(v):
+        ex.env[outs[0]] = v
+
+    # -- aliases / no-ops
+    if name in ("stop_gradient", "copy", "device_put",
+                "sharding_constraint"):
+        bind(ex.val(ins[0]))
+        return
+    if name == "convert_element_type":
+        src = ex.val(ins[0])
+        tgt = np.dtype(params["new_dtype"])
+        if isinstance(src, _Lit):
+            bind(_Lit(np.asarray(src.val, tgt).item(), tgt))
+            return
+        if src.dtype == tgt:
+            bind(src)
+            return
+        src = ex.force(src)
+        bind(ex._new_out(aval.shape, tgt, "cast", {"X": [src.name]},
+                         [("in_dtype", "i", _np_vt(src.dtype)),
+                          ("out_dtype", "i", _np_vt(tgt))]))
+        return
+
+    if name in _UNARY:
+        x = ex.val(ins[0])
+        if isinstance(x, _Lit):
+            raise NotImplementedError(
+                f"scalar-literal {name} survived constant folding "
+                "unexpectedly; please report")
+        x = ex.force(x)
+        bind(ex._new_out(aval.shape, aval.dtype, _UNARY[name],
+                         {"X": [x.name]}))
+        return
+
+    if name == "neg":
+        x = ex.force(ex.val(ins[0]))
+        bind(ex._new_out(aval.shape, aval.dtype, "scale",
+                         {"X": [x.name]},
+                         [("scale", "f", -1.0), ("bias", "f", 0.0),
+                          ("bias_after_scale", "b", True)]))
+        return
+
+    if name == "integer_pow":
+        x = ex.force(ex.val(ins[0]))
+        y = params["y"]
+        if y == 2:
+            bind(ex._new_out(aval.shape, aval.dtype, "square",
+                             {"X": [x.name]}))
+        else:
+            bind(ex._new_out(aval.shape, aval.dtype, "pow",
+                             {"X": [x.name]},
+                             [("factor", "f", float(y))]))
+        return
+
+    if name in _BINOP:
+        if name in ("and", "or", "xor") and \
+                np.dtype(aval.dtype) != np.dtype(np.bool_):
+            # jax and/or/xor double as integer BITWISE ops; the
+            # reference logical_* family is boolean-only
+            raise NotImplementedError(
+                f"integer bitwise {name!r} has no reference logical_* "
+                "translation (paddle's logical ops are boolean); "
+                "restructure with arithmetic ops or export the mask "
+                "as a bool tensor")
+        if name == "rem":
+            bind(_emit_trunc_rem(ex, ins, aval))
+            return
+        a, b = ex.val(ins[0]), ex.val(ins[1])
+        out = _emit_binop(ex, name, a, b, aval)
+        bind(out)
+        return
+
+    if name == "select_n":
+        if len(ins) != 3:
+            raise NotImplementedError(
+                "select_n with more than two cases (jnp.select/"
+                "jnp.piecewise with an integer selector) has no "
+                "reference where-op translation; restructure as nested "
+                "two-way selects")
+        pred = ex.force(ex.val(ins[0]))
+        if isinstance(pred, _Lit):
+            bind(ex.val(ins[2] if pred.val else ins[1]))
+            return
+        on_false = ex.val(ins[1])
+        on_true = ex.val(ins[2])
+        on_false = ex.force(on_false) if isinstance(on_false, _Ref) \
+            else ex.materialize(on_false)
+        on_true = ex.force(on_true) if isinstance(on_true, _Ref) \
+            else ex.materialize(on_true)
+        bind(ex._new_out(aval.shape, aval.dtype, "where",
+                         {"Condition": [pred.name], "X": [on_true.name],
+                          "Y": [on_false.name]}))
+        return
+
+    if name == "broadcast_in_dim":
+        src = ex.val(ins[0])
+        if isinstance(src, _Lit):
+            bind(src)              # scalar: numpy broadcasting covers it
+            return
+        bd = tuple(params["broadcast_dimensions"])
+        shape = tuple(int(d) for d in params["shape"])
+        expanded = any(shape[d] != src.shape[i]
+                       for i, d in enumerate(bd)) or \
+            any(i not in bd and shape[i] != 1 for i in range(len(shape)))
+        ones = [1] * len(shape)
+        for i, d in enumerate(bd):
+            ones[d] = int(src.shape[i])
+        src = ex.force(src)
+        if tuple(ones) == src.shape:
+            mid = src
+        else:
+            mid = ex._new_out(tuple(ones), src.dtype, "reshape2",
+                              {"X": [src.name]},
+                              [("shape", "ints", _reshape_attr(
+                                  src.shape, tuple(ones)))])
+        if expanded:
+            mid = _Ref(mid.name, mid.shape, mid.dtype, expand_to=shape)
+        bind(mid)
+        return
+
+    if name == "reshape":
+        x = ex.force(ex.val(ins[0]))
+        new = tuple(int(d) for d in params["new_sizes"])
+        bind(ex._new_out(new, aval.dtype, "reshape2", {"X": [x.name]},
+                         [("shape", "ints",
+                           _reshape_attr(x.shape, new))]))
+        return
+
+    if name == "squeeze":
+        x = ex.force(ex.val(ins[0]))
+        new = tuple(int(d) for d in aval.shape)
+        bind(ex._new_out(new, aval.dtype, "reshape2", {"X": [x.name]},
+                         [("shape", "ints",
+                           _reshape_attr(x.shape, new))]))
+        return
+
+    if name == "transpose":
+        x = ex.force(ex.val(ins[0]))
+        bind(ex._new_out(aval.shape, aval.dtype, "transpose2",
+                         {"X": [x.name]},
+                         [("axis", "ints",
+                           list(params["permutation"]))]))
+        return
+
+    if name in _REDUCE:
+        x = ex.force(ex.val(ins[0]))
+        axes = sorted(int(a) for a in params["axes"])
+        # reference reduce_* declare dim as std::vector<int> (INTS);
+        # LONGS would fail the GetAttr variant access at load time
+        attrs = [("dim", "ints", axes), ("keep_dim", "b", False)]
+        if len(axes) == len(x.shape):
+            attrs.append(("reduce_all", "b", True))
+        bind(ex._new_out(aval.shape, aval.dtype, _REDUCE[name],
+                         {"X": [x.name]}, attrs))
+        return
+
+    if name in ("argmax", "argmin"):
+        x = ex.force(ex.val(ins[0]))
+        axes = params["axes"]
+        if len(axes) != 1:
+            raise NotImplementedError(
+                "multi-axis argmax/argmin is not exportable")
+        op = "arg_max" if name == "argmax" else "arg_min"
+        bind(ex._new_out(aval.shape, aval.dtype, op, {"X": [x.name]},
+                         [("axis", "l", int(axes[0])),
+                          ("keepdims", "b", False),
+                          ("dtype", "i",
+                           _np_vt(aval.dtype))]))
+        return
+
+    if name == "concatenate":
+        vals = [ex.force(ex.val(a)) for a in ins]
+        if any(isinstance(v, _Lit) for v in vals):
+            vals = [v if isinstance(v, _Ref) else ex.materialize(v)
+                    for v in vals]
+        bind(ex._new_out(aval.shape, aval.dtype, "concat",
+                         {"X": [v.name for v in vals]},
+                         [("axis", "i", int(params["dimension"]))]))
+        return
+
+    if name == "dot_general":
+        bind(_emit_dot(ex, ins, params, aval))
+        return
+
+    if name == "conv_general_dilated":
+        bind(_emit_conv(ex, ins, params, aval))
+        return
+
+    raise NotImplementedError(
+        f"jax primitive {name!r} has no reference-op translation; the "
+        "exportable subset is: "
+        f"{sorted(set(_UNARY) | set(_BINOP) | set(_REDUCE)) + _OTHERS} "
+        "(if the model uses dropout or other train-only randomness, "
+        "call .eval() before export; for everything else use the "
+        "native format: static.save_inference_model(prefix, [], model))")
+
+
+_OTHERS = ["argmax", "broadcast_in_dim", "cast", "concatenate",
+           "conv_general_dilated", "dot_general", "neg", "reshape",
+           "select_n", "squeeze", "transpose"]
+
+
+def _reshape_attr(src_shape, new_shape):
+    """Encode a reshape target with dynamic-batch dims as 0/-1."""
+    out = []
+    inferred = None
+    for i, d in enumerate(new_shape):
+        if d == _BATCH:
+            if i < len(src_shape) and src_shape[i] == _BATCH:
+                out.append(0)        # 0 = copy input dim i
+                continue
+            if inferred is None:
+                inferred = i
+                out.append(-1)
+                continue
+            raise NotImplementedError(
+                "reshape places the dynamic batch extent in two "
+                "positions; inexpressible in reshape2's 0/-1 grammar — "
+                "export with a concrete batch size")
+        if d != _BATCH and d % _BATCH == 0 and _BATCH in src_shape:
+            if inferred is not None:
+                raise NotImplementedError(
+                    "reshape mixes the dynamic batch extent into "
+                    "multiple dims; export with a concrete batch size")
+            inferred = i
+            out.append(-1)
+            continue
+        out.append(int(d))
+    return out
+
+
+def _emit_binop(ex, name, a, b, aval):
+    op = _BINOP[name]
+    # scalar folds (scale / relu / pow) keep programs idiomatic
+    if isinstance(b, _Lit) and not isinstance(a, _Lit) and \
+            np.issubdtype(np.dtype(aval.dtype), np.floating):
+        a_r = ex.force(a)
+        v = float(b.val)
+        if name == "add":
+            return _scale(ex, a_r, aval, 1.0, v)
+        if name == "sub":
+            return _scale(ex, a_r, aval, 1.0, -v)
+        if name == "mul":
+            return _scale(ex, a_r, aval, v, 0.0)
+        if name == "div" and v != 0.0:
+            return _scale(ex, a_r, aval, 1.0 / v, 0.0)
+        if name == "pow":
+            return ex._new_out(aval.shape, aval.dtype, "pow",
+                               {"X": [a_r.name]},
+                               [("factor", "f", v)])
+        if name == "max":
+            if v == 0.0:
+                return ex._new_out(aval.shape, aval.dtype, "relu",
+                                   {"X": [a_r.name]})
+            if v == float("-inf"):
+                return a_r
+        if name == "min" and v == float("inf"):
+            return a_r
+    if isinstance(a, _Lit) and not isinstance(b, _Lit) and \
+            np.issubdtype(np.dtype(aval.dtype), np.floating):
+        b_r = ex.force(b)
+        v = float(a.val)
+        if name == "add":
+            return _scale(ex, b_r, aval, 1.0, v)
+        if name == "mul":
+            return _scale(ex, b_r, aval, v, 0.0)
+        if name == "sub":
+            return _scale(ex, b_r, aval, -1.0, v)
+        if name == "max" and v == float("-inf"):
+            return b_r
+        if name == "min" and v == float("inf"):
+            return b_r
+    a = a if isinstance(a, _Ref) else ex.materialize(a)
+    b = b if isinstance(b, _Ref) else ex.materialize(b)
+    # elementwise consumers don't need a pending broadcast materialized:
+    # the size-1-axes form broadcasts numpy-style to the same result —
+    # UNLESS the expansion is load-bearing for the output shape (the
+    # other operand doesn't force it), in which case expand for real
+    try:
+        implied = np.broadcast_shapes(a.shape, b.shape)
+    except ValueError:
+        implied = None
+    if implied != tuple(int(d) for d in aval.shape):
+        a, b = ex.force(a), ex.force(b)
+    return ex._new_out(aval.shape, aval.dtype, op,
+                       {"X": [a.name], "Y": [b.name]},
+                       [("axis", "i", -1)])
+
+
+def _emit_trunc_rem(ex, ins, aval):
+    """jax ``rem`` is the TRUNCATED remainder (sign of dividend);
+    paddle's elementwise_mod is floor-mod (sign of divisor), so a
+    direct mapping silently flips signs for negative operands.  Emit
+    the exact composition x - trunc(x/y)*y instead; trunc(q) =
+    sign(q)*floor(|q|)."""
+    if not np.issubdtype(np.dtype(aval.dtype), np.floating):
+        raise NotImplementedError(
+            "integer rem export is not implemented (the float "
+            "composition via floor would lose precision)")
+    a = ex.val(ins[0])
+    b = ex.val(ins[1])
+    a = ex.force(a) if isinstance(a, _Ref) else ex.materialize(a)
+    b = ex.force(b) if isinstance(b, _Ref) else ex.materialize(b)
+    q = ex._new_out(aval.shape, aval.dtype, "elementwise_div",
+                    {"X": [a.name], "Y": [b.name]}, [("axis", "i", -1)])
+    sg = ex._new_out(aval.shape, aval.dtype, "sign", {"X": [q.name]})
+    ab = ex._new_out(aval.shape, aval.dtype, "abs", {"X": [q.name]})
+    fl = ex._new_out(aval.shape, aval.dtype, "floor", {"X": [ab.name]})
+    tr = ex._new_out(aval.shape, aval.dtype, "elementwise_mul",
+                     {"X": [sg.name], "Y": [fl.name]},
+                     [("axis", "i", -1)])
+    prod = ex._new_out(aval.shape, aval.dtype, "elementwise_mul",
+                       {"X": [tr.name], "Y": [b.name]},
+                       [("axis", "i", -1)])
+    return ex._new_out(aval.shape, aval.dtype, "elementwise_sub",
+                       {"X": [a.name], "Y": [prod.name]},
+                       [("axis", "i", -1)])
+
+
+def _scale(ex, x, aval, scale, bias):
+    if scale == 1.0 and bias == 0.0:
+        return x
+    return ex._new_out(aval.shape, aval.dtype, "scale", {"X": [x.name]},
+                       [("scale", "f", scale), ("bias", "f", bias),
+                        ("bias_after_scale", "b", True)])
+
+
+def _emit_dot(ex, ins, params, aval):
+    (lc, rc), (lb, rb) = params["dimension_numbers"]
+    a = ex.force(ex.val(ins[0]))
+    b = ex.force(ex.val(ins[1]))
+    la, lb_ = len(a.shape), len(b.shape)
+    if len(lc) != 1 or len(rc) != 1:
+        raise NotImplementedError(
+            "dot_general with multiple contracting dims is not "
+            "exportable as matmul_v2")
+    if tuple(lb) != tuple(range(len(lb))) or tuple(rb) != tuple(
+            range(len(rb))) or len(lb) != len(rb):
+        raise NotImplementedError(
+            "dot_general with non-leading batch dims is not exportable")
+    nb = len(lb)
+    if la - nb != 2 or lb_ - nb != 2:
+        raise NotImplementedError(
+            "dot_general on non-matrix operands is not exportable as "
+            "matmul_v2 (vectors: reshape to [1, n] first)")
+    if lc[0] not in (la - 1, la - 2) or rc[0] not in (lb_ - 1, lb_ - 2):
+        raise NotImplementedError("dot_general contracting dim layout "
+                                  "is not a matmul")
+    trans_x = lc[0] == la - 2
+    trans_y = rc[0] == lb_ - 1
+    return ex._new_out(aval.shape, aval.dtype, "matmul_v2",
+                       {"X": [a.name], "Y": [b.name]},
+                       [("trans_x", "b", trans_x),
+                        ("trans_y", "b", trans_y)])
+
+
+def _emit_conv(ex, ins, params, aval):
+    dn = params["dimension_numbers"]
+    if (tuple(dn.lhs_spec), tuple(dn.rhs_spec), tuple(dn.out_spec)) != \
+            ((0, 1, 2, 3), (0, 1, 2, 3), (0, 1, 2, 3)):
+        raise NotImplementedError(
+            "only NCHW/OIHW conv layouts export to conv2d")
+    if tuple(params.get("lhs_dilation", (1, 1))) != (1, 1):
+        raise NotImplementedError(
+            "transposed conv (lhs_dilation) export is not implemented")
+    if params.get("batch_group_count", 1) != 1:
+        raise NotImplementedError("batch_group_count != 1")
+    x = ex.force(ex.val(ins[0]))
+    w = ex.force(ex.val(ins[1]))
+    pads = params["padding"]
+    attrs = [
+        ("strides", "ints", [int(s) for s in params["window_strides"]]),
+        ("paddings", "ints", [int(pads[0][0]), int(pads[0][1]),
+                              int(pads[1][0]), int(pads[1][1])]),
+        ("dilations", "ints",
+         [int(d) for d in params.get("rhs_dilation", (1, 1))]),
+        ("groups", "i", int(params.get("feature_group_count", 1))),
+        ("padding_algorithm", "s", "EXPLICIT"),
+    ]
+    return ex._new_out(aval.shape, aval.dtype, "conv2d",
+                       {"Input": [x.name], "Filter": [w.name]}, attrs)
+
+
+# ------------------------------------------------------------ public API --
+
+def export_reference_inference_model(path_prefix, input_specs, layer):
+    """Write ``{path_prefix}.pdmodel`` + ``.pdiparams`` in the reference
+    wire format.  Returns the list of emitted op types (feed/fetch
+    included) for introspection/testing.
+
+    ``input_specs``: list of static.InputSpec; None/-1 dims are dynamic.
+    ``layer``: a Layer (or any callable taking/returning Tensors).
+    """
+    from ..core.tensor import Tensor
+
+    specs = list(input_specs)
+    if not specs:
+        raise ValueError("reference-format export needs at least one "
+                         "InputSpec describing the program feeds")
+
+    def fn(*xs):
+        out = layer(*[Tensor(x) for x in xs])
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(o._data if isinstance(o, Tensor) else jnp.asarray(o)
+                     for o in outs)
+
+    args = []
+    for spec in specs:
+        dims = tuple(_BATCH if (d is None or d == -1) else int(d)
+                     for d in spec.shape)
+        args.append(jax.ShapeDtypeStruct(dims, np.dtype(spec.dtype)))
+    closed = jax.make_jaxpr(fn)(*args)
+
+    ex = _Exporter()
+    flat = []
+    sub = _flatten(closed.jaxpr, list(closed.consts), {}, flat)
+    outs = [_resolve(v, sub) for v in closed.jaxpr.outvars]
+    live = {v for v in outs if not isinstance(v, (Literal, _Const))}
+    flat = _dce(flat, live)
+
+    # feeds
+    feed_names = []
+    for i, (spec, arg) in enumerate(zip(specs, args)):
+        fname = spec.name or f"x{i}"
+        feed_names.append(fname)
+        ex._declare(fname, arg.shape, arg.dtype)
+        ex.env[closed.jaxpr.invars[i]] = _Ref(fname, arg.shape,
+                                              arg.dtype)
+        ex._emit("feed", {"X": ["feed"]}, {"Out": [fname]},
+                 [("col", "i", i)])
+
+    for name, ins, outvars, prm in flat:
+        translate(ex, name, ins, outvars, prm)
+
+    # fetches
+    fetch_names = []
+    for i, atom in enumerate(outs):
+        v = ex.val(atom)
+        v = ex.force(v) if isinstance(v, _Ref) else ex.materialize(v)
+        fetch_names.append(v.name)
+        ex._emit("fetch", {"X": [v.name]}, {"Out": ["fetch"]},
+                 [("col", "i", i)])
+
+    # serialize
+    var_blobs = [_enc_var("feed", [], 0, True, vtype=_FEED_MINIBATCH),
+                 _enc_var("fetch", [], 0, True, vtype=_FETCH_LIST)]
+    for name, (dims, code, persistable) in sorted(ex.vars.items()):
+        var_blobs.append(_enc_var(name, dims, code, persistable))
+    op_blobs = [_enc_op(t, i, o, a) for t, i, o, a in ex.ops]
+    with open(f"{path_prefix}.pdmodel", "wb") as f:
+        f.write(_enc_program(op_blobs, var_blobs))
+    blob = b"".join(_tensor_stream(ex.params[k])
+                    for k in sorted(ex.params))
+    with open(f"{path_prefix}.pdiparams", "wb") as f:
+        f.write(blob)
+    return [t for t, _i, _o, _a in ex.ops]

@@ -375,14 +375,6 @@ def _cat(fn, ins, attrs):
     return fn(ins["__X_all__"], axis=attrs.get("axis", 0))
 
 
-def _argmax(ins, attrs):
-    x = ins["X"]
-    dt = _DTYPES.get(attrs.get("dtype", 3), np.int64)
-    if attrs.get("flatten", False):
-        # reference: flatten=True indexes into the flattened tensor
-        return jnp.argmax(x.reshape(-1)).astype(dt)
-    return jnp.argmax(x, axis=attrs.get("axis", -1),
-                      keepdims=attrs.get("keepdims", False)).astype(dt)
 
 
 def _eltwise(fn):
@@ -596,7 +588,7 @@ _TRANSLATORS = {
     "reduce_mean": _reduce(jnp.mean),
     "reduce_sum": _reduce(jnp.sum),
     "reduce_max": _reduce(jnp.max),
-    "arg_max": _argmax,
+    "arg_max": lambda ins, attrs: _arg_reduce(jnp.argmax, ins, attrs),
     "nearest_interp_v2": _interp("nearest"),
     "bilinear_interp_v2": _interp("bilinear"),
     "equal": _eltwise(jnp.equal),
@@ -626,7 +618,87 @@ _TRANSLATORS = {
     "gather": lambda ins, attrs: _gather(ins, attrs),
     "instance_norm": lambda ins, attrs: _instance_norm(ins, attrs),
     "group_norm": lambda ins, attrs: _group_norm(ins, attrs),
+    # comparison / logical / selection family (the export side emits
+    # these from jax eq/gt/lt/ge/le/ne/and/or/xor/select_n eqns)
+    "less_than": _eltwise(jnp.less),
+    "less_equal": _eltwise(jnp.less_equal),
+    "greater_equal": _eltwise(jnp.greater_equal),
+    "not_equal": _eltwise(jnp.not_equal),
+    "logical_and": _eltwise(jnp.logical_and),
+    "logical_or": _eltwise(jnp.logical_or),
+    "logical_xor": _eltwise(jnp.logical_xor),
+    "logical_not": _act(jnp.logical_not),
+    "where": lambda ins, attrs: jnp.where(ins["Condition"], ins["X"],
+                                          ins["Y"]),
+    "sign": _act(jnp.sign),
+    "log1p": _act(jnp.log1p),
+    "log2": _act(jnp.log2),
+    "log10": _act(jnp.log10),
+    "sin": _act(jnp.sin),
+    "cos": _act(jnp.cos),
+    "tan": _act(jnp.tan),
+    "asin": _act(jnp.arcsin),
+    "acos": _act(jnp.arccos),
+    "atan": _act(jnp.arctan),
+    "sinh": _act(jnp.sinh),
+    "cosh": _act(jnp.cosh),
+    "ceil": _act(jnp.ceil),
+    # reference round is std::round (half AWAY from zero); jnp.round is
+    # banker's rounding and diverges at .5 ties
+    "round": _act(lambda x: jnp.where(x >= 0, jnp.floor(x + 0.5),
+                                      jnp.ceil(x - 0.5))),
+    "reciprocal": _act(jnp.reciprocal),
+    "arg_min": lambda ins, attrs: _arg_reduce(jnp.argmin, ins, attrs),
+    "cumsum": lambda ins, attrs: _cumsum(ins, attrs),
+    "p_norm": lambda ins, attrs: _p_norm(ins, attrs),
+    "softsign": _act(lambda x: x / (1 + jnp.abs(x))),
+    "elu": lambda ins, attrs: jax.nn.elu(ins["X"],
+                                         attrs.get("alpha", 1.0)),
+    "selu": lambda ins, attrs: attrs.get("scale", 1.0507009873554805)
+    * jnp.where(ins["X"] > 0, ins["X"],
+                attrs.get("alpha", 1.6732632423543772)
+                * (jnp.exp(ins["X"]) - 1)),
+    "maximum": _eltwise(jnp.maximum),
+    "minimum": _eltwise(jnp.minimum),
 }
+
+
+def _arg_reduce(fn, ins, attrs):
+    """Shared arg_max/arg_min attr handling (flatten/dtype/keepdims)."""
+    x = ins["X"]
+    dt = _DTYPES.get(attrs.get("dtype", 3), np.int64)
+    if attrs.get("flatten", False):
+        return fn(x.reshape(-1)).astype(dt)
+    return fn(x, axis=attrs.get("axis", -1),
+              keepdims=attrs.get("keepdims", False)).astype(dt)
+
+
+def _cumsum(ins, attrs):
+    x = ins["X"]
+    if attrs.get("flatten", False):
+        x = x.reshape(-1)
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x          # exclusive = inclusive minus current
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return out
+
+
+def _p_norm(ins, attrs):
+    x = ins["X"]
+    p = attrs.get("porder", 2.0)
+    # the reference op declares SetDefault(-1) for axis; only
+    # asvector=True flattens
+    axis = attrs.get("axis", -1)
+    keep = attrs.get("keepdim", False)
+    if attrs.get("asvector", False):
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keep)
 
 
 def _prelu(ins, attrs):
