@@ -68,7 +68,13 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
             "with paddle_tpu.jit.to_static instead.")
     specs = [v for v in (feed_vars or [])] if isinstance(
         feed_vars, (list, tuple)) else []
-    if specs and all(isinstance(s, InputSpec) for s in specs):
+    if specs:
+        bad = [s for s in specs if not isinstance(s, InputSpec)]
+        if bad:
+            raise TypeError(
+                f"feed_vars must be InputSpec entries for reference-"
+                f"format export (got {type(bad[0]).__name__}); pass an "
+                "empty feed_vars list for the native jit.save format")
         from .program_export import export_reference_inference_model
 
         export_reference_inference_model(path_prefix, specs, target)

@@ -247,7 +247,7 @@ def _dce(eqns, live):
 class _Ref:
     """A value bound to a program variable."""
 
-    __slots__ = ("name", "shape", "dtype", "expand_to")
+    __slots__ = ("name", "shape", "dtype", "expand_to", "_forced")
 
     def __init__(self, name, shape, dtype, expand_to=None):
         self.name = name
@@ -255,8 +255,10 @@ class _Ref:
         self.dtype = np.dtype(dtype)
         # pending broadcast target (see broadcast_in_dim handler): the
         # var holds the size-1-axes reshape; elementwise consumers use
-        # it directly, others force an expand_v2 first
+        # it directly, others force an expand_v2 first (cached in
+        # _forced so N consumers share one emitted expand)
         self.expand_to = expand_to
+        self._forced = None
 
 
 class _Lit:
@@ -285,7 +287,12 @@ class _Exporter:
         return f"{prefix}_{self._n:04d}"
 
     def _declare(self, name, shape, dtype, persistable=False):
-        dims = [-1 if d == _BATCH else int(d) for d in shape]
+        # persistable params have static shapes by construction — a
+        # genuine dim of _BATCH there must not re-encode as dynamic
+        if persistable:
+            dims = [int(d) for d in shape]
+        else:
+            dims = [-1 if d == _BATCH else int(d) for d in shape]
         self.vars[name] = (dims, _np_vt(dtype), persistable)
 
     def _emit(self, op_type, ins, outs, attrs=()):
@@ -327,6 +334,8 @@ class _Exporter:
     def force(self, ref):
         """Materialize a pending expand_v2 (non-elementwise consumer)."""
         if isinstance(ref, _Ref) and ref.expand_to is not None:
+            if ref._forced is not None:
+                return ref._forced
             if any(d == _BATCH for d in ref.expand_to):
                 # expand_v2's -1 means 'keep input dim' (which is 1
                 # here), so a dynamic-batch expansion is inexpressible
@@ -335,10 +344,10 @@ class _Exporter:
                     "non-broadcasting consumer; export with a concrete "
                     "batch size in the InputSpec")
             tgt = [int(d) for d in ref.expand_to]
-            out = self._new_out(ref.expand_to, ref.dtype, "expand_v2",
-                                {"X": [ref.name]},
-                                [("shape", "ints", tgt)])
-            return out
+            ref._forced = self._new_out(
+                ref.expand_to, ref.dtype, "expand_v2",
+                {"X": [ref.name]}, [("shape", "ints", tgt)])
+            return ref._forced
         return ref
 
     def materialize(self, lit, shape=(1,)):
@@ -491,6 +500,11 @@ def translate(exporter, name, ins, outs, params):
         if isinstance(src, _Lit):
             bind(src)              # scalar: numpy broadcasting covers it
             return
+        # a chained broadcast must materialize its pending expansion
+        # FIRST — the reshape target below is computed from the source's
+        # post-force shape (review regression: computing it from the
+        # deferred size-1 form exported a size-mismatched reshape2)
+        src = ex.force(src)
         bd = tuple(params["broadcast_dimensions"])
         shape = tuple(int(d) for d in params["shape"])
         expanded = any(shape[d] != src.shape[i]
@@ -499,7 +513,6 @@ def translate(exporter, name, ins, outs, params):
         ones = [1] * len(shape)
         for i, d in enumerate(bd):
             ones[d] = int(src.shape[i])
-        src = ex.force(src)
         if tuple(ones) == src.shape:
             mid = src
         else:
@@ -791,6 +804,12 @@ def export_reference_inference_model(path_prefix, input_specs, layer):
     if not specs:
         raise ValueError("reference-format export needs at least one "
                          "InputSpec describing the program feeds")
+    for spec in specs:
+        if _BATCH in [d for d in spec.shape if d is not None and d != -1]:
+            raise NotImplementedError(
+                f"a concrete InputSpec dim equals the dynamic-dim "
+                f"placeholder ({_BATCH}); pad the dimension by one or "
+                "export with a different extent")
 
     def fn(*xs):
         out = layer(*[Tensor(x) for x in xs])

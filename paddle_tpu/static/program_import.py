@@ -147,7 +147,8 @@ _OP_ATTR = {1: ("name", "str"), 2: ("type", "int"), 3: ("i", "int"),
             4: ("f", "float"), 5: ("s", "str"), 6: ("ints", "rep_int"),
             7: ("floats", "rep_float"), 8: ("strings", "rep_str"),
             10: ("b", "bool"), 11: ("bools", "rep_int"),
-            13: ("l", "int"), 15: ("longs", "rep_int"),
+            12: ("block_idx", "int"), 13: ("l", "int"),
+            14: ("blocks_idx", "rep_int"), 15: ("longs", "rep_int"),
             19: ("float64", "double")}
 _OP_DESC = {3: ("type", "str"), 1: ("inputs", "rep_msg", _OP_VAR),
             2: ("outputs", "rep_msg", _OP_VAR),
@@ -189,8 +190,12 @@ def _attr_value(a):
         return bool(a.get("b", False))
     if t == 7:
         return [bool(x) for x in a.get("bools", [])]
+    if t == 8:                      # BLOCK: a sub-block index
+        return a.get("block_idx", 0)
     if t == 9:
         return a.get("l", 0)
+    if t == 10:                     # BLOCKS
+        return list(a.get("blocks_idx", []))
     if t == 11:
         return list(a.get("longs", []))
     if t == 15:
@@ -211,12 +216,7 @@ class OpDef:
                       for a in raw.get("attrs", [])}
 
 
-def parse_program(data):
-    """bytes (a .pdmodel file) -> (ops, var_descs) of block 0."""
-    prog = _parse(data, _PROGRAM_DESC)
-    if not prog.get("blocks"):
-        raise ValueError("ProgramDesc has no blocks")
-    block = prog["blocks"][0]
+def _block_view(block):
     ops = [OpDef(o) for o in block.get("ops", [])]
     vars_ = {}
     for v in block.get("vars", []):
@@ -233,6 +233,21 @@ def parse_program(data):
             "shape": list(td.get("dims", [])),
         }
     return ops, vars_
+
+
+def parse_program_blocks(data):
+    """bytes (a .pdmodel file) -> [(ops, var_descs)] for ALL blocks —
+    sub-blocks hold conditional_block/while bodies (framework.proto
+    BlockDesc; reference conditional_block_op.cc / while_op.cc)."""
+    prog = _parse(data, _PROGRAM_DESC)
+    if not prog.get("blocks"):
+        raise ValueError("ProgramDesc has no blocks")
+    return [_block_view(b) for b in prog["blocks"]]
+
+
+def parse_program(data):
+    """bytes (a .pdmodel file) -> (ops, var_descs) of block 0."""
+    return parse_program_blocks(data)[0]
 
 
 # ------------------------------------------------------- parameter stream --
@@ -834,17 +849,32 @@ _MULTI_OUT_PARAMS = {"top_k_v2": ("Out", "Indices")}
 
 
 def supported_ops():
-    return sorted(_TRANSLATORS) + ["feed", "fetch"]
+    return sorted(set(_TRANSLATORS) | _CONTROL_OPS) + ["feed", "fetch"]
+
+
+# control-flow op types handled structurally by InferenceProgram (not
+# through _TRANSLATORS): reference conditional_block_op.cc, while_op.cc,
+# select_input_op.cc
+_CONTROL_OPS = {"conditional_block", "while", "select_input"}
 
 
 class InferenceProgram:
-    """A translated block-0 inference program: callable over the feed
-    vars (positional, in feed-op ``col`` order) returning the fetch list.
-    Jit-compiled per input-shape signature."""
+    """A translated inference program: callable over the feed vars
+    (positional, in feed-op ``col`` order) returning the fetch list.
+    Jit-compiled per input-shape signature.
 
-    def __init__(self, ops, var_descs, params):
+    Control flow: ``conditional_block`` sub-blocks lower to
+    ``lax.cond`` (the untaken branch yields zero placeholders that the
+    paired ``select_input`` never selects — the reference cond()
+    lowering runs one guarded block per branch then merges by mask);
+    ``while`` lowers to ``lax.while_loop`` over the sub-block-written
+    vars (shapes must be loop-invariant, the XLA constraint that
+    mirrors the reference's static shape requirement)."""
+
+    def __init__(self, ops, var_descs, params, blocks=None):
         self.var_descs = var_descs
         self.params = {k: jnp.asarray(v) for k, v in params.items()}
+        self.blocks = blocks or []
         self.feed_names = []
         self.fetch_names = []
         self.body = []
@@ -855,21 +885,57 @@ class InferenceProgram:
             elif op.type == "fetch":
                 fetches[op.attrs.get("col", 0)] = op.inputs["X"][0]
             else:
-                if op.type not in _TRANSLATORS:
-                    raise NotImplementedError(
-                        f"ProgramDesc op '{op.type}' has no TPU "
-                        f"translation ({len(_TRANSLATORS)} ops "
-                        "supported — see static.program_import)")
+                self._check_op(op)
                 self.body.append(op)
         self.feed_names = [feeds[k] for k in sorted(feeds)]
         self.fetch_names = [fetches[k] for k in sorted(fetches)]
         self._jitted = jax.jit(self._run)
 
+    def _check_op(self, op, depth=0):
+        if op.type in _CONTROL_OPS:
+            sub = op.attrs.get("sub_block")
+            if sub is not None:
+                if not 0 <= sub < len(self.blocks):
+                    raise ValueError(
+                        f"{op.type} references sub_block {sub} but the "
+                        f"program has {len(self.blocks)} blocks")
+                if depth > 16:
+                    raise NotImplementedError(
+                        "control-flow nesting deeper than 16 blocks")
+                for sop in self.blocks[sub][0]:
+                    self._check_op(sop, depth + 1)
+            return
+        if op.type not in _TRANSLATORS:
+            raise NotImplementedError(
+                f"ProgramDesc op '{op.type}' has no TPU "
+                f"translation ({len(_TRANSLATORS)} ops "
+                "supported — see static.program_import)")
+
     def _run(self, params, *feed_vals):
         env = dict(params)
         for name, val in zip(self.feed_names, feed_vals):
             env[name] = val
-        for op in self.body:
+        self._run_ops(self.body, env)
+        return [env[n] for n in self.fetch_names]
+
+    # pure-functional view for training: same signature as _run but a
+    # staticmethod-style entry taking the params explicitly (backward
+    # via jax.vjp works through every translator and lax.cond; see
+    # ImportedProgramLayer)
+    def apply(self, params, *feed_vals):
+        return self._run(params, *feed_vals)
+
+    def _run_ops(self, ops, env):
+        for op in ops:
+            if op.type == "conditional_block":
+                self._run_cond_block(op, env)
+                continue
+            if op.type == "while":
+                self._run_while(op, env)
+                continue
+            if op.type == "select_input":
+                self._run_select_input(op, env)
+                continue
             ins = {}
             for param, args in op.inputs.items():
                 if not args:
@@ -896,7 +962,112 @@ class InferenceProgram:
                          or op.outputs.get("Y") or [])
             for name, val in zip(names, outs):
                 env[name] = val
-        return [env[n] for n in self.fetch_names]
+
+    def _run_cond_block(self, op, env):
+        """conditional_block: run sub_block iff Cond; untaken branch
+        yields zeros (the paired select_input never picks them)."""
+        if not op.attrs.get("is_scalar_condition", True):
+            raise NotImplementedError(
+                "conditional_block with is_scalar_condition=False "
+                "(run-if-nonempty semantics) is not translated")
+        cond = env[op.inputs["Cond"][0]].reshape(()).astype(bool)
+        sub_ops = self.blocks[op.attrs["sub_block"]][0]
+        out_names = [n for n in op.outputs.get("Out", [])]
+
+        def taken(_):
+            env2 = dict(env)
+            self._run_ops(sub_ops, env2)
+            return tuple(env2[n] for n in out_names)
+
+        avals = jax.eval_shape(taken, 0)
+
+        def untaken(_):
+            return tuple(jnp.zeros(a.shape, a.dtype) for a in avals)
+
+        res = jax.lax.cond(cond, taken, untaken, 0)
+        for name, val in zip(out_names, res):
+            env[name] = val
+
+    def _run_while(self, op, env):
+        """while: loop-carried vars = sub-block-written names that
+        pre-exist in the parent env (reference scope semantics), plus
+        the Condition var the sub-block recomputes each iteration."""
+        sub_ops = self.blocks[op.attrs["sub_block"]][0]
+        cond_name = op.inputs["Condition"][0]
+        written = set()
+        for sop in sub_ops:
+            for names in sop.outputs.values():
+                written.update(names)
+        carried = sorted(n for n in written | {cond_name} if n in env)
+        if cond_name not in carried:
+            raise ValueError(
+                f"while Condition var {cond_name!r} has no initial "
+                "value in the enclosing scope")
+        ci = carried.index(cond_name)
+
+        def cond_f(carry):
+            return carry[ci].reshape(()).astype(bool)
+
+        def body_f(carry):
+            env2 = dict(env)
+            env2.update(zip(carried, carry))
+            self._run_ops(sub_ops, env2)
+            return tuple(env2[n] for n in carried)
+
+        init = tuple(env[n] for n in carried)
+        final = jax.lax.while_loop(cond_f, body_f, init)
+        env.update(zip(carried, final))
+
+    def _run_select_input(self, op, env):
+        """select_input: Out = X[Mask] (select_input_op.cc); the cond()
+        lowering merges the two conditional_block results by the cast
+        condition."""
+        xs = [env[a] for a in op.inputs["X"]]
+        mask = env[op.inputs["Mask"][0]].reshape(()).astype(jnp.int32)
+        if len(xs) == 2:
+            out = jnp.where(mask.astype(bool), xs[1], xs[0])
+        else:
+            out = jax.lax.switch(mask, [lambda x=x: x for x in xs])
+        env[op.outputs["Out"][0]] = out
+
+    def to_layer(self):
+        """Wrap this imported program as a trainable ``nn.Layer``: every
+        entry of ``params`` becomes a live framework Parameter and the
+        translated body dispatches as one tape op (backward via
+        ``jax.vjp`` through every translator, including lax.cond /
+        while sub-blocks where jax defines gradients).  Fine-tuning an
+        imported reference classifier = ``prog.to_layer()`` + any
+        optimizer; call ``sync_to_program()`` afterwards to write the
+        trained weights back for re-export."""
+        from ..nn.layer_base import Layer, Parameter
+        from ..ops.dispatch import apply_op
+
+        program = self
+
+        class ImportedProgramLayer(Layer):
+            def __init__(self):
+                super().__init__()
+                self._names = sorted(program.params)
+                self._safe = {n: n.replace(".", "__") for n in self._names}
+                for n in self._names:
+                    self.add_parameter(self._safe[n],
+                                       Parameter(program.params[n]))
+                self._fn = lambda p, *xs: tuple(program._run(p, *xs))
+
+            def forward(self, *feeds):
+                params = {n: self._parameters[self._safe[n]]
+                          for n in self._names}
+                outs = apply_op("imported_program", self._fn,
+                                (params,) + tuple(feeds), {})
+                return outs if len(outs) > 1 else outs[0]
+
+            def sync_to_program(self):
+                program.params = {
+                    n: self._parameters[self._safe[n]]._data
+                    for n in self._names}
+                return program
+
+        return ImportedProgramLayer()
 
     def __call__(self, *feeds):
         from ..core.tensor import Tensor
@@ -915,16 +1086,22 @@ def load_reference_inference_model(path_prefix):
     """(program, feed_names, fetch_names) from model.pdmodel +
     model.pdiparams (io.py:727 parity)."""
     with open(f"{path_prefix}.pdmodel", "rb") as f:
-        ops, var_descs = parse_program(f.read())
-    # only LOD_TENSOR (7) vars live in the params stream; feed/fetch
-    # holders (FEED_MINIBATCH=9 / FETCH_LIST=10) and RAW vars are
-    # persistable in real exports but never serialized
-    # (python/paddle/static/io.py is_persistable semantics)
-    persist = sorted(n for n, d in var_descs.items()
+        blocks = parse_program_blocks(f.read())
+    ops, var_descs = blocks[0]
+    # persistable params may be declared in any block (real exports put
+    # them in block 0; be liberal); only LOD_TENSOR (7) vars live in
+    # the params stream — feed/fetch holders (FEED_MINIBATCH=9 /
+    # FETCH_LIST=10) and RAW vars are persistable in real exports but
+    # never serialized (python/paddle/static/io.py is_persistable)
+    merged = {}
+    for _ops, vdescs in blocks:
+        for n, d in vdescs.items():
+            merged.setdefault(n, d)
+    persist = sorted(n for n, d in merged.items()
                      if d["persistable"] and d["vtype"] == 7)
     params = {}
     if persist:
         with open(f"{path_prefix}.pdiparams", "rb") as f:
             params = load_combined_params(f.read(), persist)
-    prog = InferenceProgram(ops, var_descs, params)
+    prog = InferenceProgram(ops, var_descs, params, blocks=blocks)
     return prog, prog.feed_names, prog.fetch_names

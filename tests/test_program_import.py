@@ -588,3 +588,205 @@ class TestRound4OpTableGrowth:
         exp = np.where(m >= 0, m, m * al[None, :, None, None])
         np.testing.assert_allclose(np.asarray(out.numpy()), exp,
                                    rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------- round-5: control flow --
+
+def attr_block(name, idx):
+    """OpDesc.Attr BLOCK (type 8): block_idx in field 12."""
+    return _fstr(1, name) + _fint(2, 8) + _fint(12, idx)
+
+
+def program_blocks(blocks):
+    """Encode a multi-block ProgramDesc: [(ops, vars), ...]; block 0 is
+    the root, others are sub-blocks (parent 0)."""
+    out = b""
+    for i, (ops, vars_) in enumerate(blocks):
+        block = _fint(1, i) + _fint(2, -1 if i == 0 else 0)
+        for v in vars_:
+            block += _fbytes(3, v)
+        for o in ops:
+            block += _fbytes(4, o)
+        out += _fbytes(1, block)
+    return out
+
+
+def write_model_blocks(tmp_path, prefix, blocks, params):
+    (tmp_path / f"{prefix}.pdmodel").write_bytes(program_blocks(blocks))
+    blob = b"".join(lod_tensor_bytes(params[k]) for k in sorted(params))
+    (tmp_path / f"{prefix}.pdiparams").write_bytes(blob)
+    return str(tmp_path / prefix)
+
+
+class TestControlFlow:
+    def _cond_program(self, tmp_path):
+        """The reference cond() lowering: two guarded conditional_blocks
+        merged by select_input(Mask=cast(cond))."""
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        b0 = feeds + [
+            op("reduce_mean", {"X": ["x"]}, {"Out": ["m"]},
+               [attr("dim", 11, longs=[0, 1]),
+                attr("reduce_all", 6, b=True)]),
+            op("fill_constant", {}, {"Out": ["z"]},
+               [attr("shape", 11, longs=[1]), attr("value", 1, f=0.0),
+                attr("dtype", 0, i=5)]),
+            op("greater_than", {"X": ["m"], "Y": ["z"]}, {"Out": ["c"]},
+               [attr("axis", 0, i=-1)]),
+            op("cast", {"X": ["c"]}, {"Out": ["ci"]},
+               [attr("in_dtype", 0, i=0), attr("out_dtype", 0, i=2)]),
+            op("logical_not", {"X": ["c"]}, {"Out": ["nc"]}),
+            op("conditional_block", {"Cond": ["c"], "Input": ["x"]},
+               {"Out": ["tb"], "Scope": ["_s0"]},
+               [attr_block("sub_block", 1),
+                attr("is_scalar_condition", 6, b=True)]),
+            op("conditional_block", {"Cond": ["nc"], "Input": ["x"]},
+               {"Out": ["fb"], "Scope": ["_s1"]},
+               [attr_block("sub_block", 2),
+                attr("is_scalar_condition", 6, b=True)]),
+            op("select_input", {"X": ["fb", "tb"], "Mask": ["ci"]},
+               {"Out": ["y"]}),
+        ] + fetches
+        sub_t = [op("scale", {"X": ["x"]}, {"Out": ["tb"]},
+                    [attr("scale", 1, f=2.0), attr("bias", 1, f=0.0),
+                     attr("bias_after_scale", 6, b=True)])]
+        sub_f = [op("scale", {"X": ["x"]}, {"Out": ["fb"]},
+                    [attr("scale", 1, f=-1.0), attr("bias", 1, f=0.0),
+                     attr("bias_after_scale", 6, b=True)])]
+        blocks = [
+            (b0, [var("x", [-1, 3])]),
+            (sub_t, [var("tb", [-1, 3])]),
+            (sub_f, [var("fb", [-1, 3])]),
+        ]
+        return write_model_blocks(tmp_path, "cond", blocks, {})
+
+    def test_conditional_block_both_branches(self, tmp_path):
+        prefix = self._cond_program(tmp_path)
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        pos = np.full((2, 3), 1.5, F32)
+        neg = np.full((2, 3), -1.5, F32)
+        (out_p,) = prog(paddle.to_tensor(pos))
+        (out_n,) = prog(paddle.to_tensor(neg))
+        np.testing.assert_allclose(np.asarray(out_p.numpy()), pos * 2,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_n.numpy()), -neg,
+                                   rtol=1e-6)
+
+    def test_while_loop(self, tmp_path):
+        """while: x doubles until i reaches 5 -> x * 32."""
+        feeds, fetches = feed_fetch(["x"], ["xo"])
+        b0 = feeds + [
+            op("fill_constant", {}, {"Out": ["i"]},
+               [attr("shape", 11, longs=[1]), attr("value", 1, f=0.0),
+                attr("dtype", 0, i=5)]),
+            op("fill_constant", {}, {"Out": ["five"]},
+               [attr("shape", 11, longs=[1]), attr("value", 1, f=5.0),
+                attr("dtype", 0, i=5)]),
+            op("less_than", {"X": ["i"], "Y": ["five"]},
+               {"Out": ["cond"]}, [attr("axis", 0, i=-1)]),
+            op("while", {"X": ["x", "i"], "Condition": ["cond"]},
+               {"Out": ["x", "i"], "StepScopes": ["_ss"]},
+               [attr_block("sub_block", 1)]),
+            op("assign", {"X": ["x"]}, {"Out": ["xo"]}),
+        ] + fetches
+        sub = [
+            op("scale", {"X": ["x"]}, {"Out": ["x"]},
+               [attr("scale", 1, f=2.0), attr("bias", 1, f=0.0),
+                attr("bias_after_scale", 6, b=True)]),
+            op("scale", {"X": ["i"]}, {"Out": ["i"]},
+               [attr("scale", 1, f=1.0), attr("bias", 1, f=1.0),
+                attr("bias_after_scale", 6, b=True)]),
+            op("less_than", {"X": ["i"], "Y": ["five"]},
+               {"Out": ["cond"]}, [attr("axis", 0, i=-1)]),
+        ]
+        blocks = [(b0, [var("x", [-1, 2])]), (sub, [])]
+        prefix = write_model_blocks(tmp_path, "wh", blocks, {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = np.array([[1.0, -2.0]], F32)
+        (out,) = prog(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), x * 32,
+                                   rtol=1e-6)
+
+    def test_missing_sub_block_rejected(self, tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        b0 = feeds + [
+            op("conditional_block", {"Cond": ["x"]}, {"Out": ["y"]},
+               [attr_block("sub_block", 7)]),
+        ] + fetches
+        prefix = write_model_blocks(tmp_path, "bad",
+                                    [(b0, [var("x", [1])])], {})
+        with pytest.raises(ValueError, match="sub_block"):
+            paddle.static.load_inference_model(prefix)
+
+
+class TestFineTuneImported:
+    def _classifier(self, tmp_path, rng):
+        w = (rng.randn(4, 3) * 0.1).astype(F32)
+        b = np.zeros(3, F32)
+        feeds, fetches = feed_fetch(["x"], ["out"])
+        ops = feeds + [
+            op("matmul_v2", {"X": ["x"], "Y": ["w"]}, {"Out": ["h"]}),
+            op("elementwise_add", {"X": ["h"], "Y": ["b"]},
+               {"Out": ["out"]}, [attr("axis", 0, i=-1)]),
+        ] + fetches
+        vars_ = [var("x", [-1, 4]), var("w", [4, 3], persistable=True),
+                 var("b", [3], persistable=True)]
+        return write_model(tmp_path, "clf", ops, vars_,
+                           {"w": w, "b": b})
+
+    def test_imported_program_fine_tunes(self, tmp_path):
+        """The round-trip the verdict asked for: a reference artifact
+        loads, wraps as a Layer, and TRAINS — backward flows through
+        the translated ops."""
+        from paddle_tpu import nn, optimizer
+
+        rng = np.random.RandomState(0)
+        prefix = self._classifier(tmp_path, rng)
+        prog, feeds, fetches = paddle.static.load_inference_model(
+            prefix)
+        layer = prog.to_layer()
+        params = layer.parameters()
+        assert len(params) == 2 and all(not p.stop_gradient
+                                        for p in params)
+
+        X = rng.randn(32, 4).astype(F32)
+        W_true = rng.randn(4, 3).astype(F32)
+        y = (X @ W_true).argmax(1).astype(np.int64)
+        opt = optimizer.Adam(learning_rate=0.1,
+                             parameters=layer.parameters())
+        losses = []
+        for _ in range(25):
+            logits = layer(paddle.to_tensor(X))
+            loss = nn.functional.cross_entropy(
+                logits, paddle.to_tensor(y), reduction="mean")
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+        # write back + the deployed program serves the tuned weights
+        layer.sync_to_program()
+        (out,) = prog(paddle.to_tensor(X))
+        acc = (np.asarray(out.numpy()).argmax(1) == y).mean()
+        assert acc > 0.7, acc
+
+    def test_grad_through_conditional_block(self, tmp_path):
+        """lax.cond is differentiable — gradients flow through an
+        imported program's control flow too."""
+        import jax
+
+        prefix = TestControlFlow()._cond_program(tmp_path)
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+
+        def f(x):
+            return sum(jnp.sum(o) for o in prog.apply({}, x))
+
+        import jax.numpy as jnp
+
+        x = jnp.full((2, 3), 1.5)
+        g = jax.grad(f)(x)
+        np.testing.assert_allclose(np.asarray(g), np.full((2, 3), 2.0),
+                                   rtol=1e-6)
+        g = jax.grad(f)(-x)
+        np.testing.assert_allclose(np.asarray(g),
+                                   np.full((2, 3), -1.0), rtol=1e-6)
