@@ -237,6 +237,21 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                         for a in _GRAPH_FUSE_FLAGS)
 
     def decorate(fn):
+        import inspect
+
+        _gen_probe = fn.forward if isinstance(fn, Layer) else fn
+        _gen_probe = getattr(_gen_probe, "__func__", _gen_probe)
+        if inspect.isgeneratorfunction(_gen_probe) or \
+                inspect.isasyncgenfunction(_gen_probe):
+            # reference-quality decline: a compiled graph has one static
+            # output structure; a generator's yields have none
+            raise NotImplementedError(
+                "to_static cannot compile a generator function: a "
+                "jitted XLA program returns a fixed output structure, "
+                "but `yield` produces values lazily. Restructure to "
+                "accumulate results and return them (e.g. append to a "
+                "list and return paddle.stack(outs)), or keep the "
+                "generator outside the compiled region.")
         if isinstance(fn, Layer):
             raw = getattr(fn.forward, "__func__", fn.forward)
             conv = ast_transform(raw)

@@ -58,10 +58,16 @@ return_transformer.py, break_continue_transformer.py semantics):
   the ORIGINAL cells, so nonlocal reads and writes stay live in both
   directions.
 
-Out of scope (left untransformed; the trace guard reports them if a
-tensor condition reaches one): ``yield``, ``while ... else`` /
-``for ... else``.  Conversion failure of any kind falls back to the
-original function.
+- ``while/for ... else``: converts — the else suite hoists after the
+  loop, guarded on the carried break flag when a break exists (python
+  semantics: else runs iff no break; exceeds the reference, whose
+  loop_transformer has no orelse handling).
+- ``yield``: a generator ENTRY POINT declines at decoration time with
+  an actionable error (a compiled graph has one static output
+  structure); generator helpers inside a compiled function run
+  natively as iterables.
+
+Conversion failure of any kind falls back to the original function.
 """
 
 import ast
@@ -174,12 +180,24 @@ def convert_while(test_fn, body_fn, names, values):
         return tuple(values)
     from ..static import nn as static_nn
 
+    values = _seed_inner_flags(names, values)
     for name, v in zip(names, values):
         if v is _UNDEF:
             raise NameError(_undef_loop_msg(name, "while"))
     return tuple(static_nn.while_loop(
         lambda *vs: test_fn(*vs), lambda *vs: tuple(body_fn(*vs)),
         list(values)))
+
+
+def _seed_inner_flags(names, values):
+    """A nested loop's break/continue flag is initialized INSIDE this
+    loop's body (write-before-read by _rewrite_bc construction), so an
+    _UNDEF pre-loop slot is dead — seed it False to keep the carry
+    structure instead of raising the user-variable error."""
+    return tuple(False if (v is _UNDEF
+                           and (n.startswith("_d2s_brk")
+                                or n.startswith("_d2s_cont")))
+                 else v for n, v in zip(names, values))
 
 
 def _undef_loop_msg(name, kind):
@@ -298,6 +316,7 @@ def convert_for(it, body_fn, names, values, brk_name=None, elt_spec=()):
         # (review regression)
         for n, i in elt_spec:
             values[names.index(n)] = tens_seed[0][i]
+    values = list(_seed_inner_flags(names, values))
     for name, v in zip(names, values):
         if v is _UNDEF and name not in elt_names:
             raise NameError(_undef_loop_msg(name, "for"))
@@ -568,23 +587,58 @@ class _LoopEscapeTransformer(ast.NodeTransformer):
         self.counter += 1
         return f"_d2s_{hint}{self.counter}"
 
+    def _declines(self, node, is_for):
+        """Decline cases shared by b/c elimination and else-hoisting:
+        a loop the control-flow transformer will NOT convert must keep
+        its native form."""
+        if _has_escape_sans_bc(node.body):
+            return True
+        if is_for and not _for_target_names(node.target):
+            return True
+        if not is_for and any(isinstance(n, ast.NamedExpr)
+                              for n in ast.walk(node.test)):
+            return True
+        return False
+
     def _handle_loop(self, node, is_for):
         self.generic_visit(node)
-        if node.orelse:
-            return node
         has_b, has_c = _find_bc(node.body)
+        if node.orelse:
+            # python loop-else: the else suite runs iff the loop exits
+            # WITHOUT break.  No break -> hoist it after the loop
+            # unconditionally; with break -> guard it on the carried
+            # flag.  (The reference's loop_transformer has no orelse
+            # handling at all — this exceeds it.)
+            if self._declines(node, is_for) or _has_escape(node.orelse):
+                return node
+            orelse = list(node.orelse)
+            node.orelse = []
+            self.changed = True
+            out = self._rewrite_bc(node, is_for, has_b, has_c)
+            if has_b:
+                guard = ast.If(
+                    test=ast.Call(
+                        func=ast.Name(id="__d2s_not", ctx=ast.Load()),
+                        args=[ast.Name(id=node._d2s_brk,
+                                       ctx=ast.Load())],
+                        keywords=[]),
+                    body=orelse, orelse=[])
+                return out + [guard]
+            return out + orelse
         if not (has_b or has_c):
             return node
         # Only rewrite loops the control-flow transformer WILL convert;
         # a declined loop (tuple for-target, other escapes in body) must
         # keep its real break/continue for native semantics.
-        if _has_escape_sans_bc(node.body):
+        if self._declines(node, is_for):
             return node
-        if is_for and not _for_target_names(node.target):
-            return node
-        if not is_for and any(isinstance(n, ast.NamedExpr)
-                              for n in ast.walk(node.test)):
-            return node
+        return self._rewrite_bc(node, is_for, has_b, has_c)
+
+    def _rewrite_bc(self, node, is_for, has_b, has_c):
+        """Eliminate break/continue into carried flags; returns the
+        statement list replacing the loop ([flag inits..., loop])."""
+        if not (has_b or has_c):
+            return [node]
         brk = self._fresh("brk") if has_b else None
         cont = self._fresh("cont") if has_c else None
         node._d2s_brk = brk  # this loop's OWN flag (nested loops get

@@ -950,3 +950,149 @@ class TestRound4Residuals:
         pairs = paddle.to_tensor(
             np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
         np.testing.assert_allclose(np.asarray(conv(pairs).numpy()), 14.0)
+
+
+class TestRound5LoopElse:
+    """while/for ... else now convert: else runs iff no break fired
+    (the reference loop_transformer has no orelse support at all)."""
+
+    def test_for_else_no_break_tensor_loop(self):
+        @to_static
+        def f(x):
+            acc = x * 0.0
+            for v in x:
+                acc = acc + v
+            else:
+                acc = acc + 100.0
+            return acc
+
+        x = np.array([1.0, 2.0, 3.0], np.float32)
+        out = f(paddle.to_tensor(x.reshape(3, 1)))
+        # acc keeps x's [3,1] shape; every row accumulates the full sum
+        np.testing.assert_allclose(out.numpy(), np.full((3, 1), 106.0),
+                                   rtol=1e-6)
+
+    def test_while_else_break_decides(self):
+        def f(x, limit):
+            i = paddle.to_tensor(np.int32(0))
+            hit = x * 0.0
+            while i < 10:
+                if x.sum() > limit:
+                    hit = hit + 1.0
+                    break
+                x = x * 2.0
+                i = i + 1
+            else:
+                hit = hit - 1.0
+            return x, hit
+
+        conv = ast_transform(f)
+        assert conv is not None
+        # break taken -> else skipped
+        x, hit = conv(paddle.to_tensor(np.full(2, 50.0, np.float32)),
+                      1.0)
+        np.testing.assert_allclose(hit.numpy(), [1.0, 1.0])
+        # loop exhausts -> else runs
+        x, hit = conv(paddle.to_tensor(np.full(2, 0.0, np.float32)),
+                      1.0)
+        np.testing.assert_allclose(hit.numpy(), [-1.0, -1.0])
+
+    def test_python_for_else_semantics_preserved(self):
+        @to_static
+        def f(x, items):
+            found = x * 0.0
+            for v in items:          # python iterable: native loop
+                if v > 2:
+                    found = found + v
+                    break
+            else:
+                found = found - 1.0
+            return found
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)), [1, 2, 5])
+        np.testing.assert_allclose(out.numpy(), [5.0])
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)), [1, 2])
+        np.testing.assert_allclose(out.numpy(), [-1.0])
+
+    def test_nested_loop_else_inner_break(self):
+        """Inner break must not suppress the OUTER else."""
+        @to_static
+        def f(x):
+            total = x * 0.0
+            j = paddle.to_tensor(np.int32(0))
+            for v in x:
+                j = j * 0        # reset each outer iteration
+                while j < 3:
+                    if j >= 1:
+                        break
+                    total = total + v
+                    j = j + 1
+                else:
+                    total = total + 1000.0   # never: inner always breaks
+            else:
+                total = total + 0.5
+            return total
+
+        x = np.array([1.0, 2.0], np.float32).reshape(2, 1)
+        out = f(paddle.to_tensor(x))
+        # total keeps [2,1]; each row accumulates v1+v2=3, +0.5 outer else
+        np.testing.assert_allclose(out.numpy(), np.full((2, 1), 3.5),
+                                   rtol=1e-6)
+
+
+class TestRound5Yield:
+    def test_generator_function_declines_actionably(self):
+        with pytest.raises(NotImplementedError, match="generator"):
+            @to_static
+            def gen(x):
+                for i in range(3):
+                    yield x + i
+
+    def test_generator_layer_forward_declines(self):
+        from paddle_tpu import nn
+
+        class G(nn.Layer):
+            def forward(self, x):
+                yield x
+
+        with pytest.raises(NotImplementedError, match="generator"):
+            to_static(G())
+
+    def test_nested_generator_helper_still_converts(self):
+        """A generator HELPER inside a compiled fn is fine — only the
+        compiled entry point itself must not be a generator."""
+        @to_static
+        def f(x):
+            def pairs():
+                yield 1.0
+                yield 2.0
+
+            for v in pairs():
+                x = x + v
+            return x
+
+        out = f(paddle.to_tensor(np.zeros(1, np.float32)))
+        np.testing.assert_allclose(out.numpy(), [3.0])
+
+    def test_while_else_break_traced_path(self):
+        """The SAME break+else shape, but compiled: the brk flag rides
+        the lax.while_loop carry and the else guard lowers to cond
+        (review gap: the eager call above never traced it)."""
+        @to_static
+        def f(x, limit):
+            i = paddle.to_tensor(np.int32(0))
+            hit = x * 0.0
+            while i < 10:
+                if x.sum() > limit:
+                    hit = hit + 1.0
+                    break
+                x = x * 2.0
+                i = i + 1
+            else:
+                hit = hit - 1.0
+            return hit
+
+        out = f(paddle.to_tensor(np.full(2, 50.0, np.float32)), 1.0)
+        np.testing.assert_allclose(out.numpy(), [1.0, 1.0])
+        out = f(paddle.to_tensor(np.full(2, 0.0, np.float32)), 1.0)
+        np.testing.assert_allclose(out.numpy(), [-1.0, -1.0])
