@@ -27,11 +27,17 @@ SKIPS = {}
 
 
 def spec(name, make, ref=None, grad=(), out=None, check=None,
-         rtol=1e-5, atol=1e-6, grad_rtol=5e-2, grad_atol=5e-3, eps=1e-2):
+         rtol=1e-5, atol=1e-6, grad_rtol=5e-2, grad_atol=5e-3, eps=1e-2,
+         grad_out=None):
+    """``grad_out(result)``: optional selector applied before the
+    grad-check scalarization — for ops whose full output set is not
+    gauge-stable under perturbation (svd/eig factors have sign/phase
+    freedom; the VALUES are differentiable and comparable)."""
     assert name not in SPECS, f"duplicate spec {name}"
     SPECS[name] = dict(make=make, ref=ref, grad=tuple(grad), out=out,
                        check=check, rtol=rtol, atol=atol,
-                       grad_rtol=grad_rtol, grad_atol=grad_atol, eps=eps)
+                       grad_rtol=grad_rtol, grad_atol=grad_atol, eps=eps,
+                       grad_out=grad_out)
 
 
 def skip(name, reason):
@@ -48,6 +54,11 @@ def _wrap(args, grad_idx):
     for i, a in enumerate(args):
         if isinstance(a, np.ndarray):
             out.append(_to_tensor(a, sg=i not in grad_idx))
+        elif isinstance(a, (list, tuple)) and a and all(
+                isinstance(e, np.ndarray) for e in a):
+            # list-valued op inputs (concat/stack/add_n/...): every
+            # element shares the position's grad marking
+            out.append([_to_tensor(e, sg=i not in grad_idx) for e in a])
         else:
             out.append(a)
     return out
@@ -138,39 +149,45 @@ def check_grad(name, s, rng):
     grad_idx = set(s["grad"])
     fn = OPS[name].user_fn
 
+    sel = s.get("grad_out") or (lambda r: r)
+
     # weights fix the scalarization so numeric/analytic losses match
-    probe = fn(*_wrap(args, set()), **kwargs)
+    probe = sel(fn(*_wrap(args, set()), **kwargs))
     weights = _make_weights(probe, rng)
 
     targs = _wrap(args, grad_idx)
-    result = fn(*targs, **kwargs)
+    result = sel(fn(*targs, **kwargs))
     loss, _ = _scalarize(result, weights)
     assert loss is not None, f"{name}: no float output to grad-check"
     loss.backward()
 
     def numeric_loss(np_args):
-        r = fn(*_wrap(np_args, set()), **kwargs)
+        r = sel(fn(*_wrap(np_args, set()), **kwargs))
         l, _ = _scalarize(r, weights)
         return float(l.numpy())
 
     eps = s["eps"]
     for i in sorted(grad_idx):
-        analytic = np.asarray(targs[i].grad.numpy())
-        x = args[i]
-        flat = x.reshape(-1)
-        num = np.zeros_like(flat, dtype=np.float64)
-        for j in range(flat.size):
-            orig = flat[j]
-            flat[j] = orig + eps
-            f_plus = numeric_loss(args)
-            flat[j] = orig - eps
-            f_minus = numeric_loss(args)
-            flat[j] = orig
-            num[j] = (f_plus - f_minus) / (2 * eps)
-        num = num.reshape(x.shape)
-        # OpTest-style relative error on the max-abs scale
-        scale = max(np.abs(num).max(), np.abs(analytic).max(), 1e-3)
-        err = np.abs(num - analytic).max() / scale
-        assert err < s["grad_rtol"], \
-            (f"{name}: grad mismatch on arg {i}: rel err {err:.4f}\n"
-             f"numeric={num}\nanalytic={analytic}")
+        tgt = targs[i]
+        # list-valued positions grad-check every element
+        pairs = (list(zip(tgt, args[i])) if isinstance(tgt, list)
+                 else [(tgt, args[i])])
+        for t, x in pairs:
+            analytic = np.asarray(t.grad.numpy())
+            flat = x.reshape(-1)
+            num = np.zeros_like(flat, dtype=np.float64)
+            for j in range(flat.size):
+                orig = flat[j]
+                flat[j] = orig + eps
+                f_plus = numeric_loss(args)
+                flat[j] = orig - eps
+                f_minus = numeric_loss(args)
+                flat[j] = orig
+                num[j] = (f_plus - f_minus) / (2 * eps)
+            num = num.reshape(x.shape)
+            # OpTest-style relative error on the max-abs scale
+            scale = max(np.abs(num).max(), np.abs(analytic).max(), 1e-3)
+            err = np.abs(num - analytic).max() / scale
+            assert err < s["grad_rtol"], \
+                (f"{name}: grad mismatch on arg {i}: rel err {err:.4f}\n"
+                 f"numeric={num}\nanalytic={analytic}")

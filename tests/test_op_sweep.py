@@ -43,13 +43,13 @@ def test_finite_only_is_justified():
     assert not unjustified, sorted(unjustified)
     stale = set(JUSTIFIED_FINITE_ONLY) - finite_only
     assert not stale, f"justifications for upgraded specs: {sorted(stale)}"
-    assert len(finite_only) < 25, len(finite_only)
+    assert len(finite_only) < 15, len(finite_only)
 
 
 def test_grad_coverage_floor():
     """The grad-checked population must not silently regress."""
     graded = [n for n, s in SPECS.items() if s["grad"]]
-    assert len(graded) >= 213, len(graded)
+    assert len(graded) >= 235, len(graded)
 
 
 def test_partition_is_exact():
