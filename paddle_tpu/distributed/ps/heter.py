@@ -9,8 +9,13 @@ ranks rows for retention.
 TPU redesign: no hand-rolled device hashtable — the cache is a pair of
 fixed-capacity jnp arrays resident in HBM (rows + adagrad accumulators)
 updated by jitted scatter ops, with a host-side dict mapping key->slot.
-Batch key sets are small (1e3-1e5) so host hashing is never the
-bottleneck; what matters on TPU is that row payloads and gradient math
+MEASURED host overhead (benchmarks/bench_heter_cache.py, CPU backend,
+2026-07): steady-state hit-path pull+push = 3.0ms @1e3 unique keys
+(host lookup 3.5% of it), 7.6ms @1e4 (14%), 48.9ms @1e5 (26%, 2.05M
+keys/s aggregate).  So: up to ~1e4 keys host hashing is noise; at 1e5
+the dict walk is a quarter of the step — material but not dominant
+(the balance is device scatter/gather), and the RTT it replaces costs
+more.  What matters on TPU is that row payloads and gradient math
 stay on-device for cache hits (no host RTT, no H2D).  Write-back uses
 GeoSGD-style deltas (``w_server += w_local - w_base``, the existing
 ``push_delta`` verb), so the host table's accessor depth — CTR stats,
@@ -80,8 +85,33 @@ class HotRowCache:
 
     def __init__(self, remote, capacity=4096, optimizer="sgd",
                  learning_rate=0.05, epsilon=1e-8, flush_interval=0,
-                 score_decay=0.98):
+                 score_decay=0.98, async_flush=False):
+        """``async_flush=True``: the periodic ``flush_interval`` flush
+        snapshots the dirty deltas under the cache lock and performs the
+        RPCs on a background thread, so the trainer's push() returns
+        without waiting a server round-trip.  Staleness bound is
+        unchanged (other trainers' updates fold in at the same refresh
+        boundaries); the refresh application skips any slot the trainer
+        dirtied or rebound while the RPC was in flight, so local updates
+        are never clobbered by a stale pull."""
+        import threading
+
         self.remote = remote
+        self.async_flush = bool(async_flush)
+        self._lock = threading.RLock()      # cache state
+        # the native PsClient matches responses by stream order with no
+        # internal mutex (same constraint as GeoSGDWorker._remote_mu):
+        # trainer-thread RPCs and the background flush must not
+        # interleave on its socket
+        self._rpc_mu = threading.Lock()
+        self._bg = None
+        self._bg_running = False
+        self._flush_pending = False
+        self._pending_refresh = False
+        self._bg_error = None
+        # deltas whose write-back RPC FAILED: retried (merged into the
+        # payload) by the next write-back; never silently dropped
+        self._failed_deltas = {}
         self.dim = int(remote.dim)
         self.capacity = int(capacity)
         self.optimizer = optimizer
@@ -123,21 +153,70 @@ class HotRowCache:
 
     def _writeback_slots(self, slots):
         """Push w - w_base for the given dirty slots (one RTT)."""
-        slots = np.asarray(slots, np.int64)
-        d = slots[self._dirty[slots]]
-        if not len(d):
+        keys, delta = self._snapshot_writeback(slots)
+        self._rpc_push_delta(keys, delta)
+
+    def _rpc_push_delta(self, keys, delta):
+        """One write-back RPC carrying ``keys/delta`` plus any deltas a
+        previous failed RPC left behind.  On failure the whole payload
+        returns to the retry buffer — the snapshot already advanced
+        ``base``, so these deltas exist nowhere else (review regression:
+        the old code cleared dirty before the RPC and a failure lost
+        the updates for good)."""
+        with self._lock:
+            if self._failed_deltas:
+                extra_k = np.fromiter(self._failed_deltas.keys(),
+                                      np.int64, len(self._failed_deltas))
+                extra_d = np.stack([self._failed_deltas[k]
+                                    for k in extra_k.tolist()])
+                self._failed_deltas.clear()
+                if keys is None:
+                    keys, delta = extra_k, extra_d
+                else:
+                    keys = np.concatenate([keys, extra_k])
+                    delta = np.concatenate([delta, extra_d])
+        if keys is None:
             return
-        delta = np.asarray(self._rows[d] - self._base[d])
-        self.remote.push_delta(self._key_of[d], delta)
+        try:
+            with self._rpc_mu:
+                self.remote.push_delta(keys, delta)
+        except Exception:
+            with self._lock:
+                for k, d in zip(keys.tolist(), delta):
+                    prev = self._failed_deltas.get(k)
+                    self._failed_deltas[k] = d if prev is None \
+                        else prev + d
+            raise
         self.rtts["push_delta"] += 1
-        self._base = self._base.at[d].set(self._rows[d])
-        self._dirty[d] = False
+
+    def _snapshot_writeback(self, slots):
+        """Under the cache lock: compute (keys, delta) for the dirty
+        subset of ``slots`` and mark them clean (base := rows).  The
+        caller owns the RPC — outside the lock for the async path."""
+        with self._lock:
+            slots = np.asarray(slots, np.int64)
+            d = slots[self._dirty[slots]]
+            if not len(d):
+                return None, None
+            delta = np.asarray(self._rows[d] - self._base[d])
+            keys = self._key_of[d].copy()
+            self._base = self._base.at[d].set(self._rows[d])
+            self._dirty[d] = False
+            return keys, delta
 
     def _admit(self, missing, pinned):
         """Fetch ``missing`` keys from the remote table and cache as many
         as fit; returns the list of keys that could NOT be cached (they
         stay on the uncached pass-through path this batch)."""
-        rows_host = self.remote.pull(missing)
+        # drop the cache lock for the server round-trip (the background
+        # refresh may hold _rpc_mu for its own RTT; holding _lock here
+        # would stall every cache operation behind it)
+        self._lock.release()
+        try:
+            with self._rpc_mu:
+                rows_host = self.remote.pull(missing)
+        finally:
+            self._lock.acquire()
         self.rtts["pull"] += 1
         m = len(missing)
         if len(self._free) < m:
@@ -188,12 +267,16 @@ class HotRowCache:
     # ------------------------------------------------------- pull / push ----
 
     def pull(self, keys):
+        with self._lock:
+            return self._pull_locked(keys)
+
+    def _pull_locked(self, keys):
         keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
                                     dtype=np.int64)
         shape = keys.shape
         uniq, inv = np.unique(keys, return_inverse=True)
-        slots = np.asarray([self._slot_of.get(int(k), -1) for k in uniq],
-                           np.int64)
+        slots = np.fromiter((self._slot_of.get(k, -1)
+                             for k in uniq.tolist()), np.int64, len(uniq))
         cached = slots >= 0
         self.hits += int(cached.sum())
         self.misses += int((~cached).sum())
@@ -218,6 +301,10 @@ class HotRowCache:
         return out[jnp.asarray(inv)].reshape(shape + (self.dim,))
 
     def push(self, keys, grads, learning_rate=None):
+        with self._lock:
+            return self._push_locked(keys, grads, learning_rate)
+
+    def _push_locked(self, keys, grads, learning_rate=None):
         keys = np.ascontiguousarray(np.asarray(keys).reshape(-1),
                                     dtype=np.int64)
         if not len(keys):
@@ -226,8 +313,8 @@ class HotRowCache:
         lr = self.learning_rate if learning_rate is None else float(
             learning_rate)
         uniq, inv = np.unique(keys, return_inverse=True)
-        slots = np.asarray([self._slot_of.get(int(k), -1) for k in uniq],
-                           np.int64)
+        slots = np.fromiter((self._slot_of.get(k, -1)
+                             for k in uniq.tolist()), np.int64, len(uniq))
         uncached = slots < 0
         if uncached.any():
             # push-before-pull or capacity overflow: the raw per-occurrence
@@ -236,8 +323,9 @@ class HotRowCache:
             # would (matching config is the caller's contract, as with
             # DistributedEmbedding)
             pos = np.nonzero(uncached[inv])[0]
-            self.remote.push(keys[pos], np.asarray(g[jnp.asarray(pos)]),
-                             learning_rate=lr)
+            with self._rpc_mu:
+                self.remote.push(keys[pos], np.asarray(g[jnp.asarray(pos)]),
+                                 learning_rate=lr)
             self.rtts["push"] += 1
         cslots_u = np.where(uncached, self.capacity, slots)  # OOB -> drop
         if self.optimizer == "sgd":
@@ -274,7 +362,10 @@ class HotRowCache:
         self._dirty[slots[~uncached]] = True
         self._steps += 1
         if self.flush_interval and self._steps % self.flush_interval == 0:
-            self.flush(refresh=True)
+            if self.async_flush:
+                self.flush_async(refresh=True)
+            else:
+                self.flush(refresh=True)
 
     # ----------------------------------------------------------- control ----
 
@@ -282,18 +373,111 @@ class HotRowCache:
         """Write back all dirty rows (one RTT).  ``refresh=True`` then
         re-pulls every cached key so other trainers' updates fold in —
         the EndPass merge of ps_gpu_wrapper."""
-        dirty = np.nonzero(self._dirty)[0]
+        self._raise_bg_error()
+        with self._lock:
+            dirty = np.nonzero(self._dirty)[0]
         self._writeback_slots(dirty)
         if refresh:
-            occ = np.nonzero(self._key_of >= 0)[0]
+            with self._lock:
+                occ = np.nonzero(self._key_of >= 0)[0]
+                occ_keys = self._key_of[occ].copy()
             if len(occ):
-                fresh = self.remote.pull(self._key_of[occ])
+                with self._rpc_mu:
+                    fresh = self.remote.pull(occ_keys)
                 self.rtts["pull"] += 1
-                fj = jnp.asarray(fresh)
-                oj = jnp.asarray(occ)
-                self._rows = self._rows.at[oj].set(fj)
-                self._base = self._base.at[oj].set(fj)
-        self._score *= self.score_decay
+                self._apply_refresh(occ, occ_keys, fresh)
+        with self._lock:
+            self._score *= self.score_decay
+
+    def _apply_refresh(self, occ, occ_keys, fresh):
+        """Fold server rows into cache slots — skipping any slot the
+        trainer dirtied or rebound while the pull was in flight (the
+        async path races by design; local updates must win until the
+        NEXT flush writes them back)."""
+        with self._lock:
+            same = self._key_of[occ] == occ_keys
+            clean = ~self._dirty[occ]
+            ok = np.nonzero(same & clean)[0]
+            if not len(ok):
+                return
+            fj = jnp.asarray(fresh[ok])
+            oj = jnp.asarray(occ[ok])
+            self._rows = self._rows.at[oj].set(fj)
+            self._base = self._base.at[oj].set(fj)
+
+    def flush_async(self, refresh=False):
+        """flush() with the RPCs on a background thread: the deltas
+        snapshot under the lock NOW (so subsequent pushes accumulate
+        against the new base), the server round-trips happen off the
+        trainer's critical path.  One background worker runs at a time;
+        a request arriving while one is in flight marks a PENDING cycle
+        that the worker executes (with a fresh snapshot + score decay)
+        before exiting — rows dirtied after the in-flight snapshot are
+        carried by that next cycle, never dropped, so the staleness
+        bound degrades by at most one server RTT, not unboundedly.
+        A background RPC failure is re-raised by the next join_flush()/
+        flush()/close(), and its deltas sit in the retry buffer."""
+        import threading
+
+        with self._lock:
+            if self._bg_running:
+                self._flush_pending = True
+                self._pending_refresh = self._pending_refresh or refresh
+                return self._bg
+            self._bg_running = True
+            keys, delta = self._snapshot_writeback(
+                np.nonzero(self._dirty)[0])
+
+        def cycle(keys, delta, refresh):
+            self._rpc_push_delta(keys, delta)
+            if refresh:
+                with self._lock:
+                    occ = np.nonzero(self._key_of >= 0)[0]
+                    occ_keys = self._key_of[occ].copy()
+                if len(occ):
+                    with self._rpc_mu:
+                        fresh = self.remote.pull(occ_keys)
+                    self.rtts["pull"] += 1
+                    self._apply_refresh(occ, occ_keys, fresh)
+            with self._lock:
+                self._score *= self.score_decay
+
+        def bg(keys, delta, refresh):
+            try:
+                while True:
+                    cycle(keys, delta, refresh)
+                    with self._lock:
+                        if not self._flush_pending:
+                            self._bg_running = False
+                            return
+                        self._flush_pending = False
+                        refresh = self._pending_refresh
+                        self._pending_refresh = False
+                        keys, delta = self._snapshot_writeback(
+                            np.nonzero(self._dirty)[0])
+            except Exception as e:  # surfaced at the next sync point
+                with self._lock:
+                    self._bg_error = e
+                    self._bg_running = False
+
+        self._bg = threading.Thread(target=bg, args=(keys, delta, refresh),
+                                    daemon=True)
+        self._bg.start()
+        return self._bg
+
+    def join_flush(self):
+        """Wait for any in-flight background flush; re-raise its error."""
+        if self._bg is not None:
+            self._bg.join()
+        self._raise_bg_error()
+
+    def _raise_bg_error(self):
+        with self._lock:
+            err, self._bg_error = self._bg_error, None
+        if err is not None:
+            raise RuntimeError(
+                "background flush failed (deltas kept in the retry "
+                "buffer for the next write-back)") from err
 
     def stats(self):
         total = self.hits + self.misses
@@ -307,4 +491,5 @@ class HotRowCache:
         }
 
     def close(self):
+        self.join_flush()
         self.flush()
