@@ -8,6 +8,17 @@
 // binary frames directly over the pd_table_* C ABI (sparse_table.cc), with
 // key->server sharding done by the client layer (key % num_servers).
 //
+// FINAL DECISION (round 5, closes the carried epoll question): the IO
+// model IS thread-per-connection; the epoll/worker-pool rewrite is
+// REJECTED, not deferred.  Rationale: (1) the measured plateau below is
+// table-mutex/memcpy-bound, so a reactor would not raise aggregate
+// throughput; (2) each trainer holds exactly one connection per server,
+// bounding threads at trainer_count — three orders of magnitude under
+// where reactors pay off; (3) horizontal scaling is already built in
+// (key % num_servers sharding -> more server processes).  Revisit ONLY
+// if a deployment needs >10k concurrent connections per process, which
+// contradicts the one-connection-per-trainer topology.
+//
 // Scale ceiling (deliberate): one OS thread per trainer connection.
 // Linux handles thousands of mostly-idle threads fine, and each trainer
 // holds exactly ONE connection per server, so the ceiling is

@@ -7,6 +7,12 @@ import paddle_tpu as paddle
 from paddle_tpu import optimizer
 
 
+import pytest
+
+
+pytestmark = pytest.mark.slow  # zoo conv compiles dominate suite time
+
+
 class TestConformer:
     def test_forward_shapes_and_grad(self):
         from paddle_tpu.models.conformer import conformer_tiny

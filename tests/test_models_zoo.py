@@ -7,6 +7,10 @@ import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
 
 
+
+pytestmark = pytest.mark.slow  # zoo conv compiles dominate suite time
+
+
 def test_vgg_forward_backward():
     paddle.seed(0)
     from paddle_tpu.vision.models.vgg import vgg11

@@ -162,6 +162,7 @@ def test_two_process_bootstrap_and_collectives(tmp_path):
                 p.kill()
 
 
+@pytest.mark.slow
 def test_spmd_trainer_spans_two_processes(tmp_path):
     """The FULL hybrid trainer over a cross-process mesh: dp=4 x mp=2 on
     8 global devices owned by two OS processes — the shape a real

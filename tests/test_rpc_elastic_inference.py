@@ -241,6 +241,7 @@ class TestInferenceDepth:
 
 
 class TestFusedMultiTransformer:
+    @pytest.mark.slow
     def test_decode_matches_full_forward(self):
         from paddle_tpu.incubate.nn import FusedMultiTransformer
         from paddle_tpu.models.gpt import gpt_tiny
