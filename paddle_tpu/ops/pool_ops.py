@@ -22,6 +22,19 @@ def _tup(v, n):
     return tuple(v)
 
 
+def _normalize_pads(paddings, nd):
+    """Per-spatial-dim (lo, hi) pairs from any accepted spelling: int,
+    [p_d...], [(lo, hi)...], or the reference's FLAT per-side form
+    [lo0, hi0, lo1, hi1, ...] (pool2d attr [pt, pb, pl, pr])."""
+    pads = _tup(paddings, nd)
+    if len(pads) == 2 * nd and all(
+            isinstance(p, (int, np.integer)) for p in pads):
+        return tuple((int(pads[2 * i]), int(pads[2 * i + 1]))
+                     for i in range(nd))
+    return tuple((p, p) if isinstance(p, int) else tuple(p)
+                 for p in pads)
+
+
 def _window_dims(ksize, strides, paddings, nd, channel_last):
     if channel_last:
         return ((1,) + ksize + (1,), (1,) + strides + (1,),
@@ -56,8 +69,7 @@ def _pool_nd(x, ksize, strides, paddings, pooling_type, exclusive,
         return out
     ksize = _tup(ksize, nd)
     strides = _tup(strides, nd)
-    pads = _tup(paddings, nd)
-    pads = tuple((p, p) if isinstance(p, int) else tuple(p) for p in pads)
+    pads = _normalize_pads(paddings, nd)
     if ceil_mode:
         new_pads = []
         for i, ax in enumerate(spatial):
@@ -122,8 +134,7 @@ def _max_pool_with_index(x, ksize, strides, paddings, nd, adaptive):
         return _adaptive_max_with_index(x, _tup(ksize, nd), nd)
     ksize = _tup(ksize, nd)
     strides = _tup(strides, nd)
-    pads = _tup(paddings, nd)
-    pads = tuple((p, p) if isinstance(p, int) else tuple(p) for p in pads)
+    pads = _normalize_pads(paddings, nd)
     wdims, wstrides, wpads = _window_dims(ksize, strides, pads, nd, False)
 
     def reducer(a, b):

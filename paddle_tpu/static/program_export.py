@@ -354,11 +354,26 @@ class _Exporter:
         """A scalar literal as a [1] tensor (numpy broadcast covers)."""
         dt = lit.dtype
         code = _np_vt(dt)
+        if any(d == _BATCH for d in shape):
+            raise NotImplementedError(
+                "a constant spanning the dynamic batch extent feeds a "
+                "shape-sensitive op; export with a concrete batch size")
         return self._new_out(
             shape, dt, "fill_constant", {},
-            [("shape", "longs", list(shape)),
+            [("shape", "longs", [int(d) for d in shape]),
              ("value", "f", float(lit.val)),
              ("dtype", "i", code)])
+
+    def as_ref(self, atom):
+        """The operand as a program var: pending broadcasts force, and
+        a deferred scalar literal materializes at the operand's TRACED
+        shape (shape-sensitive consumers — pad/cumsum/split/reshape —
+        must not see the collapsed scalar)."""
+        v = self.val(atom)
+        if isinstance(v, _Lit):
+            shape = tuple(int(d) for d in atom.aval.shape)
+            return self.materialize(v, shape or (1,))
+        return self.force(v)
 
 
 def _np_vt(dtype):
@@ -376,6 +391,7 @@ def _np_vt(dtype):
 _OUT_PARAM = {"conv2d": "Output"}
 
 _UNARY = {"exp": "exp", "log": "log", "tanh": "tanh", "abs": "abs",
+          "square": "square",
           "sqrt": "sqrt", "rsqrt": "rsqrt", "floor": "floor",
           "logistic": "sigmoid", "erf": "erf", "sign": "sign",
           "log1p": "log1p", "sin": "sin", "cos": "cos"}
@@ -427,16 +443,28 @@ def translate(exporter, name, ins, outs, params):
     if name in _UNARY:
         x = ex.val(ins[0])
         if isinstance(x, _Lit):
-            raise NotImplementedError(
-                f"scalar-literal {name} survived constant folding "
-                "unexpectedly; please report")
+            folds = {
+                "exp": np.exp, "log": np.log, "tanh": np.tanh,
+                "abs": np.abs, "sqrt": np.sqrt,
+                "rsqrt": lambda v: 1.0 / np.sqrt(v),
+                "floor": np.floor, "erf": None, "sign": np.sign,
+                "log1p": np.log1p, "sin": np.sin, "cos": np.cos,
+                "square": np.square,
+                "logistic": lambda v: 1.0 / (1.0 + np.exp(-v)),
+            }
+            fn = folds.get(name)
+            if fn is None:
+                raise NotImplementedError(
+                    f"scalar-literal {name} has no constant fold")
+            bind(_Lit(np.asarray(fn(x.val), x.dtype).item(), x.dtype))
+            return
         x = ex.force(x)
         bind(ex._new_out(aval.shape, aval.dtype, _UNARY[name],
                          {"X": [x.name]}))
         return
 
     if name == "neg":
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         bind(ex._new_out(aval.shape, aval.dtype, "scale",
                          {"X": [x.name]},
                          [("scale", "f", -1.0), ("bias", "f", 0.0),
@@ -444,7 +472,7 @@ def translate(exporter, name, ins, outs, params):
         return
 
     if name == "integer_pow":
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         y = params["y"]
         if y == 2:
             bind(ex._new_out(aval.shape, aval.dtype, "square",
@@ -526,7 +554,7 @@ def translate(exporter, name, ins, outs, params):
         return
 
     if name == "reshape":
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         new = tuple(int(d) for d in params["new_sizes"])
         bind(ex._new_out(new, aval.dtype, "reshape2", {"X": [x.name]},
                          [("shape", "ints",
@@ -534,7 +562,7 @@ def translate(exporter, name, ins, outs, params):
         return
 
     if name == "squeeze":
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         new = tuple(int(d) for d in aval.shape)
         bind(ex._new_out(new, aval.dtype, "reshape2", {"X": [x.name]},
                          [("shape", "ints",
@@ -542,7 +570,7 @@ def translate(exporter, name, ins, outs, params):
         return
 
     if name == "transpose":
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         bind(ex._new_out(aval.shape, aval.dtype, "transpose2",
                          {"X": [x.name]},
                          [("axis", "ints",
@@ -550,7 +578,7 @@ def translate(exporter, name, ins, outs, params):
         return
 
     if name in _REDUCE:
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         axes = sorted(int(a) for a in params["axes"])
         # reference reduce_* declare dim as std::vector<int> (INTS);
         # LONGS would fail the GetAttr variant access at load time
@@ -562,7 +590,7 @@ def translate(exporter, name, ins, outs, params):
         return
 
     if name in ("argmax", "argmin"):
-        x = ex.force(ex.val(ins[0]))
+        x = ex.as_ref(ins[0])
         axes = params["axes"]
         if len(axes) != 1:
             raise NotImplementedError(
@@ -583,6 +611,83 @@ def translate(exporter, name, ins, outs, params):
         bind(ex._new_out(aval.shape, aval.dtype, "concat",
                          {"X": [v.name for v in vals]},
                          [("axis", "i", int(params["dimension"]))]))
+        return
+
+    if name == "iota":
+        # input-independent: fold to a persistable constant (shapes are
+        # static at export time)
+        dim = params["dimension"]
+        shape = tuple(int(d) for d in params["shape"])
+        if _BATCH in shape:
+            raise NotImplementedError(
+                "iota over a dynamic batch extent is not exportable; "
+                "use a concrete batch size")
+        span = np.arange(shape[dim], dtype=np.dtype(params["dtype"]))
+        view = [1] * len(shape)
+        view[dim] = shape[dim]
+        arr = np.broadcast_to(span.reshape(view), shape).copy()
+        bind(ex.const_ref(arr, key=("iota", shape, dim, str(arr.dtype))))
+        return
+
+    if name == "cumsum":
+        x = ex.as_ref(ins[0])
+        if params.get("reverse", False):
+            raise NotImplementedError(
+                "reverse cumsum export is not implemented")
+        bind(ex._new_out(aval.shape, aval.dtype, "cumsum",
+                         {"X": [x.name]},
+                         [("axis", "i", int(params["axis"])),
+                          ("flatten", "b", False),
+                          ("exclusive", "b", False),
+                          ("reverse", "b", False)]))
+        return
+
+    if name == "pad":
+        x = ex.as_ref(ins[0])
+        fill = ex.val(ins[1])
+        cfg = params["padding_config"]
+        if any(int(i) != 0 for _lo, _hi, i in cfg) or \
+                any(int(lo) < 0 or int(hi) < 0 for lo, hi, _i in cfg):
+            raise NotImplementedError(
+                "interior/negative padding has no reference pad-op "
+                "translation")
+        if not isinstance(fill, _Lit):
+            raise NotImplementedError(
+                "pad with a tensor fill value is not exportable")
+        pads = []
+        for lo, hi, _i in cfg:
+            pads += [int(lo), int(hi)]
+        bind(ex._new_out(aval.shape, aval.dtype, "pad", {"X": [x.name]},
+                         [("paddings", "ints", pads),
+                          ("pad_value", "f", float(fill.val))]))
+        return
+
+    if name in ("reduce_window_max", "reduce_window_sum"):
+        bind(_emit_pool(ex, name, ins, params, aval))
+        return
+
+    if name == "gather":
+        out = _emit_gather(ex, ins, params, aval)
+        if out is not None:
+            bind(out)
+            return
+        raise NotImplementedError(
+            "only embedding-style gathers (single leading-axis index) "
+            "export to lookup_table_v2")
+
+    if name == "split":
+        x = ex.as_ref(ins[0])
+        axis = int(params["axis"])
+        sizes = [int(s) for s in params["sizes"]]
+        names_out = []
+        for ov in outs:
+            nm = ex._fresh()
+            ex._declare(nm, ov.aval.shape, ov.aval.dtype)
+            names_out.append(nm)
+        ex._emit("split", {"X": [x.name]}, {"Out": names_out},
+                 [("axis", "i", axis), ("sections", "ints", sizes)])
+        for ov, nm in zip(outs, names_out):
+            ex.env[ov] = _Ref(nm, ov.aval.shape, ov.aval.dtype)
         return
 
     if name == "dot_general":
@@ -732,7 +837,23 @@ def _scale(ex, x, aval, scale, bias):
                         ("bias_after_scale", "b", True)])
 
 
+def _maybe_transpose(ex, ref, perm):
+    if tuple(perm) == tuple(range(len(ref.shape))):
+        return ref
+    shape = tuple(ref.shape[p] for p in perm)
+    return ex._new_out(shape, ref.dtype, "transpose2", {"X": [ref.name]},
+                       [("axis", "ints", list(perm))])
+
+
 def _emit_dot(ex, ins, params, aval):
+    """dot_general -> matmul_v2, canonicalizing layout when needed.
+
+    dot_general's output dim order is ALWAYS (batch..., lhs_free...,
+    rhs_free...), which is exactly batched-matmul output order — so
+    permuting each operand to (batch..., free, contract) (using the
+    trans_x/trans_y attrs to absorb a flip for free) needs no output
+    transpose.  Attention's [B,T,H,D] q@k^T (batch dims 0,2) lands
+    here."""
     (lc, rc), (lb, rb) = params["dimension_numbers"]
     a = ex.force(ex.val(ins[0]))
     b = ex.force(ex.val(ins[1]))
@@ -741,24 +862,155 @@ def _emit_dot(ex, ins, params, aval):
         raise NotImplementedError(
             "dot_general with multiple contracting dims is not "
             "exportable as matmul_v2")
-    if tuple(lb) != tuple(range(len(lb))) or tuple(rb) != tuple(
-            range(len(rb))) or len(lb) != len(rb):
-        raise NotImplementedError(
-            "dot_general with non-leading batch dims is not exportable")
-    nb = len(lb)
-    if la - nb != 2 or lb_ - nb != 2:
+    free_l = [d for d in range(la) if d not in lb and d != lc[0]]
+    free_r = [d for d in range(lb_) if d not in rb and d != rc[0]]
+    if len(lb) == 0 and lb_ == 2 and la > 2 and len(free_r) == 1:
+        # [..., M, K] @ [K, N]-style: matmul_v2 broadcasts the leading
+        # dims (the GPT head h @ embed^T shape)
+        if lc[0] not in (la - 1, la - 2):
+            raise NotImplementedError(
+                "dot_general contracting dim layout is not a matmul")
+        return ex._new_out(aval.shape, aval.dtype, "matmul_v2",
+                           {"X": [a.name], "Y": [b.name]},
+                           [("trans_x", "b", lc[0] == la - 2),
+                            ("trans_y", "b", rc[0] == lb_ - 1)])
+    if len(free_l) != 1 or len(free_r) != 1 or len(lb) != len(rb):
         raise NotImplementedError(
             "dot_general on non-matrix operands is not exportable as "
             "matmul_v2 (vectors: reshape to [1, n] first)")
-    if lc[0] not in (la - 1, la - 2) or rc[0] not in (lb_ - 1, lb_ - 2):
-        raise NotImplementedError("dot_general contracting dim layout "
-                                  "is not a matmul")
-    trans_x = lc[0] == la - 2
-    trans_y = rc[0] == lb_ - 1
+    # lhs -> (batch..., M, K) or (batch..., K, M)+trans_x
+    perm_a = tuple(lb) + (free_l[0], lc[0])
+    alt_a = tuple(lb) + (lc[0], free_l[0])
+    ident = tuple(range(la))
+    if alt_a == ident and perm_a != ident:
+        a, trans_x = _maybe_transpose(ex, a, alt_a), True
+    else:
+        a, trans_x = _maybe_transpose(ex, a, perm_a), False
+    # rhs -> (batch..., K, N) or (batch..., N, K)+trans_y
+    perm_b = tuple(rb) + (rc[0], free_r[0])
+    alt_b = tuple(rb) + (free_r[0], rc[0])
+    ident = tuple(range(lb_))
+    if alt_b == ident and perm_b != ident:
+        b, trans_y = _maybe_transpose(ex, b, alt_b), True
+    else:
+        b, trans_y = _maybe_transpose(ex, b, perm_b), False
     return ex._new_out(aval.shape, aval.dtype, "matmul_v2",
                        {"X": [a.name], "Y": [b.name]},
                        [("trans_x", "b", trans_x),
                         ("trans_y", "b", trans_y)])
+
+
+def _emit_pool(ex, name, ins, params, aval):
+    """reduce_window over NCHW spatial dims -> pool2d.
+
+    max -> pool2d(max).  sum -> pool2d(avg, exclusive=False) scaled by
+    the window size: non-exclusive average divides by the CONSTANT
+    kh*kw and zero-pads, so sum == avg * kh*kw exactly, padding
+    included (the jaxpr's own count-divide then turns into an
+    elementwise_div of two exported tensors — the spelled-out form of
+    the reference's exclusive average)."""
+    win = tuple(int(w) for w in params["window_dimensions"])
+    strides = tuple(int(s) for s in params["window_strides"])
+    pads = params["padding"]
+    if len(win) != 4 or win[0] != 1 or win[1] != 1 or \
+            strides[0] != 1 or strides[1] != 1 or \
+            tuple(pads[0]) != (0, 0) or tuple(pads[1]) != (0, 0):
+        raise NotImplementedError(
+            "only NCHW spatial reduce_windows export to pool2d")
+    if tuple(int(d) for d in params.get("base_dilation",
+                                        (1,) * 4)) != (1,) * 4 or \
+            tuple(int(d) for d in params.get("window_dilation",
+                                             (1,) * 4)) != (1,) * 4:
+        raise NotImplementedError("dilated pooling is not exportable")
+    xval = ex.val(ins[0])
+    if isinstance(xval, _Lit):
+        # exclusive-average COUNT path: reduce_window over a constant
+        # is input-independent — fold it eagerly (batch/chan dims
+        # collapse to 1; the downstream divide broadcasts)
+        src = tuple(1 if (i < 2 or d == _BATCH) else int(d)
+                    for i, d in enumerate(ins[0].aval.shape))
+        import jax.lax as lax
+
+        dt = np.dtype(xval.dtype)
+        full = jnp.full(src, xval.val, dt)
+        if name.endswith("max"):
+            init = -np.inf if np.issubdtype(dt, np.floating) \
+                else np.iinfo(dt).min
+            folded = lax.reduce_window(
+                full, jnp.asarray(init, dt), lax.max, win, strides,
+                tuple(tuple(p) for p in pads))
+        else:
+            folded = lax.reduce_window(
+                full, jnp.asarray(0, dt), lax.add, win, strides,
+                tuple(tuple(p) for p in pads))
+        arr = np.asarray(folded)
+        ref = ex.const_ref(arr, key=("rwfold", name, src, win, strides,
+                                     tuple(map(tuple, pads)),
+                                     float(xval.val)))
+        if arr.shape != tuple(int(d) for d in aval.shape):
+            ref = _Ref(ref.name, ref.shape, ref.dtype,
+                       expand_to=tuple(int(d) for d in aval.shape))
+        return ref
+    x = ex.force(xval)
+    attrs = [
+        ("pooling_type", "s", "max" if name.endswith("max") else "avg"),
+        ("ksize", "ints", [win[2], win[3]]),
+        ("strides", "ints", [strides[2], strides[3]]),
+        ("paddings", "ints", [int(pads[2][0]), int(pads[2][1]),
+                              int(pads[3][0]), int(pads[3][1])]),
+        ("ceil_mode", "b", False),
+        ("exclusive", "b", False),
+        ("adaptive", "b", False),
+        ("global_pooling", "b", False),
+    ]
+    if name.endswith("sum") and not np.issubdtype(
+            np.dtype(aval.dtype), np.floating):
+        # integer avg pooling truncates the divide, so avg*k != sum
+        raise NotImplementedError(
+            "integer window-sum pooling is not exportable (the "
+            "avg-pool*k identity only holds for floats)")
+    out = ex._new_out(aval.shape, aval.dtype, "pool2d", {"X": [x.name]},
+                      attrs)
+    if name.endswith("sum"):
+        out = _scale(ex, out, aval, float(win[2] * win[3]), 0.0)
+    return out
+
+
+def _emit_gather(ex, ins, params, aval):
+    """The canonical embedding gather (jnp.take axis=0 / W[ids]) ->
+    lookup_table_v2 (out shape = ids.shape + row)."""
+    dn = params["dimension_numbers"]
+    w = ex.val(ins[0])
+    ids = ex.val(ins[1])
+    if not isinstance(w, _Ref) or not isinstance(ids, _Ref):
+        return None
+    w = ex.force(w)
+    ids = ex.force(ids)
+    if tuple(dn.collapsed_slice_dims) != (0,) or \
+            tuple(dn.start_index_map) != (0,):
+        return None
+    row = tuple(int(d) for d in w.shape[1:])
+    sizes = tuple(int(s) for s in params["slice_sizes"])
+    if sizes != (1,) + row:
+        return None
+    nout = len(aval.shape)
+    if tuple(dn.offset_dims) != tuple(range(nout - len(row), nout)):
+        return None
+    if not np.issubdtype(ids.dtype, np.integer):
+        return None
+    idx_shape = tuple(int(d) for d in aval.shape[:nout - len(row)])
+    if ids.shape == idx_shape + (1,):
+        # XLA appends an index-vector dim; lookup_table_v2 wants the
+        # raw ids shape
+        ids = ex._new_out(idx_shape, ids.dtype, "reshape2",
+                          {"X": [ids.name]},
+                          [("shape", "ints",
+                            _reshape_attr(ids.shape, idx_shape))])
+    elif ids.shape != idx_shape:
+        return None
+    out = ex._new_out(aval.shape, aval.dtype, "lookup_table_v2",
+                      {"W": [w.name], "Ids": [ids.name]})
+    return out
 
 
 def _emit_conv(ex, ins, params, aval):

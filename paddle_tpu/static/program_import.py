@@ -675,6 +675,11 @@ _TRANSLATORS = {
                 * (jnp.exp(ins["X"]) - 1)),
     "maximum": _eltwise(jnp.maximum),
     "minimum": _eltwise(jnp.minimum),
+    "pad": lambda ins, attrs: jnp.pad(
+        ins["X"],
+        [tuple(attrs["paddings"][2 * i:2 * i + 2])
+         for i in range(ins["X"].ndim)],
+        constant_values=attrs.get("pad_value", 0.0)),
 }
 
 

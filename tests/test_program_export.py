@@ -291,3 +291,162 @@ class TestReviewRegressions:
             export_reference_inference_model(
                 str(tmp_path / "bits"),
                 [InputSpec([None, 4], dtype="int32")], Bits())
+
+
+class TestRound5Breadth:
+    def test_pooled_cnn_roundtrip(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(
+            nn.Conv2D(1, 3, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2), nn.AvgPool2D(2, 2),
+            nn.Flatten(), nn.Linear(3 * 2 * 2, 4))
+        model.eval()
+        _, ops, prog, _, _ = _roundtrip(
+            tmp_path, model, [InputSpec([None, 1, 8, 8])])
+        assert "pool2d" in ops
+        for batch in (2, 5):
+            x = np.random.RandomState(batch).randn(
+                batch, 1, 8, 8).astype(F32)
+            (out,) = prog(paddle.to_tensor(x))
+            want = model(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(np.asarray(out.numpy()),
+                                       np.asarray(want), rtol=1e-4,
+                                       atol=1e-5)
+
+    def test_embedding_mean_roundtrip(self, tmp_path):
+        paddle.seed(1)
+
+        class EmbMean(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(50, 8)
+                self.fc = nn.Linear(8, 3)
+
+            def forward(self, ids):
+                return self.fc(paddle.mean(self.emb(ids), axis=1))
+
+        model = EmbMean()
+        model.eval()
+        _, ops, prog, _, _ = _roundtrip(
+            tmp_path, model, [InputSpec([None, 5], dtype="int32")])
+        assert "lookup_table_v2" in ops
+        ids = np.random.RandomState(2).randint(0, 50, (4, 5)).astype(
+            np.int32)
+        (out,) = prog(paddle.to_tensor(ids))
+        want = model(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_gpt_tiny_exports_to_reference_format(self, tmp_path):
+        """The headline: a whole eval-mode GPT (XLA attention path)
+        round-trips through the reference wire format."""
+        from paddle_tpu.models.gpt import gpt_tiny
+
+        paddle.seed(0)
+        model = gpt_tiny(num_layers=2, hidden_size=32,
+                         num_attention_heads=2,
+                         max_position_embeddings=16,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0,
+                         use_flash_attention=False)
+        model.eval()
+        prefix = str(tmp_path / "gpt")
+        ops = export_reference_inference_model(
+            prefix, [InputSpec([2, 16], dtype="int32")], model)
+        assert "matmul_v2" in ops and "lookup_table_v2" in ops
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        ids = np.random.RandomState(3).randint(0, 100, (2, 16)).astype(
+            np.int32)
+        (out,) = prog(paddle.to_tensor(ids))
+        want = model(paddle.to_tensor(ids)).numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want), rtol=2e-3,
+                                   atol=2e-4)
+
+
+class TestRound5NewHandlers:
+    def test_iota_cumsum_pad_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class PosMix(nn.Layer):
+            def forward(self, x):
+                d = x._data
+                pos = jnp.arange(d.shape[1], dtype=jnp.float32)
+                c = jnp.cumsum(d + pos, axis=1)
+                p = jnp.pad(c, ((0, 0), (1, 2)), constant_values=0.5)
+                return Tensor(p)
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, PosMix(),
+                                        [InputSpec([3, 4])])
+        assert "cumsum" in ops and "pad" in ops
+        x = np.random.RandomState(7).randn(3, 4).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        want = np.pad(np.cumsum(x + np.arange(4, dtype=F32), 1),
+                      ((0, 0), (1, 2)), constant_values=0.5)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_split_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class QKVish(nn.Layer):
+            def forward(self, x):
+                a, b, c = jnp.split(x._data, 3, axis=1)
+                return Tensor(a * 2 + b - c)
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, QKVish(),
+                                        [InputSpec([None, 6])])
+        assert "split" in ops
+        x = np.random.RandomState(8).randn(4, 6).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        a, b, c = np.split(x, 3, 1)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   a * 2 + b - c, rtol=1e-6)
+
+    def test_scalar_literal_unary_folds(self, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class ScaledByRsqrt(nn.Layer):
+            def forward(self, x):
+                return Tensor(x._data * jax.lax.rsqrt(jnp.float32(16.0))
+                              + jnp.sqrt(jnp.float32(4.0)))
+
+        import jax
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, ScaledByRsqrt(),
+                                        [InputSpec([None, 3])])
+        # both literals fold into one scale chain — no fill_constant
+        assert "fill_constant" not in ops
+        x = np.random.RandomState(9).randn(2, 3).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   x * 0.25 + 2.0, rtol=1e-6)
+
+    def test_deferred_literal_into_cumsum_materializes(self, tmp_path):
+        """cumsum(ones_like(x)) — the review crash repro: a deferred
+        broadcast scalar reaching a shape-sensitive consumer must
+        materialize at the traced shape, not die on _Lit.name."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class OnesCount(nn.Layer):
+            def forward(self, x):
+                return Tensor(jnp.cumsum(jnp.ones_like(x._data), axis=1)
+                              * x._data)
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, OnesCount(),
+                                        [InputSpec([2, 5])])
+        assert "fill_constant" in ops and "cumsum" in ops
+        x = np.random.RandomState(10).randn(2, 5).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        want = np.cumsum(np.ones_like(x), 1) * x
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-6)
