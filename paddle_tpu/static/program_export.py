@@ -94,9 +94,11 @@ def _ffloat(no, v):
 
 def _enc_attr(name, kind, value):
     """OpDesc.Attr: name(1), type(2), then the typed field."""
-    types = {"i": 0, "f": 1, "s": 2, "ints": 3, "b": 6, "l": 9,
-             "longs": 11}
+    types = {"i": 0, "f": 1, "s": 2, "ints": 3, "b": 6, "block": 8,
+             "l": 9, "longs": 11}
     out = _fstr(1, name) + _fint(2, types[kind])
+    if kind == "block":
+        return out + _fint(12, value)
     if kind == "i":
         out += _fint(3, value)
     elif kind == "f":
@@ -148,13 +150,21 @@ def _enc_var(name, dims, dtype_code, persistable, vtype=_LOD_TENSOR):
     return out
 
 
-def _enc_program(op_blobs, var_blobs):
+def _enc_program(op_blobs, var_blobs, sub_blocks=()):
+    """Block 0 carries all vars; sub-blocks (conditional_block/while
+    bodies) carry ops only — the importer merges var scopes."""
     block = _fint(1, 0) + _fint(2, -1)
     for v in var_blobs:
         block += _fbytes(3, v)
     for o in op_blobs:
         block += _fbytes(4, o)
-    return _fbytes(1, block)
+    out = _fbytes(1, block)
+    for i, sub_ops in enumerate(sub_blocks):
+        blk = _fint(1, i + 1) + _fint(2, 0)
+        for o in sub_ops:
+            blk += _fbytes(4, o)
+        out += _fbytes(1, blk)
+    return out
 
 
 def _tensor_stream(arr):
@@ -274,6 +284,7 @@ class _Lit:
 class _Exporter:
     def __init__(self):
         self.ops = []           # (type, ins, outs, attrs)
+        self.sub_blocks = []    # [[op tuples]] — cond/while bodies
         self.vars = {}          # name -> (dims, dtype_code, persistable)
         self.params = {}        # name -> ndarray
         self.env = {}           # jaxpr var -> _Ref | _Lit
@@ -334,8 +345,14 @@ class _Exporter:
     def force(self, ref):
         """Materialize a pending expand_v2 (non-elementwise consumer)."""
         if isinstance(ref, _Ref) and ref.expand_to is not None:
-            if ref._forced is not None:
-                return ref._forced
+            # the cache is scoped to the op list it was emitted into: a
+            # var produced inside one cond/while sub-block does not
+            # exist in the main block or a sibling branch (review
+            # regression — the importer discards sub-scope writes
+            # except the declared Out names)
+            if ref._forced is not None and \
+                    ref._forced[0] == id(self.ops):
+                return ref._forced[1]
             if any(d == _BATCH for d in ref.expand_to):
                 # expand_v2's -1 means 'keep input dim' (which is 1
                 # here), so a dynamic-batch expansion is inexpressible
@@ -344,10 +361,11 @@ class _Exporter:
                     "non-broadcasting consumer; export with a concrete "
                     "batch size in the InputSpec")
             tgt = [int(d) for d in ref.expand_to]
-            ref._forced = self._new_out(
+            out = self._new_out(
                 ref.expand_to, ref.dtype, "expand_v2",
                 {"X": [ref.name]}, [("shape", "ints", tgt)])
-            return ref._forced
+            ref._forced = (id(self.ops), out)
+            return out
         return ref
 
     def materialize(self, lit, shape=(1,)):
@@ -678,6 +696,11 @@ def translate(exporter, name, ins, outs, params):
     if name == "split":
         x = ex.as_ref(ins[0])
         axis = int(params["axis"])
+        if x.shape[axis] == _BATCH:
+            raise NotImplementedError(
+                "splitting the dynamic batch axis would bake the "
+                "placeholder extent into the sections attr; export "
+                "with a concrete batch size")
         sizes = [int(s) for s in params["sizes"]]
         names_out = []
         for ov in outs:
@@ -696,6 +719,14 @@ def translate(exporter, name, ins, outs, params):
 
     if name == "conv_general_dilated":
         bind(_emit_conv(ex, ins, params, aval))
+        return
+
+    if name == "cond":
+        _emit_cond(ex, ins, outs, params)
+        return
+
+    if name == "while":
+        _emit_while(ex, ins, outs, params)
         return
 
     raise NotImplementedError(
@@ -835,6 +866,192 @@ def _scale(ex, x, aval, scale, bias):
     return ex._new_out(aval.shape, aval.dtype, "scale", {"X": [x.name]},
                        [("scale", "f", scale), ("bias", "f", bias),
                         ("bias_after_scale", "b", True)])
+
+
+def _translate_inline(ex, closed, bindings, out_avals):
+    """Translate a jaxpr's eqns into the CURRENT op list.
+
+    ``bindings``: inner invar -> outer atom (sub-resolution) or _Ref
+    (direct env seed, for loop-carried names that are not jaxpr atoms).
+    Returns the output values as forced/materialized _Refs.  Nested
+    control flow inside ``closed`` appends its own sub-blocks; their
+    indices stay valid regardless of which block THIS translation
+    targets."""
+    sub = {}
+    for iv, tgt in bindings.items():
+        if isinstance(tgt, (_Ref, _Lit)):
+            ex.env[iv] = tgt
+        else:
+            sub[iv] = tgt
+    flat = []
+    sub = _flatten(closed.jaxpr, list(closed.consts), sub, flat)
+    outs = [_resolve(v, sub) for v in closed.jaxpr.outvars]
+    live = {v for v in outs if not isinstance(v, (Literal, _Const))}
+    for nm, ins_, outvars, prm in _dce(flat, live):
+        translate(ex, nm, ins_, outvars, prm)
+    refs = []
+    for atom, aval in zip(outs, out_avals):
+        v = ex.val(atom)
+        v = ex.force(v) if isinstance(v, _Ref) else \
+            ex.materialize(v, tuple(int(d) for d in aval.shape) or (1,))
+        refs.append(v)
+    return refs
+
+
+def _translate_subjaxpr(ex, closed, bindings, out_avals, tag):
+    """Translate a branch/body jaxpr into a NEW sub-block.  The block's
+    outputs are bound to fresh names via ``assign`` ops (the importer's
+    conditional_block/while read the Out names from the sub-scope after
+    running its ops).  Returns (out_names, block_idx) — block_idx is
+    the 1-based ProgramDesc block the ops landed in."""
+    saved = ex.ops
+    ex.ops = []
+    try:
+        vals = _translate_inline(ex, closed, bindings, out_avals)
+        out_names = []
+        for v, aval in zip(vals, out_avals):
+            nm = ex._fresh(tag)
+            ex._declare(nm, aval.shape, aval.dtype)
+            ex._emit("assign", {"X": [v.name]}, {"Out": [nm]})
+            out_names.append(nm)
+    finally:
+        sub_ops, ex.ops = ex.ops, saved
+    ex.sub_blocks.append(sub_ops)
+    return out_names, len(ex.sub_blocks)
+
+
+def _emit_cond(ex, ins, outs, params):
+    """lax.cond -> the reference cond() lowering: two guarded
+    conditional_blocks merged per-output by select_input(Mask=index)
+    (conditional_block_op.cc / select_input_op.cc)."""
+    branches = params["branches"]
+    if len(branches) != 2:
+        raise NotImplementedError(
+            "lax.switch with more than two branches has no reference "
+            "conditional_block lowering here; nest two-way conds")
+    idx = ex.as_ref(ins[0])
+    c = ex._new_out(idx.shape or (1,), np.bool_, "cast",
+                    {"X": [idx.name]},
+                    [("in_dtype", "i", _np_vt(idx.dtype)),
+                     ("out_dtype", "i", 0)])
+    nc = ex._new_out(c.shape, np.bool_, "logical_not", {"X": [c.name]})
+    out_avals = [o.aval for o in outs]
+    operand_atoms = list(ins[1:])
+
+    def bindings(closed):
+        return {iv: a for iv, a in zip(closed.jaxpr.invars,
+                                       operand_atoms)}
+
+    t_names, t_blk = _translate_subjaxpr(ex, branches[1],
+                                         bindings(branches[1]),
+                                         out_avals, "t")
+    f_names, f_blk = _translate_subjaxpr(ex, branches[0],
+                                         bindings(branches[0]),
+                                         out_avals, "f")
+    ex._emit("conditional_block", {"Cond": [c.name]},
+             {"Out": t_names, "Scope": []},
+             [("sub_block", "block", t_blk),
+              ("is_scalar_condition", "b", True)])
+    ex._emit("conditional_block", {"Cond": [nc.name]},
+             {"Out": f_names, "Scope": []},
+             [("sub_block", "block", f_blk),
+              ("is_scalar_condition", "b", True)])
+    mask = idx if np.dtype(idx.dtype) == np.dtype(np.int32) else \
+        ex._new_out(idx.shape or (1,), np.int32, "cast",
+                    {"X": [idx.name]},
+                    [("in_dtype", "i", _np_vt(idx.dtype)),
+                     ("out_dtype", "i", 2)])
+    for ov, aval, fn, tn in zip(outs, out_avals, f_names, t_names):
+        nm = ex._fresh()
+        ex._declare(nm, aval.shape, aval.dtype)
+        ex._emit("select_input", {"X": [fn, tn], "Mask": [mask.name]},
+                 {"Out": [nm]})
+        ex.env[ov] = _Ref(nm, aval.shape, aval.dtype)
+
+
+def _emit_while(ex, ins, outs, params):
+    """lax.while_loop -> the reference while op: carried vars get
+    stable names the sub-block reassigns each iteration, with the
+    Condition recomputed at the end of the body (while_op.cc scope
+    semantics; the importer's loop-carry analysis picks these up)."""
+    cond_closed = params["cond_jaxpr"]
+    body_closed = params["body_jaxpr"]
+    ncc = params["cond_nconsts"]
+    nbc = params["body_nconsts"]
+    cond_consts = list(ins[:ncc])
+    body_consts = list(ins[ncc:ncc + nbc])
+    init_atoms = list(ins[ncc + nbc:])
+
+    # stable carried names, seeded from the inits
+    w_names = []
+    for atom in init_atoms:
+        v = ex.as_ref(atom)
+        nm = ex._fresh("w")
+        ex._declare(nm, v.shape, v.dtype)
+        ex._emit("assign", {"X": [v.name]}, {"Out": [nm]})
+        w_names.append(_Ref(nm, v.shape, v.dtype))
+
+    def cond_bindings(carried_refs):
+        b = {}
+        for iv, a in zip(cond_closed.jaxpr.invars[:ncc], cond_consts):
+            b[iv] = a
+        for iv, r in zip(cond_closed.jaxpr.invars[ncc:], carried_refs):
+            b[iv] = r
+        return b
+
+    # initial condition value, computed in the MAIN block
+    cond_aval = cond_closed.jaxpr.outvars[0].aval
+    (cv0,) = _translate_inline(ex, cond_closed, cond_bindings(w_names),
+                               [cond_aval])
+    c_name = ex._fresh("c")
+    ex._declare(c_name, cond_aval.shape, cond_aval.dtype)
+    ex._emit("assign", {"X": [cv0.name]}, {"Out": [c_name]})
+
+    # body sub-block: run body, reassign carried names, recompute cond
+    body_avals = [o.aval for o in outs]
+    b = {}
+    for iv, a in zip(body_closed.jaxpr.invars[:nbc], body_consts):
+        b[iv] = a
+    for iv, r in zip(body_closed.jaxpr.invars[nbc:], w_names):
+        b[iv] = r
+    new_names, blk = _translate_subjaxpr(ex, body_closed, b,
+                                         body_avals, "wb")
+    # inside that same sub-block: fold the new values back into the
+    # carried names and recompute the condition
+    sub_ops = ex.sub_blocks[blk - 1]
+    saved, ex.ops = ex.ops, sub_ops
+    try:
+        new_refs = []
+        for nn, w in zip(new_names, w_names):
+            ex._emit("assign", {"X": [nn]}, {"Out": [w.name]})
+            new_refs.append(_Ref(w.name, w.shape, w.dtype))
+        flat = []
+        sub0 = {}
+        for iv, tgt in cond_bindings(new_refs).items():
+            if isinstance(tgt, (_Ref, _Lit)):
+                ex.env[iv] = tgt       # carried name, not a jaxpr atom
+            else:
+                sub0[iv] = tgt
+        sub = _flatten(cond_closed.jaxpr, list(cond_closed.consts),
+                       sub0, flat)
+        catoms = [_resolve(v, sub) for v in cond_closed.jaxpr.outvars]
+        live = {v for v in catoms
+                if not isinstance(v, (Literal, _Const))}
+        for nm, ins_, outvars, prm in _dce(flat, live):
+            translate(ex, nm, ins_, outvars, prm)
+        cv = ex.val(catoms[0])
+        cv = ex.force(cv) if isinstance(cv, _Ref) else \
+            ex.materialize(cv)
+        ex._emit("assign", {"X": [cv.name]}, {"Out": [c_name]})
+    finally:
+        ex.ops = saved
+
+    ex._emit("while",
+             {"X": [w.name for w in w_names], "Condition": [c_name]},
+             {"Out": [w.name for w in w_names], "StepScopes": []},
+             [("sub_block", "block", blk)])
+    for ov, w in zip(outs, w_names):
+        ex.env[ov] = _Ref(w.name, w.shape, w.dtype)
 
 
 def _maybe_transpose(ex, ref, perm):
@@ -1074,7 +1291,16 @@ def export_reference_inference_model(path_prefix, input_specs, layer):
         dims = tuple(_BATCH if (d is None or d == -1) else int(d)
                      for d in spec.shape)
         args.append(jax.ShapeDtypeStruct(dims, np.dtype(spec.dtype)))
-    closed = jax.make_jaxpr(fn)(*args)
+    # a to_static-converted forward splits the global RNG key per call;
+    # under THIS trace that would store a traced key in global state
+    # (UnexpectedTracerError on the next eager use) — snapshot/restore
+    from ..framework import random as _random
+
+    saved_key = _random._rng._key
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    finally:
+        _random._rng._key = saved_key
 
     ex = _Exporter()
     flat = []
@@ -1112,8 +1338,10 @@ def export_reference_inference_model(path_prefix, input_specs, layer):
     for name, (dims, code, persistable) in sorted(ex.vars.items()):
         var_blobs.append(_enc_var(name, dims, code, persistable))
     op_blobs = [_enc_op(t, i, o, a) for t, i, o, a in ex.ops]
+    sub_blobs = [[_enc_op(t, i, o, a) for t, i, o, a in blk]
+                 for blk in ex.sub_blocks]
     with open(f"{path_prefix}.pdmodel", "wb") as f:
-        f.write(_enc_program(op_blobs, var_blobs))
+        f.write(_enc_program(op_blobs, var_blobs, sub_blobs))
     blob = b"".join(_tensor_stream(ex.params[k])
                     for k in sorted(ex.params))
     with open(f"{path_prefix}.pdiparams", "wb") as f:

@@ -450,3 +450,151 @@ class TestRound5NewHandlers:
         want = np.cumsum(np.ones_like(x), 1) * x
         np.testing.assert_allclose(np.asarray(out.numpy()), want,
                                    rtol=1e-6)
+
+
+class TestRound5ControlFlowExport:
+    def test_cond_roundtrip(self, tmp_path):
+        """static.cond compiles to lax.cond, which now exports as the
+        reference conditional_block/select_input lowering and reloads
+        through the importer's control-flow path — full symmetry."""
+        from paddle_tpu.core.tensor import Tensor
+        from paddle_tpu.static import nn as static_nn
+
+        class Branchy(nn.Layer):
+            def forward(self, x):
+                return static_nn.cond(
+                    paddle.mean(x) > 0,
+                    lambda: x * 2.0, lambda: -x)
+
+        def run(tag, model):
+            prefix = str(tmp_path / tag)
+            ops = export_reference_inference_model(
+                prefix, [InputSpec([3, 2])], model)
+            assert "conditional_block" in ops and "select_input" in ops
+            prog, _, _ = paddle.static.load_inference_model(prefix)
+            return prog
+
+        prog = run("cond", Branchy())
+        pos = np.full((3, 2), 1.5, F32)
+        neg = np.full((3, 2), -1.5, F32)
+        (out_p,) = prog(paddle.to_tensor(pos))
+        (out_n,) = prog(paddle.to_tensor(neg))
+        np.testing.assert_allclose(np.asarray(out_p.numpy()), pos * 2,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(out_n.numpy()), -neg,
+                                   rtol=1e-6)
+
+    def test_while_roundtrip(self, tmp_path):
+        """lax.while_loop exports as the reference while op."""
+        import jax
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class Doubler(nn.Layer):
+            def forward(self, x):
+                import jax.numpy as jnp
+
+                def cond(c):
+                    i, _ = c
+                    return i < 5
+
+                def body(c):
+                    i, v = c
+                    return i + 1, v * 2.0
+
+                _, out = jax.lax.while_loop(
+                    cond, body, (jnp.int32(0), x._data))
+                return Tensor(out)
+
+        prefix = str(tmp_path / "wh")
+        ops = export_reference_inference_model(
+            prefix, [InputSpec([2, 3])], Doubler())
+        assert "while" in ops
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        x = np.random.RandomState(11).randn(2, 3).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), x * 32,
+                                   rtol=1e-6)
+
+    def test_dy2static_model_exports_with_control_flow(self, tmp_path):
+        """Natural python control flow -> dy2static -> lax -> reference
+        while/conditional_block ops -> importer — the full loop."""
+        from paddle_tpu.jit import to_static
+        from paddle_tpu.static.program_import import parse_program_blocks
+
+        class Stepper(nn.Layer):
+            def forward(self, x):
+                i = paddle.to_tensor(np.int32(0))
+                while i < 6:
+                    x = x * 2.0
+                    i = i + 1
+                if paddle.mean(x) > 0:
+                    return x + 1.0
+                return x - 1.0
+
+        model = Stepper()
+        to_static(model)     # converts forward in place
+        prefix = str(tmp_path / "stepper")
+        export_reference_inference_model(prefix, [InputSpec([2, 3])],
+                                         model)
+        blocks = parse_program_blocks(open(f"{prefix}.pdmodel",
+                                           "rb").read())
+        types = [o.type for o in blocks[0][0]]
+        assert "while" in types and "select_input" in types
+        assert len(blocks) >= 3
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        for sign in (1.0, -1.0):
+            x = np.full((2, 3), 0.25 * sign, F32)
+            (got,) = prog(paddle.to_tensor(x))
+            want = model(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(np.asarray(got.numpy()),
+                                       np.asarray(want), rtol=1e-6)
+
+    def test_split_dynamic_batch_axis_refuses(self, tmp_path):
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class BatchSplit(nn.Layer):
+            def forward(self, x):
+                a, b = jnp.split(x._data, [1], axis=0)
+                return Tensor(a.sum() + b.sum())
+
+        with pytest.raises(NotImplementedError, match="batch"):
+            export_reference_inference_model(
+                str(tmp_path / "bs"), [InputSpec([None, 3])],
+                BatchSplit())
+
+    def test_forced_expand_reemits_per_block(self, tmp_path):
+        """A broadcast forced inside a cond branch must re-emit when
+        the main block needs it too (review regression: the force cache
+        crossed block scopes and referenced a sub-scope-only var)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        base = np.array([1.0, 2.0, 3.0], F32)
+
+        class CrossBlock(nn.Layer):
+            def forward(self, x):
+                m = jnp.broadcast_to(jnp.asarray(base), (4, 3))
+                picked = jax.lax.cond(
+                    jnp.sum(x._data) > 0,
+                    lambda: jnp.transpose(m).sum(),
+                    lambda: jnp.float32(0.0))
+                tail = jnp.transpose(m).sum(axis=1)   # main-block force
+                return Tensor(tail + picked + x._data)
+
+        prefix = str(tmp_path / "xb")
+        export_reference_inference_model(prefix, [InputSpec([4, 3])],
+                                         CrossBlock())
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        for sign in (1.0, -1.0):
+            x = np.full((4, 3), 0.1 * sign, F32)
+            (out,) = prog(paddle.to_tensor(x))
+            m = np.broadcast_to(base, (4, 3))
+            picked = m.T.sum() if x.sum() > 0 else 0.0
+            want = m.T.sum(1) + picked + x
+            np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                       rtol=1e-5)
