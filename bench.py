@@ -184,6 +184,24 @@ def main():
     # discarding the whole measurement.
     print(json.dumps(out), flush=True)
 
+    if on_tpu:
+        # batch 8 x 512 under-saturates the MXU (r5 measured 30.9% MFU);
+        # 124M params use ~2.5GB for params+grads+opt, leaving v5e HBM
+        # room for much larger batches.  Measure batch 32 and take the
+        # better number as the headline (OOM falls back cleanly).
+        try:
+            tok32, mfu32 = _measure(model, dict(batch=32, seq=512),
+                                    12, 2, seed=0)
+            out["b32_tokens_per_sec"] = round(tok32, 1)
+            out["b32_mfu"] = round(mfu32, 4)
+            if mfu32 > mfu:
+                out["value"] = round(tok32, 1)
+                out["vs_baseline"] = round(mfu32 / 0.45, 4)
+                out["config"] = "batch=32,seq=512"
+        except Exception as e:  # OOM etc: the batch-8 line stands
+            out["b32_error"] = str(e)[:160]
+        print(json.dumps(out), flush=True)
+
     # Second measured config: Llama-family decoder (RoPE/GQA/SwiGLU) —
     # the parent takes the LAST valid JSON line, so re-emit the combined
     # record (extra fields; the driver reads metric/value)
