@@ -16,7 +16,7 @@ import time
 import numpy as np
 
 
-def measure(batch, seq, steps=12, warmup=2):
+def measure(batch, seq, steps=12, warmup=2, flash=True):
     import jax
 
     import paddle_tpu as paddle
@@ -27,7 +27,8 @@ def measure(batch, seq, steps=12, warmup=2):
     paddle.seed(0)
     model = gpt_124m(hidden_dropout_prob=0.0,
                      attention_probs_dropout_prob=0.0,
-                     max_position_embeddings=max(1024, seq))
+                     max_position_embeddings=max(1024, seq),
+                     use_flash_attention=flash)
     model = paddle.amp.decorate(model, level="O2", dtype="bfloat16")
     n_params = sum(p.size for p in model.parameters())
     opt = optimizer.AdamW(learning_rate=1e-4,
@@ -59,23 +60,32 @@ def measure(batch, seq, steps=12, warmup=2):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", nargs="*",
-                    default=["8,512", "16,512", "32,512", "8,1024",
-                             "16,1024", "8,2048", "16,2048", "4,4096"])
+                    default=["8,512", "8,512,xla", "16,512", "32,512",
+                             "32,512,xla", "8,1024", "16,1024",
+                             "8,2048", "16,2048", "4,4096"])
     args = ap.parse_args()
     import os
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
     best = None
     for cfg in args.configs:
-        b, s = (int(x) for x in cfg.split(","))
+        parts = cfg.split(",")
+        b, s = int(parts[0]), int(parts[1])
+        flash = True
+        if len(parts) > 2:
+            if parts[2] not in ("xla", "flash"):
+                raise SystemExit(
+                    f"config {cfg!r}: third token must be 'flash' or "
+                    "'xla'")
+            flash = parts[2] == "flash"
         try:
-            tok_s, mfu = measure(b, s)
+            tok_s, mfu = measure(b, s, flash=flash)
         except Exception as e:  # OOM etc: record and continue
-            print(json.dumps({"batch": b, "seq": s,
+            print(json.dumps({"batch": b, "seq": s, "flash": flash,
                               "error": str(e)[:200]}), flush=True)
             continue
-        rec = {"batch": b, "seq": s, "tokens_per_sec": round(tok_s, 1),
-               "mfu": round(mfu, 4)}
+        rec = {"batch": b, "seq": s, "flash": flash,
+               "tokens_per_sec": round(tok_s, 1), "mfu": round(mfu, 4)}
         print(json.dumps(rec), flush=True)
         if best is None or mfu > best["mfu"]:
             best = rec

@@ -99,11 +99,26 @@ class Predictor:
         if config._model_obj is not None:
             self._model = config._model_obj
         else:
-            from ..jit import load as jit_load
-            self._model = jit_load(config.model_path)
+            # the sniffing loader routes BOTH formats: this framework's
+            # jit.save artifacts and reference ProgramDesc exports —
+            # the latter wrap as a Layer so the predictor's precision
+            # pass / functional_call machinery applies uniformly
+            from ..static import load_inference_model
+            loaded, feeds, fetches = load_inference_model(
+                config.model_path)
+            self._model = loaded.to_layer() if hasattr(
+                loaded, "to_layer") else loaded
+            feed_names, fetch_names = list(feeds), list(fetches)
         self._model.eval()
-        self._inputs = [_IOHandle("x0")]
-        self._outputs = [_IOHandle("out0")]
+        if config._model_obj is not None:
+            feed_names, fetch_names = ["x0"], ["out0"]
+        # the program's DECLARED feed order: get_input_handle(name) +
+        # run() bind by these names, so a user filling handles in any
+        # order still feeds the right slots (the Executor fixed this
+        # same swap class by name-binding; reference ZeroCopyTensor is
+        # name-addressed too)
+        self._inputs = [_IOHandle(n) for n in feed_names]
+        self._outputs = [_IOHandle(n) for n in fetch_names]
         self._compiled_cache = {}
 
         # mixed-precision convert pass: cast float params ONCE (the
@@ -185,7 +200,15 @@ class Predictor:
         if inputs is not None:
             arrays = [np.asarray(a) for a in inputs]
         else:
-            arrays = [h._host for h in self._inputs if h._host is not None]
+            # declared-feed order, independent of handle fill order
+            filled = [h for h in self._inputs if h._host is not None]
+            missing = [h.name for h in self._inputs if h._host is None]
+            if missing and filled:
+                raise ValueError(
+                    f"feeds {missing} have no data "
+                    f"(copy_from_cpu the full declared set "
+                    f"{[h.name for h in self._inputs]})")
+            arrays = [h._host for h in filled]
         datas = [jax.numpy.asarray(a) for a in arrays]
         if self._config._precision in ("bfloat16", "float16"):
             datas = [
