@@ -186,6 +186,14 @@ def _tensor_stream(arr):
 
 # ------------------------------------------------------ jaxpr flattening --
 
+class _Aval:
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = shape
+        self.dtype = dtype
+
+
 class _Const:
     """A closed-over constant entering the flat eqn list."""
 
@@ -193,6 +201,32 @@ class _Const:
 
     def __init__(self, val):
         self.val = val
+
+    @property
+    def aval(self):
+        v = np.asarray(self.val)
+        return _Aval(v.shape, v.dtype)
+
+
+class _UVar:
+    """A per-call-site renaming of a jaxpr variable.
+
+    jax CACHES traced sub-jaxprs per (function, avals): every same-shape
+    relu/softmax call site shares ONE inner jaxpr and therefore the
+    SAME inner Var objects.  Keying the translation env by those shared
+    objects lets a later call site rebind an earlier site's value (the
+    ResNet stacked-BasicBlock residual read the wrong tensor this way),
+    so the flattener α-renames every emitted eqn's outvars to fresh
+    _UVars — one binding per call site, guaranteed."""
+
+    __slots__ = ("var",)
+
+    def __init__(self, var):
+        self.var = var
+
+    @property
+    def aval(self):
+        return self.var.aval
 
 
 def _resolve(atom, sub):
@@ -237,7 +271,16 @@ def _flatten(jaxpr, consts, sub, eqns):
                 sub[ov] = _resolve(iov, isub)
         else:
             ins = [_resolve(a, sub) for a in eqn.invars]
-            eqns.append((name, ins, eqn.outvars, eqn.params))
+            # α-rename the outputs: inner jaxprs are CACHED per
+            # (function, avals), so their Var objects recur at every
+            # same-shape call site — emitting them raw lets call site
+            # N+1 rebind call site N's values (see _UVar)
+            outs = []
+            for ov in eqn.outvars:
+                nv = _UVar(ov)
+                sub[ov] = nv
+                outs.append(nv)
+            eqns.append((name, ins, outs, eqn.params))
     return sub
 
 

@@ -598,3 +598,65 @@ class TestRound5ControlFlowExport:
             want = m.T.sum(1) + picked + x
             np.testing.assert_allclose(np.asarray(out.numpy()), want,
                                        rtol=1e-5)
+
+
+class TestRound5AlphaRename:
+    def test_stacked_residual_blocks_roundtrip(self, tmp_path):
+        """jax caches one traced sub-jaxpr per (function, avals): every
+        same-shape relu shares inner Var objects across call sites.
+        Without per-site α-renaming, block 2's residual read block 2's
+        inner relu instead of block 1's output (resnet18 diverged 0.4).
+        """
+        paddle.seed(0)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv1 = nn.Conv2D(4, 4, 3, padding=1,
+                                       bias_attr=False)
+                self.bn1 = nn.BatchNorm2D(4)
+                self.relu = nn.ReLU()
+                self.conv2 = nn.Conv2D(4, 4, 3, padding=1,
+                                       bias_attr=False)
+                self.bn2 = nn.BatchNorm2D(4)
+
+            def forward(self, x):
+                out = self.relu(self.bn1(self.conv1(x)))
+                out = self.bn2(self.conv2(out))
+                return self.relu(out + x)
+
+        model = nn.Sequential(Block(), Block())
+        model.eval()
+        _, _, prog, _, _ = _roundtrip(tmp_path, model,
+                                      [InputSpec([2, 4, 8, 8])])
+        x = np.random.RandomState(12).randn(2, 4, 8, 8).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        want = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+
+    @pytest.mark.slow
+    def test_resnet18_and_mobilenetv2_export_exact(self, tmp_path):
+        """Whole production vision models round-trip through the
+        reference wire format within float32 tolerance (measured 0.0
+        max abs error on CPU; the assert allows 1e-5 for backends with
+        different fusion orders)."""
+        from paddle_tpu.vision.models import mobilenet_v2, resnet18
+
+        rng = np.random.RandomState(13)
+        for name, ctor in (("resnet18", resnet18),
+                           ("mobilenet_v2", mobilenet_v2)):
+            paddle.seed(0)
+            model = ctor(num_classes=10)
+            model.eval()
+            prefix = str(tmp_path / name)
+            export_reference_inference_model(
+                prefix, [InputSpec([None, 3, 32, 32])], model)
+            prog, _, _ = paddle.static.load_inference_model(prefix)
+            x = rng.randn(2, 3, 32, 32).astype(F32)
+            (out,) = prog(paddle.to_tensor(x))
+            want = model(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(np.asarray(out.numpy()),
+                                       np.asarray(want), rtol=1e-5,
+                                       atol=1e-5, err_msg=name)
