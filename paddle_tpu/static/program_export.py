@@ -524,6 +524,39 @@ def translate(exporter, name, ins, outs, params):
                          {"X": [x.name]}))
         return
 
+    if name == "slice":
+        x = ex.as_ref(ins[0])
+        if any(int(s) != 1 for s in (params.get("strides") or
+                                     [1] * len(x.shape))):
+            raise NotImplementedError(
+                "strided slice export is not implemented")
+        axes, starts, ends = [], [], []
+        for d, (st, li) in enumerate(zip(params["start_indices"],
+                                         params["limit_indices"])):
+            st, li = int(st), int(li)
+            if st == 0 and li == x.shape[d]:
+                continue                       # full dim: omit the axis
+            if x.shape[d] == _BATCH:
+                raise NotImplementedError(
+                    "slicing within the dynamic batch dim would bake "
+                    "the placeholder extent; export with a concrete "
+                    "batch size")
+            axes.append(d)
+            starts.append(st)
+            ends.append(li)
+        bind(ex._new_out(aval.shape, aval.dtype, "slice",
+                         {"Input": [x.name]},
+                         [("axes", "ints", axes),
+                          ("starts", "ints", starts),
+                          ("ends", "ints", ends)]))
+        return
+
+    if name == "erfc":
+        x = ex.as_ref(ins[0])
+        e = ex._new_out(aval.shape, aval.dtype, "erf", {"X": [x.name]})
+        bind(_scale(ex, e, aval, -1.0, 1.0))   # erfc = 1 - erf
+        return
+
     if name == "neg":
         x = ex.as_ref(ins[0])
         bind(ex._new_out(aval.shape, aval.dtype, "scale",
@@ -575,13 +608,32 @@ def translate(exporter, name, ins, outs, params):
             return
         on_false = ex.val(ins[1])
         on_true = ex.val(ins[2])
-        on_false = ex.force(on_false) if isinstance(on_false, _Ref) \
+        on_false = on_false if isinstance(on_false, _Ref) \
             else ex.materialize(on_false)
-        on_true = ex.force(on_true) if isinstance(on_true, _Ref) \
+        on_true = on_true if isinstance(on_true, _Ref) \
             else ex.materialize(on_true)
-        bind(ex._new_out(aval.shape, aval.dtype, "where",
-                         {"Condition": [pred.name], "X": [on_true.name],
-                          "Y": [on_false.name]}))
+        want = tuple(int(d) for d in aval.shape) or (1,)
+        # prefer the UNFORCED operands (mirrors _emit_binop): the
+        # importer's where broadcasts numpy-style, so a deferred
+        # broadcast needs no expand_v2 when the shapes already imply
+        # the output
+        implied = np.broadcast_shapes(pred.shape, on_true.shape,
+                                      on_false.shape)
+        if implied != want:
+            pf, tf, ff = (ex.force(pred), ex.force(on_true),
+                          ex.force(on_false))
+            forced = np.broadcast_shapes(pf.shape, tf.shape, ff.shape)
+            if forced == want:
+                pred, on_true, on_false = pf, tf, ff
+                implied = forced
+            # else: all-collapsed-literal select — compute reduced and
+            # defer the broadcast (see _emit_binop)
+        out = ex._new_out(implied, aval.dtype, "where",
+                          {"Condition": [pred.name],
+                           "X": [on_true.name], "Y": [on_false.name]})
+        if implied != want:
+            out = _Ref(out.name, implied, aval.dtype, expand_to=want)
+        bind(out)
         return
 
     if name == "broadcast_in_dim":
@@ -862,15 +914,29 @@ def _emit_binop(ex, name, a, b, aval):
     # the size-1-axes form broadcasts numpy-style to the same result —
     # UNLESS the expansion is load-bearing for the output shape (the
     # other operand doesn't force it), in which case expand for real
+    # a materialized scalar is [1] by design; a () target is the same
+    # value for every consumer — not a real mismatch
+    want = tuple(int(d) for d in aval.shape) or (1,)
     try:
         implied = np.broadcast_shapes(a.shape, b.shape)
     except ValueError:
         implied = None
-    if implied != tuple(int(d) for d in aval.shape):
-        a, b = ex.force(a), ex.force(b)
-    return ex._new_out(aval.shape, aval.dtype, op,
-                       {"X": [a.name], "Y": [b.name]},
-                       [("axis", "i", -1)])
+    if implied != want:
+        af, bf = ex.force(a), ex.force(b)
+        forced = np.broadcast_shapes(af.shape, bf.shape)
+        if forced == want:
+            a, b = af, bf
+            implied = forced
+        # else: EVERY operand is a collapsed literal (BERT's
+        # token-type path compares scalar consts) — compute at the
+        # reduced shape and defer the broadcast to consumers, exactly
+        # like broadcast_in_dim does
+    out = ex._new_out(implied, aval.dtype, op,
+                      {"X": [a.name], "Y": [b.name]},
+                      [("axis", "i", -1)])
+    if implied != want:
+        out = _Ref(out.name, implied, aval.dtype, expand_to=want)
+    return out
 
 
 def _emit_trunc_rem(ex, ins, aval):

@@ -660,3 +660,50 @@ class TestRound5AlphaRename:
             np.testing.assert_allclose(np.asarray(out.numpy()),
                                        np.asarray(want), rtol=1e-5,
                                        atol=1e-5, err_msg=name)
+
+
+class TestRound5BertPath:
+    def test_collapsed_literal_compare_select(self, tmp_path):
+        """BERT's token-type path: comparisons/selects over FULLY
+        collapsed scalar constants must emit at the reduced shape and
+        defer the broadcast (the declared-vs-runtime shape mismatch
+        broke reshape downstream)."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class TokenTypish(nn.Layer):
+            def forward(self, x):
+                z = jnp.zeros(x._data.shape, jnp.int32)
+                neg = z < jnp.int32(0)
+                t = jnp.where(neg, z + jnp.int32(2), z)
+                t = t.reshape(t.shape + (1,)).reshape(t.shape)
+                return Tensor(x._data + t.astype(jnp.float32))
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, TokenTypish(),
+                                        [InputSpec([2, 5])])
+        x = np.random.RandomState(14).randn(2, 5).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out.numpy()), x,
+                                   rtol=1e-6)
+
+    @pytest.mark.slow
+    def test_bert_tiny_round_trips(self, tmp_path):
+        from paddle_tpu.models.bert import bert_tiny
+
+        paddle.seed(0)
+        model = bert_tiny()
+        model.eval()
+        prefix = str(tmp_path / "bert")
+        ops = export_reference_inference_model(
+            prefix, [InputSpec([2, 16], dtype="int32")], model)
+        assert "lookup_table_v2" in ops and "slice" in ops
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        ids = np.random.RandomState(15).randint(0, 100, (2, 16)).astype(
+            np.int32)
+        outs = prog(paddle.to_tensor(ids))
+        wants = model(paddle.to_tensor(ids))
+        for o, w in zip(outs, wants):
+            np.testing.assert_allclose(np.asarray(o.numpy()),
+                                       np.asarray(w.numpy()),
+                                       rtol=1e-4, atol=1e-5)
