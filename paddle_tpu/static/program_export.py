@@ -295,6 +295,100 @@ def _dce(eqns, live):
     return keep[::-1]
 
 
+def _fuse_softmax(eqns, outs_live):
+    """Peephole over the flat eqn list: the softmax chain
+    ``div(exp(sub(x, stop_grad/reshape(reduce_max(x)))), reshape(
+    reduce_sum(exp)))`` collapses to one synthetic ``__softmax`` eqn —
+    exported as the reference's single softmax op instead of ~8
+    elementwise ops per attention call (real reference runtimes have a
+    fused softmax kernel; the spelled-out form also bloats programs).
+    Interior values consumed OUTSIDE the pattern decline the fusion."""
+    prod = {}
+    uses = {}
+    for i, (_n, ins, outs, _p) in enumerate(eqns):
+        for o in outs:
+            prod[o] = i
+        for a in ins:
+            if not isinstance(a, (Literal, _Const)):
+                uses[a] = uses.get(a, 0) + 1
+    for v in outs_live:
+        if not isinstance(v, (Literal, _Const)):
+            uses[v] = uses.get(v, 0) + 1
+
+    def eqn_of(var, want_name):
+        i = prod.get(var)
+        if i is None or eqns[i] is None or eqns[i][0] != want_name:
+            return None, None
+        return i, eqns[i]
+
+    def chase(var, names):
+        """Follow single-use unary reshape/convert/stop_gradient links
+        ('names') up from var; max(-inf, v) (jax.nn.softmax's guard)
+        follows too.  Returns (source var, [indices])."""
+        idxs = []
+        while True:
+            i = prod.get(var)
+            if i is None or eqns[i] is None:
+                return var, idxs
+            n, ins, outs, _p = eqns[i]
+            if n == "max" and len(ins) == 2 and uses.get(outs[0]) == 1:
+                lit = [a for a in ins if isinstance(a, (Literal, _Const))]
+                oth = [a for a in ins
+                       if not isinstance(a, (Literal, _Const))]
+                if len(lit) == 1 and len(oth) == 1 and \
+                        float(np.asarray(lit[0].val)) == float("-inf"):
+                    idxs.append(i)
+                    var = oth[0]
+                    continue
+            if n in names and len(ins) == 1 and uses.get(outs[0]) == 1:
+                idxs.append(i)
+                var = ins[0]
+                continue
+            return var, idxs
+
+    changed = False
+    for di in range(len(eqns)):
+        if eqns[di] is None or eqns[di][0] != "div":
+            continue
+        _n, (e_var, t_var), d_outs, _p = eqns[di][0], eqns[di][1], \
+            eqns[di][2], eqns[di][3]
+        if isinstance(e_var, (Literal, _Const)) or \
+                isinstance(t_var, (Literal, _Const)):
+            continue
+        ei, e_eqn = eqn_of(e_var, "exp")
+        if e_eqn is None or uses.get(e_var) != 2:   # div + reduce_sum
+            continue
+        t_src, t_links = chase(t_var, ("reshape", "broadcast_in_dim"))
+        si, s_eqn = eqn_of(t_src, "reduce_sum")
+        if s_eqn is None or uses.get(t_src) != 1 or \
+                s_eqn[1][0] is not e_var:
+            continue
+        sum_axes = tuple(s_eqn[3]["axes"])
+        if len(sum_axes) != 1:
+            continue
+        bi, b_eqn = eqn_of(e_eqn[1][0], "sub")
+        if b_eqn is None or uses.get(e_eqn[1][0]) != 1:
+            continue
+        x_var, m_var = b_eqn[1]
+        m_src, m_links = chase(
+            m_var, ("reshape", "broadcast_in_dim", "stop_gradient",
+                    "max"))
+        mi, m_eqn = eqn_of(m_src, "reduce_max")
+        if m_eqn is None or m_eqn[1][0] is not x_var or \
+                tuple(m_eqn[3]["axes"]) != sum_axes:
+            continue
+        # every interior link must be single-use (chase enforced) and
+        # the max/sum reductions must serve only this chain
+        if uses.get(m_src, 0) > 1 or uses.get(e_eqn[1][0]) != 1:
+            continue
+        axis = sum_axes[0]
+        for idx in [ei, si, bi, mi] + t_links + m_links:
+            eqns[idx] = None
+        eqns[di] = ("__softmax", [x_var], d_outs, {"axis": axis})
+        changed = True
+    return [e for e in eqns if e is not None] if changed else eqns
+
+
 # ------------------------------------------------------------ translator --
 
 class _Ref:
@@ -499,6 +593,13 @@ def translate(exporter, name, ins, outs, params):
         bind(ex._new_out(aval.shape, tgt, "cast", {"X": [src.name]},
                          [("in_dtype", "i", _np_vt(src.dtype)),
                           ("out_dtype", "i", _np_vt(tgt))]))
+        return
+
+    if name == "__softmax":     # fused by _fuse_softmax
+        x = ex.as_ref(ins[0])
+        bind(ex._new_out(aval.shape, aval.dtype, "softmax",
+                         {"X": [x.name]},
+                         [("axis", "i", int(params["axis"]))]))
         return
 
     if name in _UNARY:
@@ -996,7 +1097,7 @@ def _translate_inline(ex, closed, bindings, out_avals):
     sub = _flatten(closed.jaxpr, list(closed.consts), sub, flat)
     outs = [_resolve(v, sub) for v in closed.jaxpr.outvars]
     live = {v for v in outs if not isinstance(v, (Literal, _Const))}
-    for nm, ins_, outvars, prm in _dce(flat, live):
+    for nm, ins_, outvars, prm in _fuse_softmax(_dce(flat, live), outs):
         translate(ex, nm, ins_, outvars, prm)
     refs = []
     for atom, aval in zip(outs, out_avals):
@@ -1416,7 +1517,7 @@ def export_reference_inference_model(path_prefix, input_specs, layer):
     sub = _flatten(closed.jaxpr, list(closed.consts), {}, flat)
     outs = [_resolve(v, sub) for v in closed.jaxpr.outvars]
     live = {v for v in outs if not isinstance(v, (Literal, _Const))}
-    flat = _dce(flat, live)
+    flat = _fuse_softmax(_dce(flat, live), outs)
 
     # feeds
     feed_names = []

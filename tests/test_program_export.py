@@ -38,6 +38,8 @@ class TestMLPRoundTrip:
             tmp_path, model, [InputSpec([None, 4])])
         assert ops[0] == "feed" and ops[-1] == "fetch"
         assert "matmul_v2" in ops and "relu" in ops
+        # the softmax chain fuses to the single reference op
+        assert "softmax" in ops and "exp" not in ops
         # runs at batch sizes NOT seen at export trace time
         for batch in (2, 7):
             x = np.random.RandomState(batch).randn(batch, 4).astype(F32)
