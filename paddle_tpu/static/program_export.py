@@ -295,14 +295,20 @@ def _dce(eqns, live):
     return keep[::-1]
 
 
-def _fuse_softmax(eqns, outs_live):
-    """Peephole over the flat eqn list: the softmax chain
-    ``div(exp(sub(x, stop_grad/reshape(reduce_max(x)))), reshape(
-    reduce_sum(exp)))`` collapses to one synthetic ``__softmax`` eqn —
-    exported as the reference's single softmax op instead of ~8
-    elementwise ops per attention call (real reference runtimes have a
-    fused softmax kernel; the spelled-out form also bloats programs).
-    Interior values consumed OUTSIDE the pattern decline the fusion."""
+def _fuse_peepholes(eqns, outs_live):
+    """Peepholes over the flat eqn list, fusing spelled-out chains into
+    the reference's fused ops (real runtimes have kernels for them; the
+    raw forms bloat programs):
+
+    - softmax: ``div(exp(sub(x, reduce_max(x))), reduce_sum(exp))``
+      with its reshape/stop_gradient/max(-inf) bookkeeping links ->
+      one ``__softmax`` eqn (~8 ops per attention call saved).
+    - eval-mode batch norm (see _fuse_batchnorm_eval).
+
+    Interior values consumed OUTSIDE a pattern decline the fusion, and
+    every reshape link must re-insert the reduced/channel axis exactly
+    where the fused op expects it (a wrong-axis normalization over a
+    square matrix is shape-silent — it must NOT fuse)."""
     prod = {}
     uses = {}
     for i, (_n, ins, outs, _p) in enumerate(eqns):
@@ -327,6 +333,8 @@ def _fuse_softmax(eqns, outs_live):
         follows too.  Returns (source var, [indices])."""
         idxs = []
         while True:
+            if isinstance(var, (Literal, _Const)):
+                return var, idxs
             i = prod.get(var)
             if i is None or eqns[i] is None:
                 return var, idxs
@@ -336,7 +344,9 @@ def _fuse_softmax(eqns, outs_live):
                 oth = [a for a in ins
                        if not isinstance(a, (Literal, _Const))]
                 if len(lit) == 1 and len(oth) == 1 and \
-                        float(np.asarray(lit[0].val)) == float("-inf"):
+                        np.asarray(lit[0].val).size == 1 and \
+                        float(np.asarray(lit[0].val).reshape(())) == \
+                        float("-inf"):
                     idxs.append(i)
                     var = oth[0]
                     continue
@@ -346,7 +356,7 @@ def _fuse_softmax(eqns, outs_live):
                 continue
             return var, idxs
 
-    changed = False
+    changed = _fuse_batchnorm_eval(eqns, prod, uses, chase)
     for di in range(len(eqns)):
         if eqns[di] is None or eqns[di][0] != "div":
             continue
@@ -382,11 +392,168 @@ def _fuse_softmax(eqns, outs_live):
         if uses.get(m_src, 0) > 1 or uses.get(e_eqn[1][0]) != 1:
             continue
         axis = sum_axes[0]
+        # the broadcast-back links must re-insert the REDUCED axis as a
+        # size-1 dim in x's shape — a keepdims-free reduce broadcast
+        # right-aligned onto a square matrix is shape-silent but means
+        # a different normalization axis than the fused op would use
+        x_shape = tuple(int(d) for d in x_var.aval.shape)
+        keep = tuple(1 if i == axis % len(x_shape) else d
+                     for i, d in enumerate(x_shape))
+
+        ax_n = axis % len(x_shape)
+        kept_dims = tuple(i for i in range(len(x_shape)) if i != ax_n)
+
+        def reinserts(link_idxs):
+            ok = 0
+            for i in link_idxs:
+                if eqns[i] is None:
+                    continue
+                n, _i2, _o2, p2 = eqns[i]
+                if n == "reshape":
+                    if tuple(int(d) for d in p2["new_sizes"]) != keep:
+                        return False
+                    ok += 1
+                elif n == "broadcast_in_dim":
+                    if tuple(int(d) for d in p2["shape"]) != keep or \
+                            tuple(p2["broadcast_dimensions"]) != \
+                            kept_dims:
+                        return False
+                    ok += 1
+            return ok > 0
+
+        if not (reinserts(t_links) and reinserts(m_links)):
+            continue
         for idx in [ei, si, bi, mi] + t_links + m_links:
             eqns[idx] = None
         eqns[di] = ("__softmax", [x_var], d_outs, {"axis": axis})
         changed = True
     return [e for e in eqns if e is not None] if changed else eqns
+
+
+def _fuse_batchnorm_eval(eqns, prod, uses, chase):
+    """Companion peephole: the eval-mode BN chain
+    ``add(mul(mul(sub(x, BC(mean)), BC(rsqrt(add(var, eps)))),
+    BC(gamma)), BC(beta))`` — per-channel consts broadcast over NCHW —
+    collapses to one synthetic ``__batch_norm`` eqn (the reference's
+    fused batch_norm kernel; ResNet exports drop ~10 elementwise ops
+    per BN).  BC = reshape/broadcast single-use links; every leaf must
+    be a _Const (a TRAINED-stat chain, not an activation norm)."""
+    links = ("reshape", "broadcast_in_dim", "stop_gradient")
+
+    def const_leaf(var):
+        src, idxs = chase(var, links)
+        return (src, idxs) if isinstance(src, _Const) else (None, idxs)
+
+    changed = False
+    for ai in range(len(eqns)):
+        e = eqns[ai]
+        if e is None or e[0] != "add":
+            continue
+        mul2_var, beta_var = e[1]
+        if isinstance(mul2_var, (Literal, _Const)):
+            continue
+        beta, beta_links = const_leaf(beta_var)
+        if beta is None:
+            continue
+        m2i = prod.get(mul2_var)
+        if m2i is None or eqns[m2i] is None or \
+                eqns[m2i][0] != "mul" or uses.get(mul2_var) != 1:
+            continue
+        mul1_var, gamma_var = eqns[m2i][1]
+        if isinstance(mul1_var, (Literal, _Const)):
+            continue
+        gamma, gamma_links = const_leaf(gamma_var)
+        if gamma is None:
+            continue
+        m1i = prod.get(mul1_var)
+        if m1i is None or eqns[m1i] is None or \
+                eqns[m1i][0] != "mul" or uses.get(mul1_var) != 1:
+            continue
+        sub_var, rs_var = eqns[m1i][1]
+        if isinstance(sub_var, (Literal, _Const)):
+            continue
+        rs_src, rs_links = chase(rs_var, links)
+        rsi = prod.get(rs_src)
+        if rsi is None or eqns[rsi] is None or \
+                eqns[rsi][0] != "rsqrt" or uses.get(rs_src, 0) > 1:
+            continue
+        vadd_var = eqns[rsi][1][0]
+        vi = prod.get(vadd_var)
+        if vi is None or eqns[vi] is None or eqns[vi][0] != "add" or \
+                uses.get(vadd_var) != 1:
+            continue
+        var_operand, eps_lit = eqns[vi][1]
+        if not isinstance(eps_lit, (Literal, _Const)):
+            var_operand, eps_lit = eps_lit, var_operand
+        if not isinstance(eps_lit, (Literal, _Const)):
+            continue
+        eps_arr = np.asarray(eps_lit.val)
+        if eps_arr.ndim != 0:
+            continue
+        var_c, var_links = const_leaf(var_operand) if not isinstance(
+            var_operand, (Literal, _Const)) else (var_operand, [])
+        if var_c is None:
+            continue
+        si2 = prod.get(sub_var)
+        if si2 is None or eqns[si2] is None or \
+                eqns[si2][0] != "sub" or uses.get(sub_var) != 1:
+            continue
+        x_var, mean_var = eqns[si2][1]
+        if isinstance(x_var, (Literal, _Const)):
+            continue
+        mean_c, mean_links = const_leaf(mean_var)
+        if mean_c is None:
+            continue
+        # all four stats must be per-channel vectors of one length
+        vecs = [np.asarray(c.val) for c in (mean_c, var_c, gamma, beta)]
+        if any(v.ndim != 1 for v in vecs) or \
+                len({v.shape[0] for v in vecs}) != 1:
+            continue
+        # ...and must broadcast onto CHANNEL AXIS 1 of x (NCHW): each
+        # chain needs a reshape placing C at index 1 with 1s elsewhere
+        # — otherwise this could be a last-axis affine with precomputed
+        # stats, which batch_norm would silently mis-normalize
+        ch = vecs[0].shape[0]
+        x_nd = len(x_var.aval.shape)
+
+        def _chan_shape(sz):
+            return (len(sz) == x_nd and sz[1:2] == (ch,)
+                    and all(d == 1 for j, d in enumerate(sz) if j != 1))
+
+        def on_axis1(link_idxs):
+            ok = 0
+            for idx in link_idxs:
+                if eqns[idx] is None:
+                    continue
+                n, _i2, _o2, p2 = eqns[idx]
+                if n == "reshape":
+                    if not _chan_shape(tuple(int(d)
+                                             for d in p2["new_sizes"])):
+                        return False
+                    ok += 1
+                elif n == "broadcast_in_dim":
+                    if not _chan_shape(tuple(int(d)
+                                             for d in p2["shape"])) or \
+                            tuple(p2["broadcast_dimensions"]) != (1,):
+                        return False
+                    ok += 1
+            return ok > 0
+
+        # the rsqrt factor's broadcast reshape may sit before OR after
+        # the eps-add/rsqrt (both spellings occur); its path is the
+        # rs+var chains combined.  gamma/beta/mean are independent.
+        if not (on_axis1(mean_links) and on_axis1(gamma_links)
+                and on_axis1(rs_links + var_links)
+                and on_axis1(beta_links)):
+            continue
+        for idx in ([m2i, m1i, rsi, vi, si2] + beta_links + gamma_links
+                    + rs_links + var_links + mean_links):
+            eqns[idx] = None
+        eqns[ai] = ("__batch_norm",
+                    [x_var, mean_c, var_c, gamma, beta], e[2],
+                    {"epsilon": float(eps_arr)})
+        changed = True
+    return changed
 
 
 # ------------------------------------------------------------ translator --
@@ -543,7 +710,7 @@ def _np_vt(dtype):
     return _VT[dt]
 
 
-_OUT_PARAM = {"conv2d": "Output"}
+_OUT_PARAM = {"conv2d": "Output", "batch_norm": "Y"}
 
 _UNARY = {"exp": "exp", "log": "log", "tanh": "tanh", "abs": "abs",
           "square": "square",
@@ -593,6 +760,18 @@ def translate(exporter, name, ins, outs, params):
         bind(ex._new_out(aval.shape, tgt, "cast", {"X": [src.name]},
                          [("in_dtype", "i", _np_vt(src.dtype)),
                           ("out_dtype", "i", _np_vt(tgt))]))
+        return
+
+    if name == "__batch_norm":  # fused by _fuse_batchnorm_eval
+        x = ex.as_ref(ins[0])
+        mean, var, gamma, beta = (ex.val(a) for a in ins[1:])
+        bind(ex._new_out(aval.shape, aval.dtype, "batch_norm",
+                         {"X": [x.name], "Mean": [mean.name],
+                          "Variance": [var.name],
+                          "Scale": [gamma.name], "Bias": [beta.name]},
+                         [("epsilon", "f", params["epsilon"]),
+                          ("data_layout", "s", "NCHW"),
+                          ("is_test", "b", True)]))
         return
 
     if name == "__softmax":     # fused by _fuse_softmax
@@ -1097,7 +1276,7 @@ def _translate_inline(ex, closed, bindings, out_avals):
     sub = _flatten(closed.jaxpr, list(closed.consts), sub, flat)
     outs = [_resolve(v, sub) for v in closed.jaxpr.outvars]
     live = {v for v in outs if not isinstance(v, (Literal, _Const))}
-    for nm, ins_, outvars, prm in _fuse_softmax(_dce(flat, live), outs):
+    for nm, ins_, outvars, prm in _fuse_peepholes(_dce(flat, live), outs):
         translate(ex, nm, ins_, outvars, prm)
     refs = []
     for atom, aval in zip(outs, out_avals):
@@ -1517,7 +1696,7 @@ def export_reference_inference_model(path_prefix, input_specs, layer):
     sub = _flatten(closed.jaxpr, list(closed.consts), {}, flat)
     outs = [_resolve(v, sub) for v in closed.jaxpr.outvars]
     live = {v for v in outs if not isinstance(v, (Literal, _Const))}
-    flat = _fuse_softmax(_dce(flat, live), outs)
+    flat = _fuse_peepholes(_dce(flat, live), outs)
 
     # feeds
     feed_names = []

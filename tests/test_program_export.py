@@ -124,6 +124,8 @@ class TestConvRoundTrip:
         _, ops, prog, _, _ = _roundtrip(
             tmp_path, model, [InputSpec([None, 1, 4, 4])])
         assert "conv2d" in ops
+        # eval-mode BN fuses to the reference's single batch_norm op
+        assert "batch_norm" in ops and "rsqrt" not in ops
         for batch in (2, 5):
             x = np.random.RandomState(batch).randn(
                 batch, 1, 4, 4).astype(F32)
