@@ -357,6 +357,7 @@ def _fuse_peepholes(eqns, outs_live):
             return var, idxs
 
     changed = _fuse_batchnorm_eval(eqns, prod, uses, chase)
+    changed = _fuse_gelu(eqns, prod, uses) or changed
     for di in range(len(eqns)):
         if eqns[di] is None or eqns[di][0] != "div":
             continue
@@ -428,6 +429,134 @@ def _fuse_peepholes(eqns, outs_live):
         eqns[di] = ("__softmax", [x_var], d_outs, {"axis": axis})
         changed = True
     return [e for e in eqns if e is not None] if changed else eqns
+
+
+def _lit_scalar(atom):
+    if isinstance(atom, (Literal, _Const)):
+        v = np.asarray(atom.val)
+        if v.size == 1:
+            return float(v.reshape(()))
+    return None
+
+
+def _lit_mul(eqn, want, tol=1e-5):
+    """For mul/add eqns with one scalar-literal operand ~= want
+    (RELATIVE tolerance — wide enough for f32-rounded constants,
+    narrow enough that a deliberately tweaked near-gelu coefficient
+    does not silently fuse): returns the OTHER operand, else None."""
+    a, b = eqn[1]
+    for lit, other in ((a, b), (b, a)):
+        v = _lit_scalar(lit)
+        if v is not None and abs(v - want) <= tol * abs(want):
+            if not isinstance(other, (Literal, _Const)):
+                return other
+    return None
+
+
+def _fuse_gelu(eqns, prod, uses):
+    """gelu chains -> one ``__gelu`` eqn (reference gelu op), both
+    spellings:
+
+    exact:  mul(mul(0.5, x), erfc(mul(neg(x), -1/sqrt(2)-ish)))
+    approx: mul(x, mul(0.5, add(1, tanh(0.79788*(x + 0.044715*x^3)))))
+
+    Every transformer FFN pays ~6 elementwise ops per spelled-out gelu.
+    Interior single-use only; literals matched with tolerance."""
+
+    def single(var, name):
+        if isinstance(var, (Literal, _Const)) or uses.get(var) != 1:
+            return None
+        i = prod.get(var)
+        if i is None or eqns[i] is None or eqns[i][0] != name:
+            return None
+        return i
+
+    changed = False
+    for ai in range(len(eqns)):
+        e = eqns[ai]
+        if e is None or e[0] != "mul":
+            continue
+        a, b = e[1]
+        if isinstance(a, (Literal, _Const)) or \
+                isinstance(b, (Literal, _Const)):
+            continue
+        # ---- exact form: mul(half, erfc_part) in either order
+        for half_v, erfc_v in ((a, b), (b, a)):
+            hi = single(half_v, "mul")
+            if hi is None:
+                continue
+            x_var = _lit_mul(eqns[hi], 0.5)
+            if x_var is None:
+                continue
+            ei = single(erfc_v, "erfc")
+            if ei is None:
+                continue
+            di = single(eqns[ei][1][0], "mul")
+            if di is None:
+                continue
+            c_var = _lit_mul(eqns[di], 0.7071067811865476)
+            if c_var is None:
+                continue
+            ci = single(c_var, "neg")
+            if ci is None or eqns[ci][1][0] is not x_var:
+                continue
+            if tuple(e[2][0].aval.shape) != tuple(x_var.aval.shape):
+                continue   # a size-1 rank>0 literal re-ranked the chain
+            for idx in (hi, ei, di, ci):
+                eqns[idx] = None
+            eqns[ai] = ("__gelu", [x_var], e[2], {"approximate": False})
+            changed = True
+            break
+        if eqns[ai][0] == "__gelu":
+            continue
+        # ---- tanh approximation: mul(x, half_part) in either order
+        for x_var, h_var in ((a, b), (b, a)):
+            hi = single(h_var, "mul")
+            if hi is None:
+                continue
+            g_var = _lit_mul(eqns[hi], 0.5)
+            if g_var is None:
+                continue
+            gi = single(g_var, "add")
+            if gi is None:
+                continue
+            f_var = _lit_mul(eqns[gi], 1.0)
+            if f_var is None:
+                continue
+            fi = single(f_var, "tanh")
+            if fi is None:
+                continue
+            ein = single(eqns[fi][1][0], "mul")
+            if ein is None:
+                continue
+            d_var = _lit_mul(eqns[ein], 0.7978845608028654)
+            if d_var is None:
+                continue
+            din = single(d_var, "add")
+            if din is None:
+                continue
+            da, db = eqns[din][1]
+            c_var = db if da is x_var else (da if db is x_var else None)
+            if c_var is None:
+                continue
+            cin = single(c_var, "mul")
+            if cin is None:
+                continue
+            b_var = _lit_mul(eqns[cin], 0.044715)
+            if b_var is None:
+                continue
+            bin_ = single(b_var, "integer_pow")
+            if bin_ is None or eqns[bin_][3].get("y") != 3 or \
+                    eqns[bin_][1][0] is not x_var:
+                continue
+            if tuple(e[2][0].aval.shape) != tuple(x_var.aval.shape):
+                continue
+            for idx in (hi, gi, fi, ein, din, cin, bin_):
+                eqns[idx] = None
+            eqns[ai] = ("__gelu", [x_var], e[2], {"approximate": True})
+            changed = True
+            break
+    return changed
 
 
 def _fuse_batchnorm_eval(eqns, prod, uses, chase):
@@ -772,6 +901,14 @@ def translate(exporter, name, ins, outs, params):
                          [("epsilon", "f", params["epsilon"]),
                           ("data_layout", "s", "NCHW"),
                           ("is_test", "b", True)]))
+        return
+
+    if name == "__gelu":        # fused by _fuse_gelu
+        x = ex.as_ref(ins[0])
+        bind(ex._new_out(aval.shape, aval.dtype, "gelu",
+                         {"X": [x.name]},
+                         [("approximate", "b",
+                           bool(params["approximate"]))]))
         return
 
     if name == "__softmax":     # fused by _fuse_softmax

@@ -711,3 +711,46 @@ class TestRound5BertPath:
             np.testing.assert_allclose(np.asarray(o.numpy()),
                                        np.asarray(w.numpy()),
                                        rtol=1e-4, atol=1e-5)
+
+
+class TestRound5GeluFusion:
+    def test_both_gelu_spellings_fuse(self, tmp_path):
+        for i, approx in enumerate((False, True)):
+            paddle.seed(0)
+            model = nn.Sequential(nn.Linear(4, 8),
+                                  nn.GELU(approximate=approx),
+                                  nn.Linear(8, 2))
+            model.eval()
+            _, ops, prog, _, _ = _roundtrip(
+                tmp_path, model, [InputSpec([None, 4])],
+                name=f"g{i}")
+            assert ops.count("gelu") == 1
+            assert "erfc" not in ops and "tanh" not in ops
+            x = np.random.RandomState(16 + i).randn(3, 4).astype(F32)
+            (out,) = prog(paddle.to_tensor(x))
+            want = model(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(np.asarray(out.numpy()),
+                                       np.asarray(want), rtol=1e-6,
+                                       atol=1e-7)
+
+    def test_half_scaled_product_does_not_misfuse(self, tmp_path):
+        """0.5*x*erfc(y) where y is NOT -x/sqrt(2) must stay unfused."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class NotGelu(nn.Layer):
+            def forward(self, x):
+                d = x._data
+                return Tensor((0.5 * d) * jax.lax.erfc(d * 0.5))
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, NotGelu(),
+                                        [InputSpec([2, 3])])
+        assert "gelu" not in ops
+        x = np.random.RandomState(18).randn(2, 3).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        from scipy.special import erfc as _erfc
+        want = (0.5 * x) * _erfc(x * 0.5)
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-5, atol=1e-6)
