@@ -357,6 +357,7 @@ def _fuse_peepholes(eqns, outs_live):
             return var, idxs
 
     changed = _fuse_batchnorm_eval(eqns, prod, uses, chase)
+    changed = _fuse_layernorm(eqns, prod, uses, chase) or changed
     changed = _fuse_gelu(eqns, prod, uses) or changed
     for di in range(len(eqns)):
         if eqns[di] is None or eqns[di][0] != "div":
@@ -451,6 +452,217 @@ def _lit_mul(eqn, want, tol=1e-5):
             if not isinstance(other, (Literal, _Const)):
                 return other
     return None
+
+
+def _reinserts_axis(eqns, link_idxs, x_shape, axis, require_link):
+    """Validate that every reshape/broadcast_in_dim link re-inserts the
+    reduced ``axis`` as a size-1 dim of ``x_shape`` (keepdims form) —
+    shared by the softmax and layer_norm fusions.  ``require_link``:
+    decline when the chain has no shape-bearing link at all (a raw
+    right-aligned broadcast can silently mean a different axis)."""
+    nd = len(x_shape)
+    keep = tuple(1 if i == axis % nd else d
+                 for i, d in enumerate(x_shape))
+    kept = tuple(i for i in range(nd) if i != axis % nd)
+    ok = 0
+    for idx in link_idxs:
+        if eqns[idx] is None:
+            continue
+        n_, _i, _o, p_ = eqns[idx]
+        if n_ == "reshape":
+            if tuple(int(d) for d in p_["new_sizes"]) != keep:
+                return False
+            ok += 1
+        elif n_ == "broadcast_in_dim":
+            if tuple(int(d) for d in p_["shape"]) != keep or \
+                    tuple(p_["broadcast_dimensions"]) != kept:
+                return False
+            ok += 1
+    return ok > 0 or not require_link
+
+
+def _fuse_layernorm(eqns, prod, uses, chase):
+    """Last-axis layer norm -> one ``__layer_norm`` eqn (reference
+    layer_norm op with begin_norm_axis = ndim-1):
+
+    ``add(mul(mul(sub(x, mean), rsqrt(var + eps)), BC(gamma)),
+    BC(beta))`` where mean = reduce_sum(x, -1)/n broadcast back and
+    var = reduce_sum(square(x - mean), -1)/n — the ~15-op chain every
+    transformer block pays twice.  All broadcast-back links must
+    re-insert the reduced axis; gamma/beta must be [C] consts mapping
+    onto the SAME (last) axis."""
+    links = ("reshape", "broadcast_in_dim", "stop_gradient")
+
+    def single(var, name):
+        if isinstance(var, (Literal, _Const)) or \
+                uses.get(var, 0) < 1:
+            return None
+        i = prod.get(var)
+        if i is None or eqns[i] is None or eqns[i][0] != name:
+            return None
+        return i
+
+    def const_leaf(var):
+        src, idxs = chase(var, links)
+        return (src, idxs) if isinstance(src, _Const) else (None, idxs)
+
+    def mean_of(var, x_var, axis_want=None):
+        """Match ``div(BC(reduce_sum(x)), n)``; returns (axis, n,
+        kill-list) or None."""
+        di = single(var, "div")
+        if di is None or uses.get(var, 0) > 2:
+            return None
+        num, den = eqns[di][1]
+        n_lit = _lit_scalar(den)
+        if n_lit is None or isinstance(num, (Literal, _Const)):
+            return None
+        src, lnk = chase(num, links)
+        ri = single(src, "reduce_sum") if not isinstance(
+            src, (Literal, _Const)) else None
+        if ri is None or uses.get(src, 0) != 1:
+            # a reduce output consumed OUTSIDE this chain must survive
+            return None
+        axes = tuple(eqns[ri][3]["axes"])
+        if len(axes) != 1 or eqns[ri][1][0] is not x_var:
+            return None
+        if axis_want is not None and axes[0] != axis_want:
+            return None
+        return axes[0], n_lit, [di, ri] + lnk
+
+    changed = False
+    for ai in range(len(eqns)):
+        e = eqns[ai]
+        if e is None or e[0] != "add":
+            continue
+        r_var, beta_var = e[1]
+        if isinstance(r_var, (Literal, _Const)):
+            continue
+        beta, beta_links = const_leaf(beta_var)
+        if beta is None:
+            continue
+        ri2 = single(r_var, "mul")
+        if ri2 is None or uses.get(r_var) != 1:
+            continue
+        p_var, gamma_var = eqns[ri2][1]
+        if isinstance(p_var, (Literal, _Const)):
+            continue
+        gamma, gamma_links = const_leaf(gamma_var)
+        if gamma is None:
+            continue
+        pi = single(p_var, "mul")
+        if pi is None or uses.get(p_var) != 1:
+            continue
+        l_var, n2_var = eqns[pi][1]
+        if isinstance(l_var, (Literal, _Const)):
+            continue
+        n2i = single(n2_var, "rsqrt")
+        if n2i is None or uses.get(n2_var, 0) > 1:
+            continue
+        mi = single(eqns[n2i][1][0], "add")
+        if mi is None:
+            continue
+        k2_var, eps_lit = eqns[mi][1]
+        if _lit_scalar(eps_lit) is None:
+            k2_var, eps_lit = eps_lit, k2_var
+        eps_v = _lit_scalar(eps_lit)
+        if eps_v is None or isinstance(k2_var, (Literal, _Const)):
+            continue
+        # the centered value: sub(x, mean) — possibly a SEPARATE eqn
+        # from the variance path's sub (jax traces both)
+        li = single(l_var, "sub")
+        if li is None:
+            continue
+        x_var, f_var = eqns[li][1]
+        if isinstance(x_var, (Literal, _Const)) or \
+                isinstance(f_var, (Literal, _Const)):
+            continue
+        x_shape = tuple(int(d) for d in x_var.aval.shape)
+        nd = len(x_shape)
+        axis = nd - 1
+        got = mean_of(f_var, x_var, axis_want=axis)
+        if got is None or abs(got[1] - x_shape[axis]) > 1e-6:
+            continue
+        _ax, _n, mean_kill = got
+        # variance: k2 = div(BC(reduce_sum(square(sub(x, f)))), n)
+        vi = single(k2_var, "div")
+        if vi is None:
+            continue
+        vnum, vden = eqns[vi][1]
+        vn = _lit_scalar(vden)
+        if vn is None or abs(vn - x_shape[axis]) > 1e-6 or \
+                isinstance(vnum, (Literal, _Const)):
+            continue
+        vsrc, v_lnk = chase(vnum, links)
+        vri = single(vsrc, "reduce_sum") if not isinstance(
+            vsrc, (Literal, _Const)) else None
+        if vri is None or uses.get(vsrc, 0) != 1 or \
+                tuple(eqns[vri][3]["axes"]) != (axis,):
+            continue
+        hi2 = single(eqns[vri][1][0], "square")
+        if hi2 is None:
+            continue
+        gi2 = single(eqns[hi2][1][0], "sub")
+        if gi2 is None:
+            continue
+        gx, gf = eqns[gi2][1]
+        if gx is not x_var or gf is not f_var:
+            continue
+        # every interior value must die with the fusion: the mean (f)
+        # feeds exactly the two subs (or one, if jax CSE'd them), and
+        # the var/rsqrt interiors have no external consumers
+        if uses.get(f_var) != (1 if gi2 == li else 2):
+            continue
+        if any(uses.get(v, 0) != 1 for v in
+               (l_var, k2_var, eqns[n2i][1][0], eqns[hi2][1][0],
+                eqns[vri][1][0], vnum)):
+            continue
+        # gamma/beta: [C] consts broadcasting onto the SAME last axis
+        vecs = [np.asarray(c.val) for c in (gamma, beta)]
+        if any(v.ndim != 1 or v.shape[0] != x_shape[axis]
+               for v in vecs):
+            continue
+
+        def maps_last(link_idxs):
+            ok = 0
+            for idx in link_idxs:
+                if eqns[idx] is None:
+                    continue
+                n_, _i2, _o2, p2 = eqns[idx]
+                if n_ == "reshape":
+                    sz = tuple(int(d) for d in p2["new_sizes"])
+                    if not (len(sz) <= nd and sz[-1] == x_shape[axis]
+                            and all(d == 1 for d in sz[:-1])):
+                        return False
+                    ok += 1
+                elif n_ == "broadcast_in_dim":
+                    sz = tuple(int(d) for d in p2["shape"])
+                    if not (sz[-1] == x_shape[axis]
+                            and all(d == 1 for d in sz[:-1])
+                            and tuple(p2["broadcast_dimensions"])
+                            == (len(sz) - 1,)):
+                        return False
+                    ok += 1
+            return ok > 0 or not link_idxs
+
+        if not (maps_last(gamma_links) and maps_last(beta_links)):
+            continue
+
+        if not (_reinserts_axis(eqns, mean_kill, x_shape, axis, False)
+                and _reinserts_axis(eqns, v_lnk, x_shape, axis,
+                                    False)):
+            continue
+        if tuple(e[2][0].aval.shape) != x_shape:
+            continue
+        kill = ([ri2, pi, n2i, mi, li, vi, vri, hi2]
+                + mean_kill + v_lnk + gamma_links + beta_links)
+        if gi2 != li:
+            kill.append(gi2)
+        for idx in kill:
+            eqns[idx] = None
+        eqns[ai] = ("__layer_norm", [x_var, gamma, beta], e[2],
+                    {"epsilon": eps_v, "begin_norm_axis": axis})
+        changed = True
+    return changed
 
 
 def _fuse_gelu(eqns, prod, uses):
@@ -901,6 +1113,17 @@ def translate(exporter, name, ins, outs, params):
                          [("epsilon", "f", params["epsilon"]),
                           ("data_layout", "s", "NCHW"),
                           ("is_test", "b", True)]))
+        return
+
+    if name == "__layer_norm":  # fused by _fuse_layernorm
+        x = ex.as_ref(ins[0])
+        gamma, beta = (ex.val(a) for a in ins[1:])
+        bind(ex._new_out(aval.shape, aval.dtype, "layer_norm",
+                         {"X": [x.name], "Scale": [gamma.name],
+                          "Bias": [beta.name]},
+                         [("epsilon", "f", params["epsilon"]),
+                          ("begin_norm_axis", "i",
+                           int(params["begin_norm_axis"]))]))
         return
 
     if name == "__gelu":        # fused by _fuse_gelu

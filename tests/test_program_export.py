@@ -754,3 +754,84 @@ class TestRound5GeluFusion:
         want = (0.5 * x) * _erfc(x * 0.5)
         np.testing.assert_allclose(np.asarray(out.numpy()), want,
                                    rtol=1e-5, atol=1e-6)
+
+
+class TestRound5LayerNormFusion:
+    def test_layernorm_fuses_and_roundtrips(self, tmp_path):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8),
+                              nn.Linear(8, 2))
+        model.eval()
+        _, ops, prog, _, _ = _roundtrip(tmp_path, model,
+                                        [InputSpec([None, 4])])
+        assert ops.count("layer_norm") == 1
+        assert "rsqrt" not in ops and "square" not in ops
+        x = np.random.RandomState(19).randn(5, 4).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        want = model(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_mean_reused_outside_declines(self, tmp_path):
+        """If the mean feeds anything beyond the norm chain, fusing
+        would orphan that consumer — must decline."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class NormPlusMean(nn.Layer):
+            def forward(self, x):
+                d = x._data
+                mu = jnp.mean(d, axis=-1, keepdims=True)
+                var = jnp.mean(jnp.square(d - mu), axis=-1,
+                               keepdims=True)
+                normed = (d - mu) * jax.lax.rsqrt(var + 1e-5)
+                g = jnp.full((4,), 2.0, jnp.float32)
+                b = jnp.zeros((4,), jnp.float32)
+                return Tensor(normed * g + b + mu)   # mu escapes
+
+        import jax
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, NormPlusMean(),
+                                        [InputSpec([3, 4])])
+        assert "layer_norm" not in ops
+        x = np.random.RandomState(20).randn(3, 4).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        mu = x.mean(-1, keepdims=True)
+        var = np.square(x - mu).mean(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5) * 2.0 + mu
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_shared_reduce_outside_chain_declines(self, tmp_path):
+        """The mean's reduce_sum reused outside the chain (review
+        repro: fusing nulled it and export crashed unbound)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        class SharedSum(nn.Layer):
+            def forward(self, x):
+                d = x._data
+                s = jnp.sum(d, axis=-1)
+                mu = s.reshape(3, 1) / 4.0
+                var = jnp.mean(jnp.square(d - mu), axis=-1,
+                               keepdims=True)
+                normed = (d - mu) * jax.lax.rsqrt(var + 1e-5)
+                g = jnp.full((4,), 2.0, jnp.float32)
+                b = jnp.zeros((4,), jnp.float32)
+                return Tensor(normed * g + b + s.reshape(3, 1))
+
+        _, ops, prog, _, _ = _roundtrip(tmp_path, SharedSum(),
+                                        [InputSpec([3, 4])])
+        assert "layer_norm" not in ops
+        x = np.random.RandomState(21).randn(3, 4).astype(F32)
+        (out,) = prog(paddle.to_tensor(x))
+        s = x.sum(-1, keepdims=True)
+        mu = s / 4.0
+        var = np.square(x - mu).mean(-1, keepdims=True)
+        want = (x - mu) / np.sqrt(var + 1e-5) * 2.0 + s
+        np.testing.assert_allclose(np.asarray(out.numpy()), want,
+                                   rtol=1e-4, atol=1e-5)
