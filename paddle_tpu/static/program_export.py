@@ -1242,7 +1242,7 @@ def translate(exporter, name, ins, outs, params):
                 "jnp.piecewise with an integer selector) has no "
                 "reference where-op translation; restructure as nested "
                 "two-way selects")
-        pred = ex.force(ex.val(ins[0]))
+        pred = ex.val(ins[0])
         if isinstance(pred, _Lit):
             bind(ex.val(ins[2] if pred.val else ins[1]))
             return

@@ -344,7 +344,9 @@ class TestRound5Breadth:
 
     def test_gpt_tiny_exports_to_reference_format(self, tmp_path):
         """The headline: a whole eval-mode GPT (XLA attention path)
-        round-trips through the reference wire format."""
+        round-trips through the reference wire format with a DYNAMIC
+        batch — one artifact serves any batch size — and the
+        transformer chains export as fused reference ops."""
         from paddle_tpu.models.gpt import gpt_tiny
 
         paddle.seed(0)
@@ -357,16 +359,20 @@ class TestRound5Breadth:
         model.eval()
         prefix = str(tmp_path / "gpt")
         ops = export_reference_inference_model(
-            prefix, [InputSpec([2, 16], dtype="int32")], model)
+            prefix, [InputSpec([None, 16], dtype="int32")], model)
         assert "matmul_v2" in ops and "lookup_table_v2" in ops
+        assert ops.count("softmax") == 2          # one per layer
+        assert ops.count("layer_norm") == 5
+        assert ops.count("gelu") == 2
         prog, _, _ = paddle.static.load_inference_model(prefix)
-        ids = np.random.RandomState(3).randint(0, 100, (2, 16)).astype(
-            np.int32)
-        (out,) = prog(paddle.to_tensor(ids))
-        want = model(paddle.to_tensor(ids)).numpy()
-        np.testing.assert_allclose(np.asarray(out.numpy()),
-                                   np.asarray(want), rtol=2e-3,
-                                   atol=2e-4)
+        for batch in (1, 3):
+            ids = np.random.RandomState(3 + batch).randint(
+                0, 100, (batch, 16)).astype(np.int32)
+            (out,) = prog(paddle.to_tensor(ids))
+            want = model(paddle.to_tensor(ids)).numpy()
+            np.testing.assert_allclose(np.asarray(out.numpy()),
+                                       np.asarray(want), rtol=2e-3,
+                                       atol=2e-4)
 
 
 class TestRound5NewHandlers:
