@@ -841,3 +841,29 @@ class TestRound5LayerNormFusion:
         want = (x - mu) / np.sqrt(var + 1e-5) * 2.0 + s
         np.testing.assert_allclose(np.asarray(out.numpy()), want,
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestRound5LlamaExport:
+    @pytest.mark.slow
+    def test_llama_tiny_round_trips(self, tmp_path):
+        """RoPE/GQA/SwiGLU decoder exports (rotate-half RoPE spells as
+        slice/mul chains) and round-trips."""
+        from paddle_tpu.models.llama import llama_tiny
+
+        paddle.seed(0)
+        model = llama_tiny()
+        model.eval()
+        prefix = str(tmp_path / "llama")
+        ops = export_reference_inference_model(
+            prefix, [InputSpec([2, 16], dtype="int32")], model)
+        assert "matmul_v2" in ops and "slice" in ops
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        ids = np.random.RandomState(22).randint(0, 100, (2, 16)).astype(
+            np.int32)
+        (out,) = prog(paddle.to_tensor(ids))
+        want = model(paddle.to_tensor(ids))
+        want = (want[0] if isinstance(want, (list, tuple))
+                else want).numpy()
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(want), rtol=1e-4,
+                                   atol=1e-5)
