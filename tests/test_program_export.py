@@ -652,19 +652,25 @@ class TestRound5AlphaRename:
         reference wire format within float32 tolerance (measured 0.0
         max abs error on CPU; the assert allows 1e-5 for backends with
         different fusion orders)."""
-        from paddle_tpu.vision.models import mobilenet_v2, resnet18
+        from paddle_tpu.vision.models import (mobilenet_v2, resnet18,
+                                              shufflenet_v2_x0_25,
+                                              squeezenet1_0, vgg11)
 
         rng = np.random.RandomState(13)
         for name, ctor in (("resnet18", resnet18),
-                           ("mobilenet_v2", mobilenet_v2)):
+                           ("mobilenet_v2", mobilenet_v2),
+                           ("vgg11", vgg11),
+                           ("shufflenet", shufflenet_v2_x0_25),
+                           ("squeezenet", squeezenet1_0)):
             paddle.seed(0)
             model = ctor(num_classes=10)
             model.eval()
+            side = 64 if name == "squeezenet" else 32
             prefix = str(tmp_path / name)
             export_reference_inference_model(
-                prefix, [InputSpec([None, 3, 32, 32])], model)
+                prefix, [InputSpec([None, 3, side, side])], model)
             prog, _, _ = paddle.static.load_inference_model(prefix)
-            x = rng.randn(2, 3, 32, 32).astype(F32)
+            x = rng.randn(2, 3, side, side).astype(F32)
             (out,) = prog(paddle.to_tensor(x))
             want = model(paddle.to_tensor(x)).numpy()
             np.testing.assert_allclose(np.asarray(out.numpy()),
