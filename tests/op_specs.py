@@ -103,12 +103,16 @@ _unary("i0e", np.vectorize(lambda x: float(np.i0(x) * np.exp(-abs(x))),
                            otypes=[F32]))
 _unary("i1", R.i1_ref)
 _unary("i1e", R.i1e_ref)
-_unary("conj", np.conj, grad=False)
-_unary("angle", np.angle, grad=False)
-_unary("real", np.real, grad=False,
+_unary("conj", np.conj,
        make=lambda rng: ((( _u(rng, (3, 4)) + 1j * _u(rng, (3, 4)))
                           .astype(np.complex64),), {}))
-_unary("imag", np.imag, grad=False,
+_unary("angle", np.angle,
+       make=lambda rng: ((( _u(rng, (3, 4), 0.3, 2.0) + 1j * _u(rng, (3, 4), 0.3, 2.0))
+                          .astype(np.complex64),), {}))
+_unary("real", np.real,
+       make=lambda rng: ((( _u(rng, (3, 4)) + 1j * _u(rng, (3, 4)))
+                          .astype(np.complex64),), {}))
+_unary("imag", np.imag,
        make=lambda rng: ((( _u(rng, (3, 4)) + 1j * _u(rng, (3, 4)))
                           .astype(np.complex64),), {}))
 
@@ -398,12 +402,14 @@ spec("meshgrid", lambda rng: ((_u(rng, (3,)), _u(rng, (4,))), {}),
      ref=lambda x, y: list(np.meshgrid(x, y, indexing="ij")),
      grad=(0, 1))
 spec("complex", lambda rng: ((_u(rng, (3,)), _u(rng, (3,))), {}),
-     ref=lambda x, y: (x + 1j * y).astype(np.complex64))
+     ref=lambda x, y: (x + 1j * y).astype(np.complex64), grad=(0, 1))
 spec("as_complex", lambda rng: ((_u(rng, (3, 2)),), {}),
-     ref=lambda x: (x[..., 0] + 1j * x[..., 1]).astype(np.complex64))
+     ref=lambda x: (x[..., 0] + 1j * x[..., 1]).astype(np.complex64),
+     grad=(0,))
 spec("as_real", lambda rng: (((_u(rng, (3,)) + 1j * _u(rng, (3,)))
                               .astype(np.complex64),), {}),
-     ref=lambda x: np.stack([x.real, x.imag], -1).astype(F32))
+     ref=lambda x: np.stack([x.real, x.imag], -1).astype(F32),
+     grad=(0,))
 
 # ------------------------------------------------------------ manipulation --
 

@@ -80,14 +80,21 @@ def _arrays(result):
 
 
 def _scalarize(result, weights=None):
-    """Deterministic scalar from the float outputs (for grad checks)."""
+    """Deterministic scalar from the float outputs (for grad checks).
+    Complex outputs contribute their real and imag parts as two float
+    arrays (grad convention: dL/dRe - i*dL/dIm, jax conjugate form)."""
     arrs = []
     if isinstance(result, Tensor):
         result = [result]
     for r in result if isinstance(result, (list, tuple)) else [result]:
-        if isinstance(r, Tensor) and np.issubdtype(
-                np.asarray(r.numpy()).dtype, np.floating):
+        if not isinstance(r, Tensor):
+            continue
+        dt = np.asarray(r.numpy()).dtype
+        if np.issubdtype(dt, np.floating):
             arrs.append(r)
+        elif np.issubdtype(dt, np.complexfloating):
+            arrs.append(paddle.real(r))
+            arrs.append(paddle.imag(r))
     total = None
     for j, r in enumerate(arrs):
         w = weights[j] if weights is not None else None
@@ -101,11 +108,16 @@ def _make_weights(result, rng):
     ws = []
     rs = result if isinstance(result, (list, tuple)) else [result]
     for r in rs:
-        if isinstance(r, Tensor) and np.issubdtype(
-                np.asarray(r.numpy()).dtype, np.floating):
-            ws.append(rng.uniform(0.5, 1.5,
-                                  np.asarray(r.numpy()).shape)
-                      .astype(np.asarray(r.numpy()).dtype))
+        if not isinstance(r, Tensor):
+            continue
+        a = np.asarray(r.numpy())
+        if np.issubdtype(a.dtype, np.floating):
+            ws.append(rng.uniform(0.5, 1.5, a.shape).astype(a.dtype))
+        elif np.issubdtype(a.dtype, np.complexfloating):
+            # one weight per contributed float array (real, imag)
+            for _ in range(2):
+                ws.append(rng.uniform(0.5, 1.5, a.shape)
+                          .astype(np.float32))
     return ws
 
 
@@ -175,15 +187,27 @@ def check_grad(name, s, rng):
         for t, x in pairs:
             analytic = np.asarray(t.grad.numpy())
             flat = x.reshape(-1)
-            num = np.zeros_like(flat, dtype=np.float64)
+            is_cplx = np.issubdtype(x.dtype, np.complexfloating)
+            num = np.zeros_like(flat, dtype=np.complex128 if is_cplx
+                                else np.float64)
             for j in range(flat.size):
                 orig = flat[j]
                 flat[j] = orig + eps
                 f_plus = numeric_loss(args)
                 flat[j] = orig - eps
                 f_minus = numeric_loss(args)
+                g_re = (f_plus - f_minus) / (2 * eps)
+                if is_cplx:
+                    flat[j] = orig + 1j * eps
+                    f_plus = numeric_loss(args)
+                    flat[j] = orig - 1j * eps
+                    f_minus = numeric_loss(args)
+                    g_im = (f_plus - f_minus) / (2 * eps)
+                    # tape convention: dL/dRe - i*dL/dIm (conjugate)
+                    num[j] = g_re - 1j * g_im
+                else:
+                    num[j] = g_re
                 flat[j] = orig
-                num[j] = (f_plus - f_minus) / (2 * eps)
             num = num.reshape(x.shape)
             # OpTest-style relative error on the max-abs scale
             scale = max(np.abs(num).max(), np.abs(analytic).max(), 1e-3)

@@ -49,7 +49,7 @@ def test_finite_only_is_justified():
 def test_grad_coverage_floor():
     """The grad-checked population must not silently regress."""
     graded = [n for n, s in SPECS.items() if s["grad"]]
-    assert len(graded) >= 235, len(graded)
+    assert len(graded) >= 242, len(graded)
 
 
 def test_partition_is_exact():
