@@ -680,7 +680,58 @@ _TRANSLATORS = {
         [tuple(attrs["paddings"][2 * i:2 * i + 2])
          for i in range(ins["X"].ndim)],
         constant_values=attrs.get("pad_value", 0.0)),
+    # detection family (PP-YOLO/SSD-class deployments) — delegates to
+    # the registered kernels.  DOCUMENTED DIVERGENCE: reference NMS
+    # outputs are ragged LoD tensors; the TPU-native kernels return
+    # statically-shaped keep_top_k padding (invalid rows marked -1),
+    # the same static-shape discipline as the rest of the framework.
+    "yolo_box": lambda ins, attrs: _registry_op(
+        "yolo_box", ins["X"], ins["ImgSize"],
+        anchors=list(attrs["anchors"]),
+        class_num=attrs["class_num"],
+        conf_thresh=attrs.get("conf_thresh", 0.01),
+        downsample_ratio=attrs.get("downsample_ratio", 32),
+        clip_bbox=attrs.get("clip_bbox", True),
+        scale_x_y=attrs.get("scale_x_y", 1.0),
+        iou_aware=attrs.get("iou_aware", False),
+        iou_aware_factor=attrs.get("iou_aware_factor", 0.5)),
+    "multiclass_nms3": lambda ins, attrs: _registry_op(
+        "multiclass_nms3", ins["BBoxes"], ins["Scores"],
+        rois_num=ins.get("RoisNum"),
+        score_threshold=attrs.get("score_threshold", 0.05),
+        nms_top_k=attrs.get("nms_top_k", -1),
+        keep_top_k=attrs.get("keep_top_k", 100),
+        nms_threshold=attrs.get("nms_threshold", 0.3),
+        normalized=attrs.get("normalized", True),
+        nms_eta=attrs.get("nms_eta", 1.0),
+        background_label=attrs.get("background_label", -1)),
+    "prior_box": lambda ins, attrs: _registry_op(
+        "prior_box", ins["Input"], ins["Image"],
+        min_sizes=list(attrs["min_sizes"]),
+        max_sizes=list(attrs.get("max_sizes", [])) or None,
+        aspect_ratios=list(attrs.get("aspect_ratios", [1.0])),
+        variances=list(attrs.get("variances",
+                                 [0.1, 0.1, 0.2, 0.2])),
+        flip=attrs.get("flip", False),
+        clip=attrs.get("clip", False),
+        steps=(attrs.get("step_w", 0.0), attrs.get("step_h", 0.0)),
+        offset=attrs.get("offset", 0.5),
+        min_max_aspect_ratios_order=attrs.get(
+            "min_max_aspect_ratios_order", False)),
+    "box_coder": lambda ins, attrs: _registry_op(
+        "box_coder", ins["PriorBox"], ins.get("PriorBoxVar"),
+        ins["TargetBox"],
+        code_type=attrs.get("code_type", "encode_center_size"),
+        box_normalized=attrs.get("box_normalized", True),
+        axis=attrs.get("axis", 0),
+        variance=list(attrs.get("variance", [])) or None),
 }
+
+
+def _registry_op(name, *args, **kwargs):
+    from ..ops.registry import OPS
+
+    return OPS[name].jax_fn(*args, **kwargs)
 
 
 def _arg_reduce(fn, ins, attrs):
@@ -850,7 +901,12 @@ def _group_norm(ins, attrs):
 
 
 # ops whose outputs span several parameters, bound in this order
-_MULTI_OUT_PARAMS = {"top_k_v2": ("Out", "Indices")}
+_MULTI_OUT_PARAMS = {
+    "top_k_v2": ("Out", "Indices"),
+    "yolo_box": ("Boxes", "Scores"),
+    "multiclass_nms3": ("Out", "Index", "NmsRoisNum"),
+    "prior_box": ("Boxes", "Variances"),
+}
 
 
 def supported_ops():

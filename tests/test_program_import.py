@@ -790,3 +790,96 @@ class TestFineTuneImported:
         g = jax.grad(f)(-x)
         np.testing.assert_allclose(np.asarray(g),
                                    np.full((2, 3), -1.0), rtol=1e-6)
+
+
+# ----------------------------------------- round-5: detection family --
+
+class TestDetectionOps:
+    def _add_floats(self, name, vals):
+        out = _fstr(1, name) + _fint(2, 4)     # FLOATS
+        for v in vals:
+            out += _ffloat(7, v)
+        return out
+
+    def test_yolo_box_nms_pipeline(self, tmp_path):
+        """A PP-YOLO-style tail: yolo_box -> transpose -> nms3.
+        Compares against the registered kernels directly (the importer
+        must thread attrs and multi-outputs through untouched)."""
+        from paddle_tpu.ops.registry import OPS
+
+        rng = np.random.RandomState(30)
+        feeds, fetches = feed_fetch(["x", "imgsize"], ["out", "idx",
+                                                      "num"])
+        anchors = [10, 13, 16, 30, 33, 23]
+        ops = feeds + [
+            op("yolo_box", {"X": ["x"], "ImgSize": ["imgsize"]},
+               {"Boxes": ["boxes"], "Scores": ["scores"]},
+               [attr("anchors", 3, ints=anchors),
+                attr("class_num", 0, i=2),
+                attr("conf_thresh", 1, f=0.01),
+                attr("downsample_ratio", 0, i=16)]),
+            op("transpose2", {"X": ["scores"]}, {"Out": ["scores_t"]},
+               [attr("axis", 3, ints=[0, 2, 1])]),
+            op("multiclass_nms3",
+               {"BBoxes": ["boxes"], "Scores": ["scores_t"]},
+               {"Out": ["out"], "Index": ["idx"],
+                "NmsRoisNum": ["num"]},
+               [attr("score_threshold", 1, f=0.01),
+                attr("nms_top_k", 0, i=10),
+                attr("keep_top_k", 0, i=10),
+                attr("nms_threshold", 1, f=0.45)]),
+        ] + fetches
+        vars_ = [var("x", [1, 21, 4, 4]),
+                 var("imgsize", [1, 2], dtype=2)]
+        prefix = write_model(tmp_path, "yolo", ops, vars_, {})
+        prog, feed_names, fetch_names = \
+            paddle.static.load_inference_model(prefix)
+        assert feed_names == ["x", "imgsize"]
+        x = rng.rand(1, 21, 4, 4).astype(F32)
+        img = np.asarray([[64, 64]], np.int32)
+        outs = prog(paddle.to_tensor(x), paddle.to_tensor(img))
+
+        boxes, scores = OPS["yolo_box"].jax_fn(
+            x, img, anchors=anchors, class_num=2, conf_thresh=0.01,
+            downsample_ratio=16)
+        import jax.numpy as jnp
+
+        want = OPS["multiclass_nms3"].jax_fn(
+            boxes, jnp.transpose(scores, (0, 2, 1)),
+            score_threshold=0.01, nms_top_k=10, keep_top_k=10,
+            nms_threshold=0.45)
+        for got, exp in zip(outs, want):
+            np.testing.assert_allclose(np.asarray(got.numpy()),
+                                       np.asarray(exp), rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_prior_box_and_box_coder(self, tmp_path):
+        from paddle_tpu.ops.registry import OPS
+
+        rng = np.random.RandomState(31)
+        feeds, fetches = feed_fetch(["feat", "image"], ["pb", "pv"])
+        min_sizes = self._add_floats("min_sizes", [16.0])
+        ratios = self._add_floats("aspect_ratios", [1.0, 2.0])
+        variances = self._add_floats("variances", [0.1, 0.1, 0.2, 0.2])
+        ops = feeds + [
+            op("prior_box", {"Input": ["feat"], "Image": ["image"]},
+               {"Boxes": ["pb"], "Variances": ["pv"]},
+               [min_sizes, ratios, variances,
+                attr("flip", 6, b=False), attr("clip", 6, b=True),
+                attr("offset", 1, f=0.5)]),
+        ] + fetches
+        vars_ = [var("feat", [1, 8, 4, 4]), var("image", [1, 3, 32, 32])]
+        prefix = write_model(tmp_path, "pb", ops, vars_, {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        feat = rng.rand(1, 8, 4, 4).astype(F32)
+        image = rng.rand(1, 3, 32, 32).astype(F32)
+        got_b, got_v = prog(paddle.to_tensor(feat),
+                            paddle.to_tensor(image))
+        want_b, want_v = OPS["prior_box"].jax_fn(
+            feat, image, min_sizes=[16.0], aspect_ratios=[1.0, 2.0],
+            variances=[0.1, 0.1, 0.2, 0.2], flip=False, clip=True,
+            offset=0.5)
+        np.testing.assert_allclose(np.asarray(got_b.numpy()),
+                                   np.asarray(want_b), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(got_v.numpy()),
+                                   np.asarray(want_v), rtol=1e-5)
