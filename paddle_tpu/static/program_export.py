@@ -359,6 +359,7 @@ def _fuse_peepholes(eqns, outs_live):
     changed = _fuse_batchnorm_eval(eqns, prod, uses, chase)
     changed = _fuse_layernorm(eqns, prod, uses, chase) or changed
     changed = _fuse_gelu(eqns, prod, uses) or changed
+    changed = _fuse_conv_transpose(eqns, prod, uses) or changed
     for di in range(len(eqns)):
         if eqns[di] is None or eqns[di][0] != "div":
             continue
@@ -661,6 +662,80 @@ def _fuse_layernorm(eqns, prod, uses, chase):
             eqns[idx] = None
         eqns[ai] = ("__layer_norm", [x_var, gamma, beta], e[2],
                     {"epsilon": eps_v, "begin_norm_axis": axis})
+        changed = True
+    return changed
+
+
+def _fuse_conv_transpose(eqns, prod, uses):
+    """``conv_general_dilated(x, transpose(rev(W)), lhs_dilation=s)``
+    (how a transposed conv lowers to lax) -> one ``__conv2d_transpose``
+    eqn carrying the ORIGINAL [Cin, Cout, kh, kw] filter — exported as
+    the reference conv2d_transpose op.  Recovered attrs: strides =
+    lhs_dilation; paddings p = k_eff-1-lo; output_padding = hi-lo.
+    Grouped deconvs decline (the O<->I transpose differs per group)."""
+    changed = False
+    for ci in range(len(eqns)):
+        e = eqns[ci]
+        if e is None or e[0] != "conv_general_dilated":
+            continue
+        p = e[3]
+        lhs_dil = tuple(int(d) for d in p.get("lhs_dilation", (1, 1)))
+        if lhs_dil == (1, 1):
+            continue
+        dn = p["dimension_numbers"]
+        if (tuple(dn.lhs_spec), tuple(dn.rhs_spec),
+                tuple(dn.out_spec)) != ((0, 1, 2, 3), (0, 1, 2, 3),
+                                        (0, 1, 2, 3)):
+            continue
+        if p.get("feature_group_count", 1) != 1 or \
+                p.get("batch_group_count", 1) != 1:
+            continue
+        x_var, w_var = e[1]
+        if isinstance(w_var, (Literal, _Const)) or \
+                uses.get(w_var) != 1:
+            continue
+        ti = prod.get(w_var)
+        if ti is None or eqns[ti] is None or \
+                eqns[ti][0] != "transpose" or \
+                tuple(eqns[ti][3]["permutation"]) != (1, 0, 2, 3):
+            continue
+        r_var = eqns[ti][1][0]
+        if isinstance(r_var, (Literal, _Const)) or \
+                uses.get(r_var) != 1:
+            continue
+        ri = prod.get(r_var)
+        if ri is None or eqns[ri] is None or eqns[ri][0] != "rev" or \
+                tuple(sorted(eqns[ri][3]["dimensions"])) != (2, 3):
+            continue
+        w_src = eqns[ri][1][0]
+        w_shape = tuple(int(d) for d in (
+            w_src.aval.shape if not isinstance(w_src, _Const)
+            else np.asarray(w_src.val).shape))
+        if len(w_shape) != 4:
+            continue
+        rhs_dil = tuple(int(d) for d in p.get("rhs_dilation", (1, 1)))
+        pads = [(int(lo), int(hi)) for lo, hi in p["padding"]]
+        strides_attr, pads_attr, outpad_attr, ok = [], [], [], True
+        for d in range(2):
+            k_eff = (w_shape[2 + d] - 1) * rhs_dil[d] + 1
+            lo, hi = pads[d]
+            p_ref = k_eff - 1 - lo
+            out_pad = hi - lo
+            if p_ref < 0 or out_pad < 0:
+                ok = False
+                break
+            strides_attr.append(lhs_dil[d])
+            pads_attr.append(p_ref)
+            outpad_attr.append(out_pad)
+        if not ok or tuple(int(s) for s in p["window_strides"]) != \
+                (1, 1):
+            continue
+        for idx in (ti, ri):
+            eqns[idx] = None
+        eqns[ci] = ("__conv2d_transpose", [x_var, w_src], e[2],
+                    {"strides": strides_attr, "paddings": pads_attr,
+                     "output_padding": outpad_attr,
+                     "dilations": list(rhs_dil)})
         changed = True
     return changed
 
@@ -1051,7 +1126,8 @@ def _np_vt(dtype):
     return _VT[dt]
 
 
-_OUT_PARAM = {"conv2d": "Output", "batch_norm": "Y"}
+_OUT_PARAM = {"conv2d": "Output", "batch_norm": "Y",
+              "conv2d_transpose": "Output"}
 
 _UNARY = {"exp": "exp", "log": "log", "tanh": "tanh", "abs": "abs",
           "square": "square",
@@ -1113,6 +1189,24 @@ def translate(exporter, name, ins, outs, params):
                          [("epsilon", "f", params["epsilon"]),
                           ("data_layout", "s", "NCHW"),
                           ("is_test", "b", True)]))
+        return
+
+    if name == "__conv2d_transpose":  # fused by _fuse_conv_transpose
+        x = ex.as_ref(ins[0])
+        w = ex.val(ins[1])
+        w = ex.force(w) if isinstance(w, _Ref) else w
+        if isinstance(w, _Lit):
+            raise NotImplementedError(
+                "conv2d_transpose with a scalar-literal filter")
+        bind(ex._new_out(
+            aval.shape, aval.dtype, "conv2d_transpose",
+            {"Input": [x.name], "Filter": [w.name]},
+            [("strides", "ints", params["strides"]),
+             ("paddings", "ints", params["paddings"]),
+             ("output_padding", "ints", params["output_padding"]),
+             ("dilations", "ints", params["dilations"]),
+             ("groups", "i", 1),
+             ("padding_algorithm", "s", "EXPLICIT")]))
         return
 
     if name == "__layer_norm":  # fused by _fuse_layernorm

@@ -685,6 +685,9 @@ _TRANSLATORS = {
     # outputs are ragged LoD tensors; the TPU-native kernels return
     # statically-shaped keep_top_k padding (invalid rows marked -1),
     # the same static-shape discipline as the rest of the framework.
+    "conv2d_transpose": lambda ins, attrs: _conv2d_transpose(ins, attrs),
+    "depthwise_conv2d_transpose": lambda ins, attrs: _conv2d_transpose(
+        ins, attrs),
     "yolo_box": lambda ins, attrs: _registry_op(
         "yolo_box", ins["X"], ins["ImgSize"],
         anchors=list(attrs["anchors"]),
@@ -732,6 +735,22 @@ def _registry_op(name, *args, **kwargs):
     from ..ops.registry import OPS
 
     return OPS[name].jax_fn(*args, **kwargs)
+
+
+def _conv2d_transpose(ins, attrs):
+    if attrs.get("padding_algorithm", "EXPLICIT") != "EXPLICIT":
+        raise NotImplementedError(
+            "conv2d_transpose SAME/VALID padding_algorithm is not "
+            "translated; re-export with explicit paddings")
+    out_pad = attrs.get("output_padding", [0, 0]) or [0, 0]
+    return _registry_op(
+        "conv2d_transpose", ins["Input"], ins["Filter"],
+        stride=list(attrs.get("strides", [1, 1])),
+        padding=list(attrs.get("paddings", [0, 0])),
+        output_padding=list(out_pad) if not isinstance(out_pad, int)
+        else out_pad,
+        dilation=list(attrs.get("dilations", [1, 1])),
+        groups=attrs.get("groups", 1) or 1)
 
 
 def _arg_reduce(fn, ins, attrs):

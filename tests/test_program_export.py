@@ -873,3 +873,26 @@ class TestRound5LlamaExport:
         np.testing.assert_allclose(np.asarray(out.numpy()),
                                    np.asarray(want), rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestRound5ConvTranspose:
+    def test_conv_transpose_roundtrips(self, tmp_path):
+        """rev -> transpose -> conv(lhs_dilation) fuses to the
+        reference conv2d_transpose op and round-trips."""
+        paddle.seed(0)
+        model = nn.Sequential(
+            nn.Conv2D(3, 4, 3, stride=2, padding=1),
+            nn.ReLU(),
+            nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1))
+        model.eval()
+        _, ops, prog, _, _ = _roundtrip(
+            tmp_path, model, [InputSpec([None, 3, 8, 8])])
+        assert "conv2d_transpose" in ops
+        for batch in (1, 2):
+            x = np.random.RandomState(23 + batch).randn(
+                batch, 3, 8, 8).astype(F32)
+            (out,) = prog(paddle.to_tensor(x))
+            want = model(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(np.asarray(out.numpy()),
+                                       np.asarray(want), rtol=1e-4,
+                                       atol=1e-5)
