@@ -679,9 +679,10 @@ def _fuse_conv_transpose(eqns, prod, uses):
         if e is None or e[0] != "conv_general_dilated":
             continue
         p = e[3]
+        # stride-1 deconvs have lhs_dilation (1,1) — the rev+transpose
+        # filter chain below is what uniquely identifies a transposed
+        # conv (plain convs never rev their filters)
         lhs_dil = tuple(int(d) for d in p.get("lhs_dilation", (1, 1)))
-        if lhs_dil == (1, 1):
-            continue
         dn = p["dimension_numbers"]
         if (tuple(dn.lhs_spec), tuple(dn.rhs_spec),
                 tuple(dn.out_spec)) != ((0, 1, 2, 3), (0, 1, 2, 3),

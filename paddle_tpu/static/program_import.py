@@ -742,15 +742,31 @@ def _conv2d_transpose(ins, attrs):
         raise NotImplementedError(
             "conv2d_transpose SAME/VALID padding_algorithm is not "
             "translated; re-export with explicit paddings")
-    out_pad = attrs.get("output_padding", [0, 0]) or [0, 0]
+    out_pad = list(attrs.get("output_padding", []) or [0, 0])
+    strides = list(attrs.get("strides", [1, 1]))
+    pads = list(attrs.get("paddings", [0, 0]))
+    dil = list(attrs.get("dilations", [1, 1]))
+    out_size = attrs.get("output_size", []) or []
+    if out_size:
+        # real programs may carry output_size instead of
+        # output_padding: convert (out_pad = target - minimal size)
+        x, w = ins["Input"], ins["Filter"]
+        p2 = pads if len(pads) == 2 else [pads[0], pads[2]]
+        for d in range(2):
+            k_eff = (w.shape[2 + d] - 1) * dil[d] + 1
+            minimal = (x.shape[2 + d] - 1) * strides[d] \
+                - 2 * p2[d] + k_eff
+            op_d = int(out_size[d]) - minimal
+            if not 0 <= op_d < strides[d] or (out_pad[d] and
+                                              out_pad[d] != op_d):
+                raise NotImplementedError(
+                    f"conv2d_transpose output_size {out_size} is not "
+                    "reachable from the op's strides/paddings")
+            out_pad[d] = op_d
     return _registry_op(
         "conv2d_transpose", ins["Input"], ins["Filter"],
-        stride=list(attrs.get("strides", [1, 1])),
-        padding=list(attrs.get("paddings", [0, 0])),
-        output_padding=list(out_pad) if not isinstance(out_pad, int)
-        else out_pad,
-        dilation=list(attrs.get("dilations", [1, 1])),
-        groups=attrs.get("groups", 1) or 1)
+        stride=strides, padding=pads, output_padding=out_pad,
+        dilation=dil, groups=attrs.get("groups", 1) or 1)
 
 
 def _arg_reduce(fn, ins, attrs):

@@ -880,19 +880,29 @@ class TestRound5ConvTranspose:
         """rev -> transpose -> conv(lhs_dilation) fuses to the
         reference conv2d_transpose op and round-trips."""
         paddle.seed(0)
-        model = nn.Sequential(
-            nn.Conv2D(3, 4, 3, stride=2, padding=1),
-            nn.ReLU(),
-            nn.Conv2DTranspose(4, 3, 3, stride=2, padding=1))
-        model.eval()
-        _, ops, prog, _, _ = _roundtrip(
-            tmp_path, model, [InputSpec([None, 3, 8, 8])])
-        assert "conv2d_transpose" in ops
-        for batch in (1, 2):
-            x = np.random.RandomState(23 + batch).randn(
-                batch, 3, 8, 8).astype(F32)
-            (out,) = prog(paddle.to_tensor(x))
-            want = model(paddle.to_tensor(x)).numpy()
-            np.testing.assert_allclose(np.asarray(out.numpy()),
-                                       np.asarray(want), rtol=1e-4,
-                                       atol=1e-5)
+        cases = [
+            ("basic", dict(stride=2, padding=1)),
+            ("outpad", dict(stride=2, padding=1, output_padding=1)),
+            ("stride1", dict(stride=1, padding=1)),
+            ("dilated", dict(stride=2, padding=2, dilation=2)),
+        ]
+        for i, (tag, kw) in enumerate(cases):
+            model = nn.Sequential(
+                nn.Conv2D(3, 4, 3, stride=2, padding=1),
+                nn.ReLU(),
+                nn.Conv2DTranspose(4, 3, 3, **kw))
+            model.eval()
+            _, ops, prog, _, _ = _roundtrip(
+                tmp_path, model, [InputSpec([None, 3, 8, 8])],
+                name=f"ct_{tag}")
+            assert "conv2d_transpose" in ops, tag
+            assert "rev" not in " ".join(ops), tag
+            for batch in (1, 2):
+                x = np.random.RandomState(23 + i + batch).randn(
+                    batch, 3, 8, 8).astype(F32)
+                (out,) = prog(paddle.to_tensor(x))
+                want = model(paddle.to_tensor(x)).numpy()
+                np.testing.assert_allclose(np.asarray(out.numpy()),
+                                           np.asarray(want),
+                                           rtol=1e-4, atol=1e-5,
+                                           err_msg=tag)
