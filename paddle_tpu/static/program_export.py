@@ -1559,6 +1559,13 @@ def translate(exporter, name, ins, outs, params):
         _emit_while(ex, ins, outs, params)
         return
 
+    if name == "pallas_call":
+        raise NotImplementedError(
+            "a Pallas kernel (custom TPU code) has no reference-op "
+            "translation; rebuild the model on its XLA path for export "
+            "— e.g. GPT/Llama configs take use_flash_attention=False, "
+            "and FusedMultiTransformer's decode kernel is inference-"
+            "only (export the prefill model instead)")
     raise NotImplementedError(
         f"jax primitive {name!r} has no reference-op translation; the "
         "exportable subset is: "
