@@ -1,0 +1,38 @@
+"""jax version compatibility shims.
+
+The framework targets the jax API as of ~0.5 (``jax.shard_map``,
+``jax_num_cpu_devices``); deployment images sometimes pin an older
+jaxlib where those surfaces live under experimental/XLA_FLAGS spellings.
+Centralizing the bridging here keeps every call site on the modern
+spelling — delete this module when the minimum jax is bumped.
+"""
+
+import os
+
+import jax
+
+
+def ensure_compat():
+    """Idempotent: alias modern jax surfaces that this jax lacks."""
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map
+
+        jax.shard_map = shard_map
+
+
+def set_cpu_device_count(n):
+    """``jax.config.jax_num_cpu_devices`` where available, else the
+    XLA_FLAGS spelling (effective only before backend init — same
+    constraint the config option has)."""
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+        return
+    except AttributeError:
+        pass
+    flag = f"--xla_force_host_platform_device_count={int(n)}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()
+
+
+ensure_compat()

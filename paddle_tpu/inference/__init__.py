@@ -239,5 +239,12 @@ def get_fused_multi_transformer(model, **kwargs):
     return FusedMultiTransformer(model, **kwargs)
 
 
+def create_llm_engine(model, **kwargs):
+    """Continuous-batching generative serving engine over a paged KV
+    cache (see inference.llm.LLMEngine; docs/LLM_SERVING.md)."""
+    from .llm import LLMEngine
+    return LLMEngine(model, **kwargs)
+
+
 from . import serving  # noqa: E402,F401
 from .serving import PredictorServer  # noqa: E402,F401

@@ -1,0 +1,27 @@
+"""paddle_tpu.inference.llm — continuous-batching LLM serving.
+
+The serving-shaped subsystem over the round-4 ragged decode kernel:
+
+- block_manager:  paged KV-cache allocator (free list, block tables,
+                  refcounted fork / copy-on-write)
+- scheduler:      iteration-level continuous batching with
+                  preempt-on-OOM and power-of-two shape bucketing
+- paged_attention: block-table attention dispatch (Pallas kernel on
+                  TPU, masked-XLA gather fallback everywhere)
+- engine:         LLMEngine (add_request/step/generate, two donated
+                  jitted executables) + AsyncLLMEngine for servers
+
+See docs/LLM_SERVING.md for design notes and a quickstart.
+"""
+
+from .block_manager import BlockManager, NoFreeBlocksError  # noqa: F401
+from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
+from .paged_attention import (  # noqa: F401
+    paged_decode_attention,
+    paged_decode_attention_xla,
+)
+from .scheduler import Request, ScheduledBatch, Scheduler  # noqa: F401
+
+__all__ = ["BlockManager", "NoFreeBlocksError", "Scheduler", "Request",
+           "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
+           "paged_decode_attention", "paged_decode_attention_xla"]

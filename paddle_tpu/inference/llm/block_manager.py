@@ -1,0 +1,129 @@
+"""Paged KV-cache block allocator.
+
+The serving cache is one pool of ``num_blocks`` fixed-size token pages
+(vLLM's PagedAttention allocator shape; on TPU the pool is a dense
+[num_blocks, block_size, ...] array so pages are also the DMA unit of the
+Pallas kernel).  Sequences own pages through per-sequence block tables;
+a free list recycles pages the moment a sequence finishes or is
+preempted, and ``fork`` shares pages copy-on-write for beam/parallel
+sampling.
+
+Pure host-side bookkeeping — nothing here touches device memory.  The
+engine mirrors each table into the [B, P] int32 operand the kernels
+gather through.
+"""
+
+
+class NoFreeBlocksError(RuntimeError):
+    """The pool is exhausted; callers preempt or queue."""
+
+
+class BlockManager:
+    def __init__(self, num_blocks, block_size):
+        if num_blocks <= 0 or block_size <= 0:
+            raise ValueError("num_blocks and block_size must be positive")
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        # pop() takes from the tail: keep it sorted descending so pages
+        # are handed out in ascending id order (stable tests/traces)
+        self._free = list(range(self.num_blocks - 1, -1, -1))
+        self._ref = {}          # block id -> refcount
+        self._tables = {}       # seq id -> [block ids]
+        self._tokens = {}       # seq id -> tokens occupying those blocks
+
+    # ------------------------------------------------------------ queries --
+    @property
+    def num_free_blocks(self):
+        return len(self._free)
+
+    def blocks_needed(self, num_tokens):
+        return -(-int(num_tokens) // self.block_size)
+
+    def can_allocate(self, num_tokens, margin=0):
+        return self.blocks_needed(num_tokens) + margin <= len(self._free)
+
+    def block_table(self, seq_id):
+        return list(self._tables[seq_id])
+
+    def num_tokens(self, seq_id):
+        return self._tokens[seq_id]
+
+    def has_seq(self, seq_id):
+        return seq_id in self._tables
+
+    # ---------------------------------------------------------- lifecycle --
+    def _take(self):
+        if not self._free:
+            raise NoFreeBlocksError("KV cache pool exhausted")
+        blk = self._free.pop()
+        self._ref[blk] = 1
+        return blk
+
+    def allocate(self, seq_id, num_tokens):
+        """Allocate pages for a sequence's first ``num_tokens`` tokens
+        (the prefill); returns the block table."""
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id!r} already allocated")
+        need = self.blocks_needed(num_tokens)
+        if need > len(self._free):
+            raise NoFreeBlocksError(
+                f"need {need} blocks, {len(self._free)} free")
+        table = [self._take() for _ in range(need)]
+        self._tables[seq_id] = table
+        self._tokens[seq_id] = int(num_tokens)
+        return list(table)
+
+    def can_append(self, seq_id):
+        """Would ``append_slot`` succeed without raising?"""
+        table = self._tables[seq_id]
+        tokens = self._tokens[seq_id]
+        if tokens == len(table) * self.block_size:
+            return len(self._free) >= 1          # page boundary: new page
+        if table and self._ref[table[-1]] > 1:
+            return len(self._free) >= 1          # copy-on-write copy
+        return True
+
+    def append_slot(self, seq_id):
+        """Reserve the slot for the sequence's next token.
+
+        Returns (slot, cow): ``slot`` is the absolute token slot
+        (block_id * block_size + offset) the engine writes K/V into;
+        ``cow`` is None, or ``(src_block, dst_block)`` when a shared last
+        page had to be copied first (the engine copies page contents).
+        Raises NoFreeBlocksError when a page is needed and none is free —
+        the scheduler's preemption trigger.
+        """
+        table = self._tables[seq_id]
+        tokens = self._tokens[seq_id]
+        offset = tokens % self.block_size
+        cow = None
+        if offset == 0 and tokens == len(table) * self.block_size:
+            table.append(self._take())           # page boundary: new page
+        elif self._ref[table[-1]] > 1:           # shared tail: copy-on-write
+            src = table[-1]
+            dst = self._take()
+            self._ref[src] -= 1
+            table[-1] = dst
+            cow = (src, dst)
+        self._tokens[seq_id] = tokens + 1
+        return table[-1] * self.block_size + offset, cow
+
+    def fork(self, parent_id, child_id):
+        """Child shares every parent page (refcounted, copy-on-write on
+        the next divergent append)."""
+        if child_id in self._tables:
+            raise ValueError(f"sequence {child_id!r} already allocated")
+        table = self._tables[parent_id]
+        for blk in table:
+            self._ref[blk] += 1
+        self._tables[child_id] = list(table)
+        self._tokens[child_id] = self._tokens[parent_id]
+
+    def free(self, seq_id):
+        """Release the sequence; pages return to the pool at refcount 0."""
+        for blk in self._tables.pop(seq_id):
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                del self._ref[blk]
+                self._free.append(blk)
+        del self._tokens[seq_id]

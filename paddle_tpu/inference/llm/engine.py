@@ -1,0 +1,423 @@
+"""LLMEngine — continuous-batching generation over a paged KV cache.
+
+The serving counterpart of incubate.nn.FusedMultiTransformer: the same
+stacked-params lax.scan decoder, but the KV cache is one paged pool
+([L, num_blocks, block_size, Nkv, D] per K and V) shared by every
+in-flight request, so the engine runs MANY requests of ragged lengths
+through exactly two families of jitted executables:
+
+- prefill: one sequence, prompt padded to a power-of-two bucket; writes
+  its K/V through the block table, returns the first generated token.
+- decode: the whole running set padded to a power-of-two batch bucket;
+  gathers K/V through block tables (Pallas paged kernel on TPU, masked
+  XLA gather elsewhere), appends one token per sequence.
+
+Both donate the cache buffers (the pool is updated in place in HBM) and
+contain no host round-trip between launch and the sampled token ids —
+the only sync is fetching the step's [B] token vector to drive the
+scheduler.  Compiles are bounded by the bucket grids; steady-state
+serving reuses warm executables regardless of traffic mix.
+"""
+
+import threading
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ... import profiler
+from ...incubate.nn import _layernorm
+from .block_manager import BlockManager
+from .paged_attention import paged_decode_attention
+from .scheduler import FINISHED, Request, Scheduler, bucket_size
+
+
+class RequestOutput:
+    """One finished request: ids are plain python/numpy on the host."""
+
+    def __init__(self, request_id, prompt_ids, output_ids, finish_reason,
+                 num_preemptions):
+        self.request_id = request_id
+        self.prompt_ids = np.asarray(prompt_ids)
+        self.output_ids = np.asarray(output_ids)
+        self.finish_reason = finish_reason
+        self.num_preemptions = num_preemptions
+
+    @property
+    def all_ids(self):
+        return np.concatenate([self.prompt_ids, self.output_ids])
+
+
+class LLMEngine:
+    """add_request()/step()/generate() over a GPTForCausalLM-compatible
+    model (anything with ``functional_decompose``).
+
+    >>> eng = LLMEngine(model, block_size=16, max_batch=8)
+    >>> rid = eng.add_request([5, 6, 7], max_new_tokens=16)
+    >>> while eng.has_unfinished():
+    ...     for out in eng.step():
+    ...         print(out.request_id, out.output_ids)
+    """
+
+    def __init__(self, model, *, block_size=16, num_blocks=None,
+                 max_model_len=None, max_batch=8, dtype=None):
+        d = model.functional_decompose()
+        cfg = model.config
+        self.num_layers = d["num_layers"]
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.head_dim
+        self.hidden = cfg.hidden_size
+        self.eps = cfg.layer_norm_epsilon
+        self.block_size = int(block_size)
+        self.max_batch = int(max_batch)
+        self.max_model_len = int(min(max_model_len or
+                                     cfg.max_position_embeddings,
+                                     cfg.max_position_embeddings))
+        self.max_pages = -(-self.max_model_len // self.block_size)
+        if num_blocks is None:
+            # default: the full batch at full length fits -> no preemption
+            num_blocks = self.max_batch * self.max_pages
+        if num_blocks < self.max_pages:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one max_model_len "
+                f"sequence ({self.max_pages} pages)")
+        self.num_blocks = int(num_blocks)
+        self.dtype = jnp.dtype(dtype) if dtype else jnp.float32
+        cast = (lambda x: jnp.asarray(x, self.dtype)
+                if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                else jnp.asarray(x))
+        self.params = jax.tree_util.tree_map(cast, d["params"])
+
+        self.block_manager = BlockManager(self.num_blocks, self.block_size)
+        self.scheduler = Scheduler(self.block_manager,
+                                   max_batch=self.max_batch)
+        cache_shape = (self.num_layers, self.num_blocks, self.block_size,
+                       self.num_heads, self.head_dim)
+        self._kc = jnp.zeros(cache_shape, self.dtype)
+        self._vc = jnp.zeros(cache_shape, self.dtype)
+
+        self._requests = {}
+        self._next_id = 0
+        self._rng = np.random.RandomState(0)
+        self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
+                      "tokens_generated": 0}
+
+        nh, hd, eps = self.num_heads, self.head_dim, self.eps
+        nb, bs = self.num_blocks, self.block_size
+
+        def attn_proj(p_l, x):
+            """LN -> fused QKV, the FusedMultiTransformer block head."""
+            hh = _layernorm(x, p_l["ln_1.weight"], p_l["ln_1.bias"], eps)
+            qkv = hh @ p_l["attn.qkv.weight"] + p_l["attn.qkv.bias"]
+            b, t = x.shape[0], x.shape[1]
+            qkv = qkv.reshape(b, t, 3, nh, hd)
+            return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        def mlp_residual(p_l, x, att_out):
+            x = x + att_out @ p_l["attn.proj.weight"] + p_l["attn.proj.bias"]
+            h2 = _layernorm(x, p_l["ln_2.weight"], p_l["ln_2.bias"], eps)
+            ff = jax.nn.gelu(h2 @ p_l["mlp.fc_in.weight"]
+                             + p_l["mlp.fc_in.bias"], approximate=True)
+            return x + ff @ p_l["mlp.fc_out.weight"] + p_l["mlp.fc_out.bias"]
+
+        def scatter_pages(cache, slots, values):
+            """Write [N, nh, hd] rows at absolute token slots; padded rows
+            carry an out-of-range slot and are dropped, not written."""
+            flat = cache.reshape(nb * bs, nh, hd)
+            flat = flat.at[slots].set(values.astype(cache.dtype),
+                                      mode="drop")
+            return flat.reshape(nb, bs, nh, hd)
+
+        def head_logits(params, x):
+            x = _layernorm(x, params["head"]["weight"],
+                           params["head"]["bias"], eps)
+            w = params["embed"]["word_embeddings.weight"]
+            return x @ w.T.astype(self.dtype)
+
+        def prefill_fn(params, ids, kc, vc, block_table, length):
+            """ids [1, Lb] (prompt padded to the bucket), one sequence.
+            Returns (next_id, last logits, kc, vc)."""
+            emb = params["embed"]
+            lb = ids.shape[1]
+            pos = jnp.arange(lb)
+            x = (emb["word_embeddings.weight"][ids]
+                 + emb["position_embeddings.weight"][pos][None])
+            x = x.astype(self.dtype)
+            tok = jnp.arange(lb)
+            slots = jnp.where(tok < length,
+                              block_table[tok // bs] * bs + tok % bs,
+                              nb * bs)
+
+            def layer(carry, xs):
+                x = carry
+                p_l, kc_l, vc_l = xs
+                q, k, v = attn_proj(p_l, x)
+                kc_l = scatter_pages(kc_l, slots, k[0])
+                vc_l = scatter_pages(vc_l, slots, v[0])
+                # prefix cache is empty at prefill: causal attention over
+                # the chunk itself (same formula as _block_chunk; masked
+                # tail positions vanish exactly under the f32 softmax)
+                scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
+                logits = jnp.einsum("bqnd,bknd->bnqk", q,
+                                    k.astype(x.dtype)) * scale
+                causal = (pos[None, :] <= pos[:, None])[None, None]
+                logits = jnp.where(causal, logits,
+                                   jnp.asarray(-1e30, x.dtype))
+                att = jax.nn.softmax(logits.astype(jnp.float32),
+                                     axis=-1).astype(x.dtype)
+                out = jnp.einsum("bnqk,bknd->bqnd", att,
+                                 v.astype(x.dtype))
+                out = out.reshape(1, lb, nh * hd)
+                return mlp_residual(p_l, x, out), (kc_l, vc_l)
+
+            x, (kc, vc) = jax.lax.scan(layer, x,
+                                       (params["blocks"], kc, vc))
+            logits = head_logits(params, x[0, length - 1])
+            return jnp.argmax(logits, -1), logits, kc, vc
+
+        def decode_fn(params, ids, kc, vc, block_tables, positions):
+            """ids [Bb, 1]; positions [Bb] = cached length per row, -1 for
+            padded rows.  Returns (next_ids [Bb], logits [Bb, V], kc, vc)."""
+            emb = params["embed"]
+            p_safe = jnp.maximum(positions, 0)
+            x = (emb["word_embeddings.weight"][ids]
+                 + emb["position_embeddings.weight"][p_safe][:, None])
+            x = x.astype(self.dtype)
+            bb = ids.shape[0]
+            rows = jnp.arange(bb)
+            slot = (block_tables[rows, p_safe // bs] * bs + p_safe % bs)
+            slots = jnp.where(positions >= 0, slot, nb * bs)
+            ctx = p_safe + jnp.where(positions >= 0, 1, 0)
+
+            def layer(carry, xs):
+                x = carry
+                p_l, kc_l, vc_l = xs
+                q, k, v = attn_proj(p_l, x)
+                kc_l = scatter_pages(kc_l, slots, k[:, 0])
+                vc_l = scatter_pages(vc_l, slots, v[:, 0])
+                # mirror the decode_attention IR pass rewrite exactly
+                # (framework/ir.py): pre-scale q, kernel divides sqrt(D)
+                scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
+                q = q * (scale * jnp.sqrt(jnp.asarray(hd, q.dtype)))
+                out = paged_decode_attention(q[:, 0], kc_l, vc_l,
+                                             block_tables, ctx)
+                out = out.astype(x.dtype).reshape(bb, 1, nh * hd)
+                return mlp_residual(p_l, x, out), (kc_l, vc_l)
+
+            x, (kc, vc) = jax.lax.scan(layer, x,
+                                       (params["blocks"], kc, vc))
+            logits = head_logits(params, x[:, 0])
+            return jnp.argmax(logits, -1), logits, kc, vc
+
+        self._prefill = jax.jit(prefill_fn, donate_argnums=(2, 3))
+        self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
+
+    # ----------------------------------------------------------- requests --
+    def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
+                    temperature=0.0, request_id=None):
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new_tokens > self.max_model_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + new {max_new_tokens} exceeds "
+                f"max_model_len {self.max_model_len}")
+        if request_id is None:
+            request_id = self._next_id
+            self._next_id += 1
+        req = Request(request_id=request_id, prompt_ids=tuple(prompt),
+                      max_new_tokens=int(max_new_tokens),
+                      eos_token_id=eos_token_id,
+                      temperature=float(temperature))
+        self._requests[request_id] = req
+        self.scheduler.add(req)
+        return request_id
+
+    def has_unfinished(self):
+        return self.scheduler.has_unfinished()
+
+    def warmup(self):
+        """Compile every bucketed executable before traffic arrives.
+
+        No-op on cache contents: the dummy prefill covers zero tokens and
+        the dummy decode rows are padding (position -1), so every page
+        write lands on the dropped out-of-range slot.  Serving processes
+        call this at startup so no client pays a compile stall.
+        """
+        with profiler.RecordEvent("llm_engine::warmup"):
+            lb = 8
+            while True:
+                lb = bucket_size(lb, self.max_model_len, floor=8)
+                ids = jnp.zeros((1, lb), jnp.int32)
+                table = jnp.zeros(self.max_pages, jnp.int32)
+                _, _, self._kc, self._vc = self._prefill(
+                    self.params, ids, self._kc, self._vc, table,
+                    jnp.int32(0))
+                if lb >= self.max_model_len:
+                    break
+                lb *= 2
+            bb = 1
+            while True:
+                ids = jnp.zeros((bb, 1), jnp.int32)
+                tables = jnp.zeros((bb, self.max_pages), jnp.int32)
+                positions = jnp.full((bb,), -1, jnp.int32)
+                _, _, self._kc, self._vc = self._decode(
+                    self.params, ids, self._kc, self._vc, tables,
+                    positions)
+                if bb >= self.max_batch:
+                    break
+                bb = min(bb * 2, self.max_batch)
+
+    # --------------------------------------------------------------- step --
+    def step(self):
+        """Run one scheduling iteration; returns RequestOutputs finished
+        by this step (possibly empty)."""
+        with profiler.RecordEvent("llm_engine::schedule"):
+            batch = self.scheduler.schedule()
+        if batch.kind == "idle":
+            return []
+        self.stats["steps"] += 1
+        finished = []
+        if batch.kind == "prefill":
+            self.stats["prefill_steps"] += 1
+            req = batch.requests[0]
+            tokens = req.all_ids
+            n = len(tokens)
+            lb = bucket_size(n, self.max_model_len, floor=8)
+            ids = np.zeros((1, lb), np.int32)
+            ids[0, :n] = tokens
+            table = np.zeros(self.max_pages, np.int32)
+            bt = self.block_manager.block_table(req.request_id)
+            table[:len(bt)] = bt
+            with profiler.RecordEvent("llm_engine::prefill"):
+                nxt, logits, self._kc, self._vc = self._prefill(
+                    self.params, jnp.asarray(ids), self._kc, self._vc,
+                    jnp.asarray(table), jnp.int32(n))
+            req.num_cached = n
+            self._commit_token(req, nxt, logits, finished)
+        else:
+            self.stats["decode_steps"] += 1
+            reqs = batch.requests
+            bb = bucket_size(len(reqs), self.max_batch)
+            ids = np.zeros((bb, 1), np.int32)
+            positions = np.full(bb, -1, np.int32)
+            tables = np.zeros((bb, self.max_pages), np.int32)
+            for i, r in enumerate(reqs):
+                ids[i, 0] = r.all_ids[-1]
+                positions[i] = r.num_cached
+                bt = self.block_manager.block_table(r.request_id)
+                tables[i, :len(bt)] = bt
+            with profiler.RecordEvent("llm_engine::decode"):
+                nxt, logits, self._kc, self._vc = self._decode(
+                    self.params, jnp.asarray(ids), self._kc, self._vc,
+                    jnp.asarray(tables), jnp.asarray(positions))
+            nxt = np.asarray(nxt)
+            logits_host = None
+            if any(r.temperature > 0.0 for r in reqs):
+                logits_host = np.asarray(logits)
+            for i, r in enumerate(reqs):
+                r.num_cached += 1
+                row_logits = (logits_host[i]
+                              if logits_host is not None else None)
+                self._commit_token(r, nxt[i], row_logits, finished)
+        return finished
+
+    def _commit_token(self, req, argmax_token, logits, finished):
+        if req.temperature > 0.0:
+            logits = np.asarray(logits, np.float64) / req.temperature
+            gumbel = self._rng.gumbel(size=logits.shape)
+            tok = int(np.argmax(logits + gumbel))
+        else:
+            tok = int(argmax_token)
+        req.output_ids.append(tok)
+        self.stats["tokens_generated"] += 1
+        if (req.eos_token_id is not None and tok == req.eos_token_id):
+            self._finish(req, "stop", finished)
+        elif len(req.output_ids) >= req.max_new_tokens:
+            self._finish(req, "length", finished)
+
+    def _finish(self, req, reason, finished):
+        self.scheduler.remove_running(req)
+        req.status = FINISHED
+        req.finish_reason = reason
+        del self._requests[req.request_id]
+        finished.append(RequestOutput(req.request_id, req.prompt_ids,
+                                      req.output_ids, reason,
+                                      req.num_preemptions))
+
+    # ----------------------------------------------------------- generate --
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 temperature=0.0):
+        """Batch convenience: returns one [T+new] int array per prompt
+        (ragged list, request order preserved)."""
+        if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
+            prompts = list(prompts)
+        elif not isinstance(prompts, (list, tuple)):
+            prompts = [prompts]
+        order = [self.add_request(p, max_new_tokens=max_new_tokens,
+                                  eos_token_id=eos_token_id,
+                                  temperature=temperature)
+                 for p in prompts]
+        outs = {}
+        while self.has_unfinished():
+            for fo in self.step():
+                outs[fo.request_id] = fo
+        return [outs[rid].all_ids.astype(np.int64) for rid in order]
+
+
+class AsyncLLMEngine:
+    """Thread-safe front of an LLMEngine: callers submit from any thread
+    (one per socket connection in PredictorServer) and block on their own
+    result while a single background thread steps the engine — concurrent
+    callers batch into one decode executable automatically."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self._cond = threading.Condition()
+        self._results = {}          # request_id -> RequestOutput
+        self._stopped = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while not self._stopped and \
+                        not self.engine.has_unfinished():
+                    self._cond.wait(timeout=0.5)
+                if self._stopped:
+                    return
+                for fo in self.engine.step():
+                    self._results[fo.request_id] = fo
+                self._cond.notify_all()
+
+    def submit(self, prompt_ids, **kwargs):
+        with self._cond:
+            rid = self.engine.add_request(prompt_ids, **kwargs)
+            self._cond.notify_all()
+            return rid
+
+    def result(self, request_id, timeout=None):
+        """Block until the request finishes; returns its RequestOutput."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: request_id in self._results or self._stopped,
+                timeout=timeout)
+            if not ok:
+                raise TimeoutError(f"request {request_id} still running")
+            if self._stopped and request_id not in self._results:
+                raise RuntimeError("engine stopped")
+            return self._results.pop(request_id)
+
+    def generate(self, prompt_ids, timeout=None, **kwargs):
+        return self.result(self.submit(prompt_ids, **kwargs),
+                           timeout=timeout)
+
+    def stop(self):
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5)

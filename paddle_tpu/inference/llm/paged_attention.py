@@ -1,0 +1,47 @@
+"""Paged decode attention — backend dispatch.
+
+One signature, two implementations with identical semantics:
+
+- TPU: the Pallas kernel (ops/pallas/paged_attention_kernel.py) DMAs
+  exactly the pages a sequence owns via scalar-prefetched block tables.
+- everywhere else (and under jit on CPU test rigs): gather the pages
+  into the dense ragged layout and run the round-4 masked decode
+  attention — bitwise the same math FusedMultiTransformer's decode hits
+  through the IR pass, which is what makes the engine-vs-dense
+  token-exactness tests meaningful.
+
+Like the ragged kernel, the 1/sqrt(D) scale is applied inside.
+"""
+
+import jax
+
+from ...framework.flags import get_flags
+from ...ops.pallas import paged_attention_kernel as _kernel
+from ...ops.pallas.decode_attention_kernel import decode_attention_xla
+
+
+def _use_pallas():
+    return (jax.default_backend() == "tpu"
+            and get_flags("FLAGS_use_pallas_kernels")
+            ["FLAGS_use_pallas_kernels"])
+
+
+def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, lengths):
+    """Masked-XLA fallback: gather pages -> dense ragged decode."""
+    b, num_pages = block_tables.shape
+    _, bs, nkv, d = k_pages.shape
+    k = k_pages[block_tables].reshape(b, num_pages * bs, nkv, d)
+    v = v_pages[block_tables].reshape(b, num_pages * bs, nkv, d)
+    return decode_attention_xla(q, k, v, lengths)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
+                           interpret=False):
+    """q [B, Nq, D] x paged pool -> [B, Nq, D]; lengths masks per row."""
+    _, bs, nkv, d = k_pages.shape
+    if ((_use_pallas() or interpret)
+            and _kernel.supports(bs, d, q.shape[1], nkv)):
+        return _kernel.paged_decode_attention_pallas(
+            q, k_pages, v_pages, block_tables, lengths, interpret=interpret)
+    return paged_decode_attention_xla(q, k_pages, v_pages, block_tables,
+                                      lengths)

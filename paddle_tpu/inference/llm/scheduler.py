@@ -1,0 +1,129 @@
+"""Continuous-batching scheduler.
+
+Iteration-level scheduling (Orca / vLLM policy, the serving half of the
+Gemma-on-TPU comparison in arxiv 2605.25645): every engine step is either
+ONE bucketed prefill or ONE bucketed decode over the whole running set,
+requests join and leave the batch between steps, and a sequence that
+cannot get a page is preempted (pages freed, sequence recomputed later)
+rather than deadlocking the pool.
+
+Shape discipline for XLA: a jitted executable exists per (kind, bucket)
+only — prefill lengths and decode batch sizes are rounded up to
+powers of two capped by the engine limits, so warmup compiles
+O(log(max_batch) + log(max_model_len)) programs and steady state
+recompiles nothing.
+"""
+
+import time
+from dataclasses import dataclass, field
+
+from .block_manager import NoFreeBlocksError
+
+WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
+
+
+def bucket_size(n, cap, floor=1):
+    """Smallest power of two >= n (>= floor), capped at ``cap``."""
+    b = max(int(floor), 1)
+    while b < n:
+        b *= 2
+    return min(b, int(cap))
+
+
+@dataclass
+class Request:
+    """One generation request and its mutable scheduling state."""
+    request_id: int
+    prompt_ids: tuple
+    max_new_tokens: int
+    eos_token_id: int = None
+    temperature: float = 0.0
+    arrival_time: float = field(default_factory=time.monotonic)
+    output_ids: list = field(default_factory=list)
+    num_cached: int = 0         # tokens whose K/V sit in the paged cache
+    num_preemptions: int = 0
+    status: str = WAITING
+    finish_reason: str = None
+
+    @property
+    def all_ids(self):
+        """prompt + generated so far (the recompute unit after preempt)."""
+        return list(self.prompt_ids) + self.output_ids
+
+
+@dataclass
+class ScheduledBatch:
+    kind: str                   # "prefill" | "decode" | "idle"
+    requests: list
+
+
+class Scheduler:
+    """Admission queue + running set + preempt-on-OOM policy."""
+
+    def __init__(self, block_manager, max_batch=8, watermark_blocks=1):
+        self.block_manager = block_manager
+        self.max_batch = int(max_batch)
+        self.watermark_blocks = int(watermark_blocks)
+        self.waiting = []       # FIFO; preempted sequences rejoin at the head
+        self.running = []       # arrival order == preemption priority
+        self.num_preemptions = 0
+
+    def add(self, request):
+        self.waiting.append(request)
+
+    def has_unfinished(self):
+        return bool(self.waiting or self.running)
+
+    def remove_running(self, request):
+        self.running.remove(request)
+        self.block_manager.free(request.request_id)
+
+    # ------------------------------------------------------------ policy --
+    def schedule(self):
+        """Pick the next step's work.  Prefill-first: an admissible
+        waiting request beats decoding (first tokens flow early and the
+        batch fills up); the watermark keeps a reserve of pages so a
+        fresh admission can't immediately preempt the running set."""
+        bm = self.block_manager
+        if self.waiting and len(self.running) < self.max_batch:
+            req = self.waiting[0]
+            margin = self.watermark_blocks if self.running else 0
+            if bm.can_allocate(len(req.all_ids), margin=margin):
+                self.waiting.pop(0)
+                bm.allocate(req.request_id, len(req.all_ids))
+                req.status = RUNNING
+                self.running.append(req)
+                return ScheduledBatch("prefill", [req])
+
+        if not self.running:
+            return ScheduledBatch("idle", [])
+
+        # decode: every running sequence needs one slot for its new token
+        scheduled = []
+        i = 0
+        while i < len(self.running):
+            req = self.running[i]
+            try:
+                self.block_manager.append_slot(req.request_id)
+            except NoFreeBlocksError:
+                victim = self.running[-1]
+                if victim is req and len(self.running) == 1:
+                    raise RuntimeError(
+                        "KV cache cannot hold a single sequence — "
+                        "raise num_blocks or lower max_model_len")
+                self._preempt(victim)
+                continue            # retry req (or fall off the end)
+            scheduled.append(req)
+            i += 1
+        return ScheduledBatch("decode", scheduled)
+
+    def _preempt(self, victim):
+        """Recompute-style preemption: drop the pages, queue the sequence
+        (prompt + generated so far) for a fresh prefill."""
+        self.running.remove(victim)
+        self.block_manager.free(victim.request_id)
+        victim.num_cached = 0
+        victim.num_preemptions += 1
+        victim.status = WAITING
+        self.num_preemptions += 1
+        self.waiting.insert(0, victim)

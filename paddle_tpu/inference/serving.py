@@ -76,6 +76,42 @@ def _recv_tensor(conn, max_bytes=_MAX_TENSOR_BYTES):
     return np.frombuffer(raw, dtype=dtype).reshape(dims).copy()
 
 
+class _GenerativeAdapter:
+    """Predictor-shaped front of an LLM engine.
+
+    Wire contract (same tensor encoding as Predictor): input 0 is the
+    prompt token ids (int32/int64, [T] or [1, T]); optional scalar input
+    1 is max_new_tokens (default 16).  The response is one [1, T+new]
+    int64 tensor.  Each socket connection runs in its own thread, so
+    concurrent clients batch inside the engine's continuous-batching
+    decode step — the socket path gains multi-tenant batching without a
+    protocol change.
+    """
+
+    _DEFAULT_MAX_NEW = 16
+
+    def __init__(self, engine):
+        from .llm import AsyncLLMEngine, LLMEngine
+
+        self._async = (AsyncLLMEngine(engine)
+                       if isinstance(engine, LLMEngine) else engine)
+
+    def run(self, inputs):
+        if not inputs:
+            raise ValueError("generative request needs a token-id tensor")
+        ids = np.asarray(inputs[0])
+        if not np.issubdtype(ids.dtype, np.integer):
+            raise ValueError("generative input 0 must be integer token ids")
+        max_new = (int(np.asarray(inputs[1]).reshape(-1)[0])
+                   if len(inputs) > 1 else self._DEFAULT_MAX_NEW)
+        out = self._async.generate(ids.reshape(-1),
+                                   max_new_tokens=max_new)
+        return [out.all_ids.astype(np.int64)[None]]
+
+    def stop(self):
+        self._async.stop()
+
+
 class PredictorServer:
     """Serve a Predictor to out-of-process (C/C++/Go) callers.
 
@@ -83,15 +119,23 @@ class PredictorServer:
     >>> srv = PredictorServer(create_predictor(cfg))     # port=0: free port
     >>> # C side: pd_infer_connect("127.0.0.1", srv.port) ... pd_infer_run
 
+    Generative models serve through the same socket protocol by passing
+    ``engine=LLMEngine(model)`` instead of a predictor: requests carry
+    token ids (+ optional max_new_tokens scalar) and concurrent
+    connections batch inside the engine (see _GenerativeAdapter).
+
     Trust boundary: the protocol is unauthenticated (reference C API is an
     in-process library), so the listener defaults to loopback.  Pass
     ``host="0.0.0.0"`` explicitly to serve a trusted network; ``max_bytes``
     caps each request tensor's payload.
     """
 
-    def __init__(self, predictor, host="127.0.0.1", port=0,
-                 max_bytes=_MAX_TENSOR_BYTES):
-        self._predictor = predictor
+    def __init__(self, predictor=None, host="127.0.0.1", port=0,
+                 max_bytes=_MAX_TENSOR_BYTES, engine=None):
+        if (predictor is None) == (engine is None):
+            raise ValueError("pass exactly one of predictor= or engine=")
+        self._predictor = (predictor if engine is None
+                           else _GenerativeAdapter(engine))
         self._max_bytes = max_bytes
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -157,3 +201,5 @@ class PredictorServer:
             self._sock.close()
         except OSError:
             pass
+        if isinstance(self._predictor, _GenerativeAdapter):
+            self._predictor.stop()

@@ -1,0 +1,124 @@
+"""Pallas paged decode attention — block-table indirection over a paged KV
+cache (Ragged Paged Attention, arxiv 2604.15464).
+
+The round-4 ragged decode kernel (decode_attention_kernel.py) reads a
+DENSE per-sequence cache [B, S_max, Nkv, D]; a continuous-batching server
+cannot afford that layout — sequences join and leave the batch every step,
+so the cache is carved into fixed-size token pages owned by a free-list
+allocator (inference/llm/block_manager.py) and each sequence sees the
+cache through its block table.  Shapes:
+
+    q             [B, Nq, D]      one new token per sequence (GQA:
+                                  G = Nq//Nkv query heads per KV head)
+    k_pages       [NB, bs, Nkv, D] the whole paged pool, NB pages of
+    v_pages       [NB, bs, Nkv, D] bs tokens each
+    block_tables  [B, P] int32    page id of each sequence's p-th page
+    lengths       [B]    int32    tokens valid per sequence (ctx length)
+
+Kernel layout: grid (B, Nkv, P) with the block tables and lengths as
+scalar-prefetch operands, so the BlockSpec index map dereferences
+``block_tables[b, p]`` to DMA exactly the pages a sequence owns — the
+pool itself never moves.  Online softmax accumulates across the P pages
+in VMEM scratch (the grid's innermost axis runs sequentially per (b, h)),
+and positions >= lengths[b] are masked, so a 7-token sequence in a
+4096-token pool costs one page of bandwidth, not the pool.
+
+Like the ragged kernel, the 1/sqrt(D) scale is applied INSIDE (callers
+pre-scale q if their formula differs); ``supports`` gates callers and the
+masked-XLA gather fallback (inference/llm/paged_attention.py) computes
+identical semantics everywhere else.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def supports(block_size, head_dim, num_q_heads, num_kv_heads):
+    return (head_dim <= 128 and block_size % 8 == 0
+            and num_q_heads % num_kv_heads == 0)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  o_scr, m_scr, l_scr, *, block_size):
+    """One (batch, kv_head, page) program; scratch carries the online
+    softmax state across the page axis."""
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+    g, d = q_ref.shape[2], q_ref.shape[3]
+    length = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        o_scr[...] = jnp.zeros_like(o_scr)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    base = p * block_size
+
+    @pl.when(base < length)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32)                 # [G, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+        s = q @ k.T / jnp.sqrt(jnp.asarray(d, jnp.float32))  # [G, bs]
+        pos = base + jax.lax.broadcasted_iota(jnp.int32, (g, block_size), 1)
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_prev, l_prev, o_prev = m_scr[...], l_scr[...], o_scr[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        pe = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        o_scr[...] = o_prev * alpha + pe @ v
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + pe.sum(axis=1, keepdims=True)
+
+    @pl.when(p == num_pages - 1)
+    def _finalize():
+        # lengths[b] == 0 (a padded batch slot): everything was masked —
+        # emit zeros instead of 0/0 over whatever the pool pages hold
+        safe = jnp.where(length > 0,
+                         o_scr[...] / jnp.maximum(l_scr[...], 1e-30), 0.0)
+        o_ref[0, 0] = safe.astype(o_ref.dtype)
+
+
+def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables,
+                                  lengths, interpret=False):
+    """Returns [B, Nq, D] attention outputs for one paged decode step."""
+    b, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    g = nq // nkv
+    num_pages = block_tables.shape[1]
+    qg = q.reshape(b, nkv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, nkv, num_pages),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda i, j, p, bt, ln: (i, j, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda i, j, p, bt, ln: (bt[i, p], 0, j, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda i, j, p, bt, ln: (bt[i, p], 0, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d),
+                               lambda i, j, p, bt, ln: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((g, d), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+            pltpu.VMEM((g, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, nkv, g, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(b, nq, d)

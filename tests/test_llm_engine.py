@@ -1,0 +1,432 @@
+"""Continuous-batching LLM serving engine (inference/llm/).
+
+The load-bearing claim: paged continuous-batching decode is TOKEN-EXACT
+vs the naive dense-cache FusedMultiTransformer decode — mixed-length
+traces, staggered arrivals, and preemption/recompute all reproduce the
+reference token stream bit for bit, while the block manager never leaks
+a page.  Plus: allocator/scheduler unit coverage, the paged Pallas
+kernel vs its XLA gather fallback (interpret mode), and the engine-backed
+PredictorServer socket path.
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _make_model(num_layers=2, seed=0):
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    paddle.seed(seed)
+    m = gpt_tiny(num_layers=num_layers)
+    m.eval()
+    return m
+
+
+def _fmt_reference(model, prompts, max_new, max_length=64):
+    """Naive dense-cache decode, one request at a time (batch 1)."""
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+    fmt = FusedMultiTransformer(model, max_length=max_length)
+    return [fmt.generate(np.asarray(p, np.int32)[None],
+                         max_new_tokens=max_new)[0] for p in prompts]
+
+
+# ---------------------------------------------------------------------------
+class TestBlockManager:
+    def test_alloc_free_roundtrip(self):
+        from paddle_tpu.inference.llm import BlockManager
+
+        bm = BlockManager(num_blocks=8, block_size=4)
+        t = bm.allocate("a", 10)            # ceil(10/4) = 3 pages
+        assert len(t) == 3 and bm.num_free_blocks == 5
+        assert bm.block_table("a") == t and bm.num_tokens("a") == 10
+        bm.free("a")
+        assert bm.num_free_blocks == 8 and not bm.has_seq("a")
+
+    def test_append_slot_and_page_boundary(self):
+        from paddle_tpu.inference.llm import BlockManager
+
+        bm = BlockManager(num_blocks=4, block_size=4)
+        bm.allocate("a", 3)
+        slot, cow = bm.append_slot("a")     # fills the first page
+        assert cow is None and slot == bm.block_table("a")[0] * 4 + 3
+        slot, cow = bm.append_slot("a")     # crosses into a new page
+        assert bm.num_free_blocks == 2
+        assert slot == bm.block_table("a")[1] * 4
+
+    def test_oom_raises_and_preserves_state(self):
+        from paddle_tpu.inference.llm import BlockManager, NoFreeBlocksError
+
+        bm = BlockManager(num_blocks=2, block_size=4)
+        bm.allocate("a", 8)
+        with pytest.raises(NoFreeBlocksError):
+            bm.allocate("b", 1)
+        with pytest.raises(NoFreeBlocksError):
+            bm.append_slot("a")
+        assert bm.num_tokens("a") == 8      # failed append did not count
+        bm.free("a")
+        assert bm.num_free_blocks == 2
+
+    def test_fork_refcount_and_copy_on_write(self):
+        from paddle_tpu.inference.llm import BlockManager
+
+        bm = BlockManager(num_blocks=8, block_size=4)
+        bm.allocate("parent", 6)            # 2 pages, last half-full
+        bm.fork("parent", "child")
+        assert bm.num_free_blocks == 6      # shared, nothing new
+        assert bm.block_table("child") == bm.block_table("parent")
+        # child's divergent append copies the shared tail page
+        slot, cow = bm.append_slot("child")
+        assert cow is not None
+        src, dst = cow
+        assert src == bm.block_table("parent")[-1]
+        assert dst == bm.block_table("child")[-1] and dst != src
+        assert slot == dst * 4 + 2
+        # parent's next append is in-place (its page is sole-owned again)
+        _, cow = bm.append_slot("parent")
+        assert cow is None
+        bm.free("parent")
+        assert bm.num_free_blocks == 6      # child still holds 2 pages
+        bm.free("child")
+        assert bm.num_free_blocks == 8
+
+
+# ---------------------------------------------------------------------------
+class TestScheduler:
+    def _mk(self, num_blocks=8, block_size=4, max_batch=2):
+        from paddle_tpu.inference.llm import BlockManager, Scheduler
+
+        bm = BlockManager(num_blocks, block_size)
+        return Scheduler(bm, max_batch=max_batch), bm
+
+    def _req(self, rid, n_prompt, max_new=8):
+        from paddle_tpu.inference.llm import Request
+
+        return Request(request_id=rid, prompt_ids=tuple(range(n_prompt)),
+                       max_new_tokens=max_new)
+
+    def test_prefill_first_then_decode(self):
+        sched, bm = self._mk()
+        sched.add(self._req(0, 5))
+        sched.add(self._req(1, 3))
+        b = sched.schedule()
+        assert b.kind == "prefill" and b.requests[0].request_id == 0
+        b = sched.schedule()
+        assert b.kind == "prefill" and b.requests[0].request_id == 1
+        b = sched.schedule()                # batch full -> decode both
+        assert b.kind == "decode" and len(b.requests) == 2
+        assert bm.num_tokens(0) == 6 and bm.num_tokens(1) == 4
+
+    def test_admission_respects_pool_and_batch(self):
+        sched, bm = self._mk(num_blocks=3, max_batch=4)
+        sched.add(self._req(0, 8))          # 2 pages
+        sched.add(self._req(1, 8))          # needs 2, only 1 free + margin
+        assert sched.schedule().kind == "prefill"
+        b = sched.schedule()                # cannot admit -> decode
+        assert b.kind == "decode" and len(b.requests) == 1
+        assert sched.waiting[0].request_id == 1
+
+    def test_preempt_on_oom_recycles_and_requeues(self):
+        sched, bm = self._mk(num_blocks=5, block_size=4, max_batch=2)
+        sched.add(self._req(0, 8))          # 2 pages, page-aligned
+        sched.add(self._req(1, 8))          # 2 pages, page-aligned
+        assert sched.schedule().kind == "prefill"
+        assert sched.schedule().kind == "prefill"
+        # both need a fresh page for token 9 but only one page is free:
+        # the earlier arrival gets it, the later one is preempted
+        b = sched.schedule()
+        assert b.kind == "decode"
+        assert [r.request_id for r in b.requests] == [0]
+        assert sched.num_preemptions == 1
+        victim = sched.waiting[0]
+        assert victim.request_id == 1 and victim.num_cached == 0
+        assert victim.num_preemptions == 1
+        assert bm.num_free_blocks == 2      # 0 holds 3 of the 5 pages
+
+    def test_bucket_size(self):
+        from paddle_tpu.inference.llm.scheduler import bucket_size
+
+        assert bucket_size(1, 8) == 1
+        assert bucket_size(3, 8) == 4
+        assert bucket_size(9, 8) == 8       # capped
+        assert bucket_size(5, 64, floor=8) == 8
+
+
+# ---------------------------------------------------------------------------
+class TestPagedAttention:
+    def _inputs(self, seed=0, b=3, nq=4, nkv=2, d=16, bs=8, pages=4):
+        rng = np.random.RandomState(seed)
+        nb = b * pages
+        q = rng.randn(b, nq, d).astype(np.float32)
+        kp = rng.randn(nb, bs, nkv, d).astype(np.float32)
+        vp = rng.randn(nb, bs, nkv, d).astype(np.float32)
+        bt = rng.permutation(nb).reshape(b, pages).astype(np.int32)
+        lens = np.array([5, 0, 30], np.int32)[:b]
+        return q, kp, vp, bt, lens
+
+    def test_xla_gather_matches_dense_ragged(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.llm import paged_decode_attention_xla
+        from paddle_tpu.ops.pallas.decode_attention_kernel import (
+            decode_attention_xla,
+        )
+
+        q, kp, vp, bt, lens = self._inputs()
+        out = paged_decode_attention_xla(*map(jnp.asarray,
+                                              (q, kp, vp, bt, lens)))
+        b, pages = bt.shape
+        bs = kp.shape[1]
+        k = kp[bt].reshape(b, pages * bs, *kp.shape[2:])
+        v = vp[bt].reshape(b, pages * bs, *vp.shape[2:])
+        ref = decode_attention_xla(jnp.asarray(q), jnp.asarray(k),
+                                   jnp.asarray(v), jnp.asarray(lens))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_pallas_kernel_interpret_matches_xla(self):
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.llm import paged_decode_attention_xla
+        from paddle_tpu.ops.pallas.paged_attention_kernel import (
+            paged_decode_attention_pallas,
+            supports,
+        )
+
+        assert supports(8, 16, 4, 2)
+        q, kp, vp, bt, lens = self._inputs(seed=7)
+        args = tuple(map(jnp.asarray, (q, kp, vp, bt, lens)))
+        out = paged_decode_attention_pallas(*args, interpret=True)
+        ref = paged_decode_attention_xla(*args)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_supports_gate(self):
+        from paddle_tpu.ops.pallas.paged_attention_kernel import supports
+
+        assert not supports(8, 256, 4, 2)   # head_dim too wide
+        assert not supports(6, 16, 4, 2)    # page not sublane-aligned
+        assert not supports(8, 16, 3, 2)    # ragged GQA group
+
+
+# ---------------------------------------------------------------------------
+class TestEngineTokenExact:
+    """LLMEngine.generate vs naive dense-cache FMT decode: bit-equal."""
+
+    def test_mixed_length_trace(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (3, 7, 12)]
+        refs = _fmt_reference(m, prompts, max_new=8)
+        eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64)
+        outs = eng.generate(prompts, max_new_tokens=8)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+        assert eng.stats["prefill_steps"] == 3
+
+    def test_staggered_arrivals_trace(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 9, 6)]
+        refs = _fmt_reference(m, prompts, max_new=6)
+        eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64)
+        outs = {}
+
+        def drain(n_steps):
+            for _ in range(n_steps):
+                for fo in eng.step():
+                    outs[fo.request_id] = fo.all_ids
+
+        r0 = eng.add_request(prompts[0], max_new_tokens=6)
+        drain(2)                            # r0 mid-decode when r1 lands
+        r1 = eng.add_request(prompts[1], max_new_tokens=6)
+        drain(3)
+        r2 = eng.add_request(prompts[2], max_new_tokens=6)
+        while eng.has_unfinished():
+            drain(1)
+        for rid, ref in zip((r0, r1, r2), refs):
+            np.testing.assert_array_equal(outs[rid], ref)
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_preemption_trace(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 128, (4,)).astype(np.int32)
+                   for _ in range(3)]
+        refs = _fmt_reference(m, prompts, max_new=28)
+        # 5 pages of 8 < 3 seqs x 4 pages demanded -> preempt + recompute
+        eng = LLMEngine(m, block_size=8, num_blocks=5, max_batch=3,
+                        max_model_len=40)
+        outs = eng.generate(prompts, max_new_tokens=28)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.scheduler.num_preemptions > 0
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_eos_stops_early_and_frees(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        prompt = np.array([5, 6, 7], np.int32)
+        eng = LLMEngine(m, block_size=8, max_batch=2, max_model_len=64)
+        probe = eng.generate([prompt], max_new_tokens=4)[0]
+        eos = int(probe[3])                 # first generated token
+        eng2 = LLMEngine(m, block_size=8, max_batch=2, max_model_len=64)
+        rid = eng2.add_request(prompt, max_new_tokens=8, eos_token_id=eos)
+        fo = None
+        while eng2.has_unfinished():
+            for f in eng2.step():
+                fo = f
+        assert fo.request_id == rid and fo.finish_reason == "stop"
+        assert fo.output_ids.tolist() == [eos]
+        assert eng2.block_manager.num_free_blocks == eng2.num_blocks
+
+    def test_warmup_is_a_noop_on_results(self):
+        # warmup pre-compiles every bucket via dummy prefill/decode calls
+        # whose page writes all land on the dropped OOB slot — generation
+        # after warmup must be bit-identical to a cold engine's
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (3, 11)]
+        cold = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64)
+        refs = cold.generate(prompts, max_new_tokens=8)
+        warm = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64)
+        warm.warmup()
+        assert warm.block_manager.num_free_blocks == warm.num_blocks
+        outs = warm.generate(prompts, max_new_tokens=8)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+
+    def test_request_validation(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        eng = LLMEngine(m, block_size=8, max_batch=2, max_model_len=32)
+        with pytest.raises(ValueError, match="exceeds max_model_len"):
+            eng.add_request(np.arange(30, dtype=np.int32),
+                            max_new_tokens=8)
+        with pytest.raises(ValueError, match="empty prompt"):
+            eng.add_request([], max_new_tokens=4)
+        with pytest.raises(ValueError, match="cannot hold"):
+            LLMEngine(m, block_size=8, num_blocks=2, max_model_len=32)
+
+
+# ---------------------------------------------------------------------------
+class TestServingDelegation:
+    """PredictorServer(engine=...) serves generation over the socket
+    protocol; concurrent connections batch inside the engine."""
+
+    @staticmethod
+    def _query(port, ids, max_new):
+        from paddle_tpu.inference.serving import (
+            _recv_exact,
+            _recv_tensor,
+            _send_tensor,
+        )
+
+        s = socket.create_connection(("127.0.0.1", port))
+        try:
+            s.sendall(struct.pack("<I", 2))
+            _send_tensor(s, np.asarray(ids, np.int64))
+            _send_tensor(s, np.asarray(max_new, np.int64))
+            status, n_out = struct.unpack("<BI", _recv_exact(s, 5))
+            assert status == 0, _recv_exact(s, n_out).decode()
+            return [_recv_tensor(s) for _ in range(n_out)][0]
+        finally:
+            s.close()
+
+    def test_concurrent_clients_token_exact(self):
+        from paddle_tpu.inference.llm import LLMEngine
+        from paddle_tpu.inference.serving import PredictorServer
+
+        m = _make_model()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (3, 7, 12)]
+        refs = _fmt_reference(m, prompts, max_new=8)
+        eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64)
+        srv = PredictorServer(engine=eng)
+        try:
+            results = [None] * len(prompts)
+
+            def worker(i):
+                results[i] = self._query(srv.port, prompts[i], 8)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        finally:
+            srv.stop()
+        for got, ref in zip(results, refs):
+            assert got is not None
+            np.testing.assert_array_equal(got[0], ref)
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_requires_exactly_one_backend(self):
+        from paddle_tpu.inference.serving import PredictorServer
+
+        with pytest.raises(ValueError, match="exactly one"):
+            PredictorServer()
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.slow
+class TestServingSoak:
+    """Nightly-style soak: a Poisson-ish wave of mixed requests through
+    a deliberately small pool — heavy preemption, zero leaks, every
+    request token-exact vs the dense reference."""
+
+    def test_soak_token_exact_no_leaks(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model(num_layers=3)
+        rng = np.random.RandomState(4)
+        n_requests = 24
+        prompts = [rng.randint(0, 128, (int(rng.randint(2, 14)),))
+                   .astype(np.int32) for _ in range(n_requests)]
+        max_new = [int(rng.randint(2, 12)) for _ in range(n_requests)]
+        fmt_refs = {}
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+
+        fmt = FusedMultiTransformer(m, max_length=64)
+        for i, p in enumerate(prompts):
+            fmt_refs[i] = fmt.generate(p[None],
+                                       max_new_tokens=max_new[i])[0]
+
+        eng = LLMEngine(m, block_size=8, num_blocks=10, max_batch=4,
+                        max_model_len=40)
+        pending = list(range(n_requests))
+        rid_to_i, outs = {}, {}
+        while pending or eng.has_unfinished():
+            # staggered arrivals: a couple of new requests per step
+            for _ in range(2):
+                if pending:
+                    i = pending.pop(0)
+                    rid = eng.add_request(prompts[i],
+                                          max_new_tokens=max_new[i])
+                    rid_to_i[rid] = i
+            for fo in eng.step():
+                outs[rid_to_i[fo.request_id]] = fo.all_ids
+        for i in range(n_requests):
+            np.testing.assert_array_equal(outs[i], fmt_refs[i])
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
