@@ -13,10 +13,20 @@ the engine, and timestamps every generated token.  Reported:
 max_batch=1 — i.e. the measured win of continuous batching itself over
 one-request-at-a-time serving on identical hardware and executables.
 
+``--shared-prefix`` switches to the prefix-caching workload: every
+request shares a common system prompt (``--prefix-len`` tokens) ahead
+of a short unique suffix, the trace replays once with automatic prefix
+caching ON and once OFF (the baseline), and the line reports the
+throughput ratio, both TTFT p50s, and the measured cache hit rate —
+the adopted prefix pages skip their prefill compute entirely, so both
+throughput and time-to-first-token should win.
+
 Prints ONE JSON line (bench.py convention).
 
 Usage: python benchmarks/bench_serving.py [--requests 32 --rate 256
         --max-new 24 --max-batch 8 --no-baseline]
+       python benchmarks/bench_serving.py --shared-prefix
+        [--requests 64 --prefix-len 256 --max-new 16]
 """
 
 import argparse
@@ -29,16 +39,19 @@ sys.path.insert(0, ".")
 import numpy as np
 
 
-def _build_engine(max_batch, seed=0):
+def _build_engine(max_batch, seed=0, max_model_len=64,
+                  prefix_caching=True, token_budget=64):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.models.gpt import gpt_tiny
 
     paddle.seed(seed)
-    m = gpt_tiny(num_layers=2)
+    m = gpt_tiny(num_layers=2, max_position_embeddings=max_model_len)
     m.eval()
     return LLMEngine(m, block_size=8, max_batch=max_batch,
-                     max_model_len=64)
+                     max_model_len=max_model_len,
+                     enable_prefix_caching=prefix_caching,
+                     token_budget=token_budget)
 
 
 def _trace(n_requests, rate, max_new, seed=0):
@@ -47,6 +60,20 @@ def _trace(n_requests, rate, max_new, seed=0):
     arrivals = np.cumsum(gaps)
     prompts = [rng.randint(0, 128, (int(rng.randint(2, 14)),))
                .astype(np.int32) for _ in range(n_requests)]
+    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
+                  for _ in range(n_requests)]
+    return arrivals, prompts, new_tokens
+
+
+def _shared_prefix_trace(n_requests, rate, max_new, prefix_len, seed=0):
+    """Every request = one common system prompt + a short unique tail."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    prefix = rng.randint(0, 128, (prefix_len,)).astype(np.int32)
+    prompts = [np.concatenate(
+        [prefix, rng.randint(0, 128, (int(rng.randint(4, 13)),))
+         .astype(np.int32)]) for _ in range(n_requests)]
     new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
                   for _ in range(n_requests)]
     return arrivals, prompts, new_tokens
@@ -116,6 +143,7 @@ def run(engine, arrivals, prompts, new_tokens):
         "ttft_p50_ms": float(np.percentile(ttfts, 50) * 1e3) if ttfts
         else None,
         "preemptions": engine.scheduler.num_preemptions,
+        "prefix_cache": engine.prefix_cache_stats(),
     }
 
 
@@ -133,9 +161,17 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the max_batch=1 baseline replay")
+    ap.add_argument("--shared-prefix", action="store_true",
+                    help="shared system-prompt workload; baseline is "
+                         "the same engine with prefix caching OFF")
+    ap.add_argument("--prefix-len", type=int, default=256,
+                    help="shared system prompt length (tokens)")
     args = ap.parse_args()
 
     import jax
+
+    if args.shared_prefix:
+        return _main_shared_prefix(args, jax)
 
     arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
                                            args.max_new, args.seed)
@@ -162,6 +198,50 @@ def main():
         "max_batch": args.max_batch,
         "backend": jax.default_backend(),
         "config": "gpt_tiny 2L block_size=8 max_model_len=64",
+    }))
+
+
+def _main_shared_prefix(args, jax):
+    # room for prompt (prefix + <=12 suffix) plus the generated tokens
+    max_model_len = args.prefix_len + 12 + args.max_new
+    arrivals, prompts, new_tokens = _shared_prefix_trace(
+        args.requests, args.rate, args.max_new, args.prefix_len,
+        args.seed)
+
+    eng = _build_engine(args.max_batch, args.seed,
+                        max_model_len=max_model_len)
+    res = run(eng, arrivals, prompts, new_tokens)
+
+    vs_baseline = base_ttft = None
+    if not args.no_baseline:
+        base = _build_engine(args.max_batch, args.seed,
+                             max_model_len=max_model_len,
+                             prefix_caching=False)
+        base_res = run(base, arrivals, prompts, new_tokens)
+        vs_baseline = res["tokens_per_s"] / base_res["tokens_per_s"]
+        base_ttft = base_res["ttft_p50_ms"]
+
+    pc = res["prefix_cache"]
+    print(json.dumps({
+        "metric": "llm_serving_shared_prefix",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "vs_baseline": (round(vs_baseline, 3)
+                        if vs_baseline is not None else None),
+        "ttft_p50_ms": round(res["ttft_p50_ms"], 2),
+        "baseline_ttft_p50_ms": (round(base_ttft, 2)
+                                 if base_ttft is not None else None),
+        "p50_token_ms": round(res["p50_token_ms"], 2),
+        "hit_rate": round(pc["hit_rate"], 3),
+        "reused_blocks": pc["reused_blocks"],
+        "evictions": pc["evictions"],
+        "requests": args.requests,
+        "prefix_len": args.prefix_len,
+        "preemptions": res["preemptions"],
+        "max_batch": args.max_batch,
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
     }))
 
 

@@ -35,6 +35,7 @@ local accumulator (no verb reads host g2 back).
 """
 
 import functools
+from contextlib import contextmanager
 
 import numpy as np
 
@@ -204,21 +205,56 @@ class HotRowCache:
             self._dirty[d] = False
             return keys, delta
 
-    def _admit(self, missing, pinned):
-        """Fetch ``missing`` keys from the remote table and cache as many
-        as fit; returns the list of keys that could NOT be cached (they
-        stay on the uncached pass-through path this batch)."""
-        # drop the cache lock for the server round-trip (the background
-        # refresh may hold _rpc_mu for its own RTT; holding _lock here
-        # would stall every cache operation behind it)
-        self._lock.release()
+    @contextmanager
+    def _fully_unlocked(self):
+        """Exit EVERY recursion level of this thread's hold on the cache
+        RLock for the duration, then restore the depth.  A bare
+        release() pops one level only, so a re-entrant caller (pull()
+        invoked while already inside the lock) would carry the lock into
+        the server round-trip — stalling every cache operation for a
+        full RTT and deadlocking against anything that completes the RPC
+        only once the lock frees."""
+        depth = 0
+        while self._lock._is_owned():
+            self._lock.release()
+            depth += 1
         try:
-            with self._rpc_mu:
-                rows_host = self.remote.pull(missing)
+            yield
         finally:
-            self._lock.acquire()
+            for _ in range(depth):
+                self._lock.acquire()
+
+    def _admit(self, missing, pinned):
+        """Fetch ``missing`` keys from the remote table and cache as
+        many as fit; returns {key: server_row} for keys that could NOT
+        be cached (they stay on the uncached pass-through path this
+        batch).
+
+        Structure: the miss set was computed under the lock by the
+        caller; the lock is FULLY exited for the fetch (the background
+        refresh may hold _rpc_mu for its own RTT; holding _lock here
+        would stall every cache operation behind it); admission then
+        re-resolves under the re-entered lock, since another thread may
+        have admitted some of these keys meanwhile."""
+        with self._fully_unlocked():
+            with self._rpc_mu:
+                rows_host = np.asarray(self.remote.pull(missing))
         self.rtts["pull"] += 1
-        m = len(missing)
+        row_of = {int(k): rows_host[i]
+                  for i, k in enumerate(missing.tolist())}
+        # keys admitted by a concurrent pull while the lock was down:
+        # their cached rows are newer than our snapshot — keep them, and
+        # pin their slots so our eviction below cannot claim them
+        pinned = set(pinned)
+        still = []
+        for k in missing.tolist():
+            s = self._slot_of.get(k)
+            if s is None:
+                still.append(k)
+            else:
+                pinned.add(s)
+        still = np.asarray(still, np.int64)
+        m = len(still)
         if len(self._free) < m:
             need = m - len(self._free)
             occupied = np.nonzero(self._key_of >= 0)[0]
@@ -246,11 +282,10 @@ class HotRowCache:
         slots = np.asarray([self._free.pop() for _ in range(n_fit)],
                            np.int64)
         if n_fit:
-            fit_keys = missing[:n_fit]
-            self._rows = self._rows.at[slots].set(
-                jnp.asarray(rows_host[:n_fit]))
-            self._base = self._base.at[slots].set(
-                jnp.asarray(rows_host[:n_fit]))
+            fit_keys = still[:n_fit]
+            rows_fit = np.stack([row_of[int(k)] for k in fit_keys])
+            self._rows = self._rows.at[slots].set(jnp.asarray(rows_fit))
+            self._base = self._base.at[slots].set(jnp.asarray(rows_fit))
             if self._accum is not None:
                 acc = np.stack([
                     self._accum_spill.pop(int(k),
@@ -261,8 +296,7 @@ class HotRowCache:
             self._score[slots] = 1.0
             for k, s in zip(fit_keys.tolist(), slots.tolist()):
                 self._slot_of[k] = s
-        overflow = missing[n_fit:]
-        return overflow, rows_host[n_fit:]
+        return {int(k): row_of[int(k)] for k in still[n_fit:].tolist()}
 
     # ------------------------------------------------------- pull / push ----
 
@@ -281,23 +315,26 @@ class HotRowCache:
         self.hits += int(cached.sum())
         self.misses += int((~cached).sum())
         self._score[slots[cached]] += 1.0
-        overflow_rows = None
+        passthrough = {}
         if not cached.all():
             missing = uniq[~cached]
             pinned = set(slots[cached].tolist())
-            _overflow, overflow_rows = self._admit(missing, pinned)
-            # refresh only the previously-missing entries (overflow keys
-            # stay -1; _admit preserves uniq order, so overflow_rows
-            # aligns with the tail of the missing positions)
+            passthrough = self._admit(missing, pinned)
+            # refresh only the previously-missing entries (keys that
+            # overflowed capacity stay -1 and are served from
+            # ``passthrough``, keyed — not positional — because a
+            # concurrent pull may have admitted part of the miss set)
             for i in np.nonzero(~cached)[0]:
                 slots[i] = self._slot_of.get(int(uniq[i]), -1)
         out = self._rows[jnp.asarray(np.clip(slots, 0, self.capacity - 1))]
-        still_missing = slots < 0
-        if still_missing.any():
+        still_missing = np.nonzero(slots < 0)[0]
+        if len(still_missing):
             # capacity overflow: serve those rows straight from the RPC
             # reply (pass-through path; push() mirrors it)
-            out = out.at[jnp.asarray(np.nonzero(still_missing)[0])].set(
-                jnp.asarray(overflow_rows))
+            rows = np.stack([passthrough[int(uniq[i])]
+                             for i in still_missing])
+            out = out.at[jnp.asarray(still_missing)].set(
+                jnp.asarray(rows))
         return out[jnp.asarray(inv)].reshape(shape + (self.dim,))
 
     def push(self, keys, grads, learning_rate=None):

@@ -203,7 +203,7 @@ class Predictor:
             # declared-feed order, independent of handle fill order
             filled = [h for h in self._inputs if h._host is not None]
             missing = [h.name for h in self._inputs if h._host is None]
-            if missing and filled:
+            if missing:
                 raise ValueError(
                     f"feeds {missing} have no data "
                     f"(copy_from_cpu the full declared set "

@@ -3,25 +3,43 @@
 The serving-shaped subsystem over the round-4 ragged decode kernel:
 
 - block_manager:  paged KV-cache allocator (free list, block tables,
-                  refcounted fork / copy-on-write)
-- scheduler:      iteration-level continuous batching with
+                  refcounted fork / copy-on-write) with automatic
+                  prefix caching (content-hash-addressed full pages,
+                  LRU eviction of cached-but-unreferenced pages)
+- scheduler:      iteration-level continuous batching with a per-step
+                  token budget, chunked prefill mixed with decodes,
                   preempt-on-OOM and power-of-two shape bucketing
-- paged_attention: block-table attention dispatch (Pallas kernel on
-                  TPU, masked-XLA gather fallback everywhere)
+- paged_attention: block-table attention dispatch for decode AND
+                  prefill chunks (Pallas kernels on TPU, masked-XLA
+                  gather fallback everywhere)
 - engine:         LLMEngine (add_request/step/generate, two donated
                   jitted executables) + AsyncLLMEngine for servers
 
 See docs/LLM_SERVING.md for design notes and a quickstart.
 """
 
-from .block_manager import BlockManager, NoFreeBlocksError  # noqa: F401
+from .block_manager import (  # noqa: F401
+    BlockManager,
+    NoFreeBlocksError,
+    hash_block_tokens,
+    prefix_block_hashes,
+)
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
 from .paged_attention import (  # noqa: F401
     paged_decode_attention,
     paged_decode_attention_xla,
+    paged_prefill_attention,
+    paged_prefill_attention_xla,
 )
-from .scheduler import Request, ScheduledBatch, Scheduler  # noqa: F401
+from .scheduler import (  # noqa: F401
+    PrefillChunk,
+    Request,
+    ScheduledBatch,
+    Scheduler,
+)
 
-__all__ = ["BlockManager", "NoFreeBlocksError", "Scheduler", "Request",
+__all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
+           "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
-           "paged_decode_attention", "paged_decode_attention_xla"]
+           "paged_decode_attention", "paged_decode_attention_xla",
+           "paged_prefill_attention", "paged_prefill_attention_xla"]

@@ -1,4 +1,4 @@
-"""Paged KV-cache block allocator.
+"""Paged KV-cache block allocator with automatic prefix caching.
 
 The serving cache is one pool of ``num_blocks`` fixed-size token pages
 (vLLM's PagedAttention allocator shape; on TPU the pool is a dense
@@ -8,39 +8,96 @@ a free list recycles pages the moment a sequence finishes or is
 preempted, and ``fork`` shares pages copy-on-write for beam/parallel
 sampling.
 
+Prefix caching makes FULL pages content-addressable: a full page is
+identified by the prefix-chain hash of every token id up to and
+including the page (``hash_block_tokens``), so two requests whose
+prompts share a leading run of pages map the SAME physical pages and
+skip recomputing their K/V.  Pages whose refcount drops to zero but
+whose contents are still hash-addressable park on an LRU side list
+instead of the raw free list — they count as free (allocation evicts
+the oldest when the raw list runs dry) but stay adoptable until then.
+Only full pages are ever hashed, and full pages are immutable (decode
+appends only write partially-filled tail pages, copy-on-write copies
+partial tails), so an adopted page can never be clobbered by its other
+owners.
+
 Pure host-side bookkeeping — nothing here touches device memory.  The
 engine mirrors each table into the [B, P] int32 operand the kernels
 gather through.
 """
+
+from collections import OrderedDict
 
 
 class NoFreeBlocksError(RuntimeError):
     """The pool is exhausted; callers preempt or queue."""
 
 
+def hash_block_tokens(prev_hash, tokens):
+    """Chain hash of one full page: folds the hash of everything before
+    the page with the page's own token ids, so equal hashes mean equal
+    full prefixes (int tuple hashing is process-stable, unlike str)."""
+    return hash((prev_hash, tuple(int(t) for t in tokens)))
+
+
+def prefix_block_hashes(token_ids, block_size, limit=None):
+    """Chain hashes for every FULL page of ``token_ids`` (ragged tail
+    excluded).  ``limit`` caps the number of pages hashed."""
+    n_full = len(token_ids) // block_size
+    if limit is not None:
+        n_full = min(n_full, limit)
+    hashes, h = [], None
+    for i in range(n_full):
+        h = hash_block_tokens(h, token_ids[i * block_size:
+                                           (i + 1) * block_size])
+        hashes.append(h)
+    return hashes
+
+
 class BlockManager:
-    def __init__(self, num_blocks, block_size):
+    def __init__(self, num_blocks, block_size, enable_prefix_caching=False):
         if num_blocks <= 0 or block_size <= 0:
             raise ValueError("num_blocks and block_size must be positive")
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
+        self.enable_prefix_caching = bool(enable_prefix_caching)
         # pop() takes from the tail: keep it sorted descending so pages
         # are handed out in ascending id order (stable tests/traces)
         self._free = list(range(self.num_blocks - 1, -1, -1))
         self._ref = {}          # block id -> refcount
         self._tables = {}       # seq id -> [block ids]
         self._tokens = {}       # seq id -> tokens occupying those blocks
+        # prefix cache state: full pages only
+        self._hash_to_block = {}        # chain hash -> block id
+        self._block_hash = {}           # block id -> chain hash
+        self._lru = OrderedDict()       # cached + refcount 0, oldest first
+        self.prefix_reused_blocks = 0
+        self.prefix_evictions = 0
 
     # ------------------------------------------------------------ queries --
     @property
     def num_free_blocks(self):
-        return len(self._free)
+        """Pages allocatable right now: the raw free list plus cached
+        pages nobody references (evictable on demand)."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def num_cached_blocks(self):
+        """Hash-addressable full pages currently resident (referenced
+        or parked on the LRU list)."""
+        return len(self._hash_to_block)
 
     def blocks_needed(self, num_tokens):
         return -(-int(num_tokens) // self.block_size)
 
-    def can_allocate(self, num_tokens, margin=0):
-        return self.blocks_needed(num_tokens) + margin <= len(self._free)
+    def can_allocate(self, num_tokens, margin=0, cached_hashes=()):
+        """Would ``allocate`` succeed, adopting ``cached_hashes`` pages
+        from the prefix cache?  Adopted pages parked on the LRU list
+        leave the free pool when claimed, so they count against it."""
+        in_lru = sum(1 for h in cached_hashes
+                     if self._hash_to_block.get(h) in self._lru)
+        fresh = self.blocks_needed(num_tokens) - len(cached_hashes)
+        return fresh + margin <= len(self._free) + len(self._lru) - in_lru
 
     def block_table(self, seq_id):
         return list(self._tables[seq_id])
@@ -51,24 +108,76 @@ class BlockManager:
     def has_seq(self, seq_id):
         return seq_id in self._tables
 
+    # ------------------------------------------------------- prefix cache --
+    def match_prefix(self, hashes):
+        """Length of the longest leading run of ``hashes`` whose pages
+        are still resident (referenced or LRU-parked)."""
+        if not self.enable_prefix_caching:
+            return 0
+        k = 0
+        for h in hashes:
+            if h not in self._hash_to_block:
+                break
+            k += 1
+        return k
+
+    def _adopt(self, block_hash):
+        """Take a reference on the cached page for ``block_hash``."""
+        blk = self._hash_to_block[block_hash]
+        if blk in self._lru:
+            del self._lru[blk]
+            self._ref[blk] = 1
+        else:
+            self._ref[blk] += 1
+        self.prefix_reused_blocks += 1
+        return blk
+
+    def register_full_block(self, seq_id, block_index, block_hash):
+        """Make a just-computed FULL page hash-addressable.  First
+        writer wins; a page that already carries a hash (it was adopted
+        from the cache in the first place) is left alone."""
+        if not self.enable_prefix_caching:
+            return
+        blk = self._tables[seq_id][block_index]
+        if blk in self._block_hash or block_hash in self._hash_to_block:
+            return
+        self._hash_to_block[block_hash] = blk
+        self._block_hash[blk] = block_hash
+
     # ---------------------------------------------------------- lifecycle --
     def _take(self):
-        if not self._free:
+        if self._free:
+            blk = self._free.pop()
+        elif self._lru:
+            # evict the least-recently-freed cached page
+            blk, _ = self._lru.popitem(last=False)
+            del self._hash_to_block[self._block_hash.pop(blk)]
+            self.prefix_evictions += 1
+        else:
             raise NoFreeBlocksError("KV cache pool exhausted")
-        blk = self._free.pop()
         self._ref[blk] = 1
         return blk
 
-    def allocate(self, seq_id, num_tokens):
-        """Allocate pages for a sequence's first ``num_tokens`` tokens
-        (the prefill); returns the block table."""
+    def allocate(self, seq_id, num_tokens, cached_hashes=()):
+        """Allocate pages for a sequence's first ``num_tokens`` tokens;
+        the leading ``cached_hashes`` pages are adopted from the prefix
+        cache (zero compute), the rest come fresh.  Returns the block
+        table."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
         need = self.blocks_needed(num_tokens)
-        if need > len(self._free):
+        if len(cached_hashes) > need:
+            raise ValueError("more cached pages than the sequence needs")
+        in_lru = sum(1 for h in cached_hashes
+                     if self._hash_to_block.get(h) in self._lru)
+        fresh = need - len(cached_hashes)
+        if fresh > len(self._free) + len(self._lru) - in_lru:
             raise NoFreeBlocksError(
-                f"need {need} blocks, {len(self._free)} free")
-        table = [self._take() for _ in range(need)]
+                f"need {fresh} fresh blocks, "
+                f"{len(self._free) + len(self._lru) - in_lru} free")
+        # adopt FIRST so _take's eviction can never claim a matched page
+        table = [self._adopt(h) for h in cached_hashes]
+        table += [self._take() for _ in range(fresh)]
         self._tables[seq_id] = table
         self._tokens[seq_id] = int(num_tokens)
         return list(table)
@@ -78,9 +187,9 @@ class BlockManager:
         table = self._tables[seq_id]
         tokens = self._tokens[seq_id]
         if tokens == len(table) * self.block_size:
-            return len(self._free) >= 1          # page boundary: new page
+            return self.num_free_blocks >= 1     # page boundary: new page
         if table and self._ref[table[-1]] > 1:
-            return len(self._free) >= 1          # copy-on-write copy
+            return self.num_free_blocks >= 1     # copy-on-write copy
         return True
 
     def append_slot(self, seq_id):
@@ -102,7 +211,7 @@ class BlockManager:
         elif self._ref[table[-1]] > 1:           # shared tail: copy-on-write
             src = table[-1]
             dst = self._take()
-            self._ref[src] -= 1
+            self._ref[src] -= 1                  # cow fires at ref > 1
             table[-1] = dst
             cow = (src, dst)
         self._tokens[seq_id] = tokens + 1
@@ -119,11 +228,20 @@ class BlockManager:
         self._tables[child_id] = list(table)
         self._tokens[child_id] = self._tokens[parent_id]
 
+    def _release(self, blk):
+        """Refcount hit zero: park hashed pages on the LRU list (still
+        adoptable), return unhashed pages to the raw free list."""
+        del self._ref[blk]
+        if blk in self._block_hash:
+            self._lru[blk] = None                # most-recently freed
+        else:
+            self._free.append(blk)
+
     def free(self, seq_id):
-        """Release the sequence; pages return to the pool at refcount 0."""
+        """Release the sequence; pages return to the pool (or the LRU
+        cached pool) at refcount 0."""
         for blk in self._tables.pop(seq_id):
             self._ref[blk] -= 1
             if self._ref[blk] == 0:
-                del self._ref[blk]
-                self._free.append(blk)
+                self._release(blk)
         del self._tokens[seq_id]

@@ -6,16 +6,29 @@ stacked-params lax.scan decoder, but the KV cache is one paged pool
 in-flight request, so the engine runs MANY requests of ragged lengths
 through exactly two families of jitted executables:
 
-- prefill: one sequence, prompt padded to a power-of-two bucket; writes
-  its K/V through the block table, returns the first generated token.
+- chunk: one sequence, one prefill CHUNK of at most ``token_budget``
+  prompt tokens padded to a power-of-two chunk bucket; writes the
+  chunk's K/V through the block table and attends over every earlier
+  position THROUGH THE POOL, so prior chunks and prefix-cache hits are
+  read back instead of recomputed.  The final chunk returns the first
+  generated token.  The executable family is bounded by the chunk
+  buckets (floor 8, cap token_budget) — NOT by prompt length, so a 4k
+  prompt compiles nothing a 64-token prompt didn't.
 - decode: the whole running set padded to a power-of-two batch bucket;
   gathers K/V through block tables (Pallas paged kernel on TPU, masked
   XLA gather elsewhere), appends one token per sequence.
 
-Both donate the cache buffers (the pool is updated in place in HBM) and
-contain no host round-trip between launch and the sampled token ids —
-the only sync is fetching the step's [B] token vector to drive the
-scheduler.  Compiles are bounded by the bucket grids; steady-state
+One scheduler step may launch both: the decode batch first, then each
+scheduled prefill chunk (the scheduler's token budget keeps decodes
+flowing between a long prompt's chunks instead of stalling them).
+Prefix caching rides on the block manager: every page a sequence
+completes is registered under its prefix-chain hash, and admission
+adopts matching pages at zero compute.
+
+Both executables donate the cache buffers (the pool is updated in place
+in HBM) and contain no host round-trip between launch and the sampled
+token ids — the only sync is fetching the step's token vector to drive
+the scheduler.  Compiles are bounded by the bucket grids; steady-state
 serving reuses warm executables regardless of traffic mix.
 """
 
@@ -28,8 +41,8 @@ import jax.numpy as jnp
 
 from ... import profiler
 from ...incubate.nn import _layernorm
-from .block_manager import BlockManager
-from .paged_attention import paged_decode_attention
+from .block_manager import BlockManager, prefix_block_hashes
+from .paged_attention import paged_decode_attention, paged_prefill_attention
 from .scheduler import FINISHED, Request, Scheduler, bucket_size
 
 
@@ -61,7 +74,8 @@ class LLMEngine:
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None,
-                 max_model_len=None, max_batch=8, dtype=None):
+                 max_model_len=None, max_batch=8, dtype=None,
+                 enable_prefix_caching=True, token_budget=64):
         d = model.functional_decompose()
         cfg = model.config
         self.num_layers = d["num_layers"]
@@ -83,15 +97,20 @@ class LLMEngine:
                 f"num_blocks {num_blocks} cannot hold one max_model_len "
                 f"sequence ({self.max_pages} pages)")
         self.num_blocks = int(num_blocks)
+        # one decode token per running sequence must fit in the budget
+        self.token_budget = max(int(token_budget), self.max_batch)
         self.dtype = jnp.dtype(dtype) if dtype else jnp.float32
         cast = (lambda x: jnp.asarray(x, self.dtype)
                 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
                 else jnp.asarray(x))
         self.params = jax.tree_util.tree_map(cast, d["params"])
 
-        self.block_manager = BlockManager(self.num_blocks, self.block_size)
+        self.block_manager = BlockManager(
+            self.num_blocks, self.block_size,
+            enable_prefix_caching=enable_prefix_caching)
         self.scheduler = Scheduler(self.block_manager,
-                                   max_batch=self.max_batch)
+                                   max_batch=self.max_batch,
+                                   token_budget=self.token_budget)
         cache_shape = (self.num_layers, self.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
         self._kc = jnp.zeros(cache_shape, self.dtype)
@@ -101,7 +120,7 @@ class LLMEngine:
         self._next_id = 0
         self._rng = np.random.RandomState(0)
         self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
-                      "tokens_generated": 0}
+                      "chunk_launches": 0, "tokens_generated": 0}
 
         nh, hd, eps = self.num_heads, self.head_dim, self.eps
         nb, bs = self.num_blocks, self.block_size
@@ -135,18 +154,25 @@ class LLMEngine:
             w = params["embed"]["word_embeddings.weight"]
             return x @ w.T.astype(self.dtype)
 
-        def prefill_fn(params, ids, kc, vc, block_table, length):
-            """ids [1, Lb] (prompt padded to the bucket), one sequence.
-            Returns (next_id, last logits, kc, vc)."""
+        def chunk_fn(params, ids, kc, vc, block_table, start, length):
+            """ids [1, Cb] — one sequence's prefill chunk padded to the
+            bucket, occupying absolute positions start..start+length-1.
+            Writes the chunk's K/V through the block table, attends
+            causally over positions 0..start+length-1 THROUGH THE POOL
+            (prior chunks and prefix-cache hits are read back, not
+            recomputed), and returns (next_id, logits at the chunk's
+            last row, kc, vc) — meaningful only for the final chunk."""
             emb = params["embed"]
-            lb = ids.shape[1]
-            pos = jnp.arange(lb)
+            cb = ids.shape[1]
+            tok = jnp.arange(cb)
+            # padded rows past ``length`` clamp to a valid position; their
+            # page writes drop and their outputs are never read
+            pos = jnp.minimum(start + tok, self.max_model_len - 1)
             x = (emb["word_embeddings.weight"][ids]
                  + emb["position_embeddings.weight"][pos][None])
             x = x.astype(self.dtype)
-            tok = jnp.arange(lb)
             slots = jnp.where(tok < length,
-                              block_table[tok // bs] * bs + tok % bs,
+                              block_table[pos // bs] * bs + pos % bs,
                               nb * bs)
 
             def layer(carry, xs):
@@ -155,20 +181,9 @@ class LLMEngine:
                 q, k, v = attn_proj(p_l, x)
                 kc_l = scatter_pages(kc_l, slots, k[0])
                 vc_l = scatter_pages(vc_l, slots, v[0])
-                # prefix cache is empty at prefill: causal attention over
-                # the chunk itself (same formula as _block_chunk; masked
-                # tail positions vanish exactly under the f32 softmax)
-                scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
-                logits = jnp.einsum("bqnd,bknd->bnqk", q,
-                                    k.astype(x.dtype)) * scale
-                causal = (pos[None, :] <= pos[:, None])[None, None]
-                logits = jnp.where(causal, logits,
-                                   jnp.asarray(-1e30, x.dtype))
-                att = jax.nn.softmax(logits.astype(jnp.float32),
-                                     axis=-1).astype(x.dtype)
-                out = jnp.einsum("bnqk,bknd->bqnd", att,
-                                 v.astype(x.dtype))
-                out = out.reshape(1, lb, nh * hd)
+                out = paged_prefill_attention(q, kc_l, vc_l,
+                                              block_table, start)
+                out = out.astype(x.dtype).reshape(1, cb, nh * hd)
                 return mlp_residual(p_l, x, out), (kc_l, vc_l)
 
             x, (kc, vc) = jax.lax.scan(layer, x,
@@ -210,7 +225,7 @@ class LLMEngine:
             logits = head_logits(params, x[:, 0])
             return jnp.argmax(logits, -1), logits, kc, vc
 
-        self._prefill = jax.jit(prefill_fn, donate_argnums=(2, 3))
+        self._chunk = jax.jit(chunk_fn, donate_argnums=(2, 3))
         self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
 
     # ----------------------------------------------------------- requests --
@@ -242,23 +257,24 @@ class LLMEngine:
     def warmup(self):
         """Compile every bucketed executable before traffic arrives.
 
-        No-op on cache contents: the dummy prefill covers zero tokens and
+        No-op on cache contents: the dummy chunk covers zero tokens and
         the dummy decode rows are padding (position -1), so every page
         write lands on the dropped out-of-range slot.  Serving processes
-        call this at startup so no client pays a compile stall.
+        call this at startup so no client pays a compile stall.  The
+        chunk family is O(log token_budget) — prompt length never enters
+        the executable count.
         """
         with profiler.RecordEvent("llm_engine::warmup"):
-            lb = 8
+            cb = min(8, self.token_budget)
             while True:
-                lb = bucket_size(lb, self.max_model_len, floor=8)
-                ids = jnp.zeros((1, lb), jnp.int32)
+                ids = jnp.zeros((1, cb), jnp.int32)
                 table = jnp.zeros(self.max_pages, jnp.int32)
-                _, _, self._kc, self._vc = self._prefill(
+                _, _, self._kc, self._vc = self._chunk(
                     self.params, ids, self._kc, self._vc, table,
-                    jnp.int32(0))
-                if lb >= self.max_model_len:
+                    jnp.int32(0), jnp.int32(0))
+                if cb >= self.token_budget:
                     break
-                lb *= 2
+                cb = min(cb * 2, self.token_budget)
             bb = 1
             while True:
                 ids = jnp.zeros((bb, 1), jnp.int32)
@@ -281,26 +297,9 @@ class LLMEngine:
             return []
         self.stats["steps"] += 1
         finished = []
-        if batch.kind == "prefill":
-            self.stats["prefill_steps"] += 1
-            req = batch.requests[0]
-            tokens = req.all_ids
-            n = len(tokens)
-            lb = bucket_size(n, self.max_model_len, floor=8)
-            ids = np.zeros((1, lb), np.int32)
-            ids[0, :n] = tokens
-            table = np.zeros(self.max_pages, np.int32)
-            bt = self.block_manager.block_table(req.request_id)
-            table[:len(bt)] = bt
-            with profiler.RecordEvent("llm_engine::prefill"):
-                nxt, logits, self._kc, self._vc = self._prefill(
-                    self.params, jnp.asarray(ids), self._kc, self._vc,
-                    jnp.asarray(table), jnp.int32(n))
-            req.num_cached = n
-            self._commit_token(req, nxt, logits, finished)
-        else:
+        reqs = batch.requests
+        if reqs:
             self.stats["decode_steps"] += 1
-            reqs = batch.requests
             bb = bucket_size(len(reqs), self.max_batch)
             ids = np.zeros((bb, 1), np.int32)
             positions = np.full(bb, -1, np.int32)
@@ -320,10 +319,57 @@ class LLMEngine:
                 logits_host = np.asarray(logits)
             for i, r in enumerate(reqs):
                 r.num_cached += 1
+                if r.num_cached % self.block_size == 0:
+                    self._register_full_blocks(r)
                 row_logits = (logits_host[i]
                               if logits_host is not None else None)
                 self._commit_token(r, nxt[i], row_logits, finished)
+        if batch.chunks:
+            self.stats["prefill_steps"] += 1
+        for ch in batch.chunks:
+            self.stats["chunk_launches"] += 1
+            req = ch.request
+            cb = bucket_size(ch.length, self.token_budget, floor=8)
+            ids = np.zeros((1, cb), np.int32)
+            ids[0, :ch.length] = \
+                req.all_ids[ch.start:ch.start + ch.length]
+            table = np.zeros(self.max_pages, np.int32)
+            bt = self.block_manager.block_table(req.request_id)
+            table[:len(bt)] = bt
+            with profiler.RecordEvent("llm_engine::prefill_chunk"):
+                nxt, logits, self._kc, self._vc = self._chunk(
+                    self.params, jnp.asarray(ids), self._kc, self._vc,
+                    jnp.asarray(table), jnp.int32(ch.start),
+                    jnp.int32(ch.length))
+            req.num_cached = ch.start + ch.length
+            self._register_full_blocks(req)
+            if ch.is_final:
+                self._commit_token(req, nxt, logits, finished)
         return finished
+
+    def _register_full_blocks(self, req):
+        """Make every completed full page of ``req`` hash-addressable
+        (register_full_block skips pages that already carry a hash)."""
+        bm = self.block_manager
+        if not bm.enable_prefix_caching:
+            return
+        hashes = prefix_block_hashes(
+            req.all_ids, self.block_size,
+            limit=req.num_cached // self.block_size)
+        for i, h in enumerate(hashes):
+            bm.register_full_block(req.request_id, i, h)
+
+    def prefix_cache_stats(self):
+        """Host-side prefix-cache counters (for benches and tests)."""
+        sch, bm = self.scheduler, self.block_manager
+        hit = sch.prefix_hit_tokens
+        return {"prompt_tokens": sch.prompt_tokens,
+                "prefix_hit_tokens": hit,
+                "hit_rate": hit / sch.prompt_tokens
+                if sch.prompt_tokens else 0.0,
+                "reused_blocks": bm.prefix_reused_blocks,
+                "evictions": bm.prefix_evictions,
+                "cached_blocks": bm.num_cached_blocks}
 
     def _commit_token(self, req, argmax_token, logits, finished):
         if req.temperature > 0.0:

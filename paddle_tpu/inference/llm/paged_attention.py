@@ -1,19 +1,27 @@
-"""Paged decode attention — backend dispatch.
+"""Paged attention — backend dispatch for decode AND chunked prefill.
 
-One signature, two implementations with identical semantics:
+One signature per phase, two implementations with identical semantics:
 
-- TPU: the Pallas kernel (ops/pallas/paged_attention_kernel.py) DMAs
+- TPU: the Pallas kernels (ops/pallas/paged_attention_kernel.py) DMA
   exactly the pages a sequence owns via scalar-prefetched block tables.
 - everywhere else (and under jit on CPU test rigs): gather the pages
-  into the dense ragged layout and run the round-4 masked decode
-  attention — bitwise the same math FusedMultiTransformer's decode hits
-  through the IR pass, which is what makes the engine-vs-dense
-  token-exactness tests meaningful.
+  into the dense ragged layout and run the masked attention — for
+  decode, bitwise the same math FusedMultiTransformer's decode hits
+  through the IR pass; for prefill chunks, bitwise the same masked
+  causal chain FusedMultiTransformer's prefill runs.  That shared math
+  is what makes the engine-vs-dense token-exactness tests meaningful.
 
 Like the ragged kernel, the 1/sqrt(D) scale is applied inside.
+
+Chunked prefill changes what "prefill attention" means: a chunk's
+queries sit at absolute positions [start, start + C) and must see every
+EARLIER token's K/V — prior chunks and prefix-cache hits included — so
+prefill now reads the paged pool through the block table exactly like
+decode does, instead of attending over its own chunk only.
 """
 
 import jax
+import jax.numpy as jnp
 
 from ...framework.flags import get_flags
 from ...ops.pallas import paged_attention_kernel as _kernel
@@ -45,3 +53,46 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
             q, k_pages, v_pages, block_tables, lengths, interpret=interpret)
     return paged_decode_attention_xla(q, k_pages, v_pages, block_tables,
                                       lengths)
+
+
+def paged_prefill_attention_xla(q, k_pages, v_pages, block_table, start):
+    """Masked-XLA fallback for one sequence's prefill chunk.
+
+    q [1, C, Nq, D] at absolute positions start..start+C-1; the chunk's
+    own K/V must already be scattered into the pool.  Gathers the
+    sequence's pages and runs FusedMultiTransformer's masked prefill
+    chain bitwise (same einsum strings, f32 softmax, -1e30 mask), so a
+    chunked prefill reproduces the dense one-shot prefill exactly: the
+    extra gathered positions are masked to exact zeros and contribute
+    nothing.
+    """
+    _, c, n, d = q.shape
+    num_pages = block_table.shape[0]
+    _, bs, nkv, _ = k_pages.shape
+    kk = k_pages[block_table].reshape(1, num_pages * bs, nkv, d)
+    vv = v_pages[block_table].reshape(1, num_pages * bs, nkv, d)
+    if nkv != n:                                 # GQA: expand KV heads
+        kk = jnp.repeat(kk, n // nkv, axis=2)
+        vv = jnp.repeat(vv, n // nkv, axis=2)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    logits = jnp.einsum("bqnd,bknd->bnqk", q, kk.astype(q.dtype)) * scale
+    q_pos = start + jnp.arange(c)[:, None]
+    k_pos = jnp.arange(num_pages * bs)[None, :]
+    mask = (k_pos <= q_pos)[None, None]
+    logits = jnp.where(mask, logits, jnp.asarray(-1e30, q.dtype))
+    att = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknd->bqnd", att, vv.astype(q.dtype))
+
+
+def paged_prefill_attention(q, k_pages, v_pages, block_table, start,
+                            interpret=False):
+    """q [1, C, Nq, D] chunk x paged pool -> [1, C, Nq, D] causal
+    attention over positions 0..start+C-1 through the block table."""
+    _, bs, nkv, d = k_pages.shape
+    if ((_use_pallas() or interpret)
+            and _kernel.prefill_supports(bs, d, q.shape[2], nkv,
+                                         q.shape[1])):
+        return _kernel.paged_prefill_attention_pallas(
+            q, k_pages, v_pages, block_table, start, interpret=interpret)
+    return paged_prefill_attention_xla(q, k_pages, v_pages, block_table,
+                                       start)

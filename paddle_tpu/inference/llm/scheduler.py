@@ -1,23 +1,33 @@
-"""Continuous-batching scheduler.
+"""Continuous-batching scheduler with chunked prefill.
 
 Iteration-level scheduling (Orca / vLLM policy, the serving half of the
-Gemma-on-TPU comparison in arxiv 2605.25645): every engine step is either
-ONE bucketed prefill or ONE bucketed decode over the whole running set,
-requests join and leave the batch between steps, and a sequence that
-cannot get a page is preempted (pages freed, sequence recomputed later)
-rather than deadlocking the pool.
+Gemma-on-TPU comparison in arxiv 2605.25645): requests join and leave
+the batch between steps, and a sequence that cannot get a page is
+preempted (pages freed, sequence recomputed later) rather than
+deadlocking the pool.
+
+Every step schedules against a fixed TOKEN BUDGET: each running decode
+costs one token, and whatever budget remains goes to prefill CHUNKS —
+slices of at most ``token_budget`` prompt tokens.  A long prompt
+therefore spreads across several steps instead of monopolizing one, and
+decodes keep flowing between its chunks (no inter-token latency spike
+while a 4k-token prompt prefills).  Admission consults the prefix cache
+first: pages whose chain hash is already resident are adopted at zero
+compute, so only the un-cached suffix consumes budget.
 
 Shape discipline for XLA: a jitted executable exists per (kind, bucket)
-only — prefill lengths and decode batch sizes are rounded up to
-powers of two capped by the engine limits, so warmup compiles
-O(log(max_batch) + log(max_model_len)) programs and steady state
+only — chunk lengths are bucketed to powers of two capped by the token
+budget (NOT by prompt length: the prefill executable family no longer
+grows with max_model_len) and decode batch sizes to powers of two
+capped by max_batch, so warmup compiles
+O(log(max_batch) + log(token_budget)) programs and steady state
 recompiles nothing.
 """
 
 import time
 from dataclasses import dataclass, field
 
-from .block_manager import NoFreeBlocksError
+from .block_manager import NoFreeBlocksError, prefix_block_hashes
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 
@@ -41,6 +51,7 @@ class Request:
     arrival_time: float = field(default_factory=time.monotonic)
     output_ids: list = field(default_factory=list)
     num_cached: int = 0         # tokens whose K/V sit in the paged cache
+    num_prefill_tokens: int = 0  # prefill target (len(all_ids) at admission)
     num_preemptions: int = 0
     status: str = WAITING
     finish_reason: str = None
@@ -50,23 +61,51 @@ class Request:
         """prompt + generated so far (the recompute unit after preempt)."""
         return list(self.prompt_ids) + self.output_ids
 
+    @property
+    def prefill_done(self):
+        """True once every token known at admission has K/V in the cache
+        (in steady decode the newest token's K/V is written BY the next
+        decode step, so num_cached stays one behind len(all_ids))."""
+        return self.num_cached >= self.num_prefill_tokens
+
+
+@dataclass
+class PrefillChunk:
+    """One slice of one request's prefill: compute K/V for tokens
+    [start, start + length) this step.  The final chunk (start + length
+    == len(all_ids)) also yields the request's next token."""
+    request: object
+    start: int
+    length: int
+
+    @property
+    def is_final(self):
+        return self.start + self.length >= self.request.num_prefill_tokens
+
 
 @dataclass
 class ScheduledBatch:
-    kind: str                   # "prefill" | "decode" | "idle"
-    requests: list
+    kind: str                   # "mixed" | "decode" | "idle"
+    requests: list              # decode rows this step
+    chunks: list = field(default_factory=list)   # PrefillChunks this step
 
 
 class Scheduler:
     """Admission queue + running set + preempt-on-OOM policy."""
 
-    def __init__(self, block_manager, max_batch=8, watermark_blocks=1):
+    def __init__(self, block_manager, max_batch=8, watermark_blocks=1,
+                 token_budget=64):
         self.block_manager = block_manager
         self.max_batch = int(max_batch)
         self.watermark_blocks = int(watermark_blocks)
+        # the budget must cover one decode token per running sequence,
+        # or a full batch would starve every waiting prefill forever
+        self.token_budget = max(int(token_budget), self.max_batch)
         self.waiting = []       # FIFO; preempted sequences rejoin at the head
         self.running = []       # arrival order == preemption priority
         self.num_preemptions = 0
+        self.prefix_hit_tokens = 0
+        self.prompt_tokens = 0
 
     def add(self, request):
         self.waiting.append(request)
@@ -80,31 +119,26 @@ class Scheduler:
 
     # ------------------------------------------------------------ policy --
     def schedule(self):
-        """Pick the next step's work.  Prefill-first: an admissible
-        waiting request beats decoding (first tokens flow early and the
-        batch fills up); the watermark keeps a reserve of pages so a
-        fresh admission can't immediately preempt the running set."""
+        """Pick one step's work: decode every fully-prefilled running
+        sequence (preempting the newest arrival on page OOM), then spend
+        the remaining token budget on prefill chunks — first continuing
+        mid-prefill sequences, then admitting waiting requests whose
+        pages fit (prefix-cached pages are adopted, not recomputed).
+        The watermark keeps a reserve of pages so a fresh admission
+        can't immediately preempt the running set."""
         bm = self.block_manager
-        if self.waiting and len(self.running) < self.max_batch:
-            req = self.waiting[0]
-            margin = self.watermark_blocks if self.running else 0
-            if bm.can_allocate(len(req.all_ids), margin=margin):
-                self.waiting.pop(0)
-                bm.allocate(req.request_id, len(req.all_ids))
-                req.status = RUNNING
-                self.running.append(req)
-                return ScheduledBatch("prefill", [req])
+        budget = self.token_budget
+        decodes, chunks = [], []
 
-        if not self.running:
-            return ScheduledBatch("idle", [])
-
-        # decode: every running sequence needs one slot for its new token
-        scheduled = []
+        # -- decode phase: one slot per fully-prefilled running sequence
         i = 0
         while i < len(self.running):
             req = self.running[i]
+            if not req.prefill_done:
+                i += 1
+                continue        # mid-prefill: the chunk phase feeds it
             try:
-                self.block_manager.append_slot(req.request_id)
+                bm.append_slot(req.request_id)
             except NoFreeBlocksError:
                 victim = self.running[-1]
                 if victim is req and len(self.running) == 1:
@@ -112,14 +146,61 @@ class Scheduler:
                         "KV cache cannot hold a single sequence — "
                         "raise num_blocks or lower max_model_len")
                 self._preempt(victim)
-                continue            # retry req (or fall off the end)
-            scheduled.append(req)
+                continue        # retry req (or fall off the end)
+            decodes.append(req)
+            budget -= 1
             i += 1
-        return ScheduledBatch("decode", scheduled)
+
+        # -- chunk phase: continue sequences already mid-prefill
+        for req in self.running:
+            if budget <= 0:
+                break
+            if req.prefill_done:
+                continue
+            n = len(req.all_ids)
+            c = min(budget, n - req.num_cached)
+            chunks.append(PrefillChunk(req, req.num_cached, c))
+            budget -= c
+
+        # -- admission: waiting requests, prefix cache consulted first
+        while (self.waiting and len(self.running) < self.max_batch
+               and budget > 0):
+            req = self.waiting[0]
+            n = len(req.all_ids)
+            # at least the last token must be computed (its logits seed
+            # the first generated token), so cap reuse at n-1 tokens
+            hashes = prefix_block_hashes(
+                req.all_ids, bm.block_size,
+                limit=(n - 1) // bm.block_size)
+            k = bm.match_prefix(hashes)
+            margin = self.watermark_blocks if self.running else 0
+            if not bm.can_allocate(n, margin=margin,
+                                   cached_hashes=hashes[:k]):
+                break
+            self.waiting.pop(0)
+            bm.allocate(req.request_id, n, cached_hashes=hashes[:k])
+            req.num_cached = k * bm.block_size
+            req.num_prefill_tokens = n
+            req.status = RUNNING
+            self.running.append(req)
+            self.prompt_tokens += n
+            self.prefix_hit_tokens += req.num_cached
+            c = min(budget, n - req.num_cached)
+            chunks.append(PrefillChunk(req, req.num_cached, c))
+            budget -= c
+
+        if chunks:
+            return ScheduledBatch("mixed", decodes, chunks)
+        if decodes:
+            return ScheduledBatch("decode", decodes)
+        return ScheduledBatch("idle", [])
 
     def _preempt(self, victim):
         """Recompute-style preemption: drop the pages, queue the sequence
-        (prompt + generated so far) for a fresh prefill."""
+        (prompt + generated so far) for a fresh prefill.  With prefix
+        caching on, the dropped pages stay hash-addressable until memory
+        pressure actually evicts them, so the recompute usually re-adopts
+        most of its own work."""
         self.running.remove(victim)
         self.block_manager.free(victim.request_id)
         victim.num_cached = 0

@@ -122,3 +122,112 @@ def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables,
     )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
       qg, k_pages, v_pages)
     return out.reshape(b, nq, d)
+
+
+# --------------------------------------------------------------- prefill --
+def prefill_supports(block_size, head_dim, num_q_heads, num_kv_heads,
+                     chunk):
+    """Chunked-prefill kernel constraints: on top of the decode gates,
+    the [C*G, D] query tile must satisfy the f32 (8, 128) minimum."""
+    if not supports(block_size, head_dim, num_q_heads, num_kv_heads):
+        return False
+    g = num_q_heads // num_kv_heads
+    return (chunk * g) % 8 == 0
+
+
+def _prefill_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
+                    o_scr, m_scr, l_scr, *, block_size, group):
+    """One (kv_head, page) program for ONE sequence's prefill chunk.
+
+    The chunk's C queries sit at absolute positions start..start+C-1
+    (``start`` rides in as a scalar-prefetch operand so it can be a
+    traced value under jit); row r of the [C*G, D] query tile belongs to
+    query index r // group, and the causal mask admits key position
+    k iff k <= start + r // group.  Pages past the chunk's last query
+    hold no visible keys and are skipped outright.
+    """
+    p = pl.program_id(1)
+    num_pages = pl.num_programs(1)
+    cg, d = q_ref.shape[1], q_ref.shape[2]
+    start = meta_ref[0]
+
+    @pl.when(p == 0)
+    def _init():
+        o_scr[...] = jnp.zeros_like(o_scr)
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+
+    base = p * block_size
+
+    @pl.when(base < start + cg // group)
+    def _accumulate():
+        q = q_ref[0].astype(jnp.float32)                    # [CG, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+        s = q @ k.T / jnp.sqrt(jnp.asarray(d, jnp.float32))  # [CG, bs]
+        kpos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (cg, block_size), 1)
+        qpos = start + jax.lax.broadcasted_iota(
+            jnp.int32, (cg, block_size), 0) // group
+        s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev, l_prev, o_prev = m_scr[...], l_scr[...], o_scr[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        pe = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        o_scr[...] = o_prev * alpha + pe @ v
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + pe.sum(axis=1, keepdims=True)
+
+    @pl.when(p == num_pages - 1)
+    def _finalize():
+        # every row sees at least key position 0 (qpos >= start >= 0),
+        # so l > 0 always; the maximum is belt-and-braces
+        o_ref[0] = (o_scr[...]
+                    / jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
+
+
+def paged_prefill_attention_pallas(q, k_pages, v_pages, block_table,
+                                   start, interpret=False):
+    """Causal attention for one sequence's prefill chunk through its
+    block table.
+
+    q [1, C, Nq, D] at absolute positions start..start+C-1 (K/V for the
+    chunk itself already scattered into the pool); returns
+    [1, C, Nq, D].  Grid (Nkv, P) with the page axis innermost so the
+    VMEM scratch carries the online softmax across the sequence's pages.
+    """
+    _, c, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    g = nq // nkv
+    num_pages = block_table.shape[0]
+    # [C, Nkv, G, D] -> [Nkv, C*G, D]: row r of head j is query r // G
+    qg = q[0].reshape(c, nkv, g, d).transpose(1, 0, 2, 3)
+    qg = qg.reshape(nkv, c * g, d)
+    meta = jnp.asarray(start, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(nkv, num_pages),
+        in_specs=[
+            pl.BlockSpec((1, c * g, d), lambda j, p, bt, mt: (j, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda j, p, bt, mt: (bt[p], 0, j, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda j, p, bt, mt: (bt[p], 0, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, c * g, d),
+                               lambda j, p, bt, mt: (j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((c * g, d), jnp.float32),
+            pltpu.VMEM((c * g, 1), jnp.float32),
+            pltpu.VMEM((c * g, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, block_size=bs, group=g),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nkv, c * g, d), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), meta, qg, k_pages, v_pages)
+    return out.reshape(nkv, c, g, d).transpose(1, 0, 2, 3).reshape(
+        1, c, nq, d)

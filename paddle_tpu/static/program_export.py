@@ -1097,11 +1097,17 @@ class _Exporter:
             raise NotImplementedError(
                 "a constant spanning the dynamic batch extent feeds a "
                 "shape-sensitive op; export with a concrete batch size")
-        return self._new_out(
-            shape, dt, "fill_constant", {},
-            [("shape", "longs", [int(d) for d in shape]),
-             ("value", "f", float(lit.val)),
-             ("dtype", "i", code)])
+        attrs = [("shape", "longs", [int(d) for d in shape]),
+                 ("value", "f", float(lit.val)),
+                 ("dtype", "i", code)]
+        if np.issubdtype(np.dtype(dt), np.integer) or \
+                np.dtype(dt) == np.bool_:
+            # the float32 `value` attr holds < 25 bits of mantissa; an
+            # int literal above 2^24 would round.  The reference runtime
+            # gives the string attr precedence, so carry the exact value
+            # there (bool rides along as 0/1).
+            attrs.append(("str_value", "s", str(int(lit.val))))
+        return self._new_out(shape, dt, "fill_constant", {}, attrs)
 
     def as_ref(self, atom):
         """The operand as a program var: pending broadcasts force, and

@@ -377,9 +377,27 @@ def _reshape2(ins, attrs):
             "reshape2 without a shape attr is not translated")
     x = ins["X"]
     if 0 in shape:   # 0 = copy the corresponding input dim
+        if any(d == 0 and i >= x.ndim for i, d in enumerate(shape)):
+            # reference InferShape rejects this; fabricating a size-1
+            # dim here would silently diverge from the runtime
+            raise ValueError(
+                f"reshape2: shape attr {list(shape)} uses 0 (copy input "
+                f"dim) at an index >= input rank {x.ndim}")
         shape = [s if d == 0 else d
                  for d, s in zip(shape, list(x.shape) + [1] * len(shape))]
     return x.reshape(shape)
+
+
+def _fill_constant(ins, attrs):
+    dt = _DTYPES.get(attrs.get("dtype", 5), np.float32)
+    val = attrs.get("value", 0.0)
+    sv = attrs.get("str_value", "")
+    if sv:
+        # reference semantics: the exact string attr wins over the
+        # float32 `value`, which rounds integers above 2^24
+        val = float(sv) if np.issubdtype(np.dtype(dt), np.floating) \
+            else int(sv)
+    return jnp.full(attrs.get("shape", []), val, dt)
 
 
 def _cat(fn, ins, attrs):
@@ -594,10 +612,7 @@ _TRANSLATORS = {
         _DTYPES.get(attrs.get("out_dtype", 5), np.float32)),
     "shape": lambda ins, attrs: jnp.asarray(ins["Input"].shape,
                                             jnp.int32),
-    "fill_constant": lambda ins, attrs: jnp.full(
-        attrs.get("shape", []),
-        attrs.get("value", 0.0),
-        _DTYPES.get(attrs.get("dtype", 5), np.float32)),
+    "fill_constant": _fill_constant,
     "assign": lambda ins, attrs: ins["X"],
     "lookup_table_v2": lambda ins, attrs: ins["W"][ins["Ids"]],
     "reduce_mean": _reduce(jnp.mean),

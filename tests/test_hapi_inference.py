@@ -187,3 +187,30 @@ def test_predictor_multi_feed_binds_by_name(tmp_path):
     out = predictor.get_output_handle(
         predictor.get_output_names()[0]).copy_to_cpu()
     np.testing.assert_allclose(out, a - b, rtol=1e-6)
+
+
+def test_predictor_run_with_no_filled_feeds_raises(tmp_path):
+    """run() with declared feeds but ZERO filled handles used to slip
+    past the missing-feeds check (`missing and filled` is False when
+    nothing is filled) and call forward with no args; it must raise the
+    same actionable error as a partial fill."""
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(0)
+    net = Net()
+    net.eval()
+    prefix = str(tmp_path / "nofeed_model")
+    paddle.static.save_inference_model(
+        prefix, [InputSpec([None, 8], name="x")], net)
+    predictor = create_predictor(Config(prefix))
+    assert predictor.get_input_names() == ["x"]
+    with pytest.raises(ValueError, match="copy_from_cpu"):
+        predictor.run()
+    # filling the feed afterwards recovers the normal handle-style path
+    x = np.random.RandomState(6).randn(2, 8).astype("float32")
+    predictor.get_input_handle("x").copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(
+        predictor.get_output_names()[0]).copy_to_cpu()
+    want = net(paddle.to_tensor(x)).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)

@@ -427,6 +427,62 @@ class TestRound5Hardening:
         np.testing.assert_allclose(np.asarray(remote.pull(keys)),
                                    [[-2.0] * 4], rtol=1e-6)
 
+    def test_admit_fully_releases_lock_for_reentrant_callers(self):
+        """A caller already holding cache._lock (re-entrant RLock, depth
+        2 inside _pull_locked) must not keep the lock pinned across the
+        admission RPC: _admit's old bare release()/acquire() popped ONE
+        level, so the lock stayed held for the whole RTT and any thread
+        waiting on it (e.g. the async-flush refresh) deadlocked against
+        a stalled remote.  The stub remote blocks its pull() until a
+        helper thread actually acquires cache._lock — old code times
+        out, the full-exit restructure lets it through."""
+        import threading
+
+        rpc_started = threading.Event()
+        got_lock = threading.Event()
+
+        class BlockingTable(SparseTable):
+            """pull() stalls until another thread proves it can take
+            the cache lock mid-RPC."""
+
+            def pull(self, keys):
+                rpc_started.set()
+                assert got_lock.wait(5.0), \
+                    "cache._lock still held during the admission RPC"
+                return super().pull(keys)
+
+        lr = 0.1
+        remote = BlockingTable(dim=4, optimizer="sgd", learning_rate=lr,
+                               init_range=0.01, seed=11)
+        baseline = SparseTable(dim=4, optimizer="sgd", learning_rate=lr,
+                               init_range=0.01, seed=11)
+        cache = HotRowCache(remote, optimizer="sgd", learning_rate=lr,
+                            capacity=16)
+
+        def contender():
+            rpc_started.wait(5.0)
+            if cache._lock.acquire(timeout=5.0):
+                cache._lock.release()
+                got_lock.set()
+
+        t = threading.Thread(target=contender, daemon=True)
+        t.start()
+        keys = np.arange(6, dtype=np.int64)
+        with cache._lock:                 # re-entrant caller, depth 2+
+            rows = np.asarray(cache.pull(keys))
+        t.join(10.0)
+        assert not t.is_alive()
+        assert got_lock.is_set()
+        # the fetch itself stayed exact, and state is coherent after
+        np.testing.assert_allclose(rows, np.asarray(baseline.pull(keys)),
+                                   rtol=1e-6)
+        g = np.full((len(keys), 4), 0.5, np.float32)
+        cache.push(keys, g)
+        baseline.push(keys, g, learning_rate=lr)
+        np.testing.assert_allclose(np.asarray(cache.pull(keys)),
+                                   np.asarray(baseline.pull(keys)),
+                                   rtol=1e-6)
+
     def test_pathological_duplicate_key_high_occupancy(self):
         """One hot key repeated 64x in a single push: 64 adagrad rounds
         must match the host table's sequential application exactly, and

@@ -111,23 +111,58 @@ class TestScheduler:
         return Request(request_id=rid, prompt_ids=tuple(range(n_prompt)),
                        max_new_tokens=max_new)
 
-    def test_prefill_first_then_decode(self):
+    @staticmethod
+    def _run_chunks(batch):
+        """Do the engine's part: mark every scheduled chunk computed."""
+        for c in batch.chunks:
+            c.request.num_cached = c.start + c.length
+
+    def test_admit_chunks_then_decode(self):
         sched, bm = self._mk()
         sched.add(self._req(0, 5))
         sched.add(self._req(1, 3))
-        b = sched.schedule()
-        assert b.kind == "prefill" and b.requests[0].request_id == 0
-        b = sched.schedule()
-        assert b.kind == "prefill" and b.requests[0].request_id == 1
+        b = sched.schedule()                # both fit in one budget
+        assert b.kind == "mixed" and not b.requests
+        assert [(c.request.request_id, c.start, c.length)
+                for c in b.chunks] == [(0, 0, 5), (1, 0, 3)]
+        assert all(c.is_final for c in b.chunks)
+        self._run_chunks(b)
         b = sched.schedule()                # batch full -> decode both
         assert b.kind == "decode" and len(b.requests) == 2
         assert bm.num_tokens(0) == 6 and bm.num_tokens(1) == 4
+
+    def test_long_prompt_chunks_and_mixes_with_decodes(self):
+        from paddle_tpu.inference.llm import BlockManager, Scheduler
+
+        bm = BlockManager(16, 4)
+        sched = Scheduler(bm, max_batch=2, token_budget=4)
+        sched.add(self._req(0, 4))
+        b = sched.schedule()
+        assert b.kind == "mixed" and b.chunks[0].is_final
+        self._run_chunks(b)
+        sched.add(self._req(1, 10))
+        # the 10-token prompt spreads over several steps, one decode for
+        # request 0 riding along in each (no inter-token latency spike)
+        expect = [(0, 3), (3, 3), (6, 3), (9, 1)]
+        for i, (start, length) in enumerate(expect):
+            b = sched.schedule()
+            assert b.kind == "mixed"
+            assert [r.request_id for r in b.requests] == [0]
+            c = b.chunks[0]
+            assert (c.start, c.length) == (start, length)
+            assert c.is_final == (i == len(expect) - 1)
+            self._run_chunks(b)
+        b = sched.schedule()
+        assert b.kind == "decode" and len(b.requests) == 2
 
     def test_admission_respects_pool_and_batch(self):
         sched, bm = self._mk(num_blocks=3, max_batch=4)
         sched.add(self._req(0, 8))          # 2 pages
         sched.add(self._req(1, 8))          # needs 2, only 1 free + margin
-        assert sched.schedule().kind == "prefill"
+        b = sched.schedule()
+        assert b.kind == "mixed" and len(b.chunks) == 1
+        assert b.chunks[0].request.request_id == 0
+        self._run_chunks(b)
         b = sched.schedule()                # cannot admit -> decode
         assert b.kind == "decode" and len(b.requests) == 1
         assert sched.waiting[0].request_id == 1
@@ -136,8 +171,9 @@ class TestScheduler:
         sched, bm = self._mk(num_blocks=5, block_size=4, max_batch=2)
         sched.add(self._req(0, 8))          # 2 pages, page-aligned
         sched.add(self._req(1, 8))          # 2 pages, page-aligned
-        assert sched.schedule().kind == "prefill"
-        assert sched.schedule().kind == "prefill"
+        b = sched.schedule()
+        assert b.kind == "mixed" and len(b.chunks) == 2
+        self._run_chunks(b)
         # both need a fresh page for token 9 but only one page is free:
         # the earlier arrival gets it, the later one is preempted
         b = sched.schedule()
@@ -231,7 +267,9 @@ class TestEngineTokenExact:
         for out, ref in zip(outs, refs):
             np.testing.assert_array_equal(out, ref)
         assert eng.block_manager.num_free_blocks == eng.num_blocks
-        assert eng.stats["prefill_steps"] == 3
+        # one mixed step admits all three prompts as three chunks
+        assert eng.stats["chunk_launches"] == 3
+        assert eng.stats["prefill_steps"] == 1
 
     def test_staggered_arrivals_trace(self):
         from paddle_tpu.inference.llm import LLMEngine
@@ -329,6 +367,144 @@ class TestEngineTokenExact:
 
 
 # ---------------------------------------------------------------------------
+class TestPrefixCaching:
+    """Automatic prefix caching + chunked prefill: shared prefixes are
+    adopted (not recomputed) with bit-identical outputs, full cached
+    pages survive fork/COW untouched, eviction reclaims LRU pages under
+    pressure, and chunked traces leak nothing."""
+
+    def test_shared_prefix_token_exact_with_cache_hits(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(6)
+        prefix = rng.randint(0, 128, (24,)).astype(np.int32)  # 3 pages
+        p1 = np.concatenate([prefix, rng.randint(0, 128, (4,))
+                             .astype(np.int32)])
+        p2 = np.concatenate([prefix, rng.randint(0, 128, (6,))
+                             .astype(np.int32)])
+        cold = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
+                         enable_prefix_caching=False)
+        refs = [cold.generate([p], max_new_tokens=8)[0] for p in (p1, p2)]
+        assert cold.prefix_cache_stats()["prefix_hit_tokens"] == 0
+
+        warm = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64)
+        out1 = warm.generate([p1], max_new_tokens=8)[0]
+        launches_before = warm.stats["chunk_launches"]
+        out2 = warm.generate([p2], max_new_tokens=8)[0]
+        np.testing.assert_array_equal(out1, refs[0])
+        np.testing.assert_array_equal(out2, refs[1])
+        st = warm.prefix_cache_stats()
+        # p2 adopted p1's three full prefix pages at zero compute ...
+        assert st["prefix_hit_tokens"] == 24
+        assert st["reused_blocks"] == 3
+        assert st["hit_rate"] > 0.3
+        # ... so its whole prefill was ONE chunk (the 6-token suffix)
+        assert warm.stats["chunk_launches"] - launches_before == 1
+        assert warm.block_manager.num_free_blocks == warm.num_blocks
+
+    def test_cow_never_touches_cached_full_page(self):
+        from paddle_tpu.inference.llm import (
+            BlockManager,
+            prefix_block_hashes,
+        )
+
+        bm = BlockManager(num_blocks=8, block_size=4,
+                          enable_prefix_caching=True)
+        toks = list(range(6))               # page 0 full, page 1 partial
+        bm.allocate("a", 6)
+        h0 = prefix_block_hashes(toks, 4)[0]
+        bm.register_full_block("a", 0, h0)
+        cached_page = bm.block_table("a")[0]
+        bm.fork("a", "b")
+        # the child's divergent append copies the shared PARTIAL tail;
+        # the hashed full page stays shared and untouched
+        slot, cow = bm.append_slot("b")
+        assert cow is not None
+        src, dst = cow
+        assert src == bm.block_table("a")[1]
+        assert dst == bm.block_table("b")[1]
+        assert bm.block_table("a")[0] == cached_page
+        assert bm.block_table("b")[0] == cached_page
+        # both owners gone: the cached page parks on the LRU list and a
+        # later request adopts THE SAME physical page
+        bm.free("a")
+        bm.free("b")
+        assert bm.num_free_blocks == 8 and bm.num_cached_blocks == 1
+        t = bm.allocate("c", 5, cached_hashes=(h0,))
+        assert t[0] == cached_page
+        assert bm.prefix_reused_blocks == 1
+
+    def test_eviction_under_pressure(self):
+        from paddle_tpu.inference.llm import (
+            BlockManager,
+            NoFreeBlocksError,
+            prefix_block_hashes,
+        )
+
+        bm = BlockManager(num_blocks=4, block_size=4,
+                          enable_prefix_caching=True)
+        toks = list(range(16))
+        hs = prefix_block_hashes(toks, 4)
+        bm.allocate("a", 16)
+        for i, h in enumerate(hs):
+            bm.register_full_block("a", i, h)
+        bm.free("a")
+        # the whole pool is cached-but-unreferenced: still fully free
+        assert bm.num_free_blocks == 4 and bm.num_cached_blocks == 4
+        # a fresh allocation evicts the least-recently-freed pages
+        bm.allocate("b", 8)
+        assert bm.prefix_evictions == 2 and bm.num_cached_blocks == 2
+        # the evicted leading pages break the chain for a full match ...
+        assert bm.match_prefix(hs) == 0
+        # ... but a surviving page is still adoptable (1 adopt + 1 evict)
+        bm.allocate("c", 8, cached_hashes=(hs[2],))
+        assert bm.prefix_reused_blocks == 1
+        assert bm.prefix_evictions == 3
+        assert bm.num_free_blocks == 0
+        with pytest.raises(NoFreeBlocksError):
+            bm.allocate("d", 4)
+
+    def test_chunked_prefill_trace_token_exact_no_leaks(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (40, 28)]
+        refs = _fmt_reference(m, prompts, max_new=8)
+        # budget 16 << the 40-token prompt: prefill spreads over several
+        # steps as chunks (16, 16, 8) with decodes riding along
+        eng = LLMEngine(m, block_size=8, max_batch=2, max_model_len=64,
+                        token_budget=16)
+        outs = eng.generate(prompts, max_new_tokens=8)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.stats["chunk_launches"] >= 5
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_warmup_family_covers_serving_no_new_compiles(self):
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
+                        token_budget=16)
+        eng.warmup()
+        chunk_c = eng._chunk._cache_size()
+        decode_c = eng._decode._cache_size()
+        # chunk family is O(log token_budget): buckets 8, 16
+        assert chunk_c == 2
+        rng = np.random.RandomState(8)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (3, 17, 40, 9)]
+        eng.generate(prompts, max_new_tokens=8)
+        # the serving window compiled NOTHING: every chunk bucket and
+        # decode batch bucket was covered by warmup
+        assert eng._chunk._cache_size() == chunk_c
+        assert eng._decode._cache_size() == decode_c
+
+
+# ---------------------------------------------------------------------------
 class TestServingDelegation:
     """PredictorServer(engine=...) serves generation over the socket
     protocol; concurrent connections batch inside the engine."""
@@ -387,6 +563,36 @@ class TestServingDelegation:
 
         with pytest.raises(ValueError, match="exactly one"):
             PredictorServer()
+
+
+# ---------------------------------------------------------------------------
+def test_shared_prefix_bench_smoke():
+    """benchmarks/bench_serving.py --shared-prefix runs end to end on
+    tiny parameters, emits parseable JSON, and actually hits the prefix
+    cache (throughput/TTFT claims are the slow-tier / PERF.md job —
+    at this scale the numbers are noise, only the plumbing is tested)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    rc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmarks", "bench_serving.py"),
+         "--shared-prefix", "--requests", "4", "--prefix-len", "16",
+         "--max-new", "4", "--max-batch", "2"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert rc.returncode == 0, rc.stderr[-1500:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "llm_serving_shared_prefix"
+    assert row["value"] > 0
+    assert row["vs_baseline"] is not None
+    assert row["hit_rate"] > 0.3
+    assert row["reused_blocks"] > 0
+    assert row["preemptions"] == 0
 
 
 # ---------------------------------------------------------------------------

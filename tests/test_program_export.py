@@ -462,6 +462,38 @@ class TestRound5NewHandlers:
                                    rtol=1e-6)
 
 
+class TestIntLiteralPrecision:
+    def test_big_int_literal_exports_exact_str_value(self, tmp_path):
+        """fill_constant's float32 `value` attr rounds ints above 2^24;
+        the exporter must carry the exact integer in `str_value` (which
+        the reference runtime gives precedence) and the importer must
+        honor it."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.core.tensor import Tensor
+
+        big = 16777217                       # 2**24 + 1: f32 rounds it
+
+        class IntCount(nn.Layer):
+            def forward(self, x):
+                c = jnp.cumsum(jnp.full_like(
+                    x._data.astype(jnp.int32), big), axis=1)
+                return Tensor(x._data + c.astype(jnp.float32))
+
+        prefix, ops, prog, _, _ = _roundtrip(
+            tmp_path, IntCount(), [InputSpec([2, 3])])
+        assert "fill_constant" in ops
+        fc = [o for o in parse_program(
+            open(f"{prefix}.pdmodel", "rb").read())[0]
+            if o.type == "fill_constant"][0]
+        assert fc.attrs["str_value"] == str(big)
+        (out,) = prog(paddle.to_tensor(np.zeros((2, 3), F32)))
+        # the third partial sum differs by 4 ulps if the literal rounded
+        want = np.cumsum(np.full((2, 3), big, np.int64), 1) \
+            .astype(np.float32)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), want)
+
+
 class TestRound5ControlFlowExport:
     def test_cond_roundtrip(self, tmp_path):
         """static.cond compiles to lax.cond, which now exports as the

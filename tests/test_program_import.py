@@ -493,6 +493,57 @@ class TestReviewRegressions:
             prog(paddle.to_tensor(np.zeros((4, 2), F32)))
 
 
+class TestRound6Regressions:
+    def test_fill_constant_str_value_wins_over_float(self, tmp_path):
+        """Reference exports carry exact integers in `str_value`; the
+        lossy float32 `value` (here pre-rounded to 2^24) must lose."""
+        feeds, fetches = feed_fetch([], ["y"])
+        ops = feeds + [op("fill_constant", {}, {"Out": ["y"]},
+                          [attr("shape", 11, longs=[2]),
+                           attr("value", 1, f=16777216.0),
+                           attr("str_value", 2, s="16777217"),
+                           attr("dtype", 0, i=2)])] + fetches
+        prefix = write_model(tmp_path, "fc", ops, [], {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        (out,) = prog()
+        assert np.asarray(out.numpy()).tolist() == [16777217, 16777217]
+
+    def test_fill_constant_without_str_value_unchanged(self, tmp_path):
+        feeds, fetches = feed_fetch([], ["y"])
+        ops = feeds + [op("fill_constant", {}, {"Out": ["y"]},
+                          [attr("shape", 11, longs=[3]),
+                           attr("value", 1, f=2.5),
+                           attr("dtype", 0, i=5)])] + fetches
+        prefix = write_model(tmp_path, "fcf", ops, [], {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        (out,) = prog()
+        np.testing.assert_array_equal(np.asarray(out.numpy()),
+                                      np.full(3, 2.5, F32))
+
+    def test_reshape2_zero_dim_copies_input_dim(self, tmp_path):
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("reshape2", {"X": ["x"]}, {"Out": ["y"]},
+                          [attr("shape", 3, ints=[0, 6])])] + fetches
+        prefix = write_model(tmp_path, "rs", ops, [var("x", [2, 2, 3])],
+                             {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        (out,) = prog(paddle.to_tensor(np.zeros((2, 2, 3), F32)))
+        assert np.asarray(out.numpy()).shape == (2, 6)
+
+    def test_reshape2_zero_dim_past_input_rank_raises(self, tmp_path):
+        """A `0` (copy input dim) at an index >= x.ndim is rejected by
+        reference InferShape — fabricating a size-1 dim would silently
+        diverge from the runtime."""
+        feeds, fetches = feed_fetch(["x"], ["y"])
+        ops = feeds + [op("reshape2", {"X": ["x"]}, {"Out": ["y"]},
+                          [attr("shape", 3, ints=[4, 1, 0])])] + fetches
+        prefix = write_model(tmp_path, "rsbad", ops, [var("x", [2, 2])],
+                             {})
+        prog, _, _ = paddle.static.load_inference_model(prefix)
+        with pytest.raises(ValueError, match="reshape2.*input rank"):
+            prog(paddle.to_tensor(np.zeros((2, 2), F32)))
+
+
 def test_supported_op_inventory():
     ops = supported_ops()
     assert len(ops) >= 45, len(ops)
