@@ -21,16 +21,27 @@ throughput ratio, both TTFT p50s, and the measured cache hit rate —
 the adopted prefix pages skip their prefill compute entirely, so both
 throughput and time-to-first-token should win.
 
+``--tp N`` replays the trace on a TENSOR-PARALLEL engine (params and
+the paged KV pool sharded over N devices; on a CPU-only host the bench
+forces N virtual host devices before the backend initializes) and on a
+single-device engine, reports the throughput ratio, and asserts the TP
+replay is token-exact against the single-device one.  ``--artifact``
+additionally writes a MULTICHIP-style JSON file so the round harness
+records TP serving alongside the training dryruns.
+
 Prints ONE JSON line (bench.py convention).
 
 Usage: python benchmarks/bench_serving.py [--requests 32 --rate 256
         --max-new 24 --max-batch 8 --no-baseline]
        python benchmarks/bench_serving.py --shared-prefix
         [--requests 64 --prefix-len 256 --max-new 16]
+       python benchmarks/bench_serving.py --tp 2
+        [--artifact MULTICHIP_serving.json]
 """
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -39,8 +50,27 @@ sys.path.insert(0, ".")
 import numpy as np
 
 
+def _force_device_count(n):
+    """Make >= n devices visible BEFORE the jax backend initializes.
+
+    Newer jax exposes a config knob; older ones only honor the XLA
+    flag, which must be in the environment before first device use
+    (importing jax is fine, touching jax.devices() is not).  Only
+    meaningful on CPU-only hosts — on a real multichip platform the
+    host-platform flag changes nothing.
+    """
+    import jax
+
+    try:
+        jax.config.update("jax_num_cpu_devices", int(n))
+    except AttributeError:
+        flags = os.environ.get("XLA_FLAGS", "")
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={int(n)}")
+
+
 def _build_engine(max_batch, seed=0, max_model_len=64,
-                  prefix_caching=True, token_budget=64):
+                  prefix_caching=True, token_budget=64, tp=1):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.models.gpt import gpt_tiny
@@ -51,7 +81,8 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
     return LLMEngine(m, block_size=8, max_batch=max_batch,
                      max_model_len=max_model_len,
                      enable_prefix_caching=prefix_caching,
-                     token_budget=token_budget)
+                     token_budget=token_budget,
+                     tensor_parallel=tp if tp > 1 else None)
 
 
 def _trace(n_requests, rate, max_new, seed=0):
@@ -93,6 +124,7 @@ def run(engine, arrivals, prompts, new_tokens):
     last_token_at = {}               # rid -> time of its previous token
     gen_counts = {}                  # rid -> tokens seen so far
     total_tokens_done = [0]          # tokens of already-finished requests
+    outputs = {}                     # request index -> full token ids
     ttfts, gaps = [], []
     done = 0
     while done < len(prompts):
@@ -107,6 +139,8 @@ def run(engine, arrivals, prompts, new_tokens):
         finished = engine.step()
         t_step = time.perf_counter() - t0
         done += len(finished)
+        for fo in finished:
+            outputs[rid_to_idx[fo.request_id]] = fo.all_ids.tolist()
         # credit token timestamps at step granularity: each live request
         # grew by at most one token this step
         fin_lens = {fo.request_id: len(fo.output_ids) for fo in finished}
@@ -144,6 +178,7 @@ def run(engine, arrivals, prompts, new_tokens):
         else None,
         "preemptions": engine.scheduler.num_preemptions,
         "prefix_cache": engine.prefix_cache_stats(),
+        "outputs": outputs,
     }
 
 
@@ -166,10 +201,24 @@ def main():
                          "the same engine with prefix caching OFF")
     ap.add_argument("--prefix-len", type=int, default=256,
                     help="shared system prompt length (tokens)")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: shard the engine over "
+                         "this many devices (forced virtual CPU devices "
+                         "on a single-chip host)")
+    ap.add_argument("--token-budget", type=int, default=64,
+                    help="scheduler token budget per step")
+    ap.add_argument("--artifact", default=None,
+                    help="with --tp: also write a MULTICHIP-style JSON "
+                         "artifact to this path")
     args = ap.parse_args()
+
+    if args.tp > 1:
+        _force_device_count(args.tp)
 
     import jax
 
+    if args.tp > 1:
+        return _main_tp(args, jax)
     if args.shared_prefix:
         return _main_shared_prefix(args, jax)
 
@@ -199,6 +248,58 @@ def main():
         "backend": jax.default_backend(),
         "config": "gpt_tiny 2L block_size=8 max_model_len=64",
     }))
+
+
+def _main_tp(args, jax):
+    """Replay the trace tensor-parallel and single-device; assert the
+    TP engine is token-exact, report the throughput ratio, and emit the
+    MULTICHIP-style artifact (same shape the training dryruns record)."""
+    n_dev = len(jax.devices())
+    if n_dev < args.tp:
+        raise SystemExit(
+            f"--tp {args.tp} needs {args.tp} devices, found {n_dev}")
+
+    arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
+                                           args.max_new, args.seed)
+    eng = _build_engine(args.max_batch, args.seed,
+                        token_budget=args.token_budget, tp=args.tp)
+    res = run(eng, arrivals, prompts, new_tokens)
+
+    base = _build_engine(args.max_batch, args.seed,
+                         token_budget=args.token_budget)
+    base_res = run(base, arrivals, prompts, new_tokens)
+    vs_single = res["tokens_per_s"] / base_res["tokens_per_s"]
+    token_exact = res["outputs"] == base_res["outputs"]
+
+    row = {
+        "metric": "llm_serving_tp",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "tp": args.tp,
+        "vs_single_device": round(vs_single, 3),
+        "token_exact": token_exact,
+        "p50_token_ms": round(res["p50_token_ms"], 2),
+        "ttft_p50_ms": round(res["ttft_p50_ms"], 2),
+        "requests": args.requests,
+        "preemptions": res["preemptions"],
+        "max_batch": args.max_batch,
+        "backend": jax.default_backend(),
+        "n_devices": n_dev,
+        "config": "gpt_tiny 2L block_size=8 max_model_len=64",
+    }
+    print(json.dumps(row))
+
+    if args.artifact:
+        tail = (f"serving_tp({args.tp}): {row['value']} tok/s, "
+                f"{row['vs_single_device']}x single-device, "
+                f"token_exact={token_exact} "
+                f"{'OK' if token_exact else 'MISMATCH'}\n")
+        with open(args.artifact, "w") as f:
+            json.dump({"n_devices": args.tp, "rc": 0 if token_exact else 1,
+                       "ok": token_exact, "skipped": False, "tail": tail,
+                       "bench": row}, f)
+    if not token_exact:
+        raise SystemExit("TP replay diverged from single-device replay")
 
 
 def _main_shared_prefix(args, jax):

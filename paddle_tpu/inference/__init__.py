@@ -241,7 +241,11 @@ def get_fused_multi_transformer(model, **kwargs):
 
 def create_llm_engine(model, **kwargs):
     """Continuous-batching generative serving engine over a paged KV
-    cache (see inference.llm.LLMEngine; docs/LLM_SERVING.md)."""
+    cache (see inference.llm.LLMEngine; docs/LLM_SERVING.md).
+
+    All LLMEngine kwargs pass through — notably ``tensor_parallel=N``
+    (shard params + paged KV pool over N devices, Megatron-style) and
+    ``seed=`` (sampling RNG for temperature > 0 requests)."""
     from .llm import LLMEngine
     return LLMEngine(model, **kwargs)
 

@@ -13,7 +13,10 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   prefill chunks (Pallas kernels on TPU, masked-XLA
                   gather fallback everywhere)
 - engine:         LLMEngine (add_request/step/generate, two donated
-                  jitted executables) + AsyncLLMEngine for servers
+                  jitted executables; ``tensor_parallel=N`` shards
+                  params Megatron-style and the paged pool along the
+                  head axis over an 'mp' device mesh) + AsyncLLMEngine
+                  for servers
 
 See docs/LLM_SERVING.md for design notes and a quickstart.
 """

@@ -108,6 +108,44 @@ class BlockManager:
     def has_seq(self, seq_id):
         return seq_id in self._tables
 
+    def check_invariants(self):
+        """Raise RuntimeError unless the page accounting balances.
+
+        Checked: free / LRU-parked / referenced pages are disjoint and
+        sum to ``num_blocks``; every refcount equals the number of block
+        tables holding the page; the hash maps are mutually inverse and
+        every LRU page is hashed.  The allocator is pure host state —
+        under tensor parallelism one instance drives every shard, so a
+        balanced book here certifies page traffic was shard-invariant.
+        """
+        free, lru, ref = set(self._free), set(self._lru), set(self._ref)
+        if len(free) != len(self._free):
+            raise RuntimeError("duplicate pages on the free list")
+        for a, b, what in ((free, lru, "free/LRU"), (free, ref, "free/ref"),
+                           (lru, ref, "LRU/ref")):
+            if a & b:
+                raise RuntimeError(f"pages {sorted(a & b)} on {what} lists")
+        if lru - set(self._block_hash):
+            raise RuntimeError("unhashed pages parked on the LRU list")
+        if len(free) + len(lru) + len(ref) != self.num_blocks:
+            raise RuntimeError(
+                f"page books don't balance: {len(free)} free + {len(lru)} "
+                f"cached + {len(ref)} referenced != {self.num_blocks}")
+        counts = {}
+        for table in self._tables.values():
+            for blk in table:
+                counts[blk] = counts.get(blk, 0) + 1
+        if counts != self._ref:
+            raise RuntimeError(
+                f"refcounts {self._ref} disagree with table ownership "
+                f"{counts}")
+        for h, blk in self._hash_to_block.items():
+            if self._block_hash.get(blk) != h:
+                raise RuntimeError(
+                    f"hash maps not inverse at block {blk}")
+        if len(self._block_hash) != len(self._hash_to_block):
+            raise RuntimeError("hash maps differ in size")
+
     # ------------------------------------------------------- prefix cache --
     def match_prefix(self, hashes):
         """Length of the longest leading run of ``hashes`` whose pages

@@ -28,8 +28,28 @@ adopts matching pages at zero compute.
 Both executables donate the cache buffers (the pool is updated in place
 in HBM) and contain no host round-trip between launch and the sampled
 token ids — the only sync is fetching the step's token vector to drive
-the scheduler.  Compiles are bounded by the bucket grids; steady-state
-serving reuses warm executables regardless of traffic mix.
+the scheduler (plus the logits ROWS of requests that actually sample;
+greedy-only batches transfer exactly the [Bb] token vector).  Compiles
+are bounded by the bucket grids; steady-state serving reuses warm
+executables regardless of traffic mix.
+
+Tensor parallelism (``mesh=`` / ``tensor_parallel=``): the same two
+executables span a device mesh with an ``'mp'`` axis.  Params shard
+Megatron-style — qkv/fc_in column-parallel, proj/fc_out row-parallel
+with an explicit psum — and the paged K/V pools shard along the HEAD
+axis ([L, NB, bs, Nkv/mp, D] per device), so each device runs its head
+slice of paged_prefill/decode_attention against its LOCAL pool shard.
+The whole step body runs under ``jax.shard_map`` (the paged Pallas
+kernels index the pool through scalar-prefetched block tables, which
+GSPMD cannot partition, so the kernel always sees a fully local pool),
+jitted with NamedSharding ``in_shardings``/``out_shardings`` and the
+same cache donation.  Host-side scheduling is UNCHANGED: one scheduler
+and one BlockManager drive every shard, block tables / token ids /
+positions ride replicated, and page accounting is therefore
+shard-invariant by construction (asserted every step in TP mode).
+Activations stay replicated between the two psums per layer — at these
+batch sizes the win is HBM: the pool and the qkv/mlp weights split mp
+ways, serving models whose KV pool doesn't fit one chip.
 """
 
 import threading
@@ -38,12 +58,37 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ... import profiler
+from ...framework import jax_compat  # noqa: F401  (aliases jax.shard_map)
 from ...incubate.nn import _layernorm
 from .block_manager import BlockManager, prefix_block_hashes
 from .paged_attention import paged_decode_attention, paged_prefill_attention
 from .scheduler import FINISHED, Request, Scheduler, bucket_size
+
+# Megatron-style sharding of the stacked block params over the 'mp' axis
+# (leading dim is the layer stack): qkv/fc_in split their OUTPUT columns,
+# proj/fc_out split their INPUT rows (the psum pair per layer); every
+# other leaf (layernorms, biases of row-parallel matmuls) is replicated.
+_TP_BLOCK_SPECS = {
+    "attn.qkv.weight": P(None, None, "mp"),
+    "attn.qkv.bias": P(None, "mp"),
+    "attn.proj.weight": P(None, "mp", None),
+    "mlp.fc_in.weight": P(None, None, "mp"),
+    "mlp.fc_in.bias": P(None, "mp"),
+    "mlp.fc_out.weight": P(None, "mp", None),
+}
+
+
+def _qkv_head_permutation(num_heads, head_dim, tp):
+    """Column permutation taking the fused qkv layout (3, NH, D) to
+    (tp, 3, NH/tp, D): a contiguous 1/tp column slice then holds the
+    q, k AND v projections of one head GROUP, so the plain 'mp' shard
+    of the last weight dim is exactly one device's heads."""
+    nhl = num_heads // tp
+    return np.arange(3 * num_heads * head_dim).reshape(
+        3, tp, nhl, head_dim).transpose(1, 0, 2, 3).reshape(-1)
 
 
 class RequestOutput:
@@ -71,11 +116,17 @@ class LLMEngine:
     >>> while eng.has_unfinished():
     ...     for out in eng.step():
     ...         print(out.request_id, out.output_ids)
+
+    ``tensor_parallel=N`` (or an explicit ``mesh=`` with an 'mp' axis)
+    shards the executables over N devices — see the module docstring.
+    ``seed=`` seeds the sampling RNG (temperature > 0); per-request
+    ``seed=`` in add_request overrides it with an independent stream.
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None,
                  max_model_len=None, max_batch=8, dtype=None,
-                 enable_prefix_caching=True, token_budget=64):
+                 enable_prefix_caching=True, token_budget=64,
+                 mesh=None, tensor_parallel=None, seed=None):
         d = model.functional_decompose()
         cfg = model.config
         self.num_layers = d["num_layers"]
@@ -100,10 +151,34 @@ class LLMEngine:
         # one decode token per running sequence must fit in the budget
         self.token_budget = max(int(token_budget), self.max_batch)
         self.dtype = jnp.dtype(dtype) if dtype else jnp.float32
+
+        # ------------------------------------------------ mesh resolution --
+        if mesh is None and tensor_parallel and int(tensor_parallel) > 1:
+            devs = jax.devices()
+            if int(tensor_parallel) > len(devs):
+                raise ValueError(
+                    f"tensor_parallel={tensor_parallel} exceeds the "
+                    f"{len(devs)} visible devices")
+            mesh = Mesh(np.array(devs[:int(tensor_parallel)]), ("mp",))
+        if mesh is not None and "mp" not in mesh.axis_names:
+            raise ValueError("serving mesh needs an 'mp' axis "
+                             f"(got axes {mesh.axis_names})")
+        self.tp = int(mesh.shape["mp"]) if mesh is not None else 1
+        if tensor_parallel is not None and mesh is not None and \
+                int(tensor_parallel) != self.tp:
+            raise ValueError(
+                f"tensor_parallel={tensor_parallel} disagrees with the "
+                f"mesh 'mp' extent {self.tp}")
+        self.mesh = mesh if self.tp > 1 else None
+        if self.num_heads % self.tp:
+            raise ValueError(
+                f"num_attention_heads {self.num_heads} not divisible by "
+                f"tensor_parallel {self.tp} (head-axis sharding)")
+
         cast = (lambda x: jnp.asarray(x, self.dtype)
                 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
                 else jnp.asarray(x))
-        self.params = jax.tree_util.tree_map(cast, d["params"])
+        params = jax.tree_util.tree_map(cast, d["params"])
 
         self.block_manager = BlockManager(
             self.num_blocks, self.block_size,
@@ -113,40 +188,100 @@ class LLMEngine:
                                    token_budget=self.token_budget)
         cache_shape = (self.num_layers, self.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
-        self._kc = jnp.zeros(cache_shape, self.dtype)
-        self._vc = jnp.zeros(cache_shape, self.dtype)
 
         self._requests = {}
         self._next_id = 0
-        self._rng = np.random.RandomState(0)
+        self.seed = 0 if seed is None else int(seed)
+        self._rng = np.random.RandomState(self.seed)
         self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
                       "chunk_launches": 0, "tokens_generated": 0}
 
+        tp = self.tp
         nh, hd, eps = self.num_heads, self.head_dim, self.eps
         nb, bs = self.num_blocks, self.block_size
+        nh_l = nh // tp          # heads per shard (== nh when tp == 1)
+
+        if tp > 1:
+            inter = params["blocks"]["mlp.fc_in.weight"].shape[-1]
+            if inter % tp:
+                raise ValueError(
+                    f"intermediate_size {inter} not divisible by "
+                    f"tensor_parallel {tp}")
+            # regroup fused-qkv columns head-major so the contiguous 'mp'
+            # shard of the last dim is one device's (q, k, v) head group
+            perm = _qkv_head_permutation(nh, hd, tp)
+            params = dict(params)
+            params["blocks"] = dict(params["blocks"])
+            params["blocks"]["attn.qkv.weight"] = \
+                params["blocks"]["attn.qkv.weight"][:, :, perm]
+            params["blocks"]["attn.qkv.bias"] = \
+                params["blocks"]["attn.qkv.bias"][:, perm]
+
+        # param/cache sharding layout (replicated pseudo-specs at tp == 1
+        # are never built — the single-device path skips device_put)
+        self._param_specs = {
+            "embed": {k: P() for k in params["embed"]},
+            "blocks": {k: _TP_BLOCK_SPECS.get(k, P())
+                       for k in params["blocks"]},
+            "head": {k: P() for k in params["head"]},
+        }
+        self._cache_spec = P(None, None, None, "mp", None)
+        if tp > 1:
+            named = lambda spec: NamedSharding(self.mesh, spec)  # noqa: E731
+            self._param_shardings = jax.tree_util.tree_map(
+                named, self._param_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            self._cache_sharding = named(self._cache_spec)
+            self._rep = named(P())
+            self.params = jax.tree_util.tree_map(
+                jax.device_put, params, self._param_shardings)
+            # build the pool SHARDED (never materialized on one device —
+            # the point of TP serving is a pool larger than one chip)
+            zeros = jax.jit(lambda: jnp.zeros(cache_shape, self.dtype),
+                            out_shardings=self._cache_sharding)
+            self._kc = zeros()
+            self._vc = zeros()
+        else:
+            self.params = params
+            self._kc = jnp.zeros(cache_shape, self.dtype)
+            self._vc = jnp.zeros(cache_shape, self.dtype)
+
+        def psum_mp(y):
+            """Row-parallel reduction; identity on the single-device path
+            (keeps the tp=1 graph bitwise identical to the pre-TP one)."""
+            return jax.lax.psum(y, "mp") if tp > 1 else y
 
         def attn_proj(p_l, x):
-            """LN -> fused QKV, the FusedMultiTransformer block head."""
+            """LN -> fused QKV, the FusedMultiTransformer block head.
+            Under TP the local qkv columns are this shard's head group
+            (see _qkv_head_permutation), so nh_l heads come out."""
             hh = _layernorm(x, p_l["ln_1.weight"], p_l["ln_1.bias"], eps)
             qkv = hh @ p_l["attn.qkv.weight"] + p_l["attn.qkv.bias"]
             b, t = x.shape[0], x.shape[1]
-            qkv = qkv.reshape(b, t, 3, nh, hd)
+            qkv = qkv.reshape(b, t, 3, nh_l, hd)
             return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
         def mlp_residual(p_l, x, att_out):
-            x = x + att_out @ p_l["attn.proj.weight"] + p_l["attn.proj.bias"]
+            # row-parallel proj/fc_out: partial matmul + psum, bias added
+            # once AFTER the reduction (replicated)
+            x = x + psum_mp(att_out @ p_l["attn.proj.weight"]) \
+                + p_l["attn.proj.bias"]
             h2 = _layernorm(x, p_l["ln_2.weight"], p_l["ln_2.bias"], eps)
             ff = jax.nn.gelu(h2 @ p_l["mlp.fc_in.weight"]
                              + p_l["mlp.fc_in.bias"], approximate=True)
-            return x + ff @ p_l["mlp.fc_out.weight"] + p_l["mlp.fc_out.bias"]
+            return x + psum_mp(ff @ p_l["mlp.fc_out.weight"]) \
+                + p_l["mlp.fc_out.bias"]
 
         def scatter_pages(cache, slots, values):
-            """Write [N, nh, hd] rows at absolute token slots; padded rows
-            carry an out-of-range slot and are dropped, not written."""
-            flat = cache.reshape(nb * bs, nh, hd)
+            """Write [N, nh_l, hd] rows at absolute token slots; padded
+            rows carry an out-of-range slot and are dropped, not
+            written.  Under TP ``cache`` is the LOCAL pool shard and
+            ``values`` this shard's heads — slots are replicated, so
+            every shard writes the same pages of its own head slice."""
+            flat = cache.reshape(nb * bs, nh_l, hd)
             flat = flat.at[slots].set(values.astype(cache.dtype),
                                       mode="drop")
-            return flat.reshape(nb, bs, nh, hd)
+            return flat.reshape(nb, bs, nh_l, hd)
 
         def head_logits(params, x):
             x = _layernorm(x, params["head"]["weight"],
@@ -183,7 +318,7 @@ class LLMEngine:
                 vc_l = scatter_pages(vc_l, slots, v[0])
                 out = paged_prefill_attention(q, kc_l, vc_l,
                                               block_table, start)
-                out = out.astype(x.dtype).reshape(1, cb, nh * hd)
+                out = out.astype(x.dtype).reshape(1, cb, nh_l * hd)
                 return mlp_residual(p_l, x, out), (kc_l, vc_l)
 
             x, (kc, vc) = jax.lax.scan(layer, x,
@@ -217,7 +352,7 @@ class LLMEngine:
                 q = q * (scale * jnp.sqrt(jnp.asarray(hd, q.dtype)))
                 out = paged_decode_attention(q[:, 0], kc_l, vc_l,
                                              block_tables, ctx)
-                out = out.astype(x.dtype).reshape(bb, 1, nh * hd)
+                out = out.astype(x.dtype).reshape(bb, 1, nh_l * hd)
                 return mlp_residual(p_l, x, out), (kc_l, vc_l)
 
             x, (kc, vc) = jax.lax.scan(layer, x,
@@ -225,12 +360,40 @@ class LLMEngine:
             logits = head_logits(params, x[:, 0])
             return jnp.argmax(logits, -1), logits, kc, vc
 
-        self._chunk = jax.jit(chunk_fn, donate_argnums=(2, 3))
-        self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
+        if tp > 1:
+            # shard_map: each device runs the SAME program on its local
+            # head slice — local qkv/fc columns, local pool shard, the
+            # two explicit psums per layer; block tables / ids /
+            # positions / activations ride replicated.  The jit wrapper
+            # pins NamedShardings so host operands are placed without
+            # resharding and the donated pool keeps its layout.
+            c_spec, rep = self._cache_spec, P()
+
+            def tp_wrap(fn, n_extra):
+                extra = (rep,) * n_extra
+                sm = jax.shard_map(
+                    fn, mesh=self.mesh,
+                    in_specs=(self._param_specs, rep, c_spec, c_spec)
+                    + extra,
+                    out_specs=(rep, rep, c_spec, c_spec),
+                    check_rep=False)
+                csh, rsh = self._cache_sharding, self._rep
+                return jax.jit(
+                    sm,
+                    in_shardings=(self._param_shardings, rsh, csh, csh)
+                    + (rsh,) * n_extra,
+                    out_shardings=(rsh, rsh, csh, csh),
+                    donate_argnums=(2, 3))
+
+            self._chunk = tp_wrap(chunk_fn, 3)    # table, start, length
+            self._decode = tp_wrap(decode_fn, 2)  # tables, positions
+        else:
+            self._chunk = jax.jit(chunk_fn, donate_argnums=(2, 3))
+            self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
 
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
-                    temperature=0.0, request_id=None):
+                    temperature=0.0, request_id=None, seed=None):
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
         if not prompt:
             raise ValueError("empty prompt")
@@ -246,7 +409,8 @@ class LLMEngine:
         req = Request(request_id=request_id, prompt_ids=tuple(prompt),
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=eos_token_id,
-                      temperature=float(temperature))
+                      temperature=float(temperature),
+                      seed=None if seed is None else int(seed))
         self._requests[request_id] = req
         self.scheduler.add(req)
         return request_id
@@ -262,7 +426,9 @@ class LLMEngine:
         write lands on the dropped out-of-range slot.  Serving processes
         call this at startup so no client pays a compile stall.  The
         chunk family is O(log token_budget) — prompt length never enters
-        the executable count.
+        the executable count.  Under TP the same walk compiles the
+        sharded executables over the mesh (the bucket grid is identical:
+        shapes are global, only shardings differ).
         """
         with profiler.RecordEvent("llm_engine::warmup"):
             cb = min(8, self.token_budget)
@@ -314,16 +480,19 @@ class LLMEngine:
                     self.params, jnp.asarray(ids), self._kc, self._vc,
                     jnp.asarray(tables), jnp.asarray(positions))
             nxt = np.asarray(nxt)
-            logits_host = None
-            if any(r.temperature > 0.0 for r in reqs):
-                logits_host = np.asarray(logits)
+            # fetch ONLY the rows that sample: greedy-only batches
+            # transfer exactly the [Bb] token vector above, and a mixed
+            # batch pays for its sampling rows, not [Bb, V]
+            samp = [i for i, r in enumerate(reqs) if r.temperature > 0.0]
+            row_logits = {}
+            if samp:
+                sel = np.asarray(logits[np.asarray(samp, np.int32)])
+                row_logits = dict(zip(samp, sel))
             for i, r in enumerate(reqs):
                 r.num_cached += 1
                 if r.num_cached % self.block_size == 0:
                     self._register_full_blocks(r)
-                row_logits = (logits_host[i]
-                              if logits_host is not None else None)
-                self._commit_token(r, nxt[i], row_logits, finished)
+                self._commit_token(r, nxt[i], row_logits.get(i), finished)
         if batch.chunks:
             self.stats["prefill_steps"] += 1
         for ch in batch.chunks:
@@ -344,7 +513,14 @@ class LLMEngine:
             req.num_cached = ch.start + ch.length
             self._register_full_blocks(req)
             if ch.is_final:
+                # logits is a device [V] vector; _commit_token fetches it
+                # only when this request samples
                 self._commit_token(req, nxt, logits, finished)
+        if self.tp > 1:
+            # ONE host-side allocator drives every shard (tables ride
+            # replicated), so page accounting must be shard-invariant:
+            # assert the books balance after each TP step
+            self.scheduler.check_invariants()
         return finished
 
     def _register_full_blocks(self, req):
@@ -374,7 +550,13 @@ class LLMEngine:
     def _commit_token(self, req, argmax_token, logits, finished):
         if req.temperature > 0.0:
             logits = np.asarray(logits, np.float64) / req.temperature
-            gumbel = self._rng.gumbel(size=logits.shape)
+            if req.seed is not None:
+                if req._sample_rng is None:
+                    req._sample_rng = np.random.RandomState(req.seed)
+                rng = req._sample_rng
+            else:
+                rng = self._rng
+            gumbel = rng.gumbel(size=logits.shape)
             tok = int(np.argmax(logits + gumbel))
         else:
             tok = int(argmax_token)
@@ -396,16 +578,19 @@ class LLMEngine:
 
     # ----------------------------------------------------------- generate --
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
-                 temperature=0.0):
+                 temperature=0.0, seed=None):
         """Batch convenience: returns one [T+new] int array per prompt
-        (ragged list, request order preserved)."""
+        (ragged list, request order preserved).  ``seed`` gives every
+        request of this call its own deterministic sampling stream
+        (independent of arrival interleaving); default None keeps the
+        engine-level RNG."""
         if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
             prompts = list(prompts)
         elif not isinstance(prompts, (list, tuple)):
             prompts = [prompts]
         order = [self.add_request(p, max_new_tokens=max_new_tokens,
                                   eos_token_id=eos_token_id,
-                                  temperature=temperature)
+                                  temperature=temperature, seed=seed)
                  for p in prompts]
         outs = {}
         while self.has_unfinished():
@@ -418,7 +603,16 @@ class AsyncLLMEngine:
     """Thread-safe front of an LLMEngine: callers submit from any thread
     (one per socket connection in PredictorServer) and block on their own
     result while a single background thread steps the engine — concurrent
-    callers batch into one decode executable automatically."""
+    callers batch into one decode executable automatically.
+
+    The device call runs OUTSIDE the condition lock, so ``submit()``
+    returns while a step is in flight — a request arriving mid-step is
+    admitted by the NEXT schedule() pass, which is the whole point of
+    continuous batching.  This is safe because ``add_request`` only
+    appends to the scheduler's waiting queue and the request dict (both
+    GIL-atomic list/dict ops); all other engine state is touched solely
+    by the stepping thread.
+    """
 
     def __init__(self, engine):
         self.engine = engine
@@ -436,9 +630,12 @@ class AsyncLLMEngine:
                     self._cond.wait(timeout=0.5)
                 if self._stopped:
                     return
-                for fo in self.engine.step():
+            finished = self.engine.step()    # device call: lock NOT held
+            with self._cond:
+                for fo in finished:
                     self._results[fo.request_id] = fo
-                self._cond.notify_all()
+                if finished:
+                    self._cond.notify_all()
 
     def submit(self, prompt_ids, **kwargs):
         with self._cond:

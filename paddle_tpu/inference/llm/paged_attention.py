@@ -18,6 +18,16 @@ queries sit at absolute positions [start, start + C) and must see every
 EARLIER token's K/V — prior chunks and prefix-cache hits included — so
 prefill now reads the paged pool through the block table exactly like
 decode does, instead of attending over its own chunk only.
+
+Tensor parallelism: both entry points are head-count generic, and
+attention never mixes heads — so the TP engine calls them UNCHANGED
+from inside ``jax.shard_map`` with per-shard shapes (q [.., Nq/mp, D],
+pool [NB, bs, Nkv/mp, D], block tables replicated).  Each shard runs
+its head slice against its LOCAL pool shard; no collective is needed
+until the row-parallel output projection.  This is also why the Pallas
+path survives the mesh: the kernel's scalar-prefetched block-table
+indexing cannot be GSPMD-partitioned, but under shard_map it only ever
+sees fully local operands.
 """
 
 import jax

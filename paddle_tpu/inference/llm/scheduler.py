@@ -48,6 +48,7 @@ class Request:
     max_new_tokens: int
     eos_token_id: int = None
     temperature: float = 0.0
+    seed: int = None            # per-request sampling stream (None: engine RNG)
     arrival_time: float = field(default_factory=time.monotonic)
     output_ids: list = field(default_factory=list)
     num_cached: int = 0         # tokens whose K/V sit in the paged cache
@@ -55,6 +56,7 @@ class Request:
     num_preemptions: int = 0
     status: str = WAITING
     finish_reason: str = None
+    _sample_rng: object = field(default=None, repr=False, compare=False)
 
     @property
     def all_ids(self):
@@ -194,6 +196,28 @@ class Scheduler:
         if decodes:
             return ScheduledBatch("decode", decodes)
         return ScheduledBatch("idle", [])
+
+    def check_invariants(self):
+        """Assert the host-side books balance: every running sequence
+        owns a table, every waiting one owns none, and the block
+        manager's page accounting is self-consistent.
+
+        Scheduling is pure host state, so under tensor parallelism the
+        SAME tables/decisions drive every shard — there is exactly one
+        allocator no matter how many devices execute the step.  The TP
+        engine calls this after each step to pin that down: if the
+        books balance, every shard saw identical page traffic.
+        """
+        bm = self.block_manager
+        for req in self.running:
+            if not bm.has_seq(req.request_id):
+                raise RuntimeError(
+                    f"running request {req.request_id} owns no block table")
+        for req in self.waiting:
+            if bm.has_seq(req.request_id):
+                raise RuntimeError(
+                    f"waiting request {req.request_id} still owns pages")
+        bm.check_invariants()
 
     def _preempt(self, victim):
         """Recompute-style preemption: drop the pages, queue the sequence

@@ -80,9 +80,12 @@ class _GenerativeAdapter:
     """Predictor-shaped front of an LLM engine.
 
     Wire contract (same tensor encoding as Predictor): input 0 is the
-    prompt token ids (int32/int64, [T] or [1, T]); optional scalar input
-    1 is max_new_tokens (default 16).  The response is one [1, T+new]
-    int64 tensor.  Each socket connection runs in its own thread, so
+    prompt token ids (int32/int64, [T] or [1, T]); optional scalar
+    inputs: 1 = max_new_tokens (default 16), 2 = temperature (float,
+    default 0.0 = greedy), 3 = seed (int; pins the request's sampling
+    stream so a sampled completion is reproducible per request, not per
+    server arrival order).  The response is one [1, T+new] int64
+    tensor.  Each socket connection runs in its own thread, so
     concurrent clients batch inside the engine's continuous-batching
     decode step — the socket path gains multi-tenant batching without a
     protocol change.
@@ -96,16 +99,24 @@ class _GenerativeAdapter:
         self._async = (AsyncLLMEngine(engine)
                        if isinstance(engine, LLMEngine) else engine)
 
+    @staticmethod
+    def _scalar(inputs, i, cast, default):
+        if len(inputs) <= i:
+            return default
+        return cast(np.asarray(inputs[i]).reshape(-1)[0])
+
     def run(self, inputs):
         if not inputs:
             raise ValueError("generative request needs a token-id tensor")
         ids = np.asarray(inputs[0])
         if not np.issubdtype(ids.dtype, np.integer):
             raise ValueError("generative input 0 must be integer token ids")
-        max_new = (int(np.asarray(inputs[1]).reshape(-1)[0])
-                   if len(inputs) > 1 else self._DEFAULT_MAX_NEW)
+        max_new = self._scalar(inputs, 1, int, self._DEFAULT_MAX_NEW)
+        temperature = self._scalar(inputs, 2, float, 0.0)
+        seed = self._scalar(inputs, 3, int, None)
         out = self._async.generate(ids.reshape(-1),
-                                   max_new_tokens=max_new)
+                                   max_new_tokens=max_new,
+                                   temperature=temperature, seed=seed)
         return [out.all_ids.astype(np.int64)[None]]
 
     def stop(self):

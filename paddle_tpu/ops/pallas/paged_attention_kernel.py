@@ -27,6 +27,15 @@ Like the ragged kernel, the 1/sqrt(D) scale is applied INSIDE (callers
 pre-scale q if their formula differs); ``supports`` gates callers and the
 masked-XLA gather fallback (inference/llm/paged_attention.py) computes
 identical semantics everywhere else.
+
+Under tensor parallelism the pool is sharded along the Nkv axis and the
+kernel runs inside ``jax.shard_map`` with PER-SHARD head counts (Nkv/mp
+KV heads, Nq/mp query heads) and the full local pool — nothing here
+changes: the grid simply spans fewer kv heads per device, and the
+scalar-prefetched block tables (which GSPMD could not partition through
+the index map) arrive replicated, indexing local pages.  ``supports``
+is consulted with the per-shard counts, so GQA divisibility must hold
+per shard, not just globally.
 """
 
 import functools
