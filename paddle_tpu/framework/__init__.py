@@ -38,6 +38,7 @@ from .device import (  # noqa: F401
 from .random import get_rng_key, seed, split_key  # noqa: F401
 from .flags import get_flags, set_flags  # noqa: F401
 from . import ir  # noqa: F401  (jaxpr pattern-rewrite passes)
+from . import analysis  # noqa: F401  (jaxpr static analysis / graph lint)
 from .mode import (  # noqa: F401
     grad_enabled,
     in_dynamic_mode,

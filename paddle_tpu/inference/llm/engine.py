@@ -418,6 +418,43 @@ class LLMEngine:
     def has_unfinished(self):
         return self.scheduler.has_unfinished()
 
+    def _bucket_grid(self):
+        """The complete executable family: every (kind, bucket) pair
+        serving can ever launch.  Single source of truth for warmup(),
+        executable_grid(), and the static-analysis sweep."""
+        cb = min(8, self.token_budget)
+        while True:
+            yield ("chunk", cb)
+            if cb >= self.token_budget:
+                break
+            cb = min(cb * 2, self.token_budget)
+        bb = 1
+        while True:
+            yield ("decode", bb)
+            if bb >= self.max_batch:
+                break
+            bb = min(bb * 2, self.max_batch)
+
+    def executable_grid(self):
+        """Yield ``(kind, bucket, jitted_fn, abstract_args)`` covering
+        the warmup grid with ``ShapeDtypeStruct`` stand-ins for the K/V
+        pools — framework.analysis traces these without executing (or
+        donating) anything, so a lint pass never touches cache state."""
+        sds = jax.ShapeDtypeStruct
+        kc = sds(self._kc.shape, self._kc.dtype)
+        vc = sds(self._vc.shape, self._vc.dtype)
+        i32 = jnp.int32
+        for kind, b in self._bucket_grid():
+            if kind == "chunk":
+                args = (self.params, sds((1, b), i32), kc, vc,
+                        sds((self.max_pages,), i32), sds((), i32),
+                        sds((), i32))
+                yield kind, b, self._chunk, args
+            else:
+                args = (self.params, sds((b, 1), i32), kc, vc,
+                        sds((b, self.max_pages), i32), sds((b,), i32))
+                yield kind, b, self._decode, args
+
     def warmup(self):
         """Compile every bucketed executable before traffic arrives.
 
@@ -429,29 +466,33 @@ class LLMEngine:
         the executable count.  Under TP the same walk compiles the
         sharded executables over the mesh (the bucket grid is identical:
         shapes are global, only shardings differ).
+
+        Returns a :class:`~paddle_tpu.framework.analysis.CompileWatcher`
+        armed over the freshly-warm chunk/decode executables, so callers
+        can assert the serving window compiles nothing::
+
+            watcher = eng.warmup()
+            serve_traffic()
+            watcher.assert_no_new_compiles()
         """
         with profiler.RecordEvent("llm_engine::warmup"):
-            cb = min(8, self.token_budget)
-            while True:
-                ids = jnp.zeros((1, cb), jnp.int32)
-                table = jnp.zeros(self.max_pages, jnp.int32)
-                _, _, self._kc, self._vc = self._chunk(
-                    self.params, ids, self._kc, self._vc, table,
-                    jnp.int32(0), jnp.int32(0))
-                if cb >= self.token_budget:
-                    break
-                cb = min(cb * 2, self.token_budget)
-            bb = 1
-            while True:
-                ids = jnp.zeros((bb, 1), jnp.int32)
-                tables = jnp.zeros((bb, self.max_pages), jnp.int32)
-                positions = jnp.full((bb,), -1, jnp.int32)
-                _, _, self._kc, self._vc = self._decode(
-                    self.params, ids, self._kc, self._vc, tables,
-                    positions)
-                if bb >= self.max_batch:
-                    break
-                bb = min(bb * 2, self.max_batch)
+            for kind, b in self._bucket_grid():
+                if kind == "chunk":
+                    ids = jnp.zeros((1, b), jnp.int32)
+                    table = jnp.zeros(self.max_pages, jnp.int32)
+                    _, _, self._kc, self._vc = self._chunk(
+                        self.params, ids, self._kc, self._vc, table,
+                        jnp.int32(0), jnp.int32(0))
+                else:
+                    ids = jnp.zeros((b, 1), jnp.int32)
+                    tables = jnp.zeros((b, self.max_pages), jnp.int32)
+                    positions = jnp.full((b,), -1, jnp.int32)
+                    _, _, self._kc, self._vc = self._decode(
+                        self.params, ids, self._kc, self._vc, tables,
+                        positions)
+        from ...framework.analysis import CompileWatcher
+        return CompileWatcher(self._chunk, self._decode,
+                              labels=("chunk", "decode"))
 
     # --------------------------------------------------------------- step --
     def step(self):

@@ -25,9 +25,14 @@ def ones(shape, dtype=None, name=None):
 
 def full(shape, fill_value, dtype=None, name=None):
     if isinstance(fill_value, Tensor):
-        fill_value = fill_value.item()
+        # stay on device: jnp.full broadcasts a 0-d fill array, so the
+        # value never round-trips through the host (and stays traceable)
+        fv = fill_value._data.reshape(())
+        return Tensor(jnp.full(tuple(shape), fv,
+                               dtype=_dt(dtype, default=fv.dtype)))
     if dtype is None:
-        dtype = np.asarray(fill_value).dtype
+        # python-scalar path — the Tensor branch returned above
+        dtype = np.asarray(fill_value).dtype  # noqa: H001 (py scalar)
         if dtype == np.float64:
             dtype = get_default_dtype()
     return Tensor(jnp.full(tuple(shape), fill_value, dtype=_dt(dtype)))
@@ -52,11 +57,11 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
 
 
 def linspace(start, stop, num, dtype=None, name=None):
-    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))  # noqa: H001 (scalar args by contract)
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None):
-    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base,
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base,  # noqa: H001 (scalar args by contract)
                                dtype=_dt(dtype)))
 
 

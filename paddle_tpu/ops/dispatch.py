@@ -285,5 +285,5 @@ def _check_nan_inf(name, out_leaves):
     for o in out_leaves:
         if isinstance(o, jax.core.Tracer):
             return  # cannot check under trace
-        if jnp.issubdtype(o.dtype, jnp.inexact) and not bool(jnp.isfinite(o).all()):
+        if jnp.issubdtype(o.dtype, jnp.inexact) and not bool(jnp.isfinite(o).all()):  # noqa: H001 (tracer-guarded debug check)
             raise FloatingPointError(f"NaN or Inf detected in output of op '{name}'")

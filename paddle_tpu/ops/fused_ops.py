@@ -60,7 +60,7 @@ def flash_attn_unpadded(q, k, v, cu_seqlens_q, cu_seqlens_k, max_seqlen_q,
     """
     nq = cu_seqlens_q.shape[0] - 1
     tq, n, h = q.shape
-    mq, mk = int(max_seqlen_q), int(max_seqlen_k)
+    mq, mk = int(max_seqlen_q), int(max_seqlen_k)  # noqa: H001 (static seqlen attrs)
 
     def gather_pad(x, cu, m):
         def per(i):

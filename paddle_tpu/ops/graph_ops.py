@@ -9,6 +9,8 @@ XLA lowers these to sorted scatters that vectorize well.  Sampling /
 reindex ops have inherently dynamic output shapes, so they are host ops
 (numpy) feeding the input pipeline, like the reference's CPU kernels.
 """
+# noqa-module: H001 (sampling/reindex are host ops by design — dynamic
+# output shapes cannot trace; see module docstring)
 
 import jax
 import jax.numpy as jnp

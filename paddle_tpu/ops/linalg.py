@@ -222,7 +222,7 @@ def histogram(x, bins=100, min=0, max=0, weight=None, density=False):
 
 @op()
 def bincount(x, weights=None, minlength=0):
-    length = int(max(minlength, int(jnp.max(x)) + 1 if x.size else minlength))
+    length = int(max(minlength, int(jnp.max(x)) + 1 if x.size else minlength))  # noqa: H001 (data-dependent length, eager-only)
     return jnp.bincount(x, weights=weights, length=max(length, 1))
 
 

@@ -238,7 +238,11 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
 @op()
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
     import numpy as np
-    xs = np.asarray(x)
+    import jax
+    if isinstance(jnp.asarray(x), jax.core.Tracer):
+        raise ValueError(
+            "unique_consecutive requires eager mode (dynamic shape)")
+    xs = np.asarray(x)  # noqa: H001 (tracer-guarded, dynamic shape)
     if axis is None:
         xs = xs.reshape(-1)
         keep = np.concatenate([[True], xs[1:] != xs[:-1]])
@@ -353,7 +357,7 @@ def slice(x, axes, starts, ends):
     import builtins
     idx = [builtins.slice(None)] * x.ndim
     for ax, st, en in zip(axes, starts, ends):
-        idx[ax] = builtins.slice(int(st), int(en))
+        idx[ax] = builtins.slice(int(st), int(en))  # noqa: H001 (int attrs by contract)
     return x[tuple(idx)]
 
 @op()
@@ -361,7 +365,7 @@ def strided_slice(x, axes, starts, ends, strides):
     import builtins
     idx = [builtins.slice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
-        idx[ax] = builtins.slice(int(st), int(en), int(sd))
+        idx[ax] = builtins.slice(int(st), int(en), int(sd))  # noqa: H001 (int attrs by contract)
     return x[tuple(idx)]
 
 @op()

@@ -449,9 +449,9 @@ def repeat_interleave_with_tensor_index(x, repeats, axis=None):
     reps = jnp.asarray(repeats)
     if isinstance(reps, jax.core.Tracer):
         raise ValueError("tensor repeats requires eager mode (dynamic shape)")
-    reps_np = np.asarray(reps)
+    reps_np = np.asarray(reps)  # noqa: H001 (tracer-guarded, dynamic shape)
     return jnp.repeat(x, reps_np, axis=axis,
-                      total_repeat_length=int(reps_np.sum()))
+                      total_repeat_length=int(reps_np.sum()))  # noqa: H001 (tracer-guarded, dynamic shape)
 
 
 @op()
@@ -489,7 +489,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
 
 @op()
 def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
-    p = [int(v) for v in np.asarray(paddings).reshape(-1)]
+    p = [int(v) for v in np.asarray(paddings).reshape(-1)]  # noqa: H001 (padding attrs)
     # paddle order: [left, right, top, bottom, front, back] on (W,H,D)
     if data_format == "NCDHW":
         cfg = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
@@ -533,7 +533,7 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
 
 @op()
 def assign_value(shape, dtype, values):
-    return jnp.asarray(np.asarray(values).reshape(shape), dtype=dtype)
+    return jnp.asarray(np.asarray(values).reshape(shape), dtype=dtype)  # noqa: H001 (host literal attr)
 
 
 @op()
@@ -799,8 +799,9 @@ def _register_aliases():
     register_external("full_", full_)
 
     def assign_value_(x, values):
-        arr = jnp.asarray(np.asarray(values)).reshape(x.shape) \
-            .astype(x.dtype)
+        arr = jnp.asarray(
+            np.asarray(values)  # noqa: H001 (host literal attr)
+        ).reshape(x.shape).astype(x.dtype)
         if hasattr(x, "_rebind"):
             return x._rebind(arr)
         return arr

@@ -346,8 +346,17 @@ def update_loss_scaling_(xs, found_infinite, prev_loss_scaling,
     scale_new = jnp.where(grow, scale * incr_ratio, scale_new)
     bad_new = jnp.where(shrink, 0, bad_new)
     good_new = jnp.where(grow, 0, good_new)
-    if stop_update:
-        scale_new, good_new, bad_new = scale, good, bad
+    # the reference feeds StopUpdate as a device tensor: select on
+    # device instead of a python branch (`if tensor:` would sync the
+    # value to host in eager and fail outright under jit)
+    if isinstance(stop_update, (bool, int)):
+        if stop_update:
+            scale_new, good_new, bad_new = scale, good, bad
+    else:
+        stop = jnp.asarray(stop_update).reshape(()).astype(jnp.bool_)
+        scale_new = jnp.where(stop, scale, scale_new)
+        good_new = jnp.where(stop, good, good_new)
+        bad_new = jnp.where(stop, bad, bad_new)
     outs = [jnp.where(found, jnp.zeros_like(x), x) for x in xs]
     return (outs, scale_new.reshape((1,)), good_new.reshape((1,)),
             bad_new.reshape((1,)))

@@ -29,7 +29,7 @@ def _normalize_pads(paddings, nd):
     pads = _tup(paddings, nd)
     if len(pads) == 2 * nd and all(
             isinstance(p, (int, np.integer)) for p in pads):
-        return tuple((int(pads[2 * i]), int(pads[2 * i + 1]))
+        return tuple((int(pads[2 * i]), int(pads[2 * i + 1]))  # noqa: H001 (padding attrs)
                      for i in range(nd))
     return tuple((p, p) if isinstance(p, int) else tuple(p)
                  for p in pads)
@@ -59,7 +59,7 @@ def _pool_nd(x, ksize, strides, paddings, pooling_type, exclusive,
             ends = ((np.arange(osz) + 1) * isz + osz - 1) // osz
             segs = []
             for i in range(osz):
-                s, e = int(starts[i]), int(ends[i])
+                s, e = int(starts[i]), int(ends[i])  # noqa: H001 (shape-derived bins)
                 sl = [slice(None)] * out.ndim
                 sl[ax] = slice(s, max(e, s + 1))
                 seg = out[tuple(sl)]

@@ -16,7 +16,7 @@ from .registry import op
 
 def _shape(shape):
     if isinstance(shape, Tensor):
-        shape = shape.tolist()
+        shape = shape.tolist()  # noqa: H001 (concrete shape required)
     return tuple(int(s) for s in shape)
 
 

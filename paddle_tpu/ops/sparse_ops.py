@@ -11,6 +11,8 @@ package wraps these in SparseCooTensor/SparseCsrTensor classes.  XLA has no
 native sparse HLO, so compute densifies at the op edge (the reference's GPU
 kernels do their own gather/scatter too).
 """
+# noqa-module: H001 (COO/CSR construction walks host index lists by
+# design — dynamic nnz cannot trace; see module docstring)
 
 import jax
 import jax.numpy as jnp

@@ -146,7 +146,7 @@ bicubic_interp = op("bicubic_interp")(_make_interp("bicubic_interp"))
 @op()
 def affine_grid(theta, out_shape, align_corners=True):
     """theta [N, 2, 3] (or [N, 3, 4] for 3d), out_shape (N, C, H, W)."""
-    out_shape = [int(s) for s in np.asarray(out_shape).reshape(-1)]
+    out_shape = [int(s) for s in np.asarray(out_shape).reshape(-1)]  # noqa: H001 (shape attr)
     is_3d = theta.shape[-2] == 3
     if not is_3d:
         n, _, h, w = out_shape
@@ -594,7 +594,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
         boxes.append((ms, ms))
         if max_sizes:
             for mx in max_sizes:
-                s = float(np.sqrt(ms * mx))
+                s = float(np.sqrt(ms * mx))  # noqa: H001 (prior-box size attrs)
                 boxes.append((s, s))
         for ar in ars:
             if abs(ar - 1.0) < 1e-6:
@@ -977,7 +977,7 @@ def decode_jpeg(x, mode="unchanged", name=None):
     decode_jpeg_kernel.cu uses nvjpeg; TPU has no device JPEG engine, so this
     is a host op feeding the input pipeline)."""
     import io as _io
-    data = np.asarray(x, dtype=np.uint8).tobytes()
+    data = np.asarray(x, dtype=np.uint8).tobytes()  # noqa: H001 (host JPEG decode by design)
     try:
         from PIL import Image  # noqa: PLC0415
     except ImportError as e:  # pragma: no cover
@@ -986,7 +986,7 @@ def decode_jpeg(x, mode="unchanged", name=None):
     img = Image.open(_io.BytesIO(data))
     if mode != "unchanged":
         img = img.convert(mode.upper() if mode != "gray" else "L")
-    arr = np.asarray(img)
+    arr = np.asarray(img)  # noqa: H001 (host JPEG decode by design)
     if arr.ndim == 2:
         arr = arr[None]
     else:

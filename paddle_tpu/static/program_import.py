@@ -990,19 +990,31 @@ class InferenceProgram:
         self.fetch_names = []
         self.body = []
         feeds, fetches = {}, {}
+        unknown = []
         for op in ops:
             if op.type == "feed":
                 feeds[op.attrs.get("col", 0)] = op.outputs["Out"][0]
             elif op.type == "fetch":
                 fetches[op.attrs.get("col", 0)] = op.inputs["X"][0]
             else:
-                self._check_op(op)
+                self._check_op(op, _unknown=unknown)
                 self.body.append(op)
+        if unknown:
+            # every missing translation in ONE error, with the output
+            # var names, so a port gap is actionable in a single pass
+            # (framework.analysis G001 reads the same shape of report)
+            detail = "; ".join(
+                f"'{t}' -> [{', '.join(outs) or '<no outputs>'}]"
+                for t, outs in unknown)
+            raise NotImplementedError(
+                f"{len(unknown)} ProgramDesc op(s) have no TPU "
+                f"translation ({len(_TRANSLATORS)} ops supported — see "
+                f"static.program_import): {detail}")
         self.feed_names = [feeds[k] for k in sorted(feeds)]
         self.fetch_names = [fetches[k] for k in sorted(fetches)]
         self._jitted = jax.jit(self._run)
 
-    def _check_op(self, op, depth=0):
+    def _check_op(self, op, depth=0, _unknown=None):
         if op.type in _CONTROL_OPS:
             sub = op.attrs.get("sub_block")
             if sub is not None:
@@ -1014,9 +1026,13 @@ class InferenceProgram:
                     raise NotImplementedError(
                         "control-flow nesting deeper than 16 blocks")
                 for sop in self.blocks[sub][0]:
-                    self._check_op(sop, depth + 1)
+                    self._check_op(sop, depth + 1, _unknown=_unknown)
             return
         if op.type not in _TRANSLATORS:
+            outs = [a for args in op.outputs.values() for a in args]
+            if _unknown is not None:
+                _unknown.append((op.type, outs))
+                return
             raise NotImplementedError(
                 f"ProgramDesc op '{op.type}' has no TPU "
                 f"translation ({len(_TRANSLATORS)} ops "

@@ -53,3 +53,18 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+# ----------------------------------------------------- recompile guard ----
+@pytest.fixture
+def compile_watcher():
+    """framework.analysis.CompileWatcher as a fixture:
+
+        with compile_watcher(jitted_fn, ...):
+            traffic()        # RecompileError if anything compiled
+
+    Guards a window of test execution against silent retraces (shape/
+    dtype/python-scalar signature leaks past a bucket grid)."""
+    from paddle_tpu.framework.analysis import CompileWatcher
+
+    return CompileWatcher

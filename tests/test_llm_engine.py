@@ -489,19 +489,40 @@ class TestPrefixCaching:
         m = _make_model()
         eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
                         token_budget=16)
-        eng.warmup()
-        chunk_c = eng._chunk._cache_size()
-        decode_c = eng._decode._cache_size()
+        watcher = eng.warmup()     # armed over chunk + decode
         # chunk family is O(log token_budget): buckets 8, 16
-        assert chunk_c == 2
+        assert eng._chunk._cache_size() == 2
         rng = np.random.RandomState(8)
         prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
                    for n in (3, 17, 40, 9)]
-        eng.generate(prompts, max_new_tokens=8)
-        # the serving window compiled NOTHING: every chunk bucket and
-        # decode batch bucket was covered by warmup
-        assert eng._chunk._cache_size() == chunk_c
-        assert eng._decode._cache_size() == decode_c
+        # the serving window must compile NOTHING: every chunk bucket
+        # and decode batch bucket was covered by warmup — __exit__
+        # raises RecompileError naming the offender otherwise
+        with watcher:
+            eng.generate(prompts, max_new_tokens=8)
+        assert watcher.new_compiles() == []
+
+    def test_compile_watcher_catches_injected_retrace(self,
+                                                      compile_watcher):
+        """A python-scalar bucket leak (plain int where warmup used
+        jnp.int32) gives the executable a new weak-typed signature —
+        the silent retrace class the watcher exists to catch."""
+        import jax.numpy as jnp
+
+        from paddle_tpu.framework.analysis import RecompileError
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
+                        token_budget=16)
+        eng.warmup()
+        ids = jnp.zeros((1, 8), jnp.int32)
+        table = jnp.zeros(eng.max_pages, jnp.int32)
+        with pytest.raises(RecompileError, match="chunk"):
+            with compile_watcher(eng._chunk, eng._decode,
+                                 labels=("chunk", "decode")):
+                _, _, eng._kc, eng._vc = eng._chunk(
+                    eng.params, ids, eng._kc, eng._vc, table, 0, 0)
 
 
 # ---------------------------------------------------------------------------
@@ -562,16 +583,14 @@ class TestTensorParallel:
         m = _make_model()
         tp = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
                        token_budget=16, tensor_parallel=4)
-        tp.warmup()
-        chunk_c = tp._chunk._cache_size()
-        decode_c = tp._decode._cache_size()
-        assert chunk_c == 2                 # buckets 8, 16 — same as tp=1
+        watcher = tp.warmup()
+        assert tp._chunk._cache_size() == 2  # buckets 8, 16 — as tp=1
         rng = np.random.RandomState(12)
         prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
                    for n in (3, 17, 40, 9)]
-        tp.generate(prompts, max_new_tokens=8)
-        assert tp._chunk._cache_size() == chunk_c
-        assert tp._decode._cache_size() == decode_c
+        with watcher:                        # raises on any mesh compile
+            tp.generate(prompts, max_new_tokens=8)
+        assert watcher.new_compiles() == []
 
     def test_tp_cache_is_sharded_along_heads(self):
         from jax.sharding import PartitionSpec as P

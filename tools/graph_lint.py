@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Static-analysis CLI over jitted graphs, the LLM serving engine's
+executable grid, imported static programs, and the op-kernel sources.
+
+Thin wrapper: the implementation (and the `graph-lint` console script)
+lives in ``paddle_tpu.framework.analysis`` so it ships with the wheel;
+this file exists so a checkout can run ``python tools/graph_lint.py``
+without installing.  See docs/ANALYSIS.md for the rule catalog.
+
+Examples::
+
+    python tools/graph_lint.py engine --tp 2
+    python tools/graph_lint.py program /path/to/export/inference
+    python tools/graph_lint.py ops paddle_tpu/ops
+    python tools/graph_lint.py fn mypkg.mod:f --arg f32[4,8]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.framework.analysis import main
+
+if __name__ == "__main__":
+    sys.exit(main())
