@@ -8,6 +8,9 @@ the engine, and timestamps every generated token.  Reported:
 - tokens/s        end-to-end generated-token throughput
 - p50/p99 ms      inter-token latency (per-request gap between tokens)
 - ttft p50 ms     arrival -> first token
+- tpot p50/p95    per-REQUEST time-per-output-token (decode pace after
+                  the first token)
+- e2e p50/p95     per-request end-to-end latency (arrival -> last token)
 
 ``vs_baseline`` is throughput relative to the same trace replayed at
 max_batch=1 — i.e. the measured win of continuous batching itself over
@@ -29,7 +32,20 @@ replay is token-exact against the single-device one.  ``--artifact``
 additionally writes a MULTICHIP-style JSON file so the round harness
 records TP serving alongside the training dryruns.
 
-Prints ONE JSON line (bench.py convention).
+``--spec K`` replays a REPETITIVE agentic-style trace (templated
+prompts, cyclic greedy continuations) with n-gram speculative decoding
+on (up to K draft tokens per sequence per step, scored by one jitted
+verify launch) and off, asserts the speculative replay is token-exact,
+and reports the throughput ratio plus the measured draft acceptance
+rate.  Speculation wins exactly where decode is launch-bound: the
+verify step retires several tokens for one step's worth of overhead —
+on a CPU host that regime is small batch (``--max-batch 1`` is the
+single-stream latency case speculative decoding exists for; at large
+batch the XLA-CPU step cost grows with rows and the win shrinks).
+
+Prints ONE JSON line (bench.py convention).  ``--artifact PATH``
+additionally writes the row as a JSON artifact in every mode
+(MULTICHIP-style under --tp).
 
 Usage: python benchmarks/bench_serving.py [--requests 32 --rate 256
         --max-new 24 --max-batch 8 --no-baseline]
@@ -37,6 +53,8 @@ Usage: python benchmarks/bench_serving.py [--requests 32 --rate 256
         [--requests 64 --prefix-len 256 --max-new 16]
        python benchmarks/bench_serving.py --tp 2
         [--artifact MULTICHIP_serving.json]
+       python benchmarks/bench_serving.py --spec 4 --max-batch 1
+        [--requests 16 --max-new 48 --artifact BENCH_spec.json]
 """
 
 import argparse
@@ -70,7 +88,8 @@ def _force_device_count(n):
 
 
 def _build_engine(max_batch, seed=0, max_model_len=64,
-                  prefix_caching=True, token_budget=64, tp=1):
+                  prefix_caching=True, token_budget=64, tp=1,
+                  speculative=None):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.models.gpt import gpt_tiny
@@ -82,7 +101,8 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
                      max_model_len=max_model_len,
                      enable_prefix_caching=prefix_caching,
                      token_budget=token_budget,
-                     tensor_parallel=tp if tp > 1 else None)
+                     tensor_parallel=tp if tp > 1 else None,
+                     speculative=speculative)
 
 
 def _trace(n_requests, rate, max_new, seed=0):
@@ -110,6 +130,24 @@ def _shared_prefix_trace(n_requests, rate, max_new, prefix_len, seed=0):
     return arrivals, prompts, new_tokens
 
 
+def _repetitive_trace(n_requests, rate, max_new, seed=0):
+    """Agentic-style workload for speculative decoding: every prompt is
+    a short template pattern repeated (tool-call loops, boilerplate
+    edits), so the n-gram drafter has history to look up from step one
+    and greedy decode settles into drafable cycles."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    prompts = []
+    for _ in range(n_requests):
+        pat = rng.randint(0, 128, (int(rng.randint(3, 7)),))
+        reps = int(rng.randint(2, 4))
+        prompts.append(np.tile(pat, reps).astype(np.int32))
+    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
+                  for _ in range(n_requests)]
+    return arrivals, prompts, new_tokens
+
+
 def run(engine, arrivals, prompts, new_tokens):
     """Replay the trace in real time; returns per-token timing data."""
     # compile ALL prefill/decode buckets outside the timed window —
@@ -121,11 +159,13 @@ def run(engine, arrivals, prompts, new_tokens):
     pending = list(range(len(prompts)))
     arrival_at = {}                  # request index -> absolute time
     rid_to_idx = {}
+    first_token_at = {}              # rid -> time of its first token
     last_token_at = {}               # rid -> time of its previous token
     gen_counts = {}                  # rid -> tokens seen so far
     total_tokens_done = [0]          # tokens of already-finished requests
     outputs = {}                     # request index -> full token ids
     ttfts, gaps = [], []
+    tpots, e2es = [], []             # per-REQUEST decode pace / latency
     done = 0
     while done < len(prompts):
         now = time.perf_counter() - t0
@@ -156,10 +196,21 @@ def run(engine, arrivals, prompts, new_tokens):
                 gen_counts[rid] += 1
                 if gen_counts[rid] == 1:
                     ttfts.append(t_step - arrival_at[rid])
+                    first_token_at[rid] = t_step
                 else:
                     gaps.append(t_step - last_token_at[rid])
                 last_token_at[rid] = t_step
             if rid in fin_lens:
+                # per-request summary metrics: time-per-output-token
+                # (decode pace after the first token) and end-to-end
+                # latency (arrival -> last token)
+                n = gen_counts[rid]
+                if n >= 2:
+                    tpots.append((last_token_at[rid]
+                                  - first_token_at.pop(rid)) / (n - 1))
+                else:
+                    first_token_at.pop(rid, None)
+                e2es.append(t_step - arrival_at[rid])
                 total_tokens_done[0] += gen_counts.pop(rid)
         if not engine.has_unfinished() and pending:
             time.sleep(min(0.005, arrivals[pending[0]] - now
@@ -176,8 +227,17 @@ def run(engine, arrivals, prompts, new_tokens):
         else None,
         "ttft_p50_ms": float(np.percentile(ttfts, 50) * 1e3) if ttfts
         else None,
+        "tpot_p50_ms": float(np.percentile(tpots, 50) * 1e3) if tpots
+        else None,
+        "tpot_p95_ms": float(np.percentile(tpots, 95) * 1e3) if tpots
+        else None,
+        "e2e_p50_ms": float(np.percentile(e2es, 50) * 1e3) if e2es
+        else None,
+        "e2e_p95_ms": float(np.percentile(e2es, 95) * 1e3) if e2es
+        else None,
         "preemptions": engine.scheduler.num_preemptions,
         "prefix_cache": engine.prefix_cache_stats(),
+        "spec": engine.spec_stats(),
         "outputs": outputs,
     }
 
@@ -207,9 +267,19 @@ def main():
                          "on a single-chip host)")
     ap.add_argument("--token-budget", type=int, default=64,
                     help="scheduler token budget per step")
+    ap.add_argument("--spec", type=int, default=0, metavar="K",
+                    help="speculative decoding with up to K n-gram "
+                         "draft tokens per sequence, replayed on a "
+                         "repetitive (agentic-style) trace; baseline "
+                         "is the same trace with speculation off")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="(--spec only) replay each engine this many "
+                         "times and keep the best run — wall-clock on "
+                         "a shared host is too noisy for one-shot "
+                         "A/B ratios")
     ap.add_argument("--artifact", default=None,
-                    help="with --tp: also write a MULTICHIP-style JSON "
-                         "artifact to this path")
+                    help="also write the bench row as a JSON artifact "
+                         "to this path (MULTICHIP-style under --tp)")
     args = ap.parse_args()
 
     if args.tp > 1:
@@ -219,6 +289,8 @@ def main():
 
     if args.tp > 1:
         return _main_tp(args, jax)
+    if args.spec > 0:
+        return _main_spec(args, jax)
     if args.shared_prefix:
         return _main_shared_prefix(args, jax)
 
@@ -233,7 +305,7 @@ def main():
         base_res = run(base, arrivals, prompts, new_tokens)
         vs_baseline = res["tokens_per_s"] / base_res["tokens_per_s"]
 
-    print(json.dumps({
+    row = {
         "metric": "llm_serving_throughput",
         "value": round(res["tokens_per_s"], 2),
         "unit": "tokens/s",
@@ -242,12 +314,104 @@ def main():
         "p50_token_ms": round(res["p50_token_ms"], 2),
         "p99_token_ms": round(res["p99_token_ms"], 2),
         "ttft_p50_ms": round(res["ttft_p50_ms"], 2),
+        "tpot_p50_ms": round(res["tpot_p50_ms"], 2),
+        "tpot_p95_ms": round(res["tpot_p95_ms"], 2),
+        "e2e_p50_ms": round(res["e2e_p50_ms"], 2),
+        "e2e_p95_ms": round(res["e2e_p95_ms"], 2),
         "requests": args.requests,
         "preemptions": res["preemptions"],
         "max_batch": args.max_batch,
         "backend": jax.default_backend(),
         "config": "gpt_tiny 2L block_size=8 max_model_len=64",
-    }))
+    }
+    print(json.dumps(row))
+    _write_artifact(args, row, ok=True)
+
+
+def _write_artifact(args, row, ok):
+    if not args.artifact:
+        return
+    with open(args.artifact, "w") as f:
+        json.dump({"ok": bool(ok), "rc": 0 if ok else 1,
+                   "bench": row}, f)
+
+
+def _main_spec(args, jax):
+    """Replay a repetitive trace with n-gram speculative decoding on
+    and off; assert the speculative replay is token-exact (greedy
+    acceptance is longest-prefix-vs-argmax, so this must hold by
+    construction) and report the decode-throughput ratio plus the
+    measured draft acceptance rate."""
+    # prompts stay short; leave head-room for the full generation
+    max_model_len = 32 + args.max_new
+    arrivals, prompts, new_tokens = _repetitive_trace(
+        args.requests, args.rate, args.max_new, args.seed)
+    # speculation is a DECODE-throughput optimisation, so measure the
+    # saturated regime: a Poisson-paced trace is arrival-limited (both
+    # engines finish shortly after the last arrival) and would measure
+    # the trace, not the decoder.  Queue everything at t=0 instead.
+    arrivals = np.zeros_like(arrivals)
+    # wall-clock on a shared CPU host is noisy (spec-vs-base ratios
+    # swing +-30% run to run), so replay each engine --repeats times and
+    # keep the best run — standard best-of-N; the engine (and its
+    # compiled executables) is reused so only the first replay pays
+    # warmup.  token-exactness is asserted across EVERY replay pair.
+    reps = max(1, args.repeats)
+
+    eng = _build_engine(args.max_batch, args.seed,
+                        max_model_len=max_model_len,
+                        token_budget=args.token_budget,
+                        speculative=args.spec)
+    spec_runs = [run(eng, arrivals, prompts, new_tokens)
+                 for _ in range(reps)]
+    res = max(spec_runs, key=lambda r: r["tokens_per_s"])
+
+    vs_nonspec = None
+    base_tpot = None
+    token_exact = True
+    if not args.no_baseline:
+        base = _build_engine(args.max_batch, args.seed,
+                             max_model_len=max_model_len,
+                             token_budget=args.token_budget)
+        base_runs = [run(base, arrivals, prompts, new_tokens)
+                     for _ in range(reps)]
+        base_res = max(base_runs, key=lambda r: r["tokens_per_s"])
+        vs_nonspec = res["tokens_per_s"] / base_res["tokens_per_s"]
+        base_tpot = base_res["tpot_p50_ms"]
+        token_exact = all(r["outputs"] == b["outputs"]
+                          for r in spec_runs for b in base_runs)
+
+    sp = res["spec"]
+    row = {
+        "metric": "llm_serving_spec",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "spec_tokens": args.spec,
+        "vs_nonspec": (round(vs_nonspec, 3)
+                       if vs_nonspec is not None else None),
+        "token_exact": token_exact,
+        "acceptance_rate": round(sp["acceptance_rate"], 3),
+        "draft_tokens": sp["draft_tokens"],
+        "accepted_tokens": sp["accepted_tokens"],
+        "spec_steps": sp["spec_steps"],
+        "tpot_p50_ms": round(res["tpot_p50_ms"], 2),
+        "tpot_p95_ms": round(res["tpot_p95_ms"], 2),
+        "baseline_tpot_p50_ms": (round(base_tpot, 2)
+                                 if base_tpot is not None else None),
+        "e2e_p50_ms": round(res["e2e_p50_ms"], 2),
+        "e2e_p95_ms": round(res["e2e_p95_ms"], 2),
+        "ttft_p50_ms": round(res["ttft_p50_ms"], 2),
+        "requests": args.requests,
+        "max_batch": args.max_batch,
+        "repeats": reps,
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    _write_artifact(args, row, ok=token_exact)
+    if not token_exact:
+        raise SystemExit("speculative replay diverged from non-spec")
 
 
 def _main_tp(args, jax):
@@ -280,6 +444,10 @@ def _main_tp(args, jax):
         "token_exact": token_exact,
         "p50_token_ms": round(res["p50_token_ms"], 2),
         "ttft_p50_ms": round(res["ttft_p50_ms"], 2),
+        "tpot_p50_ms": round(res["tpot_p50_ms"], 2),
+        "tpot_p95_ms": round(res["tpot_p95_ms"], 2),
+        "e2e_p50_ms": round(res["e2e_p50_ms"], 2),
+        "e2e_p95_ms": round(res["e2e_p95_ms"], 2),
         "requests": args.requests,
         "preemptions": res["preemptions"],
         "max_batch": args.max_batch,
@@ -323,7 +491,7 @@ def _main_shared_prefix(args, jax):
         base_ttft = base_res["ttft_p50_ms"]
 
     pc = res["prefix_cache"]
-    print(json.dumps({
+    row = {
         "metric": "llm_serving_shared_prefix",
         "value": round(res["tokens_per_s"], 2),
         "unit": "tokens/s",
@@ -333,6 +501,10 @@ def _main_shared_prefix(args, jax):
         "baseline_ttft_p50_ms": (round(base_ttft, 2)
                                  if base_ttft is not None else None),
         "p50_token_ms": round(res["p50_token_ms"], 2),
+        "tpot_p50_ms": round(res["tpot_p50_ms"], 2),
+        "tpot_p95_ms": round(res["tpot_p95_ms"], 2),
+        "e2e_p50_ms": round(res["e2e_p50_ms"], 2),
+        "e2e_p95_ms": round(res["e2e_p95_ms"], 2),
         "hit_rate": round(pc["hit_rate"], 3),
         "reused_blocks": pc["reused_blocks"],
         "evictions": pc["evictions"],
@@ -343,7 +515,9 @@ def _main_shared_prefix(args, jax):
         "backend": jax.default_backend(),
         "config": f"gpt_tiny 2L block_size=8 "
                   f"max_model_len={max_model_len}",
-    }))
+    }
+    print(json.dumps(row))
+    _write_artifact(args, row, ok=True)
 
 
 if __name__ == "__main__":

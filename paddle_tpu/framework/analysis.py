@@ -438,7 +438,8 @@ def analyze_jitted(fn, *args, mesh=None, rules=None, label=""):
 
 def analyze_engine(engine, rules=None):
     """Run the jaxpr rules over every executable of an LLM engine's
-    warmup bucket grid (chunk and decode families), plus S001 placement
+    warmup bucket grid (chunk, decode, and — when the engine was built
+    with ``speculative=`` — the verify family), plus S001 placement
     checks on the live params and K/V pools under tensor parallelism.
 
     Pure analysis: the engine's caches and executable caches are
@@ -798,7 +799,8 @@ def _cli_engine(ns):
     eng = LLMEngine(model, block_size=ns.block_size,
                     max_batch=ns.max_batch, max_model_len=ns.max_model_len,
                     token_budget=ns.token_budget,
-                    tensor_parallel=ns.tp if ns.tp > 1 else None)
+                    tensor_parallel=ns.tp if ns.tp > 1 else None,
+                    speculative=ns.spec if ns.spec > 0 else None)
     findings = analyze_engine(eng, rules=ns.rules)
     if ns.rules is None or "H001" in ns.rules:
         findings += check_host_sync()
@@ -847,6 +849,9 @@ def main(argv=None):
     eng.add_argument("--max-batch", type=int, default=4)
     eng.add_argument("--max-model-len", type=int, default=64)
     eng.add_argument("--token-budget", type=int, default=16)
+    eng.add_argument("--spec", type=int, default=0, metavar="K",
+                     help="lint the speculative verify family too "
+                          "(K = max draft tokens; 0 = off)")
     eng.set_defaults(run=_cli_engine)
 
     prog = sub.add_parser("program", help="lint an exported inference "

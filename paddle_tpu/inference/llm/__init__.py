@@ -12,11 +12,16 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
 - paged_attention: block-table attention dispatch for decode AND
                   prefill chunks (Pallas kernels on TPU, masked-XLA
                   gather fallback everywhere)
-- engine:         LLMEngine (add_request/step/generate, two donated
-                  jitted executables; ``tensor_parallel=N`` shards
-                  params Megatron-style and the paged pool along the
-                  head axis over an 'mp' device mesh) + AsyncLLMEngine
-                  for servers
+- spec:           model-free speculative decoding — prompt-lookup
+                  n-gram drafter (NgramDrafter / SpeculativeConfig);
+                  the engine scores K drafts + 1 bonus position per
+                  sequence in one jitted verify step
+- engine:         LLMEngine (add_request/step/generate, bucketed
+                  donated jitted executables; ``tensor_parallel=N``
+                  shards params Megatron-style and the paged pool along
+                  the head axis over an 'mp' device mesh;
+                  ``speculative=K`` adds the verify family)
+                  + AsyncLLMEngine for servers
 
 See docs/LLM_SERVING.md for design notes and a quickstart.
 """
@@ -33,6 +38,8 @@ from .paged_attention import (  # noqa: F401
     paged_decode_attention_xla,
     paged_prefill_attention,
     paged_prefill_attention_xla,
+    paged_verify_attention,
+    paged_verify_attention_xla,
 )
 from .scheduler import (  # noqa: F401
     PrefillChunk,
@@ -40,9 +47,12 @@ from .scheduler import (  # noqa: F401
     ScheduledBatch,
     Scheduler,
 )
+from .spec import NgramDrafter, SpeculativeConfig  # noqa: F401
 
 __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
+           "NgramDrafter", "SpeculativeConfig",
            "paged_decode_attention", "paged_decode_attention_xla",
-           "paged_prefill_attention", "paged_prefill_attention_xla"]
+           "paged_prefill_attention", "paged_prefill_attention_xla",
+           "paged_verify_attention", "paged_verify_attention_xla"]

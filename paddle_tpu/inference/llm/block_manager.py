@@ -255,6 +255,71 @@ class BlockManager:
         self._tokens[seq_id] = tokens + 1
         return table[-1] * self.block_size + offset, cow
 
+    def append_slots(self, seq_id, n):
+        """Reserve the next ``n`` token slots in one atomic call (the
+        speculative verify step claims 1 + K slots up front: one for
+        the committed token, K for the drafts).
+
+        Returns (slots, cows): ``slots`` are the absolute token slots in
+        append order, ``cows`` the ``(src, dst)`` copy-on-write pairs (at
+        most one — only a shared partial tail ever copies).  Raises
+        NoFreeBlocksError with NO state mutated when the pages don't fit,
+        so the scheduler can retry with fewer drafts before preempting.
+        Unaccepted slots are returned via :meth:`rollback_slots`.
+        """
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"append_slots needs n >= 1, got {n}")
+        table = self._tables[seq_id]
+        tokens = self._tokens[seq_id]
+        new_pages = self.blocks_needed(tokens + n) - len(table)
+        # the tail page takes writes only when it is partially filled
+        # (offset 0 means the new tokens land on fresh pages alone)
+        cow_needed = (tokens % self.block_size != 0 and table
+                      and self._ref[table[-1]] > 1)
+        if new_pages + int(cow_needed) > self.num_free_blocks:
+            raise NoFreeBlocksError(
+                f"need {new_pages + int(cow_needed)} blocks for "
+                f"{n} slots, {self.num_free_blocks} free")
+        cows = []
+        if cow_needed:
+            src = table[-1]
+            dst = self._take()
+            self._ref[src] -= 1              # shared: stays >= 1
+            table[-1] = dst
+            cows.append((src, dst))
+        for _ in range(new_pages):
+            table.append(self._take())
+        self._tokens[seq_id] = tokens + n
+        slots = [table[t // self.block_size] * self.block_size
+                 + t % self.block_size for t in range(tokens, tokens + n)]
+        return slots, cows
+
+    def rollback_slots(self, seq_id, n):
+        """Give back the LAST ``n`` reserved slots (rejected speculative
+        drafts): the token count shrinks and every page no longer
+        holding any of the sequence's tokens is released.  Rolled-back
+        pages are fresh tail pages — never prefix-cache registered (the
+        engine registers full pages only after accepting their tokens),
+        so they return straight to the free pool."""
+        n = int(n)
+        if n == 0:
+            return
+        if n < 0:
+            raise ValueError(f"rollback_slots needs n >= 0, got {n}")
+        tokens = self._tokens[seq_id] - n
+        if tokens < 0:
+            raise ValueError(
+                f"cannot roll back {n} of {self._tokens[seq_id]} tokens")
+        table = self._tables[seq_id]
+        keep = self.blocks_needed(tokens)
+        while len(table) > keep:
+            blk = table.pop()
+            self._ref[blk] -= 1
+            if self._ref[blk] == 0:
+                self._release(blk)
+        self._tokens[seq_id] = tokens
+
     def fork(self, parent_id, child_id):
         """Child shares every parent page (refcounted, copy-on-write on
         the next divergent append)."""

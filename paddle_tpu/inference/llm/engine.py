@@ -18,6 +18,16 @@ through exactly two families of jitted executables:
   gathers K/V through block tables (Pallas paged kernel on TPU, masked
   XLA gather elsewhere), appends one token per sequence.
 
+- verify (``speculative=``): speculative decoding's scoring step — the
+  decode body over a flattened [Bb * (Kb+1), 1] row batch, so each
+  running sequence gets its n-gram DRAFT tokens (see spec.py) plus one
+  bonus position scored through the pool in a single launch.  Greedy
+  acceptance (longest draft prefix matching the target argmax) makes
+  speculative output bitwise identical to plain decode; sampled
+  requests consume one gumbel draw per emitted token, so seeded
+  streams match too.  The family is bucketed over (batch, K) powers of
+  two and covered by warmup/CompileWatcher like everything else.
+
 One scheduler step may launch both: the decode batch first, then each
 scheduled prefill chunk (the scheduler's token budget keeps decodes
 flowing between a long prompt's chunks instead of stalling them).
@@ -64,8 +74,13 @@ from ... import profiler
 from ...framework import jax_compat  # noqa: F401  (aliases jax.shard_map)
 from ...incubate.nn import _layernorm
 from .block_manager import BlockManager, prefix_block_hashes
-from .paged_attention import paged_decode_attention, paged_prefill_attention
+from .paged_attention import (
+    paged_decode_attention,
+    paged_prefill_attention,
+    paged_verify_attention,
+)
 from .scheduler import FINISHED, Request, Scheduler, bucket_size
+from .spec import NgramDrafter, SpeculativeConfig
 
 # Megatron-style sharding of the stacked block params over the 'mp' axis
 # (leading dim is the layer stack): qkv/fc_in split their OUTPUT columns,
@@ -121,12 +136,16 @@ class LLMEngine:
     shards the executables over N devices — see the module docstring.
     ``seed=`` seeds the sampling RNG (temperature > 0); per-request
     ``seed=`` in add_request overrides it with an independent stream.
+    ``speculative=K`` (or a SpeculativeConfig / dict) turns on n-gram
+    speculative decoding with up to K draft tokens per sequence per
+    step — same tokens, fewer device steps on repetitive output.
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None,
                  max_model_len=None, max_batch=8, dtype=None,
                  enable_prefix_caching=True, token_budget=64,
-                 mesh=None, tensor_parallel=None, seed=None):
+                 mesh=None, tensor_parallel=None, seed=None,
+                 speculative=None):
         d = model.functional_decompose()
         cfg = model.config
         self.num_layers = d["num_layers"]
@@ -151,6 +170,10 @@ class LLMEngine:
         # one decode token per running sequence must fit in the budget
         self.token_budget = max(int(token_budget), self.max_batch)
         self.dtype = jnp.dtype(dtype) if dtype else jnp.float32
+        # speculative decoding (None | K | dict | SpeculativeConfig):
+        # an n-gram drafter plus the bucketed verify executable family
+        self.spec = SpeculativeConfig.resolve(speculative)
+        self.drafter = NgramDrafter(self.spec) if self.spec else None
 
         # ------------------------------------------------ mesh resolution --
         if mesh is None and tensor_parallel and int(tensor_parallel) > 1:
@@ -185,7 +208,8 @@ class LLMEngine:
             enable_prefix_caching=enable_prefix_caching)
         self.scheduler = Scheduler(self.block_manager,
                                    max_batch=self.max_batch,
-                                   token_budget=self.token_budget)
+                                   token_budget=self.token_budget,
+                                   drafter=self.drafter)
         cache_shape = (self.num_layers, self.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
 
@@ -194,7 +218,9 @@ class LLMEngine:
         self.seed = 0 if seed is None else int(seed)
         self._rng = np.random.RandomState(self.seed)
         self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
-                      "chunk_launches": 0, "tokens_generated": 0}
+                      "chunk_launches": 0, "tokens_generated": 0,
+                      "spec_steps": 0, "draft_tokens": 0,
+                      "accepted_tokens": 0}
 
         tp = self.tp
         nh, hd, eps = self.num_heads, self.head_dim, self.eps
@@ -360,6 +386,64 @@ class LLMEngine:
             logits = head_logits(params, x[:, 0])
             return jnp.argmax(logits, -1), logits, kc, vc
 
+        def verify_fn(params, ids, kc, vc, block_tables, positions, lens):
+            """Speculative verify: score Kb+1 positions per sequence in
+            ONE device step.  ids [Bb, Kb+1] — row b holds the last
+            committed token then that row's draft tokens (zero-padded);
+            positions [Bb] = cached length per row (-1 for padded rows);
+            lens [Bb] = live query tokens per row (1 + num drafts, 0 for
+            padding).
+
+            The body is the decode graph with Kb+1 query tokens per
+            sequence: query (b, j) sits at position positions[b]+j, so
+            after the per-layer scatter (every query's K/V lands before
+            attention reads) its causal window covers exactly the
+            committed prefix plus drafts 0..j-1 — bitwise the decode
+            step the engine would have run after committing j draft
+            tokens, because every per-element reduction (projections,
+            attention scores, softmax, layernorm, head) matches the
+            single-token decode graph's.  Future drafts sit in the pool
+            but are masked by each query's context length; attention
+            gathers each sequence's pages once for all Kb+1 queries
+            (see paged_verify_attention).  Returns (argmax [Bb, Kb+1],
+            logits [Bb, Kb+1, V], kc, vc)."""
+            emb = params["embed"]
+            bb, kb1 = ids.shape
+            offs = jnp.arange(kb1, dtype=jnp.int32)[None, :]
+            pos = jnp.where(offs < lens[:, None],
+                            positions[:, None] + offs, -1)   # [Bb, Kb1]
+            p_safe = jnp.maximum(pos, 0)
+            x = (emb["word_embeddings.weight"][ids]
+                 + emb["position_embeddings.weight"][p_safe])
+            x = x.astype(self.dtype)
+            flat_pos = p_safe.reshape(-1)
+            rows = jnp.repeat(jnp.arange(bb), kb1)
+            slot = (block_tables[rows, flat_pos // bs] * bs
+                    + flat_pos % bs)
+            slots = jnp.where(pos.reshape(-1) >= 0, slot, nb * bs)
+            ctx = jnp.where(pos >= 0, p_safe + 1, 0)         # [Bb, Kb1]
+
+            def layer(carry, xs):
+                x = carry
+                p_l, kc_l, vc_l = xs
+                q, k, v = attn_proj(p_l, x)      # [Bb, Kb1, nh_l, hd]
+                kc_l = scatter_pages(kc_l, slots,
+                                     k.reshape(bb * kb1, nh_l, hd))
+                vc_l = scatter_pages(vc_l, slots,
+                                     v.reshape(bb * kb1, nh_l, hd))
+                # same pre-scale dance as decode_fn (mirrors the IR pass)
+                scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
+                q = q * (scale * jnp.sqrt(jnp.asarray(hd, q.dtype)))
+                out = paged_verify_attention(q, kc_l, vc_l,
+                                             block_tables, ctx)
+                out = out.astype(x.dtype).reshape(bb, kb1, nh_l * hd)
+                return mlp_residual(p_l, x, out), (kc_l, vc_l)
+
+            x, (kc, vc) = jax.lax.scan(layer, x,
+                                       (params["blocks"], kc, vc))
+            logits = head_logits(params, x)          # [Bb, Kb1, V]
+            return jnp.argmax(logits, -1), logits, kc, vc
+
         if tp > 1:
             # shard_map: each device runs the SAME program on its local
             # head slice — local qkv/fc columns, local pool shard, the
@@ -387,9 +471,13 @@ class LLMEngine:
 
             self._chunk = tp_wrap(chunk_fn, 3)    # table, start, length
             self._decode = tp_wrap(decode_fn, 2)  # tables, positions
+            self._verify = (tp_wrap(verify_fn, 3)  # tables, positions, lens
+                            if self.spec else None)
         else:
             self._chunk = jax.jit(chunk_fn, donate_argnums=(2, 3))
             self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
+            self._verify = (jax.jit(verify_fn, donate_argnums=(2, 3))
+                            if self.spec else None)
 
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
@@ -398,7 +486,11 @@ class LLMEngine:
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
         if len(prompt) + max_new_tokens > self.max_model_len:
             raise ValueError(
                 f"prompt {len(prompt)} + new {max_new_tokens} exceeds "
@@ -434,6 +526,22 @@ class LLMEngine:
             if bb >= self.max_batch:
                 break
             bb = min(bb * 2, self.max_batch)
+        if self.spec is None:
+            return
+        # verify family: (batch bucket, draft bucket) pairs — K is
+        # bucketed to powers of two too, so the family stays
+        # O(log(max_batch) * log(K)) and warmup covers every launch
+        kb = 1
+        while True:
+            bb = 1
+            while True:
+                yield ("verify", (bb, kb))
+                if bb >= self.max_batch:
+                    break
+                bb = min(bb * 2, self.max_batch)
+            if kb >= self.spec.num_tokens:
+                break
+            kb = min(kb * 2, self.spec.num_tokens)
 
     def executable_grid(self):
         """Yield ``(kind, bucket, jitted_fn, abstract_args)`` covering
@@ -450,6 +558,12 @@ class LLMEngine:
                         sds((self.max_pages,), i32), sds((), i32),
                         sds((), i32))
                 yield kind, b, self._chunk, args
+            elif kind == "verify":
+                bb, kb = b
+                args = (self.params, sds((bb, kb + 1), i32), kc, vc,
+                        sds((bb, self.max_pages), i32), sds((bb,), i32),
+                        sds((bb,), i32))
+                yield kind, b, self._verify, args
             else:
                 args = (self.params, sds((b, 1), i32), kc, vc,
                         sds((b, self.max_pages), i32), sds((b,), i32))
@@ -483,6 +597,15 @@ class LLMEngine:
                     _, _, self._kc, self._vc = self._chunk(
                         self.params, ids, self._kc, self._vc, table,
                         jnp.int32(0), jnp.int32(0))
+                elif kind == "verify":
+                    bb, kb = b
+                    ids = jnp.zeros((bb, kb + 1), jnp.int32)
+                    tables = jnp.zeros((bb, self.max_pages), jnp.int32)
+                    positions = jnp.full((bb,), -1, jnp.int32)
+                    lens = jnp.zeros((bb,), jnp.int32)
+                    _, _, self._kc, self._vc = self._verify(
+                        self.params, ids, self._kc, self._vc, tables,
+                        positions, lens)
                 else:
                     ids = jnp.zeros((b, 1), jnp.int32)
                     tables = jnp.zeros((b, self.max_pages), jnp.int32)
@@ -491,8 +614,12 @@ class LLMEngine:
                         self.params, ids, self._kc, self._vc, tables,
                         positions)
         from ...framework.analysis import CompileWatcher
-        return CompileWatcher(self._chunk, self._decode,
-                              labels=("chunk", "decode"))
+        fns = [self._chunk, self._decode]
+        labels = ["chunk", "decode"]
+        if self._verify is not None:
+            fns.append(self._verify)
+            labels.append("verify")
+        return CompileWatcher(*fns, labels=tuple(labels))
 
     # --------------------------------------------------------------- step --
     def step(self):
@@ -507,33 +634,10 @@ class LLMEngine:
         reqs = batch.requests
         if reqs:
             self.stats["decode_steps"] += 1
-            bb = bucket_size(len(reqs), self.max_batch)
-            ids = np.zeros((bb, 1), np.int32)
-            positions = np.full(bb, -1, np.int32)
-            tables = np.zeros((bb, self.max_pages), np.int32)
-            for i, r in enumerate(reqs):
-                ids[i, 0] = r.all_ids[-1]
-                positions[i] = r.num_cached
-                bt = self.block_manager.block_table(r.request_id)
-                tables[i, :len(bt)] = bt
-            with profiler.RecordEvent("llm_engine::decode"):
-                nxt, logits, self._kc, self._vc = self._decode(
-                    self.params, jnp.asarray(ids), self._kc, self._vc,
-                    jnp.asarray(tables), jnp.asarray(positions))
-            nxt = np.asarray(nxt)
-            # fetch ONLY the rows that sample: greedy-only batches
-            # transfer exactly the [Bb] token vector above, and a mixed
-            # batch pays for its sampling rows, not [Bb, V]
-            samp = [i for i, r in enumerate(reqs) if r.temperature > 0.0]
-            row_logits = {}
-            if samp:
-                sel = np.asarray(logits[np.asarray(samp, np.int32)])
-                row_logits = dict(zip(samp, sel))
-            for i, r in enumerate(reqs):
-                r.num_cached += 1
-                if r.num_cached % self.block_size == 0:
-                    self._register_full_blocks(r)
-                self._commit_token(r, nxt[i], row_logits.get(i), finished)
+            if any(r.draft_tokens for r in reqs):
+                self._verify_step(reqs, finished)
+            else:
+                self._decode_step(reqs, finished)
         if batch.chunks:
             self.stats["prefill_steps"] += 1
         for ch in batch.chunks:
@@ -554,9 +658,9 @@ class LLMEngine:
             req.num_cached = ch.start + ch.length
             self._register_full_blocks(req)
             if ch.is_final:
-                # logits is a device [V] vector; _commit_token fetches it
+                # logits is a device [V] vector; the commit fetches it
                 # only when this request samples
-                self._commit_token(req, nxt, logits, finished)
+                self._commit_tokens([(req, nxt, logits)], finished)
         if self.tp > 1:
             # ONE host-side allocator drives every shard (tables ride
             # replicated), so page accounting must be shard-invariant:
@@ -588,25 +692,172 @@ class LLMEngine:
                 "evictions": bm.prefix_evictions,
                 "cached_blocks": bm.num_cached_blocks}
 
-    def _commit_token(self, req, argmax_token, logits, finished):
-        if req.temperature > 0.0:
-            logits = np.asarray(logits, np.float64) / req.temperature
-            if req.seed is not None:
-                if req._sample_rng is None:
-                    req._sample_rng = np.random.RandomState(req.seed)
-                rng = req._sample_rng
-            else:
-                rng = self._rng
-            gumbel = rng.gumbel(size=logits.shape)
-            tok = int(np.argmax(logits + gumbel))
+    def _decode_step(self, reqs, finished):
+        """Plain decode: one token per running sequence."""
+        bb = bucket_size(len(reqs), self.max_batch)
+        ids = np.zeros((bb, 1), np.int32)
+        positions = np.full(bb, -1, np.int32)
+        tables = np.zeros((bb, self.max_pages), np.int32)
+        for i, r in enumerate(reqs):
+            ids[i, 0] = r.all_ids[-1]
+            positions[i] = r.num_cached
+            bt = self.block_manager.block_table(r.request_id)
+            tables[i, :len(bt)] = bt
+        with profiler.RecordEvent("llm_engine::decode"):
+            nxt, logits, self._kc, self._vc = self._decode(
+                self.params, jnp.asarray(ids), self._kc, self._vc,
+                jnp.asarray(tables), jnp.asarray(positions))
+        nxt = np.asarray(nxt)
+        row_logits = self._fetch_sampling_rows(reqs, logits)
+        entries = []
+        for i, r in enumerate(reqs):
+            r.num_cached += 1
+            if r.num_cached % self.block_size == 0:
+                self._register_full_blocks(r)
+            entries.append((r, nxt[i], row_logits.get(i)))
+        self._commit_tokens(entries, finished)
+
+    def _verify_step(self, reqs, finished):
+        """Speculative decode: score every row's drafts (plus the bonus
+        position) in one verify launch, then commit the accepted run."""
+        self.stats["spec_steps"] += 1
+        kb = bucket_size(max(len(r.draft_tokens) for r in reqs),
+                         self.spec.num_tokens)
+        bb = bucket_size(len(reqs), self.max_batch)
+        ids = np.zeros((bb, kb + 1), np.int32)
+        positions = np.full(bb, -1, np.int32)
+        lens = np.zeros(bb, np.int32)
+        tables = np.zeros((bb, self.max_pages), np.int32)
+        for i, r in enumerate(reqs):
+            d = len(r.draft_tokens)
+            ids[i, 0] = r.all_ids[-1]
+            if d:
+                ids[i, 1:1 + d] = r.draft_tokens
+            positions[i] = r.num_cached
+            lens[i] = 1 + d
+            bt = self.block_manager.block_table(r.request_id)
+            tables[i, :len(bt)] = bt
+        with profiler.RecordEvent("llm_engine::verify"):
+            nxt, logits, self._kc, self._vc = self._verify(
+                self.params, jnp.asarray(ids), self._kc, self._vc,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(lens))
+        nxt = np.asarray(nxt)
+        row_logits = self._fetch_sampling_rows(reqs, logits)
+        for i, r in enumerate(reqs):
+            self._commit_verified(r, nxt[i], row_logits.get(i), finished)
+
+    def _fetch_sampling_rows(self, reqs, logits):
+        """Fetch ONLY the logits rows of requests that sample: greedy
+        batches transfer just the token vector, and a mixed batch pays
+        for its sampling rows, not the whole [Bb, ...] logits."""
+        samp = [i for i, r in enumerate(reqs) if r.temperature > 0.0]
+        if not samp:
+            return {}
+        sel = np.asarray(logits[np.asarray(samp, np.int32)])
+        return dict(zip(samp, sel))
+
+    def _sample_token(self, req, logits):
+        """Gumbel-max sample of one host logits row from the request's
+        stream (``seed=``) or the engine stream."""
+        z = np.asarray(logits, np.float64) / req.temperature
+        if req.seed is not None:
+            if req._sample_rng is None:
+                req._sample_rng = np.random.RandomState(req.seed)
+            rng = req._sample_rng
         else:
-            tok = int(argmax_token)
-        req.output_ids.append(tok)
-        self.stats["tokens_generated"] += 1
-        if (req.eos_token_id is not None and tok == req.eos_token_id):
-            self._finish(req, "stop", finished)
-        elif len(req.output_ids) >= req.max_new_tokens:
-            self._finish(req, "length", finished)
+            rng = self._rng
+        return int(np.argmax(z + rng.gumbel(size=z.shape)))
+
+    def _commit_tokens(self, entries, finished):
+        """Commit one token per (req, argmax, logits) entry, in order.
+        Engine-stream sampling rows share ONE vectorized gumbel draw:
+        the legacy RandomState fills an (n, V) array in C order, so the
+        batch is bitwise identical to the n sequential per-row draws it
+        replaces — seeded outputs don't move.  Per-request streams
+        (``seed=``) draw row-by-row as before (each owns one row here).
+        """
+        eng_rows = [j for j, (r, _t, _lg) in enumerate(entries)
+                    if r.temperature > 0.0 and r.seed is None]
+        picked = {}
+        if eng_rows:
+            z = np.stack([np.asarray(entries[j][2], np.float64)
+                          / entries[j][0].temperature for j in eng_rows])
+            g = self._rng.gumbel(size=z.shape)
+            for j, t in zip(eng_rows, np.argmax(z + g, axis=-1)):
+                picked[j] = int(t)
+        for j, (req, argmax_token, logits) in enumerate(entries):
+            if req.temperature > 0.0:
+                tok = picked[j] if j in picked \
+                    else self._sample_token(req, logits)
+            else:
+                tok = int(argmax_token)
+            req.output_ids.append(tok)
+            self.stats["tokens_generated"] += 1
+            if (req.eos_token_id is not None
+                    and tok == req.eos_token_id):
+                self._finish(req, "stop", finished)
+            elif len(req.output_ids) >= req.max_new_tokens:
+                self._finish(req, "length", finished)
+
+    def _commit_verified(self, req, argmax_row, logits_row, finished):
+        """Acceptance + bulk commit for one verified row.
+
+        Tokens emit in position order; a sampled request consumes
+        exactly one gumbel draw per EMITTED token (the draft is a
+        point-mass proposal, so sample-and-match is exact rejection
+        sampling), keeping its stream bitwise aligned with the
+        non-speculative engine.  Unaccepted slots roll back BEFORE
+        prefix-cache registration, so the cache only ever sees pages
+        full of accepted tokens."""
+        drafts = req.draft_tokens
+        req.draft_tokens = []
+        d = len(drafts)
+        self.stats["draft_tokens"] += d
+        reason = None
+        emitted = 0
+        for j in range(d + 1):
+            if req.temperature > 0.0:
+                tok = self._sample_token(req, logits_row[j])
+            else:
+                tok = int(argmax_row[j])
+            req.output_ids.append(tok)
+            emitted += 1
+            self.stats["tokens_generated"] += 1
+            matched = j < d and tok == drafts[j]
+            if matched:
+                self.stats["accepted_tokens"] += 1
+            if req.eos_token_id is not None and tok == req.eos_token_id:
+                reason = "stop"
+                break
+            if len(req.output_ids) >= req.max_new_tokens:
+                reason = "length"
+                break
+            if not matched:
+                break
+        # the scheduler reserved 1 + d slots; keep the emitted ones.
+        # K/V through position num_cached + emitted - 1 stays valid:
+        # every kept position's token matched its draft (the last
+        # emitted token's slot is the first one rolled back, preserving
+        # the num_cached == len(all_ids) - 1 decode invariant).
+        pages_before = req.num_cached // self.block_size
+        req.num_cached += emitted
+        self.block_manager.rollback_slots(req.request_id,
+                                          1 + d - emitted)
+        if req.num_cached // self.block_size > pages_before:
+            self._register_full_blocks(req)
+        if reason is not None:
+            self._finish(req, reason, finished)
+
+    def spec_stats(self):
+        """Speculative-decoding counters (acceptance rate for benches)."""
+        s = self.stats
+        prop = s["draft_tokens"]
+        return {"spec_steps": s["spec_steps"],
+                "draft_tokens": prop,
+                "accepted_tokens": s["accepted_tokens"],
+                "acceptance_rate":
+                    s["accepted_tokens"] / prop if prop else 0.0}
 
     def _finish(self, req, reason, finished):
         self.scheduler.remove_running(req)
@@ -625,6 +876,14 @@ class LLMEngine:
         request of this call its own deterministic sampling stream
         (independent of arrival interleaving); default None keeps the
         engine-level RNG."""
+        # validate shared knobs BEFORE any request is queued, so a bad
+        # call leaves the engine empty instead of half-submitted
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
         if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
             prompts = list(prompts)
         elif not isinstance(prompts, (list, tuple)):

@@ -19,6 +19,20 @@ EARLIER token's K/V — prior chunks and prefix-cache hits included — so
 prefill now reads the paged pool through the block table exactly like
 decode does, instead of attending over its own chunk only.
 
+Speculative verify gets a third entry point with DECODE semantics per
+row: each sequence carries K drafts + 1 bonus position as K+1
+single-token query rows, with per-row context ``lengths`` enforcing
+causality (row j sees positions <= pos+j, so the later drafts already
+scattered into the pool stay masked).  On the XLA path the K+1 rows
+fold into the GQA group axis so the sequence's pages are gathered ONCE
+(the flattened form would re-gather the same pages K+1 times — on CPU
+that redundant traffic eats most of the speculation win); every
+per-element reduction is the same as single-token decode's, so scores
+stay bitwise identical to the decode step the engine would have run.
+On the Pallas path verify flattens into the proven decode kernel — the
+kernel DMAs only the pages a row owns, so redundancy there is cheap
+and no new kernel is needed.
+
 Tensor parallelism: both entry points are head-count generic, and
 attention never mixes heads — so the TP engine calls them UNCHANGED
 from inside ``jax.shard_map`` with per-shard shapes (q [.., Nq/mp, D],
@@ -63,6 +77,61 @@ def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
             q, k_pages, v_pages, block_tables, lengths, interpret=interpret)
     return paged_decode_attention_xla(q, k_pages, v_pages, block_tables,
                                       lengths)
+
+
+def paged_verify_attention_xla(q, k_pages, v_pages, block_tables, ctx):
+    """Speculative verify: q [B, T, Nq, D] — T single-token query rows
+    per sequence at consecutive positions; ctx [B, T] is each row's
+    visible context length (0 for dead rows -> exact-zero output).
+
+    Gathers each sequence's pages ONCE and folds the T rows into the
+    GQA group axis before running decode_attention_xla's exact masked
+    chain (same einsum strings, f32 softmax, -1e30 mask).  Every
+    (query, key) score and every softmax row reduces over the same
+    elements in the same order as a [B*T] flattened single-token decode
+    batch, so the outputs are bitwise the decode steps the engine would
+    have run — at 1/T of the flattened form's gather traffic.
+    """
+    b, t, nq, d = q.shape
+    num_pages = block_tables.shape[1]
+    _, bs, nkv, _ = k_pages.shape
+    s_max = num_pages * bs
+    k = k_pages[block_tables].reshape(b, s_max, nkv, d)
+    v = v_pages[block_tables].reshape(b, s_max, nkv, d)
+    g = nq // nkv
+    qg = (q.reshape(b, t, nkv, g, d).transpose(0, 2, 1, 3, 4)
+          .reshape(b, nkv, t * g, d))
+    lens_tg = jnp.repeat(ctx, g, axis=1)            # [B, T*G], t-major
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("bngd,bsnd->bngs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(s_max)[None, None, None, :] < \
+        lens_tg[:, None, :, None]
+    logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bngs,bsnd->bngd", p, v.astype(jnp.float32))
+    out = jnp.where(lens_tg[:, None, :, None] > 0, out, 0.0)
+    return (out.reshape(b, nkv, t, g, d).transpose(0, 2, 1, 3, 4)
+            .reshape(b, t, nq, d).astype(q.dtype))
+
+
+def paged_verify_attention(q, k_pages, v_pages, block_tables, ctx,
+                           interpret=False):
+    """q [B, T, Nq, D] verify rows x paged pool -> [B, T, Nq, D]; ctx
+    masks per row.  Pallas path flattens into the decode kernel (it
+    DMAs only owned pages, so per-row gather is cheap there); XLA path
+    gathers once per sequence."""
+    b, t, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    if ((_use_pallas() or interpret)
+            and _kernel.supports(bs, d, nq, nkv)):
+        flat = _kernel.paged_decode_attention_pallas(
+            q.reshape(b * t, nq, d), k_pages, v_pages,
+            jnp.repeat(block_tables, t, axis=0), ctx.reshape(b * t),
+            interpret=interpret)
+        return flat.reshape(b, t, nq, d)
+    return paged_verify_attention_xla(q, k_pages, v_pages, block_tables,
+                                      ctx)
 
 
 def paged_prefill_attention_xla(q, k_pages, v_pages, block_table, start):

@@ -56,6 +56,9 @@ class Request:
     num_preemptions: int = 0
     status: str = WAITING
     finish_reason: str = None
+    # draft tokens proposed for THIS step's verify launch (speculative
+    # decoding); empty means the row rides the plain decode executable
+    draft_tokens: list = field(default_factory=list)
     _sample_rng: object = field(default=None, repr=False, compare=False)
 
     @property
@@ -96,13 +99,18 @@ class Scheduler:
     """Admission queue + running set + preempt-on-OOM policy."""
 
     def __init__(self, block_manager, max_batch=8, watermark_blocks=1,
-                 token_budget=64):
+                 token_budget=64, drafter=None):
         self.block_manager = block_manager
         self.max_batch = int(max_batch)
         self.watermark_blocks = int(watermark_blocks)
         # the budget must cover one decode token per running sequence,
         # or a full batch would starve every waiting prefill forever
         self.token_budget = max(int(token_budget), self.max_batch)
+        # speculative decoding: a drafter proposes up to K draft tokens
+        # per decode row; drafts are charged against the SAME token
+        # budget (a verify row costs 1 + len(drafts) tokens), so
+        # speculation and chunked prefill share the step fairly
+        self.drafter = drafter
         self.waiting = []       # FIFO; preempted sequences rejoin at the head
         self.running = []       # arrival order == preemption priority
         self.num_preemptions = 0
@@ -132,26 +140,50 @@ class Scheduler:
         budget = self.token_budget
         decodes, chunks = [], []
 
-        # -- decode phase: one slot per fully-prefilled running sequence
+        # -- decode phase: one slot per fully-prefilled running sequence,
+        # plus up to K draft slots each when a drafter is attached.  One
+        # decode token per pending sequence is reserved UP FRONT, so a
+        # greedy drafter can spend only the spare budget and never
+        # starves another sequence's decode slot.
+        spare = budget - sum(1 for r in self.running if r.prefill_done)
         i = 0
         while i < len(self.running):
             req = self.running[i]
             if not req.prefill_done:
                 i += 1
                 continue        # mid-prefill: the chunk phase feeds it
+            drafts = []
+            if self.drafter is not None and spare > 0:
+                # the bonus token always lands, so draft at most
+                # max_new - generated - 1 (a draft past the length cap
+                # could never be accepted into the output)
+                cap = min(spare,
+                          req.max_new_tokens - len(req.output_ids) - 1)
+                if cap > 0:
+                    drafts = self.drafter.propose(req.all_ids, cap)
             try:
-                bm.append_slot(req.request_id)
+                if drafts:
+                    try:
+                        bm.append_slots(req.request_id, 1 + len(drafts))
+                    except NoFreeBlocksError:
+                        drafts = []   # degrade to plain decode first
+                if not drafts:
+                    bm.append_slot(req.request_id)
             except NoFreeBlocksError:
                 victim = self.running[-1]
                 if victim is req and len(self.running) == 1:
                     raise RuntimeError(
                         "KV cache cannot hold a single sequence — "
                         "raise num_blocks or lower max_model_len")
+                if victim.prefill_done:
+                    spare += 1  # its reserved decode token is freed
                 self._preempt(victim)
                 continue        # retry req (or fall off the end)
+            req.draft_tokens = drafts
+            spare -= len(drafts)
             decodes.append(req)
-            budget -= 1
             i += 1
+        budget = spare
 
         # -- chunk phase: continue sequences already mid-prefill
         for req in self.running:
@@ -228,6 +260,7 @@ class Scheduler:
         self.running.remove(victim)
         self.block_manager.free(victim.request_id)
         victim.num_cached = 0
+        victim.draft_tokens = []
         victim.num_preemptions += 1
         victim.status = WAITING
         self.num_preemptions += 1

@@ -1,0 +1,109 @@
+"""Model-free speculative decoding: prompt-lookup n-gram drafting.
+
+Decode throughput at small batch is launch-latency-bound on TPU — the
+device finishes a one-token step long before the host can schedule the
+next one.  Speculative decoding amortizes that: a cheap DRAFTER guesses
+the next K tokens of each running sequence and one jitted VERIFY step
+scores all K+1 positions through the paged pool at once (the verify
+executable is the decode body over a flattened [B*(K+1)] row batch —
+see LLMEngine).  Accepted tokens commit in bulk; the first mismatch
+falls back to the target model's own token, so output is exactly what
+step-by-step decode would have produced.
+
+The drafter here is prompt lookup (model-free n-gram matching, the
+"assisted generation without a draft model" trick): the last few tokens
+of a sequence are searched for earlier in its own prompt+output history,
+and the continuation of the most recent previous occurrence becomes the
+draft.  Repetitive workloads — agentic tool loops, code edits, extractive
+summaries, shared boilerplate — hit constantly; free-form prose rarely
+matches and the engine transparently degrades to plain decode (a
+sequence with no draft costs exactly one decode slot, as before).
+
+Acceptance rule (per sequence, drafts d_0..d_{K-1}, verify row gives the
+target distribution at every position):
+
+- greedy: commit the longest prefix with d_j == argmax_j, plus the
+  target's own argmax at the first mismatch (the "bonus" token) —
+  bitwise identical to non-speculative greedy by construction;
+- temperature > 0: walk the positions in order, drawing ONE gumbel
+  sample from the request's stream per emitted token; while the sample
+  equals the draft, keep going.  Each emitted token is an exact sample
+  from the target softmax (the draft proposes a point mass, so
+  sample-and-match IS rejection sampling for that proposal), and the
+  draw count equals the emit count — per-request seeded streams stay
+  bitwise identical to the non-speculative engine.
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class SpeculativeConfig:
+    """Knobs for n-gram speculative decoding.
+
+    num_tokens: max draft length K per sequence per step (the verify
+        executable family is bucketed over powers of two up to K).
+    max_ngram / min_ngram: the drafter matches the longest suffix of the
+        history between these lengths (longer matches first — a 3-gram
+        hit is a stronger signal than a 1-gram hit).
+    """
+    num_tokens: int = 4
+    max_ngram: int = 3
+    min_ngram: int = 1
+
+    def __post_init__(self):
+        if self.num_tokens < 1:
+            raise ValueError("speculative num_tokens must be >= 1")
+        if not (1 <= self.min_ngram <= self.max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{self.min_ngram}..{self.max_ngram}")
+
+    @classmethod
+    def resolve(cls, spec):
+        """Engine-kwarg sugar: None | K | dict | SpeculativeConfig."""
+        if spec is None or isinstance(spec, cls):
+            return spec
+        if isinstance(spec, bool):      # speculative=True: defaults
+            return cls() if spec else None
+        if isinstance(spec, int):
+            return cls(num_tokens=spec)
+        if isinstance(spec, dict):
+            return cls(**spec)
+        raise TypeError(
+            f"speculative= takes None/bool/int/dict/SpeculativeConfig, "
+            f"got {type(spec).__name__}")
+
+
+class NgramDrafter:
+    """Prompt-lookup drafting over a sequence's own token history.
+
+    ``propose`` scans for the most recent earlier occurrence of the
+    history's trailing n-gram (longest n first) and returns the tokens
+    that followed it.  Pure host-side; O(len(history) * max_ngram) per
+    call on lists of python ints — negligible next to a device step.
+    """
+
+    def __init__(self, config):
+        self.config = config
+
+    def propose(self, token_ids, max_tokens):
+        """Draft up to ``max_tokens`` next tokens for ``token_ids``
+        (prompt + output so far).  Returns [] when no n-gram of length
+        min_ngram..max_ngram recurs, or when the budget is 0."""
+        cfg = self.config
+        n_hist = len(token_ids)
+        max_tokens = min(int(max_tokens), cfg.num_tokens)
+        if max_tokens <= 0 or n_hist <= cfg.min_ngram:
+            return []
+        for n in range(min(cfg.max_ngram, n_hist - 1), cfg.min_ngram - 1,
+                       -1):
+            tail = token_ids[n_hist - n:]
+            # most recent earlier occurrence wins (recency beats the
+            # prompt: the sequence's own output is the better predictor)
+            for start in range(n_hist - n - 1, -1, -1):
+                if token_ids[start:start + n] == tail:
+                    cont = token_ids[start + n:start + n + max_tokens]
+                    if cont:
+                        return list(cont)
+        return []

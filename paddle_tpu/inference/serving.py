@@ -114,6 +114,15 @@ class _GenerativeAdapter:
         max_new = self._scalar(inputs, 1, int, self._DEFAULT_MAX_NEW)
         temperature = self._scalar(inputs, 2, float, 0.0)
         seed = self._scalar(inputs, 3, int, None)
+        # validate BEFORE submitting: a bad knob must come back as a
+        # clear wire error, not an odd empty generation (the engine
+        # re-checks, but by then the request would be half-queued)
+        if max_new < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new}")
+        if temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {temperature}")
         out = self._async.generate(ids.reshape(-1),
                                    max_new_tokens=max_new,
                                    temperature=temperature, seed=seed)

@@ -194,13 +194,21 @@ class TestCleanRuns:
         fs = A.analyze_engine(_make_engine(tp=2))
         assert fs == [], [f.format() for f in fs]
 
+    def test_engine_grid_zero_findings_speculative(self):
+        """speculative=K adds the ("verify", (bb, kb)) executable family
+        to the grid — the lint sweep must cover it and find nothing
+        (donation consumed, shardings declared, no dtype leaks)."""
+        fs = A.analyze_engine(_make_engine(speculative=2))
+        assert fs == [], [f.format() for f in fs]
+
     def test_analysis_leaves_executable_caches_cold(self):
         """The sweep uses the AOT trace path: linting an engine must
         not compile (or retrace into) any serving executable."""
-        eng = _make_engine()
+        eng = _make_engine(speculative=2)
         A.analyze_engine(eng)
         assert eng._chunk._cache_size() == 0
         assert eng._decode._cache_size() == 0
+        assert eng._verify._cache_size() == 0
 
 
 # ---------------------------------------------------------------------------
@@ -229,6 +237,12 @@ class TestGraphLintCLI:
 
     def test_cli_engine_grid_clean(self, capsys):
         rc = A.main(["engine", "--tp", "2", "--layers", "2"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "0 error(s), 0 warning(s)" in out
+
+    def test_cli_engine_spec_grid_clean(self, capsys):
+        rc = A.main(["engine", "--tp", "2", "--layers", "2",
+                     "--spec", "2"])
         out = capsys.readouterr().out
         assert rc == 0 and "0 error(s), 0 warning(s)" in out
 
