@@ -280,7 +280,14 @@ def main():
     ap.add_argument("--artifact", default=None,
                     help="also write the bench row as a JSON artifact "
                          "to this path (MULTICHIP-style under --tp)")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the static cost census (graph-lint cost) "
+                         "over the engine's warmup grid BEFORE the "
+                         "replay and embed it in the artifact — "
+                         "compile count, per-bucket FLOPs/HBM, memory "
+                         "model, M001/C001/B001 findings")
     args = ap.parse_args()
+    args._census = None
 
     if args.tp > 1:
         _force_device_count(args.tp)
@@ -297,6 +304,7 @@ def main():
     arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
                                            args.max_new, args.seed)
     eng = _build_engine(args.max_batch, args.seed)
+    _lint_census(args, eng)
     res = run(eng, arrivals, prompts, new_tokens)
 
     vs_baseline = None
@@ -328,12 +336,33 @@ def main():
     _write_artifact(args, row, ok=True)
 
 
+def _lint_census(args, eng):
+    """Static pre-replay census of the engine about to be benched
+    (framework.cost).  AOT-only, so it adds no compiles and leaves the
+    executable caches exactly as warmup will find them; the summary
+    goes to stderr (stdout stays the one bench JSON line)."""
+    if not args.lint:
+        return None
+    from paddle_tpu.framework.cost import run_census
+
+    census = run_census(eng)
+    doc = census.to_dict()
+    doc["clean"] = not any(
+        f["severity"] == "error" for f in doc["findings"])
+    print(f"lint: census {census.compile_count} executable(s), "
+          f"{len(census.findings)} finding(s)", file=sys.stderr)
+    args._census = doc
+    return doc
+
+
 def _write_artifact(args, row, ok):
     if not args.artifact:
         return
+    doc = {"ok": bool(ok), "rc": 0 if ok else 1, "bench": row}
+    if getattr(args, "_census", None) is not None:
+        doc["census"] = args._census
     with open(args.artifact, "w") as f:
-        json.dump({"ok": bool(ok), "rc": 0 if ok else 1,
-                   "bench": row}, f)
+        json.dump(doc, f)
 
 
 def _main_spec(args, jax):
@@ -362,6 +391,7 @@ def _main_spec(args, jax):
                         max_model_len=max_model_len,
                         token_budget=args.token_budget,
                         speculative=args.spec)
+    _lint_census(args, eng)
     spec_runs = [run(eng, arrivals, prompts, new_tokens)
                  for _ in range(reps)]
     res = max(spec_runs, key=lambda r: r["tokens_per_s"])
@@ -427,6 +457,7 @@ def _main_tp(args, jax):
                                            args.max_new, args.seed)
     eng = _build_engine(args.max_batch, args.seed,
                         token_budget=args.token_budget, tp=args.tp)
+    _lint_census(args, eng)
     res = run(eng, arrivals, prompts, new_tokens)
 
     base = _build_engine(args.max_batch, args.seed,
@@ -462,10 +493,13 @@ def _main_tp(args, jax):
                 f"{row['vs_single_device']}x single-device, "
                 f"token_exact={token_exact} "
                 f"{'OK' if token_exact else 'MISMATCH'}\n")
+        doc = {"n_devices": args.tp, "rc": 0 if token_exact else 1,
+               "ok": token_exact, "skipped": False, "tail": tail,
+               "bench": row}
+        if getattr(args, "_census", None) is not None:
+            doc["census"] = args._census
         with open(args.artifact, "w") as f:
-            json.dump({"n_devices": args.tp, "rc": 0 if token_exact else 1,
-                       "ok": token_exact, "skipped": False, "tail": tail,
-                       "bench": row}, f)
+            json.dump(doc, f)
     if not token_exact:
         raise SystemExit("TP replay diverged from single-device replay")
 
@@ -479,6 +513,7 @@ def _main_shared_prefix(args, jax):
 
     eng = _build_engine(args.max_batch, args.seed,
                         max_model_len=max_model_len)
+    _lint_census(args, eng)
     res = run(eng, arrivals, prompts, new_tokens)
 
     vs_baseline = base_ttft = None

@@ -6,6 +6,14 @@ stack).  TPU redesign: costs come from the framework's own profiler host
 events (eager) or from timing jitted ops directly; results are cached and
 exportable as JSON — the same role the reference's benchmark json plays
 for auto-parallel/tuner decisions.
+
+The *static* cost side (no execution at all) lives in
+:mod:`paddle_tpu.framework.cost` — jaxpr-walk FLOPs/HBM/collective
+estimates, donation-aware peak memory, rooflines, and the serving
+executable census (docs/ANALYSIS.md §"Cost model & executable
+census").  Its public surface is re-exported here so
+``paddle_tpu.cost_model`` is the one import for both the measured and
+the predicted view.
 """
 
 import json
@@ -13,7 +21,14 @@ import time
 
 import numpy as np
 
-__all__ = ["CostModel"]
+from ..framework.cost import (CostEstimate, derive_max_batch,
+                              engine_memory_model, estimate_jaxpr,
+                              estimate_jitted, parse_bytes, run_census,
+                              xla_cost_analysis)
+
+__all__ = ["CostModel", "CostEstimate", "estimate_jaxpr",
+           "estimate_jitted", "xla_cost_analysis", "run_census",
+           "engine_memory_model", "derive_max_batch", "parse_bytes"]
 
 
 class CostModel:

@@ -27,14 +27,20 @@ Rule catalog (see docs/ANALYSIS.md):
   which carry no effects), plus — for imported static programs — ops
   whose outputs never reach a fetch target, reported with the
   program's real variable names.
-- **H001 host-sync** — an AST lint over ``paddle_tpu/ops/`` flagging
-  ``.item()``/``.tolist()``, ``np.asarray``/``np.array``, and
-  ``float()``/``int()``/``bool()`` applied to tensor arguments inside
-  op kernels: each is a device→host round-trip that breaks under
-  ``jit`` and stalls the pipeline in eager.  Sites that are host-side
-  by contract carry an inline ``# noqa: H001`` tag (or a module-wide
-  ``# noqa-module: H001`` pragma for eager-only modules); everything
-  untagged fails.
+- **H001 host-sync** — an AST lint over ``paddle_tpu/ops/`` and
+  ``paddle_tpu/inference/llm/`` flagging ``.item()``/``.tolist()``,
+  ``np.asarray``/``np.array``, and ``float()``/``int()``/``bool()``
+  applied to tensor arguments: each is a device→host round-trip that
+  breaks under ``jit`` and stalls the pipeline in eager.  Sites that
+  are host-side by contract carry an inline ``# noqa: H001`` tag (or a
+  module-wide ``# noqa-module: H001`` pragma for host-by-design
+  modules — the scheduler, BlockManager, and n-gram drafter);
+  everything untagged fails.
+
+The cost layer lives next door in :mod:`paddle_tpu.framework.cost`:
+static FLOPs/HBM/collective estimates, the donation-aware peak-memory
+model, and the executable census with rules M001 (per-chip HBM budget),
+C001 (collective placement), B001 (bucket-grid blowup).
 
 ``CompileWatcher`` is the dynamic companion: it snapshots the
 executable caches of watched jitted callables (and optionally the
@@ -49,7 +55,11 @@ Traversal reuses the helpers in :mod:`paddle_tpu.framework.ir`
 
 import argparse
 import ast
+import collections
+import json
+import logging
 import os
+import re
 import sys
 
 import numpy as np
@@ -621,8 +631,9 @@ def collect_host_sync_sites(paths=None):
     """All host-sync sites the AST lint matches, allowlisted or not —
     the classification view behind :func:`check_host_sync`."""
     if paths is None:
-        paths = [os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), "ops")]
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = [os.path.join(pkg, "ops"),
+                 os.path.join(pkg, "inference", "llm")]
     files = []
     for p in paths:
         if os.path.isdir(p):
@@ -674,6 +685,81 @@ class RecompileError(AssertionError):
 _BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
+class _CompileKeyLog(logging.Handler):
+    """Captures the cache key of every executable build.
+
+    jax has no public API for enumerating a pjit cache's keys, but the
+    lowering path logs ``Compiling <fn> with global shapes and types
+    [ShapedArray(...)]`` for each new executable — at DEBUG even when
+    ``jax_log_compiles`` is off, and including ``weak_type=True``
+    (exactly the bit the classic python-scalar bucket leak flips).
+    This handler parses those lines so :class:`RecompileError` can name
+    the new cache keys, not just the growth count.
+
+    Capture is reference-counted and WINDOW-scoped (armed by
+    CompileWatcher, released at assert/exit): the pxla logger is only
+    held at DEBUG while a guard window is open, because jax installs
+    its own stderr handler on the parent 'jax' logger and a permanent
+    DEBUG level would echo every later legitimate compile to stderr.
+    """
+
+    _RE = re.compile(
+        r"Compiling ([^\s]+) with global shapes and types (\[.*?\])"
+        r"(?:\.|$)")
+    _LOGGER = "jax._src.interpreters.pxla"
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.seq = 0
+        self.entries = collections.deque(maxlen=256)
+        self._count = 0
+        self._saved_level = None
+        self._saved_propagate = None
+
+    def emit(self, record):
+        try:
+            m = self._RE.search(record.getMessage())
+        except Exception:  # pragma: no cover - malformed record
+            return
+        if m:
+            self.seq += 1
+            self.entries.append((self.seq, m.group(1), m.group(2)))
+
+    def since(self, mark):
+        """[(fn_name, avals_str)] for compiles after sequence ``mark``."""
+        return [(name, key) for s, name, key in self.entries
+                if s > mark]
+
+    def acquire(self):
+        if self._count == 0:
+            lg = logging.getLogger(self._LOGGER)
+            self._saved_level = lg.level
+            self._saved_propagate = lg.propagate
+            lg.addHandler(self)
+            if lg.getEffectiveLevel() > logging.DEBUG:
+                lg.setLevel(logging.DEBUG)
+            # handlers attached here still fire; stop the records from
+            # reaching the parent 'jax' stderr handler while the
+            # window is open (the keys surface via RecompileError, not
+            # the console)
+            lg.propagate = False
+        self._count += 1
+        return self.seq
+
+    def release(self):
+        if self._count == 0:
+            return
+        self._count -= 1
+        if self._count == 0:
+            lg = logging.getLogger(self._LOGGER)
+            lg.removeHandler(self)
+            lg.setLevel(self._saved_level)
+            lg.propagate = self._saved_propagate
+
+
+_compile_key_log = _CompileKeyLog()
+
+
 class CompileWatcher:
     """Guard a window of execution against unexpected recompiles.
 
@@ -706,6 +792,8 @@ class CompileWatcher:
         self._listener = None
         self.backend_compiles = 0
         self._base = self._sizes()
+        self._capturing = True
+        self._key_mark = _compile_key_log.acquire()
 
     @staticmethod
     def _size(fn):
@@ -726,19 +814,50 @@ class CompileWatcher:
             deltas.append(("<backend>", self.backend_compiles))
         return deltas
 
+    def new_cache_keys(self):
+        """[(fn_name, avals_str)] of every executable built inside the
+        guard window — the actual cache keys behind the growth counts
+        :meth:`new_compiles` reports (empty once the window closed)."""
+        if not self._capturing:
+            return []
+        return _compile_key_log.since(self._key_mark)
+
+    def _release_capture(self):
+        if self._capturing:
+            self._capturing = False
+            _compile_key_log.release()
+
+    def __del__(self):
+        # a watcher that is never asserted (warmup()'s return value,
+        # dropped) must not hold the capture window open forever
+        try:
+            self._release_capture()
+        except Exception:  # pragma: no cover - interpreter teardown
+            pass
+
     def assert_no_new_compiles(self):
         deltas = self.new_compiles()
+        keys = self.new_cache_keys()
+        self._release_capture()
         if deltas:
             detail = ", ".join(f"{lbl}: +{n}" for lbl, n in deltas)
+            keydetail = "; ".join(f"{name} {key}" for name, key
+                                  in keys[-8:])
             raise RecompileError(
                 f"unexpected recompile(s) inside guarded window — "
                 f"{detail}. A new executable signature appeared "
                 "(shape/dtype/python-scalar leak past the bucket "
-                "grid?)")
+                "grid?)"
+                + (f" New cache keys: {keydetail}" if keydetail else ""))
 
     def __enter__(self):
         self._base = self._sizes()
         self.backend_compiles = 0
+        if not self._capturing:
+            self._capturing = True
+            self._key_mark = _compile_key_log.acquire()
+        else:
+            self._key_mark = _compile_key_log.seq
         if self._watch_backend:
             def _listener(event, _dur, **_kw):
                 if event == _BACKEND_COMPILE_EVENT:
@@ -759,21 +878,45 @@ class CompileWatcher:
             self._listener = None
         if exc_type is None and self.strict:
             self.assert_no_new_compiles()
+        else:
+            self._release_capture()
         return False
 
 
 # --------------------------------------------------------------------------
 # CLI — tools/graph_lint.py and the `graph-lint` console script
 # --------------------------------------------------------------------------
-def _report(findings, out=None):
+def _report(findings, out=None, json_out=False, strict=False,
+            extra=None):
+    """Print findings and return the exit code.
+
+    Exit codes (documented in docs/ANALYSIS.md): 0 = clean (or
+    warnings only), 1 = any error-severity finding — or any warning
+    under ``strict`` — 2 = usage error (argparse's own).  ``json_out``
+    emits one machine-readable JSON document instead of text;
+    ``extra`` merges additional keys into it (the cost subcommand's
+    census artifact)."""
     out = out or sys.stdout
-    for f in findings:
-        print(f.format(), file=out)
     errors = sum(1 for f in findings if f.severity == ERROR)
     warnings = len(findings) - errors
-    print(f"graph-lint: {errors} error(s), {warnings} warning(s)",
-          file=out)
-    return 1 if errors else 0
+    if json_out:
+        doc = {
+            "findings": [
+                {"rule": f.rule, "severity": f.severity,
+                 "category": f.category, "where": f.where,
+                 "message": f.message} for f in findings],
+            "errors": errors,
+            "warnings": warnings,
+        }
+        if extra:
+            doc.update(extra)
+        print(json.dumps(doc, indent=2), file=out)
+    else:
+        for f in findings:
+            print(f.format(), file=out)
+        print(f"graph-lint: {errors} error(s), {warnings} warning(s)",
+              file=out)
+    return 1 if errors or (strict and warnings) else 0
 
 
 def _parse_spec(spec):
@@ -788,7 +931,7 @@ def _parse_spec(spec):
     return jax.ShapeDtypeStruct(shape, dt)
 
 
-def _cli_engine(ns):
+def _cli_build_engine(ns):
     from ..inference.llm import LLMEngine
     from ..models.gpt import gpt_tiny
     import paddle_tpu as paddle
@@ -796,15 +939,49 @@ def _cli_engine(ns):
     paddle.seed(0)
     model = gpt_tiny(num_layers=ns.layers)
     model.eval()
-    eng = LLMEngine(model, block_size=ns.block_size,
-                    max_batch=ns.max_batch, max_model_len=ns.max_model_len,
-                    token_budget=ns.token_budget,
-                    tensor_parallel=ns.tp if ns.tp > 1 else None,
-                    speculative=ns.spec if ns.spec > 0 else None)
+    return LLMEngine(model, block_size=ns.block_size,
+                     max_batch=ns.max_batch,
+                     max_model_len=ns.max_model_len,
+                     token_budget=ns.token_budget,
+                     tensor_parallel=ns.tp if ns.tp > 1 else None,
+                     speculative=ns.spec if ns.spec > 0 else None)
+
+
+def _cli_engine(ns):
+    eng = _cli_build_engine(ns)
     findings = analyze_engine(eng, rules=ns.rules)
     if ns.rules is None or "H001" in ns.rules:
         findings += check_host_sync()
     return findings
+
+
+def _cli_cost(ns):
+    from .cost import run_census
+    eng = _cli_build_engine(ns)
+    census = run_census(eng, memory_budget=ns.memory_budget,
+                        profile=ns.profile,
+                        max_executables=ns.max_executables)
+    doc = census.to_dict()
+    ns._extra = {"census": doc}
+    if not ns.json:
+        fams = ", ".join(f"{k}: {v}"
+                         for k, v in sorted(census.families.items()))
+        print(f"census: {census.compile_count} executable(s) — {fams}")
+        for e in doc["entries"]:
+            c = e["cost"]
+            print(f"  {e['label']:<16} flops={c['flops']:<12} "
+                  f"hbm={c['hbm_bytes']:<10} peak={c['peak_bytes']:<10} "
+                  f"{e['roofline']}-bound")
+        mem = doc["memory"]
+        line = (f"memory/chip (tp={mem['tp']}): weights "
+                f"{mem['weights_bytes']} + kv pool "
+                f"{mem['kv_pool_bytes']} "
+                f"({mem['num_blocks']} x {mem['page_bytes']}B pages)")
+        if mem.get("memory_budget") is not None:
+            line += (f"; budget {mem['memory_budget']} admits "
+                     f"max_batch <= {mem.get('derived_max_batch', 0)}")
+        print(line)
+    return census.findings
 
 
 def _cli_program(ns):
@@ -839,33 +1016,64 @@ def main(argv=None):
                     "docs/ANALYSIS.md)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids (default: all)")
+    # common output flags, valid after every subcommand; exit codes:
+    # 0 clean, 1 errors (or warnings under --strict), 2 usage
+    common = argparse.ArgumentParser(add_help=False)
+    common.add_argument("--json", action="store_true",
+                        help="emit one machine-readable JSON document "
+                             "instead of text findings")
+    common.add_argument("--strict", action="store_true",
+                        help="exit 1 on warnings too, not just errors")
     sub = ap.add_subparsers(dest="cmd", required=True)
 
-    eng = sub.add_parser("engine", help="lint the LLM engine's warmup "
-                                        "executable grid")
-    eng.add_argument("--tp", type=int, default=1)
-    eng.add_argument("--layers", type=int, default=2)
-    eng.add_argument("--block-size", type=int, default=8)
-    eng.add_argument("--max-batch", type=int, default=4)
-    eng.add_argument("--max-model-len", type=int, default=64)
-    eng.add_argument("--token-budget", type=int, default=16)
-    eng.add_argument("--spec", type=int, default=0, metavar="K",
-                     help="lint the speculative verify family too "
-                          "(K = max draft tokens; 0 = off)")
+    engine_args = argparse.ArgumentParser(add_help=False)
+    engine_args.add_argument("--tp", type=int, default=1)
+    engine_args.add_argument("--layers", type=int, default=2)
+    engine_args.add_argument("--block-size", type=int, default=8)
+    engine_args.add_argument("--max-batch", type=int, default=4)
+    engine_args.add_argument("--max-model-len", type=int, default=64)
+    engine_args.add_argument("--token-budget", type=int, default=16)
+    engine_args.add_argument("--spec", type=int, default=0, metavar="K",
+                             help="include the speculative verify "
+                                  "family (K = max draft tokens; "
+                                  "0 = off)")
+
+    eng = sub.add_parser("engine", parents=[common, engine_args],
+                         help="lint the LLM engine's warmup "
+                              "executable grid")
     eng.set_defaults(run=_cli_engine)
 
-    prog = sub.add_parser("program", help="lint an exported inference "
-                                          "program (.pdmodel prefix)")
+    cost = sub.add_parser(
+        "cost", aliases=["census"], parents=[common, engine_args],
+        help="static cost census over the engine's warmup grid: "
+             "FLOPs/HBM/collectives per bucket, compile count, "
+             "memory model, rules M001/C001/B001")
+    cost.add_argument("--memory-budget", default=None,
+                      help="per-chip HBM budget for M001, bytes or "
+                           "'16GiB'")
+    cost.add_argument("--profile", default="tpu-v4",
+                      help="roofline device profile: "
+                           "tpu-v4 | tpu-v5e | cpu")
+    cost.add_argument("--max-executables", type=int, default=64,
+                      help="B001 threshold on the census compile "
+                           "count")
+    cost.set_defaults(run=_cli_cost)
+
+    prog = sub.add_parser("program", parents=[common],
+                          help="lint an exported inference "
+                               "program (.pdmodel prefix)")
     prog.add_argument("path_prefix")
     prog.set_defaults(run=_cli_program)
 
-    ops = sub.add_parser("ops", help="H001 host-sync lint over op "
-                                     "kernel sources")
+    ops = sub.add_parser("ops", parents=[common],
+                         help="H001 host-sync lint over op "
+                              "kernel sources")
     ops.add_argument("paths", nargs="*")
     ops.set_defaults(run=_cli_ops)
 
-    fn = sub.add_parser("fn", help="lint an importable (jitted) "
-                                   "callable: module.path:attr")
+    fn = sub.add_parser("fn", parents=[common],
+                        help="lint an importable (jitted) "
+                             "callable: module.path:attr")
     fn.add_argument("target")
     fn.add_argument("--arg", action="append", default=[],
                     metavar="SPEC", help="abstract arg, e.g. f32[2,8]")
@@ -876,7 +1084,9 @@ def main(argv=None):
     ns = ap.parse_args(argv)
     ns.rules = tuple(r.strip() for r in ns.rules.split(",")) \
         if ns.rules else None
-    return _report(ns.run(ns))
+    ns._extra = None
+    return _report(ns.run(ns), json_out=ns.json, strict=ns.strict,
+                   extra=ns._extra)
 
 
 if __name__ == "__main__":  # pragma: no cover

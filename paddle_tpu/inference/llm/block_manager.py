@@ -25,6 +25,9 @@ Pure host-side bookkeeping — nothing here touches device memory.  The
 engine mirrors each table into the [B, P] int32 operand the kernels
 gather through.
 """
+# noqa-module: H001 (pure host bookkeeping by design — page refcounts,
+# free lists and content-hash maps never touch device memory; the pool
+# arrays live in the engine, this module only hands out indices)
 
 from collections import OrderedDict
 

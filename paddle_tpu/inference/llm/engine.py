@@ -96,6 +96,23 @@ _TP_BLOCK_SPECS = {
 }
 
 
+def _params_bytes_per_chip(params, tp):
+    """Per-chip weight bytes under the Megatron layout: block leaves
+    whose _TP_BLOCK_SPECS entry names 'mp' hold 1/tp of the global
+    tensor; everything else (embed/head/layernorms) is replicated."""
+    total = 0
+    for group, sub in params.items():
+        for key, w in sub.items():
+            nbytes = int(np.prod(w.shape)) * jnp.dtype(w.dtype).itemsize
+            spec = _TP_BLOCK_SPECS.get(key, P()) if group == "blocks" \
+                else P()
+            sharded = any(
+                "mp" in (part if isinstance(part, tuple) else (part,))
+                for part in tuple(spec))
+            total += nbytes // tp if sharded else nbytes
+    return total
+
+
 def _qkv_head_permutation(num_heads, head_dim, tp):
     """Column permutation taking the fused qkv layout (3, NH, D) to
     (tp, 3, NH/tp, D): a contiguous 1/tp column slice then holds the
@@ -112,8 +129,8 @@ class RequestOutput:
     def __init__(self, request_id, prompt_ids, output_ids, finish_reason,
                  num_preemptions):
         self.request_id = request_id
-        self.prompt_ids = np.asarray(prompt_ids)
-        self.output_ids = np.asarray(output_ids)
+        self.prompt_ids = np.asarray(prompt_ids)  # noqa: H001 (host output contract)
+        self.output_ids = np.asarray(output_ids)  # noqa: H001 (host output contract)
         self.finish_reason = finish_reason
         self.num_preemptions = num_preemptions
 
@@ -139,13 +156,19 @@ class LLMEngine:
     ``speculative=K`` (or a SpeculativeConfig / dict) turns on n-gram
     speculative decoding with up to K draft tokens per sequence per
     step — same tokens, fewer device steps on repetitive output.
+    ``memory_budget=`` (bytes, or '16GiB'-style) declares the per-chip
+    HBM capacity: the admissible ``max_batch`` is then derived from the
+    static pages+weights model (framework.cost) and clamps the
+    requested one, the defaulted page pool is sized to the clamped
+    batch, and ``graph-lint cost`` flags any bucket whose estimated
+    peak exceeds the budget (M001).
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None,
                  max_model_len=None, max_batch=8, dtype=None,
                  enable_prefix_caching=True, token_budget=64,
                  mesh=None, tensor_parallel=None, seed=None,
-                 speculative=None):
+                 speculative=None, memory_budget=None):
         d = model.functional_decompose()
         cfg = model.config
         self.num_layers = d["num_layers"]
@@ -155,20 +178,10 @@ class LLMEngine:
         self.eps = cfg.layer_norm_epsilon
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
-        self.max_model_len = int(min(max_model_len or
+        self.max_model_len = int(min(max_model_len or  # noqa: H001 (static config int)
                                      cfg.max_position_embeddings,
                                      cfg.max_position_embeddings))
         self.max_pages = -(-self.max_model_len // self.block_size)
-        if num_blocks is None:
-            # default: the full batch at full length fits -> no preemption
-            num_blocks = self.max_batch * self.max_pages
-        if num_blocks < self.max_pages:
-            raise ValueError(
-                f"num_blocks {num_blocks} cannot hold one max_model_len "
-                f"sequence ({self.max_pages} pages)")
-        self.num_blocks = int(num_blocks)
-        # one decode token per running sequence must fit in the budget
-        self.token_budget = max(int(token_budget), self.max_batch)
         self.dtype = jnp.dtype(dtype) if dtype else jnp.float32
         # speculative decoding (None | K | dict | SpeculativeConfig):
         # an n-gram drafter plus the bucketed verify executable family
@@ -202,6 +215,42 @@ class LLMEngine:
                 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
                 else jnp.asarray(x))
         params = jax.tree_util.tree_map(cast, d["params"])
+
+        # ---------------------------------------------- HBM budget --------
+        # pages + weights bound max_batch (ROADMAP item 3): under a
+        # declared per-chip budget the admissible batch is derived from
+        # the static memory model, and the defaulted page pool is sized
+        # for THAT batch so the pool itself cannot overrun the budget.
+        from ...framework.cost import derive_max_batch, parse_bytes
+        self.memory_budget = parse_bytes(memory_budget)
+        weights_per_chip = _params_bytes_per_chip(params, self.tp)
+        page_bytes = (2 * self.num_layers * self.block_size
+                      * (self.num_heads // self.tp) * self.head_dim
+                      * jnp.dtype(self.dtype).itemsize)
+        if self.memory_budget is not None:
+            seq_bytes = self.max_pages * page_bytes
+            admissible = derive_max_batch(self.memory_budget,
+                                          weights_per_chip, seq_bytes)
+            if self.max_batch > admissible:
+                self.max_batch = admissible
+        if num_blocks is None:
+            # default: the full batch at full length fits -> no preemption
+            num_blocks = self.max_batch * self.max_pages
+        if num_blocks < self.max_pages:
+            raise ValueError(
+                f"num_blocks {num_blocks} cannot hold one max_model_len "
+                f"sequence ({self.max_pages} pages)")
+        self.num_blocks = int(num_blocks)
+        if self.memory_budget is not None and \
+                weights_per_chip + self.num_blocks * page_bytes \
+                > self.memory_budget:
+            raise ValueError(
+                f"num_blocks {self.num_blocks} puts the per-chip paged "
+                f"pool ({self.num_blocks * page_bytes} bytes) plus "
+                f"weights ({weights_per_chip} bytes) over "
+                f"memory_budget {self.memory_budget}")
+        # one decode token per running sequence must fit in the budget
+        self.token_budget = max(int(token_budget), self.max_batch)
 
         self.block_manager = BlockManager(
             self.num_blocks, self.block_size,
@@ -482,7 +531,7 @@ class LLMEngine:
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                     temperature=0.0, request_id=None, seed=None):
-        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]
+        prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]  # noqa: H001 (host request boundary)
         if not prompt:
             raise ValueError("empty prompt")
         if max_new_tokens < 1:
@@ -568,6 +617,15 @@ class LLMEngine:
                 args = (self.params, sds((b, 1), i32), kc, vc,
                         sds((b, self.max_pages), i32), sds((b,), i32))
                 yield kind, b, self._decode, args
+
+    def memory_model(self, memory_budget=None):
+        """Static per-chip HBM breakdown — weight bytes (sharding-
+        aware), page/pool/sequence bytes, and, under a budget (the
+        engine's own ``memory_budget=`` or an override), the admissible
+        ``max_batch`` it supports.  Delegates to
+        :func:`paddle_tpu.framework.cost.engine_memory_model`."""
+        from ...framework.cost import engine_memory_model
+        return engine_memory_model(self, memory_budget=memory_budget)
 
     def warmup(self):
         """Compile every bucketed executable before traffic arrives.
@@ -742,7 +800,7 @@ class LLMEngine:
                 self.params, jnp.asarray(ids), self._kc, self._vc,
                 jnp.asarray(tables), jnp.asarray(positions),
                 jnp.asarray(lens))
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per verify step)
         row_logits = self._fetch_sampling_rows(reqs, logits)
         for i, r in enumerate(reqs):
             self._commit_verified(r, nxt[i], row_logits.get(i), finished)
@@ -754,20 +812,20 @@ class LLMEngine:
         samp = [i for i, r in enumerate(reqs) if r.temperature > 0.0]
         if not samp:
             return {}
-        sel = np.asarray(logits[np.asarray(samp, np.int32)])
+        sel = np.asarray(logits[np.asarray(samp, np.int32)])  # noqa: H001 (fetches only the sampling rows)
         return dict(zip(samp, sel))
 
     def _sample_token(self, req, logits):
         """Gumbel-max sample of one host logits row from the request's
         stream (``seed=``) or the engine stream."""
-        z = np.asarray(logits, np.float64) / req.temperature
+        z = np.asarray(logits, np.float64) / req.temperature  # noqa: H001 (host row, already fetched)
         if req.seed is not None:
             if req._sample_rng is None:
                 req._sample_rng = np.random.RandomState(req.seed)
             rng = req._sample_rng
         else:
             rng = self._rng
-        return int(np.argmax(z + rng.gumbel(size=z.shape)))
+        return int(np.argmax(z + rng.gumbel(size=z.shape)))  # noqa: H001 (host sampling math)
 
     def _commit_tokens(self, entries, finished):
         """Commit one token per (req, argmax, logits) entry, in order.
@@ -781,17 +839,17 @@ class LLMEngine:
                     if r.temperature > 0.0 and r.seed is None]
         picked = {}
         if eng_rows:
-            z = np.stack([np.asarray(entries[j][2], np.float64)
+            z = np.stack([np.asarray(entries[j][2], np.float64)  # noqa: H001 (host rows, already fetched)
                           / entries[j][0].temperature for j in eng_rows])
             g = self._rng.gumbel(size=z.shape)
             for j, t in zip(eng_rows, np.argmax(z + g, axis=-1)):
-                picked[j] = int(t)
+                picked[j] = int(t)  # noqa: H001 (host sampling math)
         for j, (req, argmax_token, logits) in enumerate(entries):
             if req.temperature > 0.0:
                 tok = picked[j] if j in picked \
                     else self._sample_token(req, logits)
             else:
-                tok = int(argmax_token)
+                tok = int(argmax_token)  # noqa: H001 (host token, already fetched)
             req.output_ids.append(tok)
             self.stats["tokens_generated"] += 1
             if (req.eos_token_id is not None
@@ -820,7 +878,7 @@ class LLMEngine:
             if req.temperature > 0.0:
                 tok = self._sample_token(req, logits_row[j])
             else:
-                tok = int(argmax_row[j])
+                tok = int(argmax_row[j])  # noqa: H001 (host row, already fetched)
             req.output_ids.append(tok)
             emitted += 1
             self.stats["tokens_generated"] += 1

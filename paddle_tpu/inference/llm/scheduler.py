@@ -23,6 +23,9 @@ capped by max_batch, so warmup compiles
 O(log(max_batch) + log(token_budget)) programs and steady state
 recompiles nothing.
 """
+# noqa-module: H001 (iteration-level scheduling is host-side by design —
+# the scheduler reads finished-token counts and page availability between
+# device steps; nothing here runs under jit)
 
 import time
 from dataclasses import dataclass, field

@@ -273,6 +273,58 @@ class TestGraphLintCLI:
         out = capsys.readouterr().out
         assert rc == 1 and "D001" in out
 
+    def test_cli_json_output(self, capsys):
+        import json
+
+        rc = A.main(["fn", "tests.test_analysis:_donation_waster",
+                     "--arg", "f32[8]", "--arg", "f32[8]",
+                     "--donate", "0", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1 and doc["errors"] == 1
+        assert doc["findings"][0]["rule"] == "D001"
+        assert {"severity", "where", "message"} <= set(doc["findings"][0])
+
+    def test_cli_strict_promotes_warnings(self, capsys):
+        """T001 weak-type is warning severity: exit 0 normally, exit 1
+        under --strict (the documented CI hard-gate mode)."""
+        argv = ["fn", "tests.test_analysis:_weak_output",
+                "--arg", "f32[4]"]
+        assert A.main(argv) == 0
+        rc = A.main(argv + ["--strict"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "T001" in out
+
+    def test_cli_cost_census_json(self, capsys):
+        """`graph-lint cost --json` emits the census document (entries,
+        memory model, roofline) merged into the findings doc."""
+        import json
+
+        rc = A.main(["cost", "--layers", "2", "--json"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0 and doc["errors"] == 0
+        cen = doc["census"]
+        assert cen["compile_count"] == 5
+        assert cen["memory"]["weights_bytes"] > 0
+        assert all("roofline" in e for e in cen["entries"])
+
+    def test_cli_cost_m001_exit_code(self, capsys):
+        rc = A.main(["cost", "--layers", "2",
+                     "--memory-budget", "64KiB"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "M001" in out
+
+    def test_h001_default_sweep_covers_llm_tree(self):
+        """The default H001 sweep now includes inference/llm: the
+        scheduler/BlockManager pragmas and the engine's tagged host
+        pulls must classify every site as allowlisted (zero findings
+        via test_ops_tree_is_h001_clean), and the collector must
+        actually SEE the llm tree — coverage, not absence."""
+        sites = A.collect_host_sync_sites()
+        llm = [s for s in sites
+               if "inference" in s.path and s.path.endswith(".py")]
+        assert llm, "H001 sweep lost the inference/llm tree"
+        assert all(s.allowed for s in llm)
+
 
 # CLI `fn` targets (module-level so importlib can find them)
 def _donating_identity(buf):
@@ -281,3 +333,7 @@ def _donating_identity(buf):
 
 def _donation_waster(buf, x):
     return x + 1.0
+
+
+def _weak_output(x):
+    return x, 1.0 + 2.0

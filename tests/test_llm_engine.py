@@ -594,11 +594,16 @@ class TestPrefixCaching:
         eng.warmup()
         ids = jnp.zeros((1, 8), jnp.int32)
         table = jnp.zeros(eng.max_pages, jnp.int32)
-        with pytest.raises(RecompileError, match="chunk"):
+        with pytest.raises(RecompileError, match="chunk") as ei:
             with compile_watcher(eng._chunk, eng._decode,
                                  labels=("chunk", "decode")):
                 _, _, eng._kc, eng._vc = eng._chunk(
                     eng.params, ids, eng._kc, eng._vc, table, 0, 0)
+        # the report names the offending cache KEY, not just a count —
+        # and the key shows the weak_type bit the plain ints flipped
+        msg = str(ei.value)
+        assert "New cache keys" in msg
+        assert "weak_type=True" in msg
 
 
 # ---------------------------------------------------------------------------

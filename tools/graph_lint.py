@@ -10,9 +10,14 @@ without installing.  See docs/ANALYSIS.md for the rule catalog.
 Examples::
 
     python tools/graph_lint.py engine --tp 2
+    python tools/graph_lint.py cost --tp 2 --memory-budget 16GiB --json
+    python tools/graph_lint.py census --spec 4 --max-executables 32
     python tools/graph_lint.py program /path/to/export/inference
-    python tools/graph_lint.py ops paddle_tpu/ops
+    python tools/graph_lint.py ops paddle_tpu/ops --strict
     python tools/graph_lint.py fn mypkg.mod:f --arg f32[4,8]
+
+Exit codes: 0 clean (warnings allowed), 1 any error-severity finding
+(or any warning under ``--strict``), 2 usage error.
 """
 
 import os
