@@ -282,10 +282,13 @@ def main():
                          "to this path (MULTICHIP-style under --tp)")
     ap.add_argument("--lint", action="store_true",
                     help="run the static cost census (graph-lint cost) "
-                         "over the engine's warmup grid BEFORE the "
-                         "replay and embed it in the artifact — "
-                         "compile count, per-bucket FLOPs/HBM, memory "
-                         "model, M001/C001/B001 findings")
+                         "AND the Pallas kernel verifier (graph-lint "
+                         "kernels, K001-K005) over the engine's warmup "
+                         "grid BEFORE the replay and embed both in the "
+                         "artifact — compile count, per-bucket "
+                         "FLOPs/HBM, memory model, M001/C001/B001 "
+                         "findings, per-kernel tiling/VMEM/bounds/race "
+                         "verdicts")
     args = ap.parse_args()
     args._census = None
 
@@ -347,10 +350,23 @@ def _lint_census(args, eng):
 
     census = run_census(eng)
     doc = census.to_dict()
+    # the kernel verifier sweeps the registry at this engine's real
+    # launch shapes — a bench artifact that says "fast" must also say
+    # "the kernels it ran are provably launchable on the TPU"
+    from paddle_tpu.framework.kernel_lint import lint_registry
+
+    kfs = lint_registry(eng)
+    doc["kernel_lint"] = {
+        "findings": [{"rule": f.rule, "severity": f.severity,
+                      "where": f.where, "message": f.message}
+                     for f in kfs],
+        "clean": not any(f.severity == "error" for f in kfs),
+    }
     doc["clean"] = not any(
         f["severity"] == "error" for f in doc["findings"])
     print(f"lint: census {census.compile_count} executable(s), "
-          f"{len(census.findings)} finding(s)", file=sys.stderr)
+          f"{len(census.findings)} finding(s); kernels "
+          f"{len(kfs)} finding(s)", file=sys.stderr)
     args._census = doc
     return doc
 

@@ -984,6 +984,12 @@ def _cli_cost(ns):
     return census.findings
 
 
+def _cli_kernels(ns):
+    from .kernel_lint import lint_registry
+    eng = _cli_build_engine(ns)
+    return lint_registry(eng, rules=ns.rules, profile=ns.profile)
+
+
 def _cli_program(ns):
     from ..static.program_import import load_reference_inference_model
     prog, _feeds, _fetches = load_reference_inference_model(ns.path_prefix)
@@ -1011,9 +1017,10 @@ def main(argv=None):
         prog="graph-lint",
         description="Static analysis over jitted graphs, the LLM "
                     "serving engine's executable grid, imported static "
-                    "programs, and the op-kernel sources "
-                    "(rules D001/S001/T001/G001/H001 — see "
-                    "docs/ANALYSIS.md)")
+                    "programs, the op-kernel sources, and the Pallas "
+                    "kernel registry "
+                    "(rules D001/S001/T001/G001/H001 + K001-K005 — "
+                    "see docs/ANALYSIS.md)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids (default: all)")
     # common output flags, valid after every subcommand; exit codes:
@@ -1058,6 +1065,16 @@ def main(argv=None):
                       help="B001 threshold on the census compile "
                            "count")
     cost.set_defaults(run=_cli_cost)
+
+    kern = sub.add_parser(
+        "kernels", parents=[common, engine_args],
+        help="Pallas kernel verifier: sweep the kernel registry over "
+             "the engine's executable-grid shapes "
+             "(rules K001-K005, framework/kernel_lint.py)")
+    kern.add_argument("--profile", default="tpu-v4",
+                      help="device profile for the K002 VMEM budget: "
+                           "tpu-v4 | tpu-v5e | cpu")
+    kern.set_defaults(run=_cli_kernels)
 
     prog = sub.add_parser("program", parents=[common],
                           help="lint an exported inference "

@@ -93,13 +93,18 @@ __all__ = [
 # per-chip memory and interconnect bandwidths in bytes/s.  These feed
 # only the compute/hbm/comms CLASSIFICATION — the byte and flop counts
 # themselves are hardware-independent.
+# ``vmem_bytes`` is the per-core VMEM budget the Pallas kernel verifier
+# (framework/kernel_lint.py, rule K002) checks per-grid-step
+# block+scratch residency against (~16 MiB/core on current TPUs; the
+# cpu profile keeps the same budget so interpret-mode lint matches what
+# the chip will enforce).
 DEVICE_PROFILES = {
     "tpu-v4": {"flops_per_s": 275e12, "hbm_bytes_per_s": 1.2e12,
-               "ici_bytes_per_s": 3.0e11},
+               "ici_bytes_per_s": 3.0e11, "vmem_bytes": 16 * 1024 * 1024},
     "tpu-v5e": {"flops_per_s": 197e12, "hbm_bytes_per_s": 8.2e11,
-                "ici_bytes_per_s": 1.6e11},
+                "ici_bytes_per_s": 1.6e11, "vmem_bytes": 16 * 1024 * 1024},
     "cpu": {"flops_per_s": 1.0e11, "hbm_bytes_per_s": 5.0e10,
-            "ici_bytes_per_s": 2.0e10},
+            "ici_bytes_per_s": 2.0e10, "vmem_bytes": 16 * 1024 * 1024},
 }
 
 _BYTE_UNITS = {"b": 1, "kb": 1000, "mb": 1000**2, "gb": 1000**3,

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import registry
+
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
@@ -273,6 +275,32 @@ def _block_candidates(seq_q, seq_k):
     return head + rest
 
 
+def _vmem_validate(seq_q, seq_k, head, dtype, profile="tpu-v4"):
+    """Candidate screen for autotune.pick: reject (block_q, block_k) whose
+    per-grid-step residency (kernel_lint's K002 model — double-buffered
+    blocks) cannot fit VMEM for the forward, dq, or dkv kernel."""
+    from ...framework.kernel_lint import vmem_fits
+
+    f32 = jnp.float32
+
+    def validate(cand):
+        bq, bk = cand
+        fwd = [((1, bq, head), dtype), ((1, seq_k, head), dtype),
+               ((1, seq_k, head), dtype), ((1, bq, head), dtype),
+               ((1, bq, 1), f32)]
+        dq = [((1, bq, head), dtype), ((1, seq_k, head), dtype),
+              ((1, seq_k, head), dtype), ((1, bq, head), dtype),
+              ((1, bq, 1), f32), ((1, bq, 1), f32), ((1, bq, head), dtype)]
+        dkv = [((1, seq_q, head), dtype), ((1, bk, head), dtype),
+               ((1, bk, head), dtype), ((1, seq_q, head), dtype),
+               ((1, seq_q, 1), f32), ((1, seq_q, 1), f32),
+               ((1, bk, head), dtype), ((1, bk, head), dtype)]
+        return all(vmem_fits(blocks, profile=profile)
+                   for blocks in (fwd, dq, dkv))
+
+    return validate
+
+
 def _tuned_blocks(q, k, causal, scale, interpret):
     """Autotuned (block_q, block_k) for this shape (FLAGS_use_autotune);
     the heuristic (128-preferred divisor) wins with the flag off."""
@@ -303,7 +331,8 @@ def _tuned_blocks(q, k, causal, scale, interpret):
     return autotune.pick(
         "flash_attention",
         (seq_q, seq_k, head, str(q.dtype), causal),
-        cands, measure=measure)
+        cands, measure=measure,
+        validate=_vmem_validate(seq_q, seq_k, head, q.dtype))
 
 
 def _fwd_rule(q, k, v, causal, scale, interpret):
@@ -322,6 +351,37 @@ def _bwd_rule(causal, scale, interpret, res, do):
 _flash_attention_bnsh.defvjp(_fwd_rule, _bwd_rule)
 
 
+def _engine_cases(engine):
+    """Sweep flash at the engine's full-context envelope with per-shard
+    head counts; the vjp case traces jax.grad through the custom_vjp so
+    the lint sees the backward kernels (_bwd_dq/_bwd_dkv) too."""
+    n = max(engine.num_heads // engine.tp, 1)
+    h = engine.head_dim
+    seq = engine.max_model_len
+    if not supports(seq, seq, h):
+        return
+    sds = jax.ShapeDtypeStruct
+    x = sds((engine.max_batch, seq, n, h), engine.dtype)
+
+    def fwd(q, k, v):
+        return flash_attention_pallas(q, k, v, is_causal=True)
+
+    def vjp(q, k, v):
+        def loss(*a):
+            return jnp.sum(fwd(*a).astype(jnp.float32))
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    yield registry.KernelCase(f"fwd[s{seq}]", fwd, (x, x, x), None)
+    yield registry.KernelCase(f"vjp[s{seq}]", vjp, (x, x, x), None)
+
+
+@registry.register_kernel(
+    "flash_attention",
+    fallback="paddle_tpu.ops.pallas:_xla_attention",
+    parity="tests/test_pallas_kernels.py::test_flash_attention_grads",
+    engine_shapes=_engine_cases,
+    supports=supports,
+    grad=True)
 def flash_attention_pallas(q, k, v, is_causal=False, scale=None,
                            interpret=False):
     """q, k, v: [batch, seq, num_heads, head_dim] (paddle flash-attn layout).

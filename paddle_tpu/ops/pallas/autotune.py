@@ -15,6 +15,7 @@ across processes as JSON.
 
 import json
 import os
+import tempfile
 import threading
 import time
 
@@ -46,14 +47,29 @@ def _load_file_once():
 
 
 def _save_file():
+    # Atomic: concurrent processes sharing PADDLE_TPU_AUTOTUNE_CACHE must
+    # never observe a torn/partial JSON (truncate-then-write loses the whole
+    # cache if a reader races the writer or the writer dies mid-dump).
     path = _cache_file()
     if not path:
         return
+    tmp = None
     try:
-        with open(path, "w") as f:
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(os.path.abspath(path)) or ".",
+            prefix=os.path.basename(path) + ".")
+        with os.fdopen(fd, "w") as f:
             json.dump({k: v for k, v in _CACHE.items()}, f)
+        os.replace(tmp, path)
+        tmp = None
     except Exception:
         pass
+    finally:
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
 
 def _enabled():
@@ -75,7 +91,8 @@ def autotune_cache_clear():
         _CACHE.clear()
 
 
-def pick(kernel, key, candidates, measure=None, warmup=1, iters=3):
+def pick(kernel, key, candidates, measure=None, warmup=1, iters=3,
+         validate=None):
     """Return the winning candidate for ``(kernel, key)``.
 
     ``candidates``: non-empty list, first = author heuristic (the flag-off
@@ -83,9 +100,19 @@ def pick(kernel, key, candidates, measure=None, warmup=1, iters=3):
     config on real inputs; it is timed with ``warmup`` untimed runs then
     best-of-``iters``.  A candidate whose measure raises is skipped (e.g.
     VMEM overflow for an oversized block).
+
+    ``validate(candidate) -> bool`` statically screens candidates before
+    any compile/measure (kernel_lint's K002 VMEM residency model is the
+    intended screen) — rejected candidates never burn a compile.  If the
+    screen rejects everything the original list is kept: the model is
+    advisory and the measure path's try/except stays the backstop.
     """
     if not candidates:
         raise ValueError("no candidates")
+    if validate is not None:
+        screened = [c for c in candidates if validate(c)]
+        if screened:
+            candidates = screened
     ck = f"{kernel}|{key}"
     want_tuning = measure is not None and _enabled() and len(candidates) > 1
     with _LOCK:

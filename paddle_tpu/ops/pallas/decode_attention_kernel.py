@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from . import registry
+
 DEFAULT_BLOCK_S = 128
 _NEG_INF = -1e30
 
@@ -78,6 +80,33 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_s):
     o_ref[0, :, 0, :] = safe.astype(o_ref.dtype)
 
 
+def _engine_cases(engine):
+    """Dense-cache decode at the engine's decode buckets (S_max is the
+    paged pool's token horizon, per-shard head counts under tp)."""
+    nkv = max(engine.num_heads // engine.tp, 1)
+    d = engine.head_dim
+    s_max = engine.max_pages * engine.block_size
+    if not supports(s_max, d, nkv, nkv):
+        return
+    sds = jax.ShapeDtypeStruct
+    for kind, bkt in engine._bucket_grid():
+        if kind != "decode":
+            continue
+        q = sds((bkt, nkv, d), engine.dtype)
+        kc = sds((bkt, s_max, nkv, d), engine.dtype)
+        yield registry.KernelCase(
+            f"decode[{bkt}]", decode_attention_pallas,
+            (q, kc, kc, sds((bkt,), jnp.int32)), None)
+
+
+@registry.register_kernel(
+    "decode_attention",
+    fallback="paddle_tpu.ops.pallas.decode_attention_kernel:"
+             "decode_attention_xla",
+    parity="tests/test_pallas_kernels.py::TestDecodeAttention::"
+           "test_matches_xla_reference_ragged_gqa",
+    engine_shapes=_engine_cases,
+    supports=supports)
 def decode_attention_pallas(q, k_cache, v_cache, lengths, block_s=None,
                             interpret=False):
     """Returns [B, Nq, D] attention outputs for one decode step."""

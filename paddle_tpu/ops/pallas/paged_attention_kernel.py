@@ -45,6 +45,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from . import registry
+
 _NEG_INF = -1e30
 
 
@@ -96,6 +98,43 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         o_ref[0, 0] = safe.astype(o_ref.dtype)
 
 
+def _decode_engine_cases(engine):
+    """Every decode/verify launch of the paged kernel: decode buckets
+    are [B] single-token rows, verify buckets flatten to Bb*(Kb+1)
+    rows; block-table entries are page ids in [0, num_blocks - 1] (the
+    scalar_bounds K003 needs to prove the prefetch indirection safe)."""
+    nkv = max(engine.num_heads // engine.tp, 1)
+    d = engine.head_dim
+    if not supports(engine.block_size, d, nkv, nkv):
+        return
+    sds = jax.ShapeDtypeStruct
+    kp = sds((engine.num_blocks, engine.block_size, nkv, d),
+             engine.dtype)
+    bounds = {0: (0, engine.num_blocks - 1),
+              1: (0, engine.max_model_len)}
+    for kind, bkt in engine._bucket_grid():
+        if kind == "decode":
+            rows, label = bkt, f"decode[{bkt}]"
+        elif kind == "verify":
+            bb, kb = bkt
+            rows, label = bb * (kb + 1), f"verify[{bkt}]"
+        else:
+            continue
+        yield registry.KernelCase(
+            label, paged_decode_attention_pallas,
+            (sds((rows, nkv, d), engine.dtype), kp, kp,
+             sds((rows, engine.max_pages), jnp.int32),
+             sds((rows,), jnp.int32)), bounds)
+
+
+@registry.register_kernel(
+    "paged_decode_attention",
+    fallback="paddle_tpu.inference.llm.paged_attention:"
+             "paged_decode_attention_xla",
+    parity="tests/test_pallas_kernels.py::TestPagedAttention::"
+           "test_decode_parity_ragged_gqa",
+    engine_shapes=_decode_engine_cases,
+    supports=supports)
 def paged_decode_attention_pallas(q, k_pages, v_pages, block_tables,
                                   lengths, interpret=False):
     """Returns [B, Nq, D] attention outputs for one paged decode step."""
@@ -195,6 +234,36 @@ def _prefill_kernel(bt_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
                     / jnp.maximum(l_scr[...], 1e-30)).astype(o_ref.dtype)
 
 
+def _prefill_engine_cases(engine):
+    """Every chunked-prefill launch: one case per chunk bucket (start
+    rides in as a traced scalar, bounded by the model horizon)."""
+    nkv = max(engine.num_heads // engine.tp, 1)
+    d = engine.head_dim
+    sds = jax.ShapeDtypeStruct
+    kp = sds((engine.num_blocks, engine.block_size, nkv, d),
+             engine.dtype)
+    bounds = {0: (0, engine.num_blocks - 1),
+              1: (0, engine.max_model_len - 1)}
+    for kind, bkt in engine._bucket_grid():
+        if kind != "chunk":
+            continue
+        if not prefill_supports(engine.block_size, d, nkv, nkv, bkt):
+            continue
+        yield registry.KernelCase(
+            f"chunk[{bkt}]", paged_prefill_attention_pallas,
+            (sds((1, bkt, nkv, d), engine.dtype), kp, kp,
+             sds((engine.max_pages,), jnp.int32),
+             sds((), jnp.int32)), bounds)
+
+
+@registry.register_kernel(
+    "paged_prefill_attention",
+    fallback="paddle_tpu.inference.llm.paged_attention:"
+             "paged_prefill_attention_xla",
+    parity="tests/test_pallas_kernels.py::TestPagedAttention::"
+           "test_prefill_parity_partial_page",
+    engine_shapes=_prefill_engine_cases,
+    supports=prefill_supports)
 def paged_prefill_attention_pallas(q, k_pages, v_pages, block_table,
                                    start, interpret=False):
     """Causal attention for one sequence's prefill chunk through its

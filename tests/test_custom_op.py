@@ -289,6 +289,57 @@ class TestAutotune:
         finally:
             paddle.set_flags({"FLAGS_use_autotune": False})
 
+    def test_validate_screens_candidates_before_measure(self):
+        from paddle_tpu.ops.pallas import autotune
+
+        autotune.autotune_cache_clear()
+        measured = []
+        paddle.set_flags({"FLAGS_use_autotune": True})
+        try:
+            got = autotune.pick("k5", (5,), ["huge", "ok", "ok2"],
+                                measure=measured.append,
+                                validate=lambda c: c != "huge")
+            # the rejected candidate never reached measure (no compile)
+            assert got in ("ok", "ok2") and "huge" not in measured
+        finally:
+            paddle.set_flags({"FLAGS_use_autotune": False})
+
+    def test_validate_rejecting_all_keeps_original_list(self):
+        from paddle_tpu.ops.pallas import autotune
+
+        autotune.autotune_cache_clear()
+        # screen is advisory: rejecting everything must not error out
+        assert autotune.pick("k6", (6,), ["a", "b"],
+                             validate=lambda c: False) == "a"
+
+    def test_save_file_is_atomic(self, tmp_path, monkeypatch):
+        """Crash mid-dump must never corrupt an existing cache file
+        (truncate-then-write lost the whole cache before)."""
+        import json
+        import os
+
+        from paddle_tpu.ops.pallas import autotune
+
+        path = tmp_path / "cache.json"
+        monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_CACHE", str(path))
+        autotune.autotune_cache_clear()
+        assert autotune.pick("k7", (7,), ["a"]) == "a"
+        good = json.loads(path.read_text())
+        assert good["k7|(7,)"] == ["a", False]
+
+        # poison the dump: the existing file must survive untouched
+        monkeypatch.setattr(autotune.json, "dump",
+                            lambda *a, **k: 1 / 0)
+        autotune.autotune_cache_clear()
+        autotune.pick("k8", (8,), ["b"])
+        assert json.loads(path.read_text()) == good
+        # and no temp-file litter next to the cache
+        leftovers = [f for f in os.listdir(tmp_path)
+                     if f != "cache.json"]
+        assert leftovers == []
+        monkeypatch.undo()
+        autotune.autotune_cache_clear()
+
     def test_flash_attention_still_correct(self):
         # interpret-mode pallas on CPU: autotuned block path must match XLA
         from paddle_tpu.ops.pallas.attention_kernel import (

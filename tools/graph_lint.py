@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Static-analysis CLI over jitted graphs, the LLM serving engine's
-executable grid, imported static programs, and the op-kernel sources.
+executable grid, imported static programs, the op-kernel sources, and
+the Pallas kernel registry.
 
 Thin wrapper: the implementation (and the `graph-lint` console script)
 lives in ``paddle_tpu.framework.analysis`` so it ships with the wheel;
@@ -12,6 +13,7 @@ Examples::
     python tools/graph_lint.py engine --tp 2
     python tools/graph_lint.py cost --tp 2 --memory-budget 16GiB --json
     python tools/graph_lint.py census --spec 4 --max-executables 32
+    python tools/graph_lint.py kernels --tp 2 --strict --profile tpu-v5e
     python tools/graph_lint.py program /path/to/export/inference
     python tools/graph_lint.py ops paddle_tpu/ops --strict
     python tools/graph_lint.py fn mypkg.mod:f --arg f32[4,8]
