@@ -89,7 +89,8 @@ def _force_device_count(n):
 
 def _build_engine(max_batch, seed=0, max_model_len=64,
                   prefix_caching=True, token_budget=64, tp=1,
-                  speculative=None):
+                  speculative=None, faults=None, retry=None,
+                  max_queue=None):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.models.gpt import gpt_tiny
@@ -102,7 +103,8 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
                      enable_prefix_caching=prefix_caching,
                      token_budget=token_budget,
                      tensor_parallel=tp if tp > 1 else None,
-                     speculative=speculative)
+                     speculative=speculative, faults=faults,
+                     retry=retry, max_queue=max_queue)
 
 
 def _trace(n_requests, rate, max_new, seed=0):
@@ -148,8 +150,14 @@ def _repetitive_trace(n_requests, rate, max_new, seed=0):
     return arrivals, prompts, new_tokens
 
 
-def run(engine, arrivals, prompts, new_tokens):
-    """Replay the trace in real time; returns per-token timing data."""
+def run(engine, arrivals, prompts, new_tokens, deadline_ms=None,
+        faults=None):
+    """Replay the trace in real time; returns per-token timing data.
+
+    ``deadline_ms`` attaches a per-request deadline to every admission;
+    ``faults`` is a FaultInjector whose "client"-site faults the driver
+    applies as abort_request on the oldest live request (the step/alloc
+    sites fire inside the engine on their own)."""
     # compile ALL prefill/decode buckets outside the timed window —
     # with cold buckets the first steps at each new batch size stall on
     # XLA compiles and the measurement reflects compile time, not serving
@@ -164,6 +172,7 @@ def run(engine, arrivals, prompts, new_tokens):
     gen_counts = {}                  # rid -> tokens seen so far
     total_tokens_done = [0]          # tokens of already-finished requests
     outputs = {}                     # request index -> full token ids
+    reasons = {}                     # request index -> finish_reason
     ttfts, gaps = [], []
     tpots, e2es = [], []             # per-REQUEST decode pace / latency
     done = 0
@@ -172,15 +181,22 @@ def run(engine, arrivals, prompts, new_tokens):
         while pending and arrivals[pending[0]] <= now:
             i = pending.pop(0)
             rid = engine.add_request(prompts[i],
-                                     max_new_tokens=new_tokens[i])
+                                     max_new_tokens=new_tokens[i],
+                                     deadline_ms=deadline_ms)
             rid_to_idx[rid] = i
             arrival_at[rid] = arrivals[i]
             gen_counts[rid] = 0
+        if faults is not None and \
+                faults.scheduled("client", engine._step_index + 1):
+            live = sorted(engine._requests)
+            if live:
+                engine.abort_request(live[0])
         finished = engine.step()
         t_step = time.perf_counter() - t0
         done += len(finished)
         for fo in finished:
             outputs[rid_to_idx[fo.request_id]] = fo.all_ids.tolist()
+            reasons[rid_to_idx[fo.request_id]] = fo.finish_reason
         # credit token timestamps at step granularity: each live request
         # grew by at most one token this step
         fin_lens = {fo.request_id: len(fo.output_ids) for fo in finished}
@@ -238,7 +254,9 @@ def run(engine, arrivals, prompts, new_tokens):
         "preemptions": engine.scheduler.num_preemptions,
         "prefix_cache": engine.prefix_cache_stats(),
         "spec": engine.spec_stats(),
+        "lifecycle": engine.lifecycle_stats(),
         "outputs": outputs,
+        "reasons": reasons,
     }
 
 
@@ -272,6 +290,20 @@ def main():
                          "draft tokens per sequence, replayed on a "
                          "repetitive (agentic-style) trace; baseline "
                          "is the same trace with speculation off")
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="replay the standard trace under a "
+                         "randomized-but-seeded fault schedule "
+                         "(transient/raise step faults, forced "
+                         "allocator OOMs, client aborts) against a "
+                         "fault-free baseline replay; reports "
+                         "shed/abort/retry/deadline counts and the "
+                         "p95 latency deltas the chaos cost")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="(--chaos) per-request deadline_ms attached "
+                         "to every admission")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="(--chaos) bounded admission: waiting-queue "
+                         "depth past which requests are shed")
     ap.add_argument("--repeats", type=int, default=3,
                     help="(--spec only) replay each engine this many "
                          "times and keep the best run — wall-clock on "
@@ -303,6 +335,8 @@ def main():
         return _main_spec(args, jax)
     if args.shared_prefix:
         return _main_shared_prefix(args, jax)
+    if args.chaos is not None:
+        return _main_chaos(args, jax)
 
     arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
                                            args.max_new, args.seed)
@@ -458,6 +492,92 @@ def _main_spec(args, jax):
     _write_artifact(args, row, ok=token_exact)
     if not token_exact:
         raise SystemExit("speculative replay diverged from non-spec")
+
+
+def _main_chaos(args, jax):
+    """Replay the standard trace fault-free, then again under a
+    randomized-but-seeded fault schedule (transient + hard step faults,
+    forced allocator OOMs, client aborts — optionally deadlines and
+    bounded admission via --deadline-ms / --max-queue).  Reports the
+    failure-path counters and the p95 tail-latency cost of the chaos,
+    and asserts every surviving (cleanly finished) request is
+    token-exact vs the fault-free replay."""
+    import warnings
+
+    from paddle_tpu.inference.llm import FaultInjector
+
+    arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
+                                           args.max_new, args.seed)
+    base = _build_engine(args.max_batch, args.seed,
+                         token_budget=args.token_budget)
+    base_res = run(base, arrivals, prompts, new_tokens)
+
+    fi = FaultInjector.random(
+        args.chaos, steps=4096, p_step=0.005, p_transient=0.03,
+        p_oom=0.02, p_abort=0.01)
+    eng = _build_engine(
+        args.max_batch, args.seed, token_budget=args.token_budget,
+        faults=fi,
+        retry={"max_attempts": 3, "base_delay_s": 0.001, "jitter": 0.0},
+        max_queue=args.max_queue)
+    _lint_census(args, eng)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)   # quarantines
+        res = run(eng, arrivals, prompts, new_tokens,
+                  deadline_ms=args.deadline_ms, faults=fi)
+    eng.scheduler.check_invariants()
+    leaked = eng.num_blocks - eng.block_manager.num_free_blocks
+
+    # survivors must be byte-identical to the fault-free replay; chaos
+    # casualties (abort/deadline/shed/error) are allowed to differ
+    survivors = [i for i, r in res["reasons"].items()
+                 if r in ("stop", "length")]
+    token_exact = all(res["outputs"][i] == base_res["outputs"][i]
+                      for i in survivors)
+
+    ls = res["lifecycle"]
+    row = {
+        "metric": "llm_serving_chaos",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "chaos_seed": args.chaos,
+        "fault_events": len(fi.events),
+        "survivors": len(survivors),
+        "requests": args.requests,
+        "survivor_token_exact": token_exact,
+        "leaked_pages": leaked,
+        "shed": ls["shed"],
+        "aborted": ls["aborted"],
+        "deadline_missed": ls["deadline_missed"],
+        "retries": ls["retries"],
+        "quarantined": ls["quarantined"],
+        "step_faults": ls["step_faults"],
+        "preemptions": ls["preemptions"],
+        "tpot_p95_ms": (round(res["tpot_p95_ms"], 2)
+                        if res["tpot_p95_ms"] is not None else None),
+        "tpot_p95_delta_ms": (
+            round(res["tpot_p95_ms"] - base_res["tpot_p95_ms"], 2)
+            if res["tpot_p95_ms"] is not None
+            and base_res["tpot_p95_ms"] is not None else None),
+        "e2e_p95_ms": (round(res["e2e_p95_ms"], 2)
+                       if res["e2e_p95_ms"] is not None else None),
+        "e2e_p95_delta_ms": (
+            round(res["e2e_p95_ms"] - base_res["e2e_p95_ms"], 2)
+            if res["e2e_p95_ms"] is not None
+            and base_res["e2e_p95_ms"] is not None else None),
+        "deadline_ms": args.deadline_ms,
+        "max_queue": args.max_queue,
+        "max_batch": args.max_batch,
+        "backend": jax.default_backend(),
+        "config": "gpt_tiny 2L block_size=8 max_model_len=64",
+    }
+    print(json.dumps(row))
+    ok = token_exact and leaked == 0
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "chaos replay violated its contract: "
+            f"token_exact={token_exact} leaked_pages={leaked}")
 
 
 def _main_tp(args, jax):

@@ -16,11 +16,17 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   n-gram drafter (NgramDrafter / SpeculativeConfig);
                   the engine scores K drafts + 1 bonus position per
                   sequence in one jitted verify step
+- faults:         request-lifecycle vocabulary (FinishReason) and the
+                  deterministic fault-injection harness (FaultInjector,
+                  RetryPolicy, StepWatchdog) — seeded fault schedules
+                  at the device-step / allocator / socket boundaries
 - engine:         LLMEngine (add_request/step/generate, bucketed
                   donated jitted executables; ``tensor_parallel=N``
                   shards params Megatron-style and the paged pool along
                   the head axis over an 'mp' device mesh;
-                  ``speculative=K`` adds the verify family)
+                  ``speculative=K`` adds the verify family;
+                  ``abort_request``/``deadline_ms``/``max_queue``/
+                  ``faults=`` for lifecycle hardening)
                   + AsyncLLMEngine for servers
 
 See docs/LLM_SERVING.md for design notes and a quickstart.
@@ -33,6 +39,15 @@ from .block_manager import (  # noqa: F401
     prefix_block_hashes,
 )
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
+from .faults import (  # noqa: F401
+    Fault,
+    FaultInjector,
+    FinishReason,
+    InjectedFault,
+    PoolLostError,
+    RetryPolicy,
+    StepWatchdog,
+)
 from .paged_attention import (  # noqa: F401
     paged_decode_attention,
     paged_decode_attention_xla,
@@ -47,12 +62,18 @@ from .scheduler import (  # noqa: F401
     ScheduledBatch,
     Scheduler,
 )
-from .spec import NgramDrafter, SpeculativeConfig  # noqa: F401
+from .spec import (  # noqa: F401
+    NgramDrafter,
+    SpeculativeConfig,
+    rollback_draft_reservation,
+)
 
 __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
-           "NgramDrafter", "SpeculativeConfig",
+           "NgramDrafter", "SpeculativeConfig", "rollback_draft_reservation",
+           "Fault", "FaultInjector", "FinishReason", "InjectedFault",
+           "PoolLostError", "RetryPolicy", "StepWatchdog",
            "paged_decode_attention", "paged_decode_attention_xla",
            "paged_prefill_attention", "paged_prefill_attention_xla",
            "paged_verify_attention", "paged_verify_attention_xla"]

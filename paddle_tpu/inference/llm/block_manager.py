@@ -64,6 +64,12 @@ class BlockManager:
         self.num_blocks = int(num_blocks)
         self.block_size = int(block_size)
         self.enable_prefix_caching = bool(enable_prefix_caching)
+        # fault injection (faults.FaultInjector): when attached, the
+        # public reservation entry points consult it FIRST and raise a
+        # genuine NoFreeBlocksError before mutating anything — a forced
+        # OOM at step N exercises the same preempt/recompute path a
+        # real exhausted pool does, with zero special-casing downstream
+        self.fault_hook = None
         # pop() takes from the tail: keep it sorted descending so pages
         # are handed out in ascending id order (stable tests/traces)
         self._free = list(range(self.num_blocks - 1, -1, -1))
@@ -206,6 +212,10 @@ class BlockManager:
         table."""
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id!r} already allocated")
+        if self.fault_hook is not None and self.fault_hook.alloc("allocate"):
+            err = NoFreeBlocksError("injected OOM (fault schedule)")
+            err.injected = True
+            raise err
         need = self.blocks_needed(num_tokens)
         if len(cached_hashes) > need:
             raise ValueError("more cached pages than the sequence needs")
@@ -243,6 +253,11 @@ class BlockManager:
         Raises NoFreeBlocksError when a page is needed and none is free —
         the scheduler's preemption trigger.
         """
+        if self.fault_hook is not None and \
+                self.fault_hook.alloc("append_slot"):
+            err = NoFreeBlocksError("injected OOM (fault schedule)")
+            err.injected = True
+            raise err
         table = self._tables[seq_id]
         tokens = self._tokens[seq_id]
         offset = tokens % self.block_size
@@ -273,6 +288,11 @@ class BlockManager:
         n = int(n)
         if n < 1:
             raise ValueError(f"append_slots needs n >= 1, got {n}")
+        if self.fault_hook is not None and \
+                self.fault_hook.alloc("append_slots"):
+            err = NoFreeBlocksError("injected OOM (fault schedule)")
+            err.injected = True
+            raise err
         table = self._tables[seq_id]
         tokens = self._tokens[seq_id]
         new_pages = self.blocks_needed(tokens + n) - len(table)

@@ -63,6 +63,8 @@ ways, serving models whose KV pool doesn't fit one chip.
 """
 
 import threading
+import time
+import warnings
 
 import numpy as np
 
@@ -74,13 +76,20 @@ from ... import profiler
 from ...framework import jax_compat  # noqa: F401  (aliases jax.shard_map)
 from ...incubate.nn import _layernorm
 from .block_manager import BlockManager, prefix_block_hashes
+from .faults import (
+    FinishReason,
+    InjectedFault,
+    PoolLostError,
+    RetryPolicy,
+    StepWatchdog,
+)
 from .paged_attention import (
     paged_decode_attention,
     paged_prefill_attention,
     paged_verify_attention,
 )
 from .scheduler import FINISHED, Request, Scheduler, bucket_size
-from .spec import NgramDrafter, SpeculativeConfig
+from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
 
 # Megatron-style sharding of the stacked block params over the 'mp' axis
 # (leading dim is the layer stack): qkv/fc_in split their OUTPUT columns,
@@ -124,18 +133,31 @@ def _qkv_head_permutation(num_heads, head_dim, tp):
 
 
 class RequestOutput:
-    """One finished request: ids are plain python/numpy on the host."""
+    """One finished request: ids are plain python/numpy on the host.
+
+    ``finish_reason`` is one of :class:`~.faults.FinishReason.ALL`;
+    ``ok`` is True for the "done" family (stop/length) — aborted,
+    deadline-missed, shed, and quarantined requests carry a truncated
+    (possibly empty) ``output_ids`` and, for ``error``, the failing
+    step's message in ``error``."""
 
     def __init__(self, request_id, prompt_ids, output_ids, finish_reason,
-                 num_preemptions):
+                 num_preemptions, error=None):
         self.request_id = request_id
         self.prompt_ids = np.asarray(prompt_ids)  # noqa: H001 (host output contract)
         self.output_ids = np.asarray(output_ids)  # noqa: H001 (host output contract)
         self.finish_reason = finish_reason
         self.num_preemptions = num_preemptions
+        self.error = error
+
+    @property
+    def ok(self):
+        return FinishReason.is_done(self.finish_reason)
 
     @property
     def all_ids(self):
+        if self.output_ids.size == 0:    # shed/aborted before any token
+            return np.array(self.prompt_ids)
         return np.concatenate([self.prompt_ids, self.output_ids])
 
 
@@ -168,7 +190,41 @@ class LLMEngine:
                  max_model_len=None, max_batch=8, dtype=None,
                  enable_prefix_caching=True, token_budget=64,
                  mesh=None, tensor_parallel=None, seed=None,
-                 speculative=None, memory_budget=None):
+                 speculative=None, memory_budget=None,
+                 faults=None, retry=None, max_queue=None,
+                 step_timeout_s=None, clock=None):
+        # ----------------------------------------- lifecycle hardening ----
+        # validate the robustness knobs FIRST (mirrors max_new_tokens):
+        # a bad config must fail loudly at construction, not mid-traffic
+        if max_queue is not None:
+            if not isinstance(max_queue, (int, np.integer)) \
+                    or isinstance(max_queue, bool) or max_queue < 1:
+                raise ValueError(
+                    f"max_queue must be a positive int (waiting-queue "
+                    f"depth before load-shedding), got {max_queue!r}")
+            max_queue = int(max_queue)
+        self.max_queue = max_queue
+        self.faults = faults
+        self.retry = RetryPolicy.resolve(retry)
+        if step_timeout_s is not None:
+            if isinstance(step_timeout_s, bool) or \
+                    not isinstance(step_timeout_s,
+                                   (int, float, np.integer, np.floating)) \
+                    or step_timeout_s <= 0:
+                raise ValueError(
+                    f"step_timeout_s must be a positive number of "
+                    f"seconds, got {step_timeout_s!r}")
+        self.watchdog = (StepWatchdog(step_timeout_s)
+                         if step_timeout_s is not None else None)
+        self._clock = clock if clock is not None else time.monotonic
+        self._early = []         # outputs finished without a device step
+        self._draining = False
+        self._step_index = -1
+        # deterministic lifecycle event log: (step, kind, *detail)
+        # tuples with no wall-times, so two replays of the same fault
+        # seed produce IDENTICAL logs (the chaos determinism contract)
+        self.events = []
+
         d = model.functional_decompose()
         cfg = model.config
         self.num_layers = d["num_layers"]
@@ -255,6 +311,7 @@ class LLMEngine:
         self.block_manager = BlockManager(
             self.num_blocks, self.block_size,
             enable_prefix_caching=enable_prefix_caching)
+        self.block_manager.fault_hook = self.faults
         self.scheduler = Scheduler(self.block_manager,
                                    max_batch=self.max_batch,
                                    token_budget=self.token_budget,
@@ -269,7 +326,10 @@ class LLMEngine:
         self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
                       "chunk_launches": 0, "tokens_generated": 0,
                       "spec_steps": 0, "draft_tokens": 0,
-                      "accepted_tokens": 0}
+                      "accepted_tokens": 0,
+                      # lifecycle/fault counters (lifecycle_stats())
+                      "aborted": 0, "deadline_missed": 0, "shed": 0,
+                      "retries": 0, "quarantined": 0, "step_faults": 0}
 
         tp = self.tp
         nh, hd, eps = self.num_heads, self.head_dim, self.eps
@@ -530,7 +590,8 @@ class LLMEngine:
 
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
-                    temperature=0.0, request_id=None, seed=None):
+                    temperature=0.0, request_id=None, seed=None,
+                    deadline_ms=None):
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]  # noqa: H001 (host request boundary)
         if not prompt:
             raise ValueError("empty prompt")
@@ -540,6 +601,14 @@ class LLMEngine:
         if temperature < 0.0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
+        if deadline_ms is not None and \
+                (isinstance(deadline_ms, bool)
+                 or not isinstance(deadline_ms, (int, float, np.integer,
+                                                 np.floating))
+                 or deadline_ms <= 0):
+            raise ValueError(
+                f"deadline_ms must be a positive number of "
+                f"milliseconds, got {deadline_ms!r}")
         if len(prompt) + max_new_tokens > self.max_model_len:
             raise ValueError(
                 f"prompt {len(prompt)} + new {max_new_tokens} exceeds "
@@ -547,17 +616,117 @@ class LLMEngine:
         if request_id is None:
             request_id = self._next_id
             self._next_id += 1
+        now = self._clock()
         req = Request(request_id=request_id, prompt_ids=tuple(prompt),
                       max_new_tokens=int(max_new_tokens),
                       eos_token_id=eos_token_id,
                       temperature=float(temperature),
-                      seed=None if seed is None else int(seed))
+                      seed=None if seed is None else int(seed),
+                      deadline=(None if deadline_ms is None
+                                else now + float(deadline_ms) / 1e3),
+                      arrival_time=now)
+        # bounded admission: past the configured waiting-queue depth
+        # (or while draining) the request is SHED — it finishes
+        # immediately with FinishReason.shed instead of growing an
+        # unbounded queue whose tail can never meet a deadline
+        if self._draining or (self.max_queue is not None
+                              and self.scheduler.queue_depth()
+                              >= self.max_queue):
+            self.stats["shed"] += 1
+            self.events.append((self._step_index, "shed", request_id))
+            req.status = FINISHED
+            req.finish_reason = FinishReason.SHED
+            self._early.append(RequestOutput(
+                request_id, req.prompt_ids, req.output_ids,
+                FinishReason.SHED, 0))
+            return request_id
         self._requests[request_id] = req
         self.scheduler.add(req)
+        self.events.append((self._step_index, "add", request_id))
         return request_id
 
+    def abort_request(self, request_id):
+        """Cancel a request in ANY state — waiting, chunk-prefilling,
+        decoding, holding a speculative reservation, or preempted —
+        reclaiming its pages refcount-correctly (COW-shared pages drop
+        one reference; prefix-cache registrations survive on the LRU
+        list).  The RequestOutput (FinishReason.aborted, whatever
+        tokens were already emitted) is delivered by the next step().
+        Returns True if the request existed and was aborted, False if
+        it was unknown or already finished."""
+        req = self._requests.get(request_id)
+        if req is None or req.status == FINISHED:
+            return False
+        rollback_draft_reservation(self.block_manager, req)
+        self.scheduler.abort(req)
+        self.stats["aborted"] += 1
+        self.events.append((self._step_index, "abort", request_id))
+        self._finish_early(req, FinishReason.ABORTED)
+        return True
+
+    def _finish_early(self, req, reason, error=None):
+        """Terminal bookkeeping for a request that exits WITHOUT a
+        device step (abort / deadline / quarantine): pages are already
+        reclaimed by the caller; the output joins the next step()'s
+        finished list."""
+        req.status = FINISHED
+        req.finish_reason = reason
+        self._requests.pop(req.request_id, None)
+        self._early.append(RequestOutput(
+            req.request_id, req.prompt_ids, req.output_ids, reason,
+            req.num_preemptions, error=error))
+
+    def _expire_deadlines(self, finished):
+        """Scheduler-enforced deadlines: pop every request past its
+        ``deadline_ms`` (waiting or running — pages freed either way)
+        and emit its output with FinishReason.deadline."""
+        expired = self.scheduler.expire_deadlines(self._clock())
+        for req in expired:
+            self.stats["deadline_missed"] += 1
+            self.events.append(
+                (self._step_index, "deadline", req.request_id))
+            self._finish_early(req, FinishReason.DEADLINE)
+        if expired:
+            finished.extend(self._drain_early())
+
+    def _drain_early(self):
+        early, self._early = self._early, []
+        return early
+
     def has_unfinished(self):
-        return self.scheduler.has_unfinished()
+        return bool(self._early) or self.scheduler.has_unfinished()
+
+    def drain(self, timeout_s=None):
+        """Graceful shutdown: stop admitting (new requests are shed),
+        step until every in-flight request finishes, and return their
+        outputs.  ``timeout_s`` bounds the wall-clock wait — requests
+        still running when it expires are aborted, so drain() always
+        terminates with zero pages leaked."""
+        self._draining = True
+        deadline = (None if timeout_s is None
+                    else self._clock() + float(timeout_s))
+        outs = []
+        try:
+            while self.has_unfinished():
+                if deadline is not None and self._clock() >= deadline:
+                    for rid in list(self._requests):
+                        self.abort_request(rid)
+                outs.extend(self.step())
+        finally:
+            self._draining = False
+        return outs
+
+    def lifecycle_stats(self):
+        """Failure-path counters (chaos bench artifact rows)."""
+        s = self.stats
+        return {"aborted": s["aborted"],
+                "deadline_missed": s["deadline_missed"],
+                "shed": s["shed"], "retries": s["retries"],
+                "quarantined": s["quarantined"],
+                "step_faults": s["step_faults"],
+                "preemptions": self.scheduler.num_preemptions,
+                "wedged_steps": (self.watchdog.num_wedged
+                                 if self.watchdog else 0)}
 
     def _bucket_grid(self):
         """The complete executable family: every (kind, bucket) pair
@@ -682,13 +851,24 @@ class LLMEngine:
     # --------------------------------------------------------------- step --
     def step(self):
         """Run one scheduling iteration; returns RequestOutputs finished
-        by this step (possibly empty)."""
+        by this step (possibly empty) — including requests that exited
+        through a failure path (aborted / deadline / shed / error)
+        since the previous step."""
+        self._step_index += 1
+        if self.faults is not None:
+            self.faults.begin_step(self._step_index)
+        finished = self._drain_early()
+        self._expire_deadlines(finished)
+        pre_preempt = self.scheduler.num_preemptions
         with profiler.RecordEvent("llm_engine::schedule"):
             batch = self.scheduler.schedule()
+        if self.scheduler.num_preemptions > pre_preempt:
+            self.events.append(
+                (self._step_index, "preempt",
+                 self.scheduler.num_preemptions - pre_preempt))
         if batch.kind == "idle":
-            return []
+            return finished
         self.stats["steps"] += 1
-        finished = []
         reqs = batch.requests
         if reqs:
             self.stats["decode_steps"] += 1
@@ -699,8 +879,10 @@ class LLMEngine:
         if batch.chunks:
             self.stats["prefill_steps"] += 1
         for ch in batch.chunks:
-            self.stats["chunk_launches"] += 1
             req = ch.request
+            if req.status == FINISHED:
+                continue        # quarantined earlier this same step
+            self.stats["chunk_launches"] += 1
             cb = bucket_size(ch.length, self.token_budget, floor=8)
             ids = np.zeros((1, cb), np.int32)
             ids[0, :ch.length] = \
@@ -708,11 +890,18 @@ class LLMEngine:
             table = np.zeros(self.max_pages, np.int32)
             bt = self.block_manager.block_table(req.request_id)
             table[:len(bt)] = bt
-            with profiler.RecordEvent("llm_engine::prefill_chunk"):
-                nxt, logits, self._kc, self._vc = self._chunk(
-                    self.params, jnp.asarray(ids), self._kc, self._vc,
-                    jnp.asarray(table), jnp.int32(ch.start),
-                    jnp.int32(ch.length))
+
+            def launch_chunk(ids=ids, table=table, ch=ch):
+                with profiler.RecordEvent("llm_engine::prefill_chunk"):
+                    return self._chunk(
+                        self.params, jnp.asarray(ids), self._kc,
+                        self._vc, jnp.asarray(table),
+                        jnp.int32(ch.start), jnp.int32(ch.length))
+
+            out = self._launch("chunk", [req], launch_chunk)
+            if out is None:
+                continue        # quarantined; pages already reclaimed
+            nxt, logits, self._kc, self._vc = out
             req.num_cached = ch.start + ch.length
             self._register_full_blocks(req)
             if ch.is_final:
@@ -724,7 +913,82 @@ class LLMEngine:
             # replicated), so page accounting must be shard-invariant:
             # assert the books balance after each TP step
             self.scheduler.check_invariants()
+        finished.extend(self._drain_early())
         return finished
+
+    # ------------------------------------------------- step isolation ----
+    def _launch(self, kind, reqs, launch):
+        """Run one jitted launch behind the isolation boundary: fault
+        injection fires first (so injected failures never consume the
+        donated pool), the RetryPolicy absorbs transient faults with
+        seeded backoff, the watchdog clocks every attempt, and a launch
+        that still fails is quarantined — the responsible request(s)
+        finish with FinishReason.error, the rest of the engine keeps
+        serving.  Returns the launch outputs, or None after a
+        quarantine (callers skip their commit phase)."""
+        attempt = 0
+        while True:
+            t0 = time.perf_counter()
+            try:
+                if self.faults is not None:
+                    self.faults.device_step(kind)
+                return launch()
+            except Exception as e:   # noqa: BLE001 — isolation boundary
+                self.stats["step_faults"] += 1
+                if self._pool_lost():
+                    # the failing call consumed the donated K/V pool:
+                    # nothing to retry INTO — surface it, don't limp
+                    raise PoolLostError(
+                        f"device step consumed the donated KV pool "
+                        f"before failing; cache unrecoverable: {e}"
+                    ) from e
+                attempt += 1
+                if attempt < self.retry.max_attempts:
+                    self.stats["retries"] += 1
+                    self.events.append(
+                        (self._step_index, "retry", kind, attempt))
+                    delay = self.retry.backoff(attempt - 1)
+                    if delay > 0:
+                        time.sleep(delay)
+                    continue
+                self._quarantine(kind, reqs, e)
+                return None
+            finally:
+                if self.watchdog is not None:
+                    self.watchdog.observe(self._step_index, kind,
+                                          time.perf_counter() - t0)
+
+    def _pool_lost(self):
+        deleted = getattr(self._kc, "is_deleted", None)
+        return bool(deleted and self._kc.is_deleted())
+
+    def _quarantine(self, kind, reqs, exc):
+        """A launch failed after every retry: quarantine the
+        responsible request(s) with FinishReason.error instead of
+        killing the batch.  An injected fault names its victim row;
+        unattributable (real) failures quarantine every row of the
+        failing launch.  Non-victim rows roll back their outstanding
+        slot reservation and STAY RUNNING — the failed launch never
+        executed, so their K/V state is untouched and the next step
+        re-reserves and re-launches them token-exactly."""
+        victim = getattr(exc, "victim", None)
+        victims = (list(reqs) if victim is None or not reqs
+                   else [reqs[victim % len(reqs)]])
+        msg = f"{type(exc).__name__}: {exc}"
+        warnings.warn(f"quarantining {len(victims)} request(s) after "
+                      f"failed {kind} step: {msg}", RuntimeWarning,
+                      stacklevel=3)
+        for req in reqs:
+            if kind != "chunk":
+                # decode rows reserved 1 slot, verify rows 1 + K; give
+                # them back so survivors' books read exactly num_cached
+                rollback_draft_reservation(self.block_manager, req)
+        for req in victims:
+            self.scheduler.abort(req)
+            self.stats["quarantined"] += 1
+            self.events.append(
+                (self._step_index, "quarantine", req.request_id))
+            self._finish_early(req, FinishReason.ERROR, error=msg)
 
     def _register_full_blocks(self, req):
         """Make every completed full page of ``req`` hash-addressable
@@ -761,11 +1025,18 @@ class LLMEngine:
             positions[i] = r.num_cached
             bt = self.block_manager.block_table(r.request_id)
             tables[i, :len(bt)] = bt
-        with profiler.RecordEvent("llm_engine::decode"):
-            nxt, logits, self._kc, self._vc = self._decode(
-                self.params, jnp.asarray(ids), self._kc, self._vc,
-                jnp.asarray(tables), jnp.asarray(positions))
-        nxt = np.asarray(nxt)
+
+        def launch_decode():
+            with profiler.RecordEvent("llm_engine::decode"):
+                return self._decode(
+                    self.params, jnp.asarray(ids), self._kc, self._vc,
+                    jnp.asarray(tables), jnp.asarray(positions))
+
+        out = self._launch("decode", reqs, launch_decode)
+        if out is None:
+            return              # quarantined; survivors retry next step
+        nxt, logits, self._kc, self._vc = out
+        nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per decode step)
         row_logits = self._fetch_sampling_rows(reqs, logits)
         entries = []
         for i, r in enumerate(reqs):
@@ -795,11 +1066,18 @@ class LLMEngine:
             lens[i] = 1 + d
             bt = self.block_manager.block_table(r.request_id)
             tables[i, :len(bt)] = bt
-        with profiler.RecordEvent("llm_engine::verify"):
-            nxt, logits, self._kc, self._vc = self._verify(
-                self.params, jnp.asarray(ids), self._kc, self._vc,
-                jnp.asarray(tables), jnp.asarray(positions),
-                jnp.asarray(lens))
+
+        def launch_verify():
+            with profiler.RecordEvent("llm_engine::verify"):
+                return self._verify(
+                    self.params, jnp.asarray(ids), self._kc, self._vc,
+                    jnp.asarray(tables), jnp.asarray(positions),
+                    jnp.asarray(lens))
+
+        out = self._launch("verify", reqs, launch_verify)
+        if out is None:
+            return              # quarantined; reservations rolled back
+        nxt, logits, self._kc, self._vc = out
         nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per verify step)
         row_logits = self._fetch_sampling_rows(reqs, logits)
         for i, r in enumerate(reqs):
@@ -922,18 +1200,22 @@ class LLMEngine:
         req.status = FINISHED
         req.finish_reason = reason
         del self._requests[req.request_id]
+        self.events.append(
+            (self._step_index, "finish", req.request_id, reason))
         finished.append(RequestOutput(req.request_id, req.prompt_ids,
                                       req.output_ids, reason,
                                       req.num_preemptions))
 
     # ----------------------------------------------------------- generate --
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
-                 temperature=0.0, seed=None):
+                 temperature=0.0, seed=None, deadline_ms=None):
         """Batch convenience: returns one [T+new] int array per prompt
         (ragged list, request order preserved).  ``seed`` gives every
         request of this call its own deterministic sampling stream
         (independent of arrival interleaving); default None keeps the
-        engine-level RNG."""
+        engine-level RNG.  ``deadline_ms`` applies per request; a
+        request past it finishes with FinishReason.deadline and
+        returns whatever tokens it emitted."""
         # validate shared knobs BEFORE any request is queued, so a bad
         # call leaves the engine empty instead of half-submitted
         if max_new_tokens < 1:
@@ -942,13 +1224,22 @@ class LLMEngine:
         if temperature < 0.0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
+        if deadline_ms is not None and \
+                (isinstance(deadline_ms, bool)
+                 or not isinstance(deadline_ms, (int, float, np.integer,
+                                                 np.floating))
+                 or deadline_ms <= 0):
+            raise ValueError(
+                f"deadline_ms must be a positive number of "
+                f"milliseconds, got {deadline_ms!r}")
         if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
             prompts = list(prompts)
         elif not isinstance(prompts, (list, tuple)):
             prompts = [prompts]
         order = [self.add_request(p, max_new_tokens=max_new_tokens,
                                   eos_token_id=eos_token_id,
-                                  temperature=temperature, seed=seed)
+                                  temperature=temperature, seed=seed,
+                                  deadline_ms=deadline_ms)
                  for p in prompts]
         outs = {}
         while self.has_unfinished():
@@ -970,12 +1261,23 @@ class AsyncLLMEngine:
     appends to the scheduler's waiting queue and the request dict (both
     GIL-atomic list/dict ops); all other engine state is touched solely
     by the stepping thread.
+
+    Lifecycle: ``abort(request_id)`` queues a cancel that the stepping
+    thread applies between device calls (engine state stays
+    single-threaded); ``result(timeout=)`` expiring ABORTS the request
+    — a caller that gave up must not leave its request generating (and
+    holding pages) forever.  ``close()`` aborts everything still in
+    flight, reclaims the pages, joins the worker, and raises if the
+    thread survives — a close that silently leaks a live stepping
+    thread is how a "drained" replica keeps touching the device.
     """
 
     def __init__(self, engine):
         self.engine = engine
         self._cond = threading.Condition()
         self._results = {}          # request_id -> RequestOutput
+        self._aborts = set()        # rids to cancel, applied by the loop
+        self._abandoned = set()     # rids whose caller gave up (timeout)
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -983,42 +1285,99 @@ class AsyncLLMEngine:
     def _loop(self):
         while True:
             with self._cond:
-                while not self._stopped and \
+                while not self._stopped and not self._aborts and \
                         not self.engine.has_unfinished():
                     self._cond.wait(timeout=0.5)
                 if self._stopped:
-                    return
+                    break
+                aborts, self._aborts = self._aborts, set()
+            # engine state is touched ONLY on this thread: queued
+            # aborts apply here, between device calls
+            for rid in aborts:
+                self.engine.abort_request(rid)
             finished = self.engine.step()    # device call: lock NOT held
-            with self._cond:
-                for fo in finished:
-                    self._results[fo.request_id] = fo
-                if finished:
-                    self._cond.notify_all()
+            self._publish(finished)
+        # stopped: abort whatever is still in flight so pages are
+        # reclaimed and blocked result() callers get a terminal output
+        # instead of waiting on a dead thread (getattr: stub engines
+        # without the lifecycle surface just stop stepping)
+        abort = getattr(self.engine, "abort_request", None)
+        if abort is not None:
+            for rid in list(getattr(self.engine, "_requests", ())):
+                abort(rid)
+            while self.engine.has_unfinished():
+                self._publish(self.engine.step())
+        with self._cond:
+            self._cond.notify_all()
+
+    def _publish(self, finished):
+        if not finished:
+            return
+        with self._cond:
+            for fo in finished:
+                if fo.request_id in self._abandoned:
+                    self._abandoned.discard(fo.request_id)
+                    continue        # caller timed out and walked away
+                self._results[fo.request_id] = fo
+            self._cond.notify_all()
 
     def submit(self, prompt_ids, **kwargs):
         with self._cond:
+            if self._stopped:
+                raise RuntimeError("engine stopped")
             rid = self.engine.add_request(prompt_ids, **kwargs)
             self._cond.notify_all()
             return rid
 
+    def abort(self, request_id):
+        """Queue a cancel for ``request_id``; the stepping thread
+        applies it before its next device call and the aborted output
+        (FinishReason.aborted) arrives like any other result."""
+        with self._cond:
+            self._aborts.add(request_id)
+            self._cond.notify_all()
+
     def result(self, request_id, timeout=None):
-        """Block until the request finishes; returns its RequestOutput."""
+        """Block until the request finishes; returns its RequestOutput.
+        On timeout the request is ABORTED (pages reclaimed, output
+        discarded) before TimeoutError is raised — an abandoned request
+        never keeps generating."""
         with self._cond:
             ok = self._cond.wait_for(
                 lambda: request_id in self._results or self._stopped,
                 timeout=timeout)
             if not ok:
-                raise TimeoutError(f"request {request_id} still running")
-            if self._stopped and request_id not in self._results:
-                raise RuntimeError("engine stopped")
-            return self._results.pop(request_id)
+                self._abandoned.add(request_id)
+                self._aborts.add(request_id)
+                self._cond.notify_all()
+                raise TimeoutError(
+                    f"request {request_id} timed out and was aborted")
+            if request_id in self._results:
+                return self._results.pop(request_id)
+            # stopped before this request ever produced an output
+            raise RuntimeError("engine stopped")
 
     def generate(self, prompt_ids, timeout=None, **kwargs):
         return self.result(self.submit(prompt_ids, **kwargs),
                            timeout=timeout)
 
-    def stop(self):
+    def close(self, join_timeout=5.0):
+        """Stop the worker: pending requests are aborted (pages
+        reclaimed, outputs published with FinishReason.aborted), the
+        thread is joined, and a worker that outlives the join raises —
+        silently leaking a live stepping thread leaves a 'stopped'
+        engine still issuing device calls."""
         with self._cond:
             self._stopped = True
             self._cond.notify_all()
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=join_timeout)
+        if self._thread.is_alive():
+            warnings.warn(
+                "AsyncLLMEngine worker thread survived close(); a device "
+                "step is wedged", RuntimeWarning, stacklevel=2)
+            raise RuntimeError(
+                f"AsyncLLMEngine worker thread failed to stop within "
+                f"{join_timeout}s (wedged device step?)")
+
+    # historical name; close() is the documented surface
+    stop = close

@@ -1,0 +1,305 @@
+"""Request-lifecycle vocabulary + deterministic fault injection.
+
+A fleet is only as reliable as each replica's failure behavior, and a
+failure path that cannot be *tested* has no defined behavior at all.
+This module gives the serving engine both halves:
+
+- the lifecycle vocabulary (:class:`FinishReason`) every request exits
+  through — ``stop``/``length`` (the "done" family), ``aborted``
+  (client cancel), ``deadline`` (per-request ``deadline_ms`` missed),
+  ``shed`` (bounded admission rejected it), ``error`` (a device step
+  failed and the request was quarantined);
+- a seeded, deterministic :class:`FaultInjector` the engine and
+  PredictorServer consult at their injection points: the device-step
+  boundary (raise / delay / transient-then-succeed), the page
+  allocator (forced OOM at step N — exercises the preempt/recompute
+  path), and the socket layer (disconnect, partial-frame write).
+  Every fault schedule is MATERIALIZED AS DATA at construction
+  (:meth:`FaultInjector.random` draws it once from the seed), so
+  replaying the same seed replays byte-identical fault timing — the
+  chaos soak's determinism contract;
+- :class:`RetryPolicy` (exponential backoff + seeded jitter, bounded
+  attempts) absorbing transient step faults, and :class:`StepWatchdog`
+  flagging wedged device steps that exceed a wall-clock threshold.
+
+Faults raise BEFORE the jitted call launches, so the donated K/V pool
+is never half-consumed by an injected failure — retry re-launches with
+valid buffers, and a quarantined step leaves the pool exactly as the
+previous step committed it.  (A *real* in-flight XLA failure can lose
+donated buffers; the engine detects that and raises
+:class:`PoolLostError` instead of limping on with a dead cache.)
+"""
+# noqa-module: H001 (host-side fault scheduling by design — the injector
+# decides between device steps; nothing here runs under jit)
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class FinishReason:
+    """Terminal states of a request.  ``stop`` and ``length`` are the
+    "done" family (generation ran to completion); everything else names
+    the failure path that ended the request early."""
+
+    STOP = "stop"          # hit eos_token_id
+    LENGTH = "length"      # hit max_new_tokens
+    ABORTED = "aborted"    # abort_request() / client vanished
+    DEADLINE = "deadline"  # missed its deadline_ms
+    SHED = "shed"          # bounded admission rejected it (queue full)
+    ERROR = "error"        # device step failed; request quarantined
+
+    DONE = (STOP, LENGTH)
+    ALL = (STOP, LENGTH, ABORTED, DEADLINE, SHED, ERROR)
+
+    @staticmethod
+    def is_done(reason):
+        """True when generation completed normally (survivors of a
+        chaos replay must be token-exact; other reasons end early)."""
+        return reason in FinishReason.DONE
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the injector at the device-step boundary.  Carries the
+    scheduled victim so quarantine can blame the responsible request
+    instead of killing the whole batch."""
+
+    def __init__(self, message, victim=None):
+        super().__init__(message)
+        self.victim = victim
+
+
+class PoolLostError(RuntimeError):
+    """A device step failed AFTER consuming the donated K/V pool — the
+    cache is gone and the engine cannot recover in place."""
+
+
+@dataclass
+class Fault:
+    """One scheduled fault.
+
+    site:   "step" (device-step boundary), "alloc" (page allocator),
+            "socket" (PredictorServer response path), "client"
+            (driver-level: abort a request — consumed by chaos
+            drivers, not the engine).
+    kind:   step:   "raise" (fails every attempt -> quarantine),
+                    "transient" (fails ``count`` attempts, then
+                    succeeds -> absorbed by RetryPolicy),
+                    "delay" (sleep delay_s, then proceed -> exercises
+                    the StepWatchdog);
+            alloc:  "oom" (NoFreeBlocksError -> preempt/recompute);
+            socket: "disconnect" (drop the connection before the
+                    response), "partial" (write half a frame, then
+                    drop);
+            client: "abort".
+    step:   engine step index ("step"/"alloc"/"client" sites) or
+            response index ("socket" site) the fault fires at.
+    count:  "transient" only — how many attempts fail before success.
+    delay_s: "delay" only — injected stall length.
+    victim: "raise" only — index into the launch's request rows; the
+            quarantined request is ``reqs[victim % len(reqs)]``.  None
+            quarantines every row of the failing launch.
+    """
+
+    site: str
+    kind: str
+    step: int
+    count: int = 1
+    delay_s: float = 0.0
+    victim: int = None
+
+
+class FaultInjector:
+    """Deterministic fault schedule + the counters to replay it.
+
+    Build one explicitly::
+
+        fi = FaultInjector(schedule=[
+            Fault("step", "transient", step=3),   # retry absorbs it
+            Fault("alloc", "oom", step=5),        # forces a preemption
+            Fault("step", "raise", step=8, victim=0),
+        ])
+        eng = LLMEngine(model, faults=fi)
+
+    or draw a randomized-but-seeded one (the chaos soak)::
+
+        fi = FaultInjector.random(seed=7, steps=200, p_step=0.02)
+
+    The schedule is plain data; ``events`` records every fault that
+    actually fired as ``(step, site, kind, attempt)`` tuples, so two
+    runs from the same seed produce identical event logs.
+    """
+
+    def __init__(self, schedule=(), seed=0):
+        self.seed = int(seed)
+        self.schedule = list(schedule)
+        for f in self.schedule:
+            if f.site not in ("step", "alloc", "socket", "client"):
+                raise ValueError(f"unknown fault site {f.site!r}")
+        self.events = []
+        self._step = -1          # current engine step index
+        self._attempts = {}      # (site, step) -> attempts so far
+        self._socket_idx = -1    # response counter (socket site)
+        self._by_site = {}
+        for f in self.schedule:
+            self._by_site.setdefault((f.site, f.step), []).append(f)
+
+    @classmethod
+    def random(cls, seed, steps=128, *, p_step=0.0, p_transient=0.0,
+               p_oom=0.0, p_delay=0.0, p_abort=0.0, delay_s=0.0,
+               max_victim=8):
+        """Materialize a randomized schedule from ``seed`` — one
+        Bernoulli draw per (site, step) in a fixed order, so the same
+        seed always yields the same schedule (replayable by data, not
+        by accident of interleaving)."""
+        rng = np.random.RandomState(int(seed))
+        schedule = []
+        for s in range(int(steps)):
+            draws = rng.uniform(size=5)
+            if draws[0] < p_step:
+                schedule.append(Fault("step", "raise", step=s,
+                                      victim=int(rng.randint(max_victim))))
+            if draws[1] < p_transient:
+                schedule.append(Fault("step", "transient", step=s,
+                                      count=1))
+            if draws[2] < p_oom:
+                schedule.append(Fault("alloc", "oom", step=s))
+            if draws[3] < p_delay:
+                schedule.append(Fault("step", "delay", step=s,
+                                      delay_s=delay_s))
+            if draws[4] < p_abort:
+                schedule.append(Fault("client", "abort", step=s))
+        return cls(schedule=schedule, seed=seed)
+
+    # ------------------------------------------------------- engine hooks --
+    def begin_step(self, step_index):
+        """Engine calls this at the top of every step()."""
+        self._step = int(step_index)
+
+    def scheduled(self, site, step=None):
+        """Faults scheduled for ``site`` at ``step`` (default: the
+        current one).  Chaos drivers read the "client" site from here."""
+        key = (site, self._step if step is None else int(step))
+        return list(self._by_site.get(key, ()))
+
+    def device_step(self, kind):
+        """Consulted once per launch ATTEMPT at the device-step
+        boundary, before the jitted call.  Raises InjectedFault for
+        "raise"/"transient" faults, sleeps for "delay" faults."""
+        for f in self.scheduled("step"):
+            key = ("step", self._step, f.kind)
+            attempt = self._attempts.get(key, 0)
+            if f.kind == "delay":
+                if attempt == 0:
+                    self._attempts[key] = 1
+                    self.events.append((self._step, "step", "delay", 0))
+                    time.sleep(f.delay_s)
+                continue
+            if f.kind == "transient" and attempt >= f.count:
+                continue        # absorbed: this attempt succeeds
+            self._attempts[key] = attempt + 1
+            self.events.append((self._step, "step", f.kind, attempt))
+            raise InjectedFault(
+                f"injected {f.kind} fault at step {self._step} "
+                f"({kind} launch, attempt {attempt})", victim=f.victim)
+
+    def alloc(self, what):
+        """Consulted by the page allocator's entry points.  Returns
+        True exactly once per scheduled step when a forced OOM should
+        fire (the caller raises its own NoFreeBlocksError so the
+        scheduler's preempt path sees the genuine article)."""
+        for f in self.scheduled("alloc"):
+            key = ("alloc", self._step)
+            if f.kind == "oom" and not self._attempts.get(key):
+                self._attempts[key] = 1
+                self.events.append((self._step, "alloc", "oom", 0))
+                return True
+        return False
+
+    def socket_fault(self):
+        """Consulted by PredictorServer once per response; returns
+        "disconnect" | "partial" | None for this response index."""
+        self._socket_idx += 1
+        for f in self._by_site.get(("socket", self._socket_idx), ()):
+            self.events.append(
+                (self._socket_idx, "socket", f.kind, 0))
+            return f.kind
+        return None
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded retries with exponential backoff + seeded jitter.
+
+    ``max_attempts`` counts launches (1 = no retry).  Backoff for
+    attempt ``a`` (0-based retry index) is
+    ``min(max_delay_s, base_delay_s * 2**a) * (1 + jitter * u)`` with
+    ``u ~ Uniform(-1, 1)`` from a private seeded stream — deterministic
+    per policy instance, so chaos replays sleep identical schedules.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.02
+    max_delay_s: float = 1.0
+    jitter: float = 0.1
+    seed: int = 0
+    _rng: object = field(default=None, repr=False, compare=False)
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {self.max_attempts}")
+        if self.base_delay_s < 0 or self.max_delay_s < 0:
+            raise ValueError("backoff delays must be >= 0")
+        if not (0.0 <= self.jitter <= 1.0):
+            raise ValueError(f"jitter must be in [0, 1], got {self.jitter}")
+        self._rng = np.random.RandomState(int(self.seed))
+
+    @classmethod
+    def resolve(cls, retry):
+        """Engine-kwarg sugar: None | attempts | dict | RetryPolicy."""
+        if retry is None:
+            return cls()
+        if isinstance(retry, cls):
+            return retry
+        if isinstance(retry, bool):
+            raise TypeError("retry= takes None/int/dict/RetryPolicy")
+        if isinstance(retry, int):
+            return cls(max_attempts=retry)
+        if isinstance(retry, dict):
+            return cls(**retry)
+        raise TypeError(
+            f"retry= takes None/int/dict/RetryPolicy, "
+            f"got {type(retry).__name__}")
+
+    def backoff(self, attempt):
+        """Delay (seconds) before retry ``attempt`` (0-based)."""
+        base = min(self.max_delay_s, self.base_delay_s * (2 ** attempt))
+        return base * (1.0 + self.jitter * self._rng.uniform(-1.0, 1.0))
+
+
+class StepWatchdog:
+    """Flags device steps that exceed a wall-clock threshold.
+
+    The engine cannot interrupt a wedged XLA launch, but it CAN report
+    one: every launch's wall time is observed, and launches past
+    ``threshold_s`` are recorded in ``wedged`` (and counted), so an
+    operator (or the chaos bench artifact) sees the stall without the
+    step having to finish inside a profiler window.
+    """
+
+    def __init__(self, threshold_s):
+        if threshold_s <= 0:
+            raise ValueError(
+                f"watchdog threshold must be > 0, got {threshold_s}")
+        self.threshold_s = float(threshold_s)
+        self.wedged = []          # (step_index, kind, elapsed_s)
+        self.num_wedged = 0
+
+    def observe(self, step_index, kind, elapsed_s):
+        if elapsed_s > self.threshold_s:
+            self.num_wedged += 1
+            self.wedged.append((int(step_index), kind, float(elapsed_s)))
+            return True
+        return False

@@ -52,6 +52,7 @@ class Request:
     eos_token_id: int = None
     temperature: float = 0.0
     seed: int = None            # per-request sampling stream (None: engine RNG)
+    deadline: float = None      # absolute clock() deadline (None: no limit)
     arrival_time: float = field(default_factory=time.monotonic)
     output_ids: list = field(default_factory=list)
     num_cached: int = 0         # tokens whose K/V sit in the paged cache
@@ -126,9 +127,48 @@ class Scheduler:
     def has_unfinished(self):
         return bool(self.waiting or self.running)
 
+    def queue_depth(self):
+        """Requests admitted but not yet running (the load-shed gauge)."""
+        return len(self.waiting)
+
     def remove_running(self, request):
         self.running.remove(request)
         self.block_manager.free(request.request_id)
+
+    def abort(self, request):
+        """Remove ``request`` from whichever queue holds it, reclaiming
+        pages refcount-correctly in every state: waiting (no pages),
+        preempted (re-queued at the waiting head, pages already freed),
+        chunk-prefilling or decoding (running: the block table is freed,
+        shared/COW pages drop one reference, and prefix-cache
+        registrations survive on the LRU list).  Pending draft tokens
+        are dropped.  Returns True when the request was queued here."""
+        request.draft_tokens = []
+        if request in self.running:
+            self.running.remove(request)
+            self.block_manager.free(request.request_id)
+            return True
+        if request in self.waiting:
+            self.waiting.remove(request)
+            if self.block_manager.has_seq(request.request_id):
+                # defensive: waiting sequences own no pages (preemption
+                # frees them), but never leak if that ever changes
+                self.block_manager.free(request.request_id)
+            return True
+        return False
+
+    def expire_deadlines(self, now):
+        """Pop every request whose ``deadline`` has passed (waiting OR
+        running — a deadline miss mid-generation still frees its pages).
+        Returns the expired requests; the engine assigns the
+        FinishReason and emits their outputs."""
+        expired = [r for r in self.waiting
+                   if r.deadline is not None and now >= r.deadline]
+        expired += [r for r in self.running
+                    if r.deadline is not None and now >= r.deadline]
+        for req in expired:
+            self.abort(req)
+        return expired
 
     # ------------------------------------------------------------ policy --
     def schedule(self):
@@ -172,9 +212,13 @@ class Scheduler:
                         drafts = []   # degrade to plain decode first
                 if not drafts:
                     bm.append_slot(req.request_id)
-            except NoFreeBlocksError:
+            except NoFreeBlocksError as e:
                 victim = self.running[-1]
-                if victim is req and len(self.running) == 1:
+                if victim is req and len(self.running) == 1 and \
+                        not getattr(e, "injected", False):
+                    # a REAL pool too small for one sequence can never
+                    # make progress; an injected OOM fires once per
+                    # step, so self-preempt + recompute recovers
                     raise RuntimeError(
                         "KV cache cannot hold a single sequence — "
                         "raise num_blocks or lower max_model_len")
@@ -215,7 +259,14 @@ class Scheduler:
                                    cached_hashes=hashes[:k]):
                 break
             self.waiting.pop(0)
-            bm.allocate(req.request_id, n, cached_hashes=hashes[:k])
+            try:
+                bm.allocate(req.request_id, n, cached_hashes=hashes[:k])
+            except NoFreeBlocksError:
+                # can_allocate said yes but allocate refused (an
+                # injected fault, or pressure from a racing path):
+                # re-queue at the head and stop admitting this step
+                self.waiting.insert(0, req)
+                break
             req.num_cached = k * bm.block_size
             req.num_prefill_tokens = n
             req.status = RUNNING

@@ -40,6 +40,29 @@ target distribution at every position):
 from dataclasses import dataclass
 
 
+def rollback_draft_reservation(block_manager, request):
+    """Return every speculative slot reserved for ``request`` that has
+    not been committed: the scheduler claims ``1 + K`` slots up front
+    (append_slots) for a verify launch, so an abort or a quarantined
+    step between reservation and commit must shrink the reservation
+    back to ``num_cached`` before the pages are counted or freed —
+    otherwise the books show phantom tokens on a request that never
+    emitted them.  Drops the pending draft list too.  No-op for a
+    request with no outstanding reservation (plain decode rows roll
+    back their single slot through the same arithmetic)."""
+    request.draft_tokens = []
+    if not block_manager.has_seq(request.request_id) \
+            or not request.prefill_done:
+        # mid-prefill rows hold their PROMPT allocation, not a
+        # speculative reservation — nothing to roll back
+        return 0
+    extra = block_manager.num_tokens(request.request_id) \
+        - request.num_cached
+    if extra > 0:
+        block_manager.rollback_slots(request.request_id, extra)
+    return max(extra, 0)
+
+
 @dataclass
 class SpeculativeConfig:
     """Knobs for n-gram speculative decoding.

@@ -114,6 +114,7 @@ class _GenerativeAdapter:
         max_new = self._scalar(inputs, 1, int, self._DEFAULT_MAX_NEW)
         temperature = self._scalar(inputs, 2, float, 0.0)
         seed = self._scalar(inputs, 3, int, None)
+        deadline_ms = self._scalar(inputs, 4, float, None)
         # validate BEFORE submitting: a bad knob must come back as a
         # clear wire error, not an odd empty generation (the engine
         # re-checks, but by then the request would be half-queued)
@@ -123,13 +124,25 @@ class _GenerativeAdapter:
         if temperature < 0.0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
         out = self._async.generate(ids.reshape(-1),
                                    max_new_tokens=max_new,
-                                   temperature=temperature, seed=seed)
+                                   temperature=temperature, seed=seed,
+                                   deadline_ms=deadline_ms)
+        if not out.ok:
+            # a shed/deadline/quarantined request must surface as a wire
+            # ERROR, not as a truncated completion the client can't tell
+            # from a short generation
+            detail = f": {out.error}" if out.error else ""
+            raise RuntimeError(
+                f"request finished with reason "
+                f"{out.finish_reason!r}{detail}")
         return [out.all_ids.astype(np.int64)[None]]
 
     def stop(self):
-        self._async.stop()
+        self._async.close()
 
 
 class PredictorServer:
@@ -151,12 +164,17 @@ class PredictorServer:
     """
 
     def __init__(self, predictor=None, host="127.0.0.1", port=0,
-                 max_bytes=_MAX_TENSOR_BYTES, engine=None):
+                 max_bytes=_MAX_TENSOR_BYTES, engine=None, faults=None):
         if (predictor is None) == (engine is None):
             raise ValueError("pass exactly one of predictor= or engine=")
         self._predictor = (predictor if engine is None
                            else _GenerativeAdapter(engine))
         self._max_bytes = max_bytes
+        # fault injection at the socket layer: a FaultInjector whose
+        # "socket"-site faults make the server drop or truncate a
+        # response, so client-side robustness (reconnect, short-read
+        # detection) is testable deterministically
+        self._faults = faults
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -174,7 +192,13 @@ class PredictorServer:
             except socket.timeout:
                 continue
             except OSError:
-                break
+                # transient accept errors (ECONNABORTED: the peer gave
+                # up during the handshake) must not kill the server —
+                # only a deliberate stop() (which closes the listener)
+                # ends the loop
+                if self._stop.is_set():
+                    break
+                continue
             threading.Thread(target=self._serve, args=(conn,),
                              daemon=True).start()
 
@@ -198,12 +222,15 @@ class PredictorServer:
                             t = _recv_tensor(conn, budget)
                             budget -= t.nbytes
                             inputs.append(t)
-                    except ValueError as e:
-                        # protocol violation: report it, then drop the
-                        # (desynced) connection
+                    except (ValueError, struct.error, OverflowError) as e:
+                        # malformed frame: report it explicitly, then
+                        # drop the (desynced) connection — NEVER let a
+                        # bad client frame propagate past this handler
                         msg = str(e).encode()[:4096]
                         conn.sendall(struct.pack("<BI", 1, len(msg)) + msg)
                         return
+                    if self._inject_socket_fault(conn):
+                        return      # this connection dies; server lives
                     try:
                         outs = self._predictor.run(inputs)
                         conn.sendall(struct.pack("<BI", 0, len(outs)))
@@ -213,7 +240,29 @@ class PredictorServer:
                         msg = str(e).encode()[:4096]
                         conn.sendall(struct.pack("<BI", 1, len(msg)) + msg)
         except (ConnectionError, OSError):
+            # a dead peer (disconnect / short read mid-frame) fails only
+            # THIS connection thread; the accept loop never sees it
             pass
+
+    def _inject_socket_fault(self, conn):
+        """Apply a scheduled socket-site fault to this response.
+        Returns True when the connection was sacrificed."""
+        if self._faults is None:
+            return False
+        kind = self._faults.socket_fault()
+        if kind == "disconnect":
+            conn.close()            # vanish before the response
+            return True
+        if kind == "partial":
+            # half a response header, then gone: the client's framing
+            # layer must detect the short read, not hang
+            try:
+                conn.sendall(struct.pack("<BI", 0, 1)[:3])
+            except OSError:
+                pass
+            conn.close()
+            return True
+        return False
 
     def stop(self):
         self._stop.set()
