@@ -150,6 +150,46 @@ def _repetitive_trace(n_requests, rate, max_new, seed=0):
     return arrivals, prompts, new_tokens
 
 
+def _fleet_trace(n_requests, rate, max_new, seed=0, tenants=4,
+                 prefix_len=16):
+    """Multi-tenant workload for the fleet router: each request is one
+    of ``tenants`` shared tenant prefixes (system prompts, 2 pages at
+    block_size=8) plus a short unique tail, so prefix-affinity routing
+    has real structure to exploit — same-tenant traffic concentrating
+    on one replica turns the shared pages into cache hits instead of
+    recomputes on every replica."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    prefixes = [rng.randint(0, 128, (prefix_len,)).astype(np.int32)
+                for _ in range(tenants)]
+    prompts = [np.concatenate(
+        [prefixes[int(rng.randint(tenants))],
+         rng.randint(0, 128, (int(rng.randint(4, 13)),))
+         .astype(np.int32)]) for _ in range(n_requests)]
+    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
+                  for _ in range(n_requests)]
+    return arrivals, prompts, new_tokens
+
+
+def _build_fleet(replicas, args, max_model_len=64, faults=None):
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.llm import Fleet
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    paddle.seed(args.seed)
+    m = gpt_tiny(num_layers=2, max_position_embeddings=max_model_len)
+    m.eval()
+    # parallel_step threads the per-replica device steps; on a
+    # single-core host the GIL bounds the overlap, so the scaling
+    # column reads near 1x there — the token-exactness and failover
+    # gates are what tier-1 asserts
+    return Fleet(m, replicas=replicas, block_size=8,
+                 max_batch=args.max_batch, max_model_len=max_model_len,
+                 token_budget=args.token_budget, faults=faults,
+                 parallel_step=True)
+
+
 def run(engine, arrivals, prompts, new_tokens, deadline_ms=None,
         faults=None):
     """Replay the trace in real time; returns per-token timing data.
@@ -251,7 +291,7 @@ def run(engine, arrivals, prompts, new_tokens, deadline_ms=None,
         else None,
         "e2e_p95_ms": float(np.percentile(e2es, 95) * 1e3) if e2es
         else None,
-        "preemptions": engine.scheduler.num_preemptions,
+        "preemptions": engine.lifecycle_stats()["preemptions"],
         "prefix_cache": engine.prefix_cache_stats(),
         "spec": engine.spec_stats(),
         "lifecycle": engine.lifecycle_stats(),
@@ -298,6 +338,17 @@ def main():
                          "fault-free baseline replay; reports "
                          "shed/abort/retry/deadline counts and the "
                          "p95 latency deltas the chaos cost")
+    ap.add_argument("--replicas", type=int, default=0, metavar="N",
+                    help="serve a Fleet of N engine replicas behind "
+                         "the prefix-affinity router on a multi-tenant "
+                         "trace; baseline is ONE replica on the same "
+                         "trace (tokens/s scaling), and with --kill-at "
+                         "or --chaos a failover leg replays the trace "
+                         "under replica faults and asserts survivors "
+                         "stay token-exact")
+    ap.add_argument("--kill-at", type=int, default=None, metavar="STEP",
+                    help="(--replicas) kill replica N-1 at this fleet "
+                         "step in the failover leg")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="(--chaos) per-request deadline_ms attached "
                          "to every admission")
@@ -331,6 +382,10 @@ def main():
 
     if args.tp > 1:
         return _main_tp(args, jax)
+    if args.replicas > 0:
+        # --chaos combines with --replicas as the fleet-chaos seed, so
+        # the fleet dispatch must win over the single-engine chaos one
+        return _main_fleet(args, jax)
     if args.spec > 0:
         return _main_spec(args, jax)
     if args.shared_prefix:
@@ -689,6 +744,149 @@ def _main_shared_prefix(args, jax):
     }
     print(json.dumps(row))
     _write_artifact(args, row, ok=True)
+
+
+def _main_fleet(args, jax):
+    """Replay a multi-tenant trace on a Fleet of N replicas and on one
+    replica; assert the fleet is token-exact vs the single engine
+    (routing must never change tokens), that every replica shares ONE
+    executable signature set (per-replica static census — replicated
+    serving must not multiply compiles), and that armed CompileWatchers
+    see zero post-warmup compiles.  With --kill-at / --chaos a failover
+    leg replays the same trace under replica faults: surviving requests
+    must be token-exact vs the fault-free fleet replay and the live
+    replicas must leak zero pages."""
+    import warnings
+
+    from paddle_tpu.framework.cost import run_census
+    from paddle_tpu.inference.llm import Fault, FaultInjector
+
+    max_model_len = max(64, 32 + args.max_new)
+    arrivals, prompts, new_tokens = _fleet_trace(
+        args.requests, args.rate, args.max_new, args.seed)
+    # replication is a THROUGHPUT optimisation: measure the saturated
+    # regime (everything queued at t=0), or a Poisson-paced trace is
+    # arrival-limited and fleet-vs-one measures the trace
+    arrivals = np.zeros_like(arrivals)
+    reps = max(1, args.repeats)
+
+    fleet = _build_fleet(args.replicas, args, max_model_len)
+    _lint_census(args, fleet.replicas[0].engine)
+    # one executable signature set across the fleet, by static census —
+    # the replicas literally share replica 0's jitted callables, and
+    # this asserts the census sees the same grid through each of them
+    sigs = {tuple(sorted(e["label"]
+                         for e in run_census(r.engine).entries))
+            for r in fleet.replicas}
+    executables_shared = (len(sigs) == 1 and len(
+        {id(r.engine._decode) for r in fleet.replicas}) == 1)
+    watcher = fleet.warmup()
+    fleet_runs = [run(fleet, arrivals, prompts, new_tokens)
+                  for _ in range(reps)]
+    res = max(fleet_runs, key=lambda r: r["tokens_per_s"])
+    new_compiles = watcher.new_compiles()
+
+    scaling = None
+    token_exact = True
+    if not args.no_baseline:
+        base = _build_engine(args.max_batch, args.seed,
+                             max_model_len=max_model_len,
+                             token_budget=args.token_budget)
+        base_runs = [run(base, arrivals, prompts, new_tokens)
+                     for _ in range(reps)]
+        base_res = max(base_runs, key=lambda r: r["tokens_per_s"])
+        scaling = res["tokens_per_s"] / base_res["tokens_per_s"]
+        token_exact = all(r["outputs"] == b["outputs"]
+                          for r in fleet_runs for b in base_runs)
+
+    # failover leg: same trace, fresh fleet, seeded replica faults
+    failover = None
+    leaked = 0
+    fail_ok = True
+    if args.kill_at is not None or args.chaos is not None:
+        if args.kill_at is not None:
+            fi = FaultInjector(schedule=[
+                Fault("replica", "kill", step=args.kill_at,
+                      victim=args.replicas - 1)])
+        else:
+            fi = FaultInjector.random_fleet(
+                args.chaos, steps=4096, replicas=args.replicas,
+                p_kill=0.004, p_heartbeat=0.01, p_drain=0.002)
+        chaos_fleet = _build_fleet(args.replicas, args, max_model_len,
+                                   faults=fi)
+        chaos_fleet.warmup()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            fres = run(chaos_fleet, arrivals, prompts, new_tokens)
+        chaos_fleet.check_invariants()
+        leaked = sum(r.engine.num_blocks
+                     - r.engine.block_manager.num_free_blocks
+                     for r in chaos_fleet.replicas if r.live)
+        survivors = [i for i, r in fres["reasons"].items()
+                     if r in ("stop", "length")]
+        surv_exact = all(fres["outputs"][i] == res["outputs"][i]
+                         for i in survivors)
+        fail_ok = surv_exact and leaked == 0
+        ls = fres["lifecycle"]
+        failover = {
+            "fault_events": len(fi.events),
+            "survivors": len(survivors),
+            "survivor_token_exact": surv_exact,
+            "leaked_pages": leaked,
+            "killed": ls["killed"],
+            "drains": ls["drains"],
+            "requeued": ls["requeued"],
+            "shed": ls["shed"],
+            "lost": ls["lost"],
+            "replicas_live": ls["replicas_live"],
+            "e2e_p95_delta_ms": (
+                round(fres["e2e_p95_ms"] - res["e2e_p95_ms"], 2)
+                if fres["e2e_p95_ms"] is not None
+                and res["e2e_p95_ms"] is not None else None),
+        }
+
+    ls = res["lifecycle"]
+    row = {
+        "metric": "llm_serving_fleet",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "replicas": args.replicas,
+        "scaling_vs_1": (round(scaling, 3)
+                         if scaling is not None else None),
+        "token_exact": token_exact,
+        "executables_shared": executables_shared,
+        "new_compiles": len(new_compiles),
+        "routed": ls["routed"],
+        "affinity_hit_rate": round(ls["affinity_hit_rate"], 3),
+        "prefix_hit_rate": round(res["prefix_cache"]["hit_rate"], 3),
+        "requeued": ls["requeued"],
+        "shed": ls["shed"],
+        "failover": failover,
+        "tpot_p50_ms": (round(res["tpot_p50_ms"], 2)
+                        if res["tpot_p50_ms"] is not None else None),
+        "e2e_p50_ms": (round(res["e2e_p50_ms"], 2)
+                       if res["e2e_p50_ms"] is not None else None),
+        "e2e_p95_ms": (round(res["e2e_p95_ms"], 2)
+                       if res["e2e_p95_ms"] is not None else None),
+        "requests": args.requests,
+        "max_batch": args.max_batch,
+        "repeats": reps,
+        "kill_at": args.kill_at,
+        "chaos_seed": args.chaos,
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    ok = (token_exact and fail_ok and executables_shared
+          and not new_compiles)
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "fleet replay violated its contract: "
+            f"token_exact={token_exact} failover_ok={fail_ok} "
+            f"executables_shared={executables_shared} "
+            f"new_compiles={len(new_compiles)}")
 
 
 if __name__ == "__main__":

@@ -28,6 +28,12 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   ``abort_request``/``deadline_ms``/``max_queue``/
                   ``faults=`` for lifecycle hardening)
                   + AsyncLLMEngine for servers
+- fleet:          Fleet — N engine replicas behind a prefix-affinity
+                  Router with heartbeat health checking (HealthConfig
+                  hysteresis), token-exact failover of a dead
+                  replica's requests onto survivors, fleet-level
+                  bounded admission and rolling drain/restart; the
+                  replicas share one compiled executable set
 
 See docs/LLM_SERVING.md for design notes and a quickstart.
 """
@@ -39,6 +45,7 @@ from .block_manager import (  # noqa: F401
     prefix_block_hashes,
 )
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
+from .fleet import Fleet, HealthConfig, Replica, Router  # noqa: F401
 from .faults import (  # noqa: F401
     Fault,
     FaultInjector,
@@ -72,6 +79,7 @@ __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
            "NgramDrafter", "SpeculativeConfig", "rollback_draft_reservation",
+           "Fleet", "HealthConfig", "Replica", "Router",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",
            "PoolLostError", "RetryPolicy", "StepWatchdog",
            "paged_decode_attention", "paged_decode_attention_xla",

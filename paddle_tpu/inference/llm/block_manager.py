@@ -156,6 +156,17 @@ class BlockManager:
             raise RuntimeError("hash maps differ in size")
 
     # ------------------------------------------------------- prefix cache --
+    def prefix_chain_hashes(self, token_ids, limit=None):
+        """Chain hashes of ``token_ids``'s full pages at THIS pool's
+        page size — the public spelling of the content-hash scheme the
+        cache registers pages under.  The fleet router keys prefix
+        affinity on these, so router keys and cache registrations hash
+        identically by construction (one authority, one page size);
+        ``limit`` caps the number of pages hashed, mirroring the
+        scheduler's admission cap of ``(n - 1) // block_size``."""
+        return prefix_block_hashes(token_ids, self.block_size,
+                                   limit=limit)
+
     def match_prefix(self, hashes):
         """Length of the longest leading run of ``hashes`` whose pages
         are still resident (referenced or LRU-parked)."""

@@ -75,7 +75,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ... import profiler
 from ...framework import jax_compat  # noqa: F401  (aliases jax.shard_map)
 from ...incubate.nn import _layernorm
-from .block_manager import BlockManager, prefix_block_hashes
+from .block_manager import BlockManager
 from .faults import (
     FinishReason,
     InjectedFault,
@@ -220,6 +220,7 @@ class LLMEngine:
         self._early = []         # outputs finished without a device step
         self._draining = False
         self._step_index = -1
+        self._last_step_ms = None   # wall ms of the latest step() (gauge)
         # deterministic lifecycle event log: (step, kind, *detail)
         # tuples with no wall-times, so two replays of the same fault
         # seed produce IDENTICAL logs (the chaos determinism contract)
@@ -717,7 +718,14 @@ class LLMEngine:
         return outs
 
     def lifecycle_stats(self):
-        """Failure-path counters (chaos bench artifact rows)."""
+        """Failure-path counters (chaos bench artifact rows) plus the
+        live gauges a fleet health checker polls between steps:
+        ``queue_depth`` (admitted, not yet running), ``inflight``
+        (running set size), ``free_pages`` (allocatable right now,
+        LRU-parked cached pages included), and ``last_step_ms`` (wall
+        time of the most recent step(); None before the first step —
+        the one wall-clock value here, and it never enters ``events``,
+        so seed replays still produce identical logs)."""
         s = self.stats
         return {"aborted": s["aborted"],
                 "deadline_missed": s["deadline_missed"],
@@ -726,7 +734,11 @@ class LLMEngine:
                 "step_faults": s["step_faults"],
                 "preemptions": self.scheduler.num_preemptions,
                 "wedged_steps": (self.watchdog.num_wedged
-                                 if self.watchdog else 0)}
+                                 if self.watchdog else 0),
+                "queue_depth": self.scheduler.queue_depth(),
+                "inflight": len(self.scheduler.running),
+                "free_pages": self.block_manager.num_free_blocks,
+                "last_step_ms": self._last_step_ms}
 
     def _bucket_grid(self):
         """The complete executable family: every (kind, bucket) pair
@@ -854,6 +866,16 @@ class LLMEngine:
         by this step (possibly empty) — including requests that exited
         through a failure path (aborted / deadline / shed / error)
         since the previous step."""
+        t0 = time.perf_counter()
+        try:
+            return self._step_impl()
+        finally:
+            # the last_step_ms health gauge: wall time of the whole
+            # iteration (schedule + launches + commit), kept OUT of the
+            # deterministic event log
+            self._last_step_ms = (time.perf_counter() - t0) * 1e3
+
+    def _step_impl(self):
         self._step_index += 1
         if self.faults is not None:
             self.faults.begin_step(self._step_index)
@@ -996,9 +1018,8 @@ class LLMEngine:
         bm = self.block_manager
         if not bm.enable_prefix_caching:
             return
-        hashes = prefix_block_hashes(
-            req.all_ids, self.block_size,
-            limit=req.num_cached // self.block_size)
+        hashes = bm.prefix_chain_hashes(
+            req.all_ids, limit=req.num_cached // self.block_size)
         for i, h in enumerate(hashes):
             bm.register_full_block(req.request_id, i, h)
 
@@ -1266,7 +1287,10 @@ class AsyncLLMEngine:
     thread applies between device calls (engine state stays
     single-threaded); ``result(timeout=)`` expiring ABORTS the request
     — a caller that gave up must not leave its request generating (and
-    holding pages) forever.  ``close()`` aborts everything still in
+    holding pages) forever.  ``drain(timeout_s=)`` quiesces without
+    stopping: in-flight work completes, racing submits shed (their
+    callers still get a per-request FinishReason), and admission
+    reopens afterwards.  ``close()`` aborts everything still in
     flight, reclaims the pages, joins the worker, and raises if the
     thread survives — a close that silently leaks a live stepping
     thread is how a "drained" replica keeps touching the device.
@@ -1278,6 +1302,7 @@ class AsyncLLMEngine:
         self._results = {}          # request_id -> RequestOutput
         self._aborts = set()        # rids to cancel, applied by the loop
         self._abandoned = set()     # rids whose caller gave up (timeout)
+        self._draining = False
         self._stopped = False
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
@@ -1360,6 +1385,48 @@ class AsyncLLMEngine:
     def generate(self, prompt_ids, timeout=None, **kwargs):
         return self.result(self.submit(prompt_ids, **kwargs),
                            timeout=timeout)
+
+    def drain(self, timeout_s=None):
+        """Graceful quiesce WITHOUT stopping the worker: admission is
+        closed (the engine sheds, so a submit racing the drain still
+        gets a terminal output — its ``result()`` returns
+        FinishReason.shed; nothing is silently dropped), every
+        in-flight request runs to completion, and admission reopens on
+        return.  ``timeout_s`` bounds the wait: requests still running
+        when it expires are aborted (their callers see
+        FinishReason.aborted), so drain() always terminates with zero
+        pages leaked.  Safe to call from any thread; the stepping
+        thread keeps publishing results throughout."""
+        with self._cond:
+            if self._stopped:
+                raise RuntimeError("engine stopped")
+            self._draining = True
+            # the engine-level flag makes add_request shed: a submit
+            # that loses the race still finishes with a FinishReason
+            # (shed) instead of queueing into a closing engine
+            self.engine._draining = True
+            self._cond.notify_all()
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + float(timeout_s))
+        try:
+            with self._cond:
+                while not self._stopped:
+                    if not self._aborts and \
+                            not self.engine.has_unfinished():
+                        break
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        deadline = None     # abort once, then wait
+                        for rid in list(getattr(self.engine,
+                                                "_requests", ())):
+                            self._aborts.add(rid)
+                        self._cond.notify_all()
+                        continue
+                    self._cond.wait(timeout=0.02)
+        finally:
+            with self._cond:
+                self.engine._draining = False
+                self._draining = False
 
     def close(self, join_timeout=5.0):
         """Stop the worker: pending requests are aborted (pages

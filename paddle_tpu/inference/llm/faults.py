@@ -82,7 +82,9 @@ class Fault:
     site:   "step" (device-step boundary), "alloc" (page allocator),
             "socket" (PredictorServer response path), "client"
             (driver-level: abort a request — consumed by chaos
-            drivers, not the engine).
+            drivers, not the engine), "replica" (fleet-level:
+            consumed by inference.llm.fleet.Fleet at its step
+            boundary, never by a single engine).
     kind:   step:   "raise" (fails every attempt -> quarantine),
                     "transient" (fails ``count`` attempts, then
                     succeeds -> absorbed by RetryPolicy),
@@ -92,14 +94,21 @@ class Fault:
             socket: "disconnect" (drop the connection before the
                     response), "partial" (write half a frame, then
                     drop);
-            client: "abort".
-    step:   engine step index ("step"/"alloc"/"client" sites) or
-            response index ("socket" site) the fault fires at.
+            client: "abort";
+            replica: "kill" (the victim replica dies; its requests
+                    fail over), "heartbeat" (the victim misses this
+                    fleet step's heartbeat — a DATA signal, no real
+                    sleep, so replays stay wall-clock-free),
+                    "drain" (rolling drain of the victim begins).
+    step:   engine step index ("step"/"alloc"/"client" sites), fleet
+            step index ("replica" site), or response index ("socket"
+            site) the fault fires at.
     count:  "transient" only — how many attempts fail before success.
     delay_s: "delay" only — injected stall length.
-    victim: "raise" only — index into the launch's request rows; the
-            quarantined request is ``reqs[victim % len(reqs)]``.  None
-            quarantines every row of the failing launch.
+    victim: "raise" — index into the launch's request rows; the
+            quarantined request is ``reqs[victim % len(reqs)]``; None
+            quarantines every row of the failing launch.  "replica"
+            site — the replica index (mod fleet size).
     """
 
     site: str
@@ -126,6 +135,12 @@ class FaultInjector:
 
         fi = FaultInjector.random(seed=7, steps=200, p_step=0.02)
 
+    or a fleet-chaos one ("replica"-site kills / heartbeat misses /
+    rolling drains, consumed by inference.llm.fleet.Fleet)::
+
+        fi = FaultInjector.random_fleet(seed=7, steps=256, replicas=3,
+                                        p_kill=0.02, p_heartbeat=0.05)
+
     The schedule is plain data; ``events`` records every fault that
     actually fired as ``(step, site, kind, attempt)`` tuples, so two
     runs from the same seed produce identical event logs.
@@ -135,8 +150,14 @@ class FaultInjector:
         self.seed = int(seed)
         self.schedule = list(schedule)
         for f in self.schedule:
-            if f.site not in ("step", "alloc", "socket", "client"):
+            if f.site not in ("step", "alloc", "socket", "client",
+                              "replica"):
                 raise ValueError(f"unknown fault site {f.site!r}")
+            if f.site == "replica" and \
+                    f.kind not in ("kill", "heartbeat", "drain"):
+                raise ValueError(
+                    f"unknown replica fault kind {f.kind!r} "
+                    f"(kill | heartbeat | drain)")
         self.events = []
         self._step = -1          # current engine step index
         self._attempts = {}      # (site, step) -> attempts so far
@@ -172,6 +193,41 @@ class FaultInjector:
                 schedule.append(Fault("client", "abort", step=s))
         return cls(schedule=schedule, seed=seed)
 
+    @classmethod
+    def random_fleet(cls, seed, steps=256, *, replicas, p_kill=0.0,
+                     p_heartbeat=0.0, p_drain=0.0, max_kills=None,
+                     max_drains=1):
+        """Materialize a seeded fleet-chaos schedule ("replica"-site
+        faults only): per fleet step, Bernoulli draws for a replica
+        kill, a missed heartbeat, and a rolling drain, each with a
+        uniformly drawn victim.  Victims are drawn unconditionally so
+        the schedule is a pure function of ``seed`` regardless of the
+        caps.  ``max_kills`` defaults to ``replicas - 1`` — a chaos
+        schedule that can kill every replica has no survivors left to
+        assert token-exactness on."""
+        if int(replicas) < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if max_kills is None:
+            max_kills = max(0, int(replicas) - 1)
+        rng = np.random.RandomState(int(seed))
+        schedule = []
+        kills = drains = 0
+        for s in range(int(steps)):
+            draws = rng.uniform(size=3)
+            victims = rng.randint(int(replicas), size=3)
+            if draws[0] < p_kill and kills < max_kills:
+                kills += 1
+                schedule.append(Fault("replica", "kill", step=s,
+                                      victim=int(victims[0])))
+            if draws[1] < p_heartbeat:
+                schedule.append(Fault("replica", "heartbeat", step=s,
+                                      victim=int(victims[1])))
+            if draws[2] < p_drain and drains < max_drains:
+                drains += 1
+                schedule.append(Fault("replica", "drain", step=s,
+                                      victim=int(victims[2])))
+        return cls(schedule=schedule, seed=seed)
+
     # ------------------------------------------------------- engine hooks --
     def begin_step(self, step_index):
         """Engine calls this at the top of every step()."""
@@ -203,6 +259,22 @@ class FaultInjector:
             raise InjectedFault(
                 f"injected {f.kind} fault at step {self._step} "
                 f"({kind} launch, attempt {attempt})", victim=f.victim)
+
+    def replica_faults(self, step=None):
+        """Fleet hook: the "replica"-site faults due at ``step``
+        (default: the current one), each consumed — and recorded in
+        ``events`` as ``(step, "replica", kind, victim)`` — exactly
+        once, so a drained schedule replays to an identical log."""
+        s = self._step if step is None else int(step)
+        fired = []
+        for f in self._by_site.get(("replica", s), ()):
+            key = ("replica", s, f.kind, f.victim)
+            if self._attempts.get(key):
+                continue
+            self._attempts[key] = 1
+            self.events.append((s, "replica", f.kind, f.victim))
+            fired.append(f)
+        return fired
 
     def alloc(self, what):
         """Consulted by the page allocator's entry points.  Returns

@@ -1,0 +1,754 @@
+# noqa-module: H001 (fleet orchestration is host-side by design — the
+# router, health checker and failover logic run between engine steps;
+# nothing here runs under jit)
+"""Fleet — health-checked replica router with token-exact failover.
+
+One LLMEngine serves one chip group; a *fleet* is N of them behind a
+router, and it is only worth running if it survives a replica dying
+mid-decode.  Everything here builds on two invariants the single-engine
+stack already proved:
+
+- **Exactness**: a request's output is fully determined by (prompt,
+  seed, sampling params) — greedy and per-request-seeded streams are
+  batch-order independent — so replaying a dead replica's requests from
+  scratch on a survivor reproduces the SAME tokens.  Failover is a
+  bitwise guarantee, not best-effort.
+- **Determinism**: engine event logs are wall-clock-free and fault
+  schedules are materialized data (faults.py), so a seeded fleet-chaos
+  run (kill replica k at step s, miss heartbeats, partial drains)
+  replays to an identical fleet event log.
+
+Design:
+
+- **Replicas share one executable signature set.**  Every replica is
+  its own LLMEngine — own scheduler, own BlockManager, own K/V pools —
+  but replicas 1..N-1 adopt replica 0's jitted chunk/decode/verify
+  callables (the closures capture only static config, the params and
+  pools are call arguments), so N replicas compile exactly once and a
+  single armed CompileWatcher covers the whole fleet.
+- **Prefix-cache affinity routing** (Router): a prompt's affinity keys
+  are its page-aligned prefix-chain hashes from
+  ``BlockManager.prefix_chain_hashes`` — the SAME hashes the cache
+  registers pages under, capped at ``(n-1)//block_size`` exactly like
+  scheduler admission.  Routing scores each candidate by the longest
+  leading run of keys it has warm (a shadow set of dispatched hashes,
+  floored by the live ``match_prefix`` residency), routes to the
+  highest score, and falls back least-loaded (queue depth + running
+  set) with lowest-index tie-breaks — fully deterministic.
+- **Health checking** (three states + hysteresis): every fleet step
+  each live replica emits a heartbeat derived from data the engine
+  already exposes — ``lifecycle_stats()`` gauges, StepWatchdog wedge
+  counts, injected "heartbeat" faults — and a replica transitions
+  healthy -> degraded after ``degraded_after`` consecutive misses,
+  degraded -> dead after ``dead_after``, degraded -> healthy after
+  ``recover_after`` consecutive beats.  One slow step never flaps a
+  replica out of rotation.  A replica whose step() RAISES
+  (PoolLostError, an unabsorbed injected fault) is dead immediately.
+- **Token-exact failover**: a dead replica's in-flight and queued
+  requests are requeued (original prompt + kwargs, same request id)
+  onto survivors and replayed from scratch; the dead engine is never
+  touched again (process-death semantics).  Outputs are forwarded only
+  while the emitting replica still owns the request, so stale outputs
+  from a rerouted request are swallowed, and the fleet-level request
+  id IS the replica-level id (no mapping to corrupt).
+- **Bounded admission + rolling drain**: ``max_queue`` sheds at the
+  fleet level when capacity drops (FinishReason.shed, immediately);
+  ``drain_replica(i)`` reroutes the victim's waiting requests, lets
+  its running ones finish in place, and parks it ``drained`` for a
+  zero-downtime ``restart_replica(i)`` (a dead replica restarts with a
+  fresh engine that adopts the shared executables — zero compiles).
+
+``parallel_step=True`` steps live replicas in one thread each (real
+overlap on multi-core hosts; on a single core the GIL serializes the
+host side and the gain is bounded by XLA's internal threading).
+Results are COLLECTED in replica-index order either way, so the fleet
+event log is identical in both modes.
+"""
+
+import threading
+import time
+import warnings
+from dataclasses import dataclass
+
+import numpy as np
+
+from .engine import LLMEngine, RequestOutput
+from .faults import FinishReason
+
+# replica lifecycle states (three-state health machine + drain states)
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DRAINING = "draining"
+DRAINED = "drained"
+DEAD = "dead"
+
+
+@dataclass
+class HealthConfig:
+    """Hysteresis thresholds for the replica health state machine.
+
+    ``degraded_after`` consecutive missed heartbeats demote healthy ->
+    degraded (no new routing; in-flight work continues);
+    ``dead_after`` consecutive misses kill a degraded replica
+    (failover); ``recover_after`` consecutive good beats promote
+    degraded -> healthy.  ``slow_step_ms`` (optional) additionally
+    counts a step slower than the threshold as a miss — a WALL-CLOCK
+    signal, so leave it None (default) when replaying seeded chaos
+    schedules that must produce identical event logs."""
+
+    degraded_after: int = 2
+    dead_after: int = 4
+    recover_after: int = 2
+    slow_step_ms: float = None
+
+    def __post_init__(self):
+        if not (1 <= self.degraded_after < self.dead_after):
+            raise ValueError(
+                f"need 1 <= degraded_after < dead_after, got "
+                f"{self.degraded_after} / {self.dead_after}")
+        if self.recover_after < 1:
+            raise ValueError(
+                f"recover_after must be >= 1, got {self.recover_after}")
+        if self.slow_step_ms is not None and self.slow_step_ms <= 0:
+            raise ValueError(
+                f"slow_step_ms must be > 0, got {self.slow_step_ms}")
+
+    @classmethod
+    def resolve(cls, health):
+        """Fleet-kwarg sugar: None | dict | HealthConfig."""
+        if health is None:
+            return cls()
+        if isinstance(health, cls):
+            return health
+        if isinstance(health, dict):
+            return cls(**health)
+        raise TypeError(
+            f"health= takes None/dict/HealthConfig, "
+            f"got {type(health).__name__}")
+
+
+class Replica:
+    """One engine plus its fleet-side health and affinity state."""
+
+    def __init__(self, index, engine):
+        self.index = index
+        self.engine = engine
+        self.state = HEALTHY
+        self.miss_streak = 0
+        self.ok_streak = 0
+        # shadow set of prefix-chain hashes dispatched to this replica:
+        # routing must see pages that are still PREFILLING (the live
+        # cache only knows completed pages), at the cost of counting
+        # pages the cache may since have evicted — affinity is a
+        # placement heuristic, correctness never depends on it
+        self.warm_hashes = set()
+        self._last_wedged = 0
+
+    @property
+    def routable(self):
+        return self.state in (HEALTHY, DEGRADED)
+
+    @property
+    def live(self):
+        """Still stepped by the fleet (draining replicas finish their
+        in-place work; drained/dead ones are never stepped)."""
+        return self.state in (HEALTHY, DEGRADED, DRAINING)
+
+    def load(self):
+        """Logical load for least-loaded routing: admitted-but-waiting
+        plus running.  Pure scheduler state — deterministic."""
+        sch = self.engine.scheduler
+        return sch.queue_depth() + len(sch.running)
+
+
+class Router:
+    """Prefix-affinity placement with deterministic least-loaded
+    fallback (see the module docstring for the policy)."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+        self.routed = 0
+        self.affinity_hits = 0
+
+    def affinity_keys(self, prompt_ids):
+        """The prompt's page-aligned prefix-chain hashes — EXACTLY the
+        hashes scheduler admission matches and the cache registers
+        pages under (one hashing authority: BlockManager), capped at
+        ``(n - 1) // block_size`` like admission (the last token is
+        always recomputed for its logits)."""
+        bm = self.replicas[0].engine.block_manager
+        n = len(prompt_ids)
+        return bm.prefix_chain_hashes(prompt_ids,
+                                      limit=(n - 1) // bm.block_size)
+
+    def score(self, replica, keys):
+        """Warm-page affinity: longest leading run of ``keys`` this
+        replica has seen dispatched, floored by the pages actually
+        resident in its cache right now."""
+        run = 0
+        for h in keys:
+            if h not in replica.warm_hashes:
+                break
+            run += 1
+        return max(run, replica.engine.block_manager.match_prefix(keys))
+
+    def pick(self, keys, pool):
+        """Highest affinity score wins; ties (including the score-0
+        cold case) fall back to least-loaded, then lowest index.
+        Returns (replica, score); pool must be non-empty."""
+        best = best_key = None
+        for r in pool:
+            k = (-self.score(r, keys), r.load(), r.index)
+            if best is None or k < best_key:
+                best, best_key = r, k
+        return best, -best_key[0]
+
+    def record(self, replica, keys, hit):
+        self.routed += 1
+        if hit:
+            self.affinity_hits += 1
+        replica.warm_hashes.update(keys)
+
+    def forget(self, replica):
+        """Drop the replica's affinity state (death / drain / restart
+        — its warm pages are gone or about to be)."""
+        replica.warm_hashes.clear()
+
+    def stats(self):
+        return {"routed": self.routed,
+                "affinity_hits": self.affinity_hits,
+                "affinity_hit_rate": (self.affinity_hits / self.routed
+                                      if self.routed else 0.0)}
+
+
+@dataclass
+class _FleetRequest:
+    """Fleet-side record of one live request: everything needed to
+    replay it from scratch on a survivor, plus current ownership."""
+
+    prompt_ids: tuple
+    kwargs: dict
+    replica: int
+    requeues: int = 0
+
+
+class Fleet:
+    """N LLMEngine replicas behind a health-checked affinity router.
+
+    >>> fleet = Fleet(model, replicas=3, block_size=16, max_batch=8)
+    >>> watcher = fleet.warmup()          # one compile set, N replicas
+    >>> rid = fleet.add_request([5, 6, 7], max_new_tokens=16)
+    >>> while fleet.has_unfinished():
+    ...     for out in fleet.step():
+    ...         print(out.request_id, out.output_ids)
+
+    The engine surface is mirrored (``add_request`` / ``step`` /
+    ``generate`` / ``abort_request`` / ``drain`` / ``has_unfinished`` /
+    ``lifecycle_stats`` / ``prefix_cache_stats`` / ``spec_stats``), so
+    AsyncLLMEngine, PredictorServer (``fleet=``) and the serving bench
+    drive a fleet exactly like a single engine.
+
+    ``faults=`` takes a FaultInjector whose "replica"-site schedule the
+    fleet consumes at each step boundary (kill / heartbeat / drain);
+    ``engine_faults=`` optionally gives each replica its own injector
+    for engine-level chaos.  ``max_queue`` bounds TOTAL waiting depth
+    across routable replicas — past it (or with no routable replica
+    left) requests shed at the fleet gate.  All remaining keyword
+    arguments are forwarded to every replica's LLMEngine.
+    """
+
+    def __init__(self, model, replicas=2, *, health=None, faults=None,
+                 max_queue=None, parallel_step=False, engine_faults=None,
+                 **engine_kwargs):
+        if not isinstance(replicas, (int, np.integer)) or \
+                isinstance(replicas, bool) or replicas < 1:
+            raise ValueError(
+                f"replicas must be a positive int, got {replicas!r}")
+        if max_queue is not None:
+            if not isinstance(max_queue, (int, np.integer)) \
+                    or isinstance(max_queue, bool) or max_queue < 1:
+                raise ValueError(
+                    f"max_queue must be a positive int (total waiting "
+                    f"depth before load-shedding), got {max_queue!r}")
+            max_queue = int(max_queue)
+        if engine_faults is None:
+            engine_faults = [None] * int(replicas)
+        elif len(engine_faults) != int(replicas):
+            raise ValueError(
+                f"engine_faults needs one entry per replica "
+                f"({replicas}), got {len(engine_faults)}")
+        self.health = HealthConfig.resolve(health)
+        self.faults = faults
+        self.max_queue = max_queue
+        self.parallel_step = bool(parallel_step)
+        self._model = model
+        self._engine_kwargs = dict(engine_kwargs)
+        self._engine_faults = list(engine_faults)
+        self._shared_fns = None
+        self.replicas = [Replica(i, self._build_engine(i))
+                         for i in range(int(replicas))]
+        self.router = Router(self.replicas)
+        self._live = {}          # fleet rid -> _FleetRequest
+        self._early = []         # outputs finished without a step
+        self._next_id = 0
+        self._step_index = -1
+        self._draining = False
+        self._hb_missed = set()  # replica indices missing THIS beat
+        # deterministic fleet event log — same contract as the engine's:
+        # (step, kind, *detail) tuples, no wall times, so seed replays
+        # of a chaos schedule compare equal
+        self.events = []
+        self.stats = {"requeued": 0, "killed": 0, "drains": 0,
+                      "restarts": 0, "shed": 0, "lost": 0}
+
+    # ----------------------------------------------------------- replicas --
+    def _build_engine(self, index):
+        """Construct one replica engine.  The first engine's jitted
+        callables become the fleet's shared executable set; later
+        engines (and restarts) adopt them BEFORE any trace, so the
+        fleet compiles each (kind, bucket) exactly once and every
+        replica shares one executable signature set by construction."""
+        eng = LLMEngine(self._model, faults=self._engine_faults[index],
+                        **self._engine_kwargs)
+        if self._shared_fns is None:
+            self._shared_fns = (eng._chunk, eng._decode, eng._verify)
+        else:
+            eng._chunk, eng._decode, eng._verify = self._shared_fns
+        return eng
+
+    def warmup(self):
+        """Warm every replica (replica 0 compiles, the rest replay the
+        warm cache) and return ONE armed CompileWatcher — the replicas
+        share their executables, so a single watcher certifies the
+        whole fleet compiled nothing after warmup."""
+        watcher = None
+        for r in self.replicas:
+            watcher = r.engine.warmup()
+        return watcher
+
+    def replica_states(self):
+        return {r.index: r.state for r in self.replicas}
+
+    def _routable(self, exclude=None):
+        """Routing pool: healthy replicas; if none, degraded ones (a
+        degraded fleet sheds only when it must).  Never includes
+        ``exclude`` or draining/drained/dead replicas."""
+        pool = [r for r in self.replicas
+                if r.state == HEALTHY and r is not exclude]
+        if not pool:
+            pool = [r for r in self.replicas
+                    if r.state == DEGRADED and r is not exclude]
+        return pool
+
+    # ----------------------------------------------------------- requests --
+    def add_request(self, prompt_ids, max_new_tokens=16,
+                    eos_token_id=None, temperature=0.0, request_id=None,
+                    seed=None, deadline_ms=None):
+        """Route one request to a replica (affinity first, least-loaded
+        fallback).  Sheds at the fleet gate — FinishReason.shed, output
+        delivered by the next step() — while draining, when no replica
+        is routable, or past ``max_queue`` total waiting depth."""
+        prompt = tuple(int(t) for t in np.asarray(prompt_ids).reshape(-1))
+        if request_id is None:
+            request_id = self._next_id
+            self._next_id += 1
+        pool = self._routable()
+        depth = sum(r.engine.scheduler.queue_depth() for r in pool)
+        if self._draining or not pool or \
+                (self.max_queue is not None and depth >= self.max_queue):
+            self.stats["shed"] += 1
+            self.events.append((self._step_index, "shed", request_id))
+            self._early.append(RequestOutput(
+                request_id, prompt, [], FinishReason.SHED, 0))
+            return request_id
+        kwargs = dict(max_new_tokens=max_new_tokens,
+                      eos_token_id=eos_token_id, temperature=temperature,
+                      seed=seed, deadline_ms=deadline_ms)
+        keys = self.router.affinity_keys(prompt)
+        target, score = self.router.pick(keys, pool)
+        # the replica-level id IS the fleet-level id: a validation error
+        # propagates from the engine with nothing half-recorded here
+        target.engine.add_request(prompt, request_id=request_id, **kwargs)
+        self.router.record(target, keys, score > 0)
+        self._live[request_id] = _FleetRequest(prompt, kwargs,
+                                               target.index)
+        self.events.append((self._step_index, "route", request_id,
+                            target.index, score))
+        return request_id
+
+    def abort_request(self, request_id):
+        """Cancel a live request wherever it currently runs; the
+        aborted output is forwarded by a following step()."""
+        fr = self._live.get(request_id)
+        if fr is None:
+            return False
+        return self.replicas[fr.replica].engine.abort_request(request_id)
+
+    def has_unfinished(self):
+        return bool(self._early) or bool(self._live)
+
+    # --------------------------------------------------------------- step --
+    def step(self):
+        """One fleet iteration: consume due replica-site faults, step
+        every live replica (threads under ``parallel_step``), forward
+        outputs still owned by their emitting replica, update health
+        beats, and promote emptied draining replicas to drained.
+        Returns the finished RequestOutputs (fleet-shed and failover
+        casualties included)."""
+        self._step_index += 1
+        if self.faults is not None:
+            self.faults.begin_step(self._step_index)
+            for f in self.faults.replica_faults():
+                self._apply_fault(f)
+        finished = self._early
+        self._early = []
+        live = [r for r in self.replicas if r.live]
+        results = self._step_replicas(live)
+        for r in live:
+            status, payload = results[r.index]
+            if status == "err":
+                # a step that RAISES is instant death — PoolLostError
+                # and unabsorbed faults mean this engine cannot serve
+                self._mark_dead(r, tag=type(payload).__name__,
+                                detail=str(payload))
+                continue
+            for fo in payload:
+                fr = self._live.get(fo.request_id)
+                if fr is None or fr.replica != r.index:
+                    continue     # stale output of a rerouted request
+                del self._live[fo.request_id]
+                self.events.append((self._step_index, "finish",
+                                    fo.request_id, fo.finish_reason))
+                finished.append(fo)
+            if r.state in (HEALTHY, DEGRADED):
+                self._beat(r)
+        for r in self.replicas:
+            if r.state == DRAINING and not r.engine.has_unfinished():
+                r.state = DRAINED
+                self.events.append(
+                    (self._step_index, "drained", r.index))
+        self._hb_missed.clear()
+        finished.extend(self._early)
+        self._early = []
+        return finished
+
+    def _step_replicas(self, live):
+        """Step each live replica, catching per-replica failures.
+        Threaded mode overlaps replica steps (each engine's state is
+        touched only by its own thread); results are keyed by replica
+        index and consumed in index order, so both modes produce the
+        same event log."""
+        results = {}
+
+        def one(r):
+            try:
+                results[r.index] = ("ok", r.engine.step())
+            except Exception as e:  # noqa: BLE001 — replica isolation
+                results[r.index] = ("err", e)
+
+        if self.parallel_step and len(live) > 1:
+            threads = [threading.Thread(target=one, args=(r,))
+                       for r in live]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            for r in live:
+                one(r)
+        return results
+
+    # ------------------------------------------------------------- health --
+    def _beat(self, r):
+        """One heartbeat for a routable replica: injected misses and
+        watchdog wedges are data signals (replay-safe); the optional
+        ``slow_step_ms`` wall-clock gauge is opt-in.  Streak counters
+        give the hysteresis — one slow step never flaps."""
+        miss = None
+        if r.index in self._hb_missed:
+            miss = "heartbeat"
+        else:
+            wd = r.engine.watchdog
+            if wd is not None and wd.num_wedged > r._last_wedged:
+                miss = "wedged"
+            elif self.health.slow_step_ms is not None and \
+                    (r.engine._last_step_ms or 0.0) \
+                    > self.health.slow_step_ms:
+                miss = "slow"
+        if r.engine.watchdog is not None:
+            r._last_wedged = r.engine.watchdog.num_wedged
+        if miss is not None:
+            r.miss_streak += 1
+            r.ok_streak = 0
+            if r.state == HEALTHY and \
+                    r.miss_streak >= self.health.degraded_after:
+                r.state = DEGRADED
+                self.events.append((self._step_index, "degraded",
+                                    r.index, miss))
+            elif r.state == DEGRADED and \
+                    r.miss_streak >= self.health.dead_after:
+                self._mark_dead(r, tag=miss)
+        else:
+            r.ok_streak += 1
+            r.miss_streak = 0
+            if r.state == DEGRADED and \
+                    r.ok_streak >= self.health.recover_after:
+                r.state = HEALTHY
+                self.events.append(
+                    (self._step_index, "recovered", r.index))
+
+    def _apply_fault(self, f):
+        idx = (0 if f.victim is None else int(f.victim)) \
+            % len(self.replicas)
+        if f.kind == "kill":
+            r = self.replicas[idx]
+            if r.state != DEAD:
+                self._mark_dead(r, tag="kill")
+        elif f.kind == "drain":
+            self.drain_replica(idx)
+        elif f.kind == "heartbeat":
+            self._hb_missed.add(idx)
+        else:
+            raise ValueError(f"unknown replica fault kind {f.kind!r}")
+
+    # ----------------------------------------------------------- failover --
+    def _mark_dead(self, r, tag, detail=None):
+        """Process-death semantics: the engine is never touched again
+        (its pages die with it), affinity state is dropped, and every
+        request it owned fails over to a survivor."""
+        if r.state == DEAD:
+            return
+        r.state = DEAD
+        self.stats["killed"] += 1
+        self.router.forget(r)
+        self.events.append((self._step_index, "dead", r.index, tag))
+        warnings.warn(
+            f"fleet replica {r.index} died ({tag})"
+            + (f": {detail}" if detail else ""),
+            RuntimeWarning, stacklevel=3)
+        self._failover(r)
+
+    def _failover(self, dead):
+        """Requeue every request the dead replica owned — original
+        prompt, original kwargs (seed included), SAME request id — on
+        the best surviving replica, replayed from scratch.  Exactness
+        of the replay is the engine's batch-order-independence
+        guarantee: greedy and per-request-seeded outputs do not depend
+        on which batch (or replica) computes them.  With no routable
+        survivor the request finishes FinishReason.error."""
+        victims = [rid for rid, fr in self._live.items()
+                   if fr.replica == dead.index]
+        for rid in victims:
+            fr = self._live[rid]
+            pool = self._routable()
+            if not pool:
+                del self._live[rid]
+                self.stats["lost"] += 1
+                self.events.append((self._step_index, "lost", rid))
+                self._early.append(RequestOutput(
+                    rid, fr.prompt_ids, [], FinishReason.ERROR, 0,
+                    error=f"replica {dead.index} died with no "
+                          f"routable survivor"))
+                continue
+            keys = self.router.affinity_keys(fr.prompt_ids)
+            target, score = self.router.pick(keys, pool)
+            target.engine.add_request(fr.prompt_ids, request_id=rid,
+                                      **fr.kwargs)
+            self.router.record(target, keys, score > 0)
+            fr.replica = target.index
+            fr.requeues += 1
+            self.stats["requeued"] += 1
+            self.events.append((self._step_index, "failover", rid,
+                                dead.index, target.index))
+
+    def kill_replica(self, index):
+        """Simulate replica process death (the chaos surface behind
+        "replica"/"kill" faults).  Returns False if already dead."""
+        r = self.replicas[index]
+        if r.state == DEAD:
+            return False
+        self._mark_dead(r, tag="kill")
+        return True
+
+    # -------------------------------------------------------------- drain --
+    def drain_replica(self, index):
+        """Rolling drain for zero-downtime restart: the replica leaves
+        the routing pool, its WAITING requests reroute to peers (their
+        pages were never computed — nothing is lost), its RUNNING ones
+        finish in place, and once empty it parks ``drained``.  With no
+        routable peer the waiting requests stay put and the drain just
+        takes longer — a drain never drops work.  Returns False if the
+        replica is dead or already drained."""
+        r = self.replicas[index]
+        if r.state in (DEAD, DRAINED):
+            return False
+        if r.state == DRAINING:
+            return True
+        r.state = DRAINING
+        self.stats["drains"] += 1
+        self.router.forget(r)
+        self.events.append((self._step_index, "draining", r.index))
+        waiting = [req.request_id
+                   for req in list(r.engine.scheduler.waiting)]
+        for rid in waiting:
+            fr = self._live.get(rid)
+            if fr is None or fr.replica != r.index:
+                continue
+            pool = self._routable(exclude=r)
+            if not pool:
+                break            # no peer: the drain serves them itself
+            # reassign ownership FIRST, then abort the old copy — the
+            # draining replica's aborted output arrives at its next
+            # step and is swallowed by the ownership check
+            keys = self.router.affinity_keys(fr.prompt_ids)
+            target, score = self.router.pick(keys, pool)
+            r.engine.abort_request(rid)
+            target.engine.add_request(fr.prompt_ids, request_id=rid,
+                                      **fr.kwargs)
+            self.router.record(target, keys, score > 0)
+            fr.replica = target.index
+            fr.requeues += 1
+            self.stats["requeued"] += 1
+            self.events.append((self._step_index, "reroute", rid,
+                                r.index, target.index))
+        return True
+
+    def restart_replica(self, index):
+        """Return a drained or dead replica to service.  A drained
+        replica keeps its engine (and its still-warm prefix cache); a
+        dead one gets a fresh engine that adopts the fleet's shared
+        executables — warm compile cache, zero new compiles."""
+        r = self.replicas[index]
+        if r.state not in (DRAINED, DEAD):
+            raise RuntimeError(
+                f"replica {index} is {r.state}; only drained or dead "
+                f"replicas restart")
+        if r.state == DEAD:
+            r.engine = self._build_engine(index)
+            r.engine.warmup()    # replays the warm cache — no compiles
+            self.router.forget(r)
+        r.state = HEALTHY
+        r.miss_streak = r.ok_streak = 0
+        r._last_wedged = 0
+        self.stats["restarts"] += 1
+        self.events.append((self._step_index, "restart", r.index))
+
+    def drain(self, timeout_s=None):
+        """Fleet-wide graceful quiesce (mirrors LLMEngine.drain): new
+        requests shed, every in-flight request runs to completion (or
+        aborts at ``timeout_s``), outputs are returned.  Admission
+        reopens on return."""
+        self._draining = True
+        deadline = (None if timeout_s is None
+                    else time.monotonic() + float(timeout_s))
+        outs = []
+        try:
+            while self.has_unfinished():
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    for rid in list(self._live):
+                        self.abort_request(rid)
+                outs.extend(self.step())
+        finally:
+            self._draining = False
+        return outs
+
+    # ----------------------------------------------------------- generate --
+    def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
+                 temperature=0.0, seed=None, deadline_ms=None):
+        """Batch convenience mirroring LLMEngine.generate: one [T+new]
+        int array per prompt, request order preserved — whatever
+        replica served (or re-served) each request."""
+        if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
+            prompts = list(prompts)
+        elif not isinstance(prompts, (list, tuple)):
+            prompts = [prompts]
+        order = [self.add_request(p, max_new_tokens=max_new_tokens,
+                                  eos_token_id=eos_token_id,
+                                  temperature=temperature, seed=seed,
+                                  deadline_ms=deadline_ms)
+                 for p in prompts]
+        outs = {}
+        while self.has_unfinished():
+            for fo in self.step():
+                outs[fo.request_id] = fo
+        return [outs[rid].all_ids.astype(np.int64) for rid in order]
+
+    # -------------------------------------------------------------- stats --
+    @property
+    def _requests(self):
+        """Live requests as {rid: scheduler.Request} — the bench/driver
+        surface a single engine exposes (rebuilt per call; rids whose
+        owning engine hasn't admitted them yet are simply absent)."""
+        out = {}
+        for rid, fr in self._live.items():
+            req = self.replicas[fr.replica].engine._requests.get(rid)
+            if req is not None:
+                out[rid] = req
+        return out
+
+    def lifecycle_stats(self):
+        """Aggregate lifecycle view: engine counters summed over every
+        replica (dead ones keep their history), live gauges summed over
+        live replicas, ``last_step_ms`` the slowest live replica's, and
+        the fleet-level routing/failover counters on top."""
+        agg = {}
+        slowest = None
+        for r in self.replicas:
+            ls = r.engine.lifecycle_stats()
+            if r.live:
+                ms = ls["last_step_ms"]
+                if ms is not None:
+                    slowest = ms if slowest is None else max(slowest, ms)
+            for k, v in ls.items():
+                if k == "last_step_ms":
+                    continue
+                if k in ("queue_depth", "inflight", "free_pages") \
+                        and not r.live:
+                    continue     # gauges of a dead replica are gone
+                agg[k] = agg.get(k, 0) + v
+        agg["last_step_ms"] = slowest
+        agg["shed"] = agg.get("shed", 0) + self.stats["shed"]
+        agg.update(self.router.stats())
+        agg.update(requeued=self.stats["requeued"],
+                   killed=self.stats["killed"],
+                   drains=self.stats["drains"],
+                   restarts=self.stats["restarts"],
+                   lost=self.stats["lost"],
+                   replicas=len(self.replicas),
+                   replicas_live=sum(1 for r in self.replicas if r.live))
+        return agg
+
+    def prefix_cache_stats(self):
+        keys = ("prompt_tokens", "prefix_hit_tokens", "reused_blocks",
+                "evictions", "cached_blocks")
+        agg = {k: 0 for k in keys}
+        for r in self.replicas:
+            if not r.live:
+                continue
+            pc = r.engine.prefix_cache_stats()
+            for k in keys:
+                agg[k] += pc[k]
+        agg["hit_rate"] = (agg["prefix_hit_tokens"] / agg["prompt_tokens"]
+                           if agg["prompt_tokens"] else 0.0)
+        return agg
+
+    def spec_stats(self):
+        keys = ("spec_steps", "draft_tokens", "accepted_tokens")
+        agg = {k: 0 for k in keys}
+        for r in self.replicas:
+            if not r.live:
+                continue
+            sp = r.engine.spec_stats()
+            for k in keys:
+                agg[k] += sp[k]
+        agg["acceptance_rate"] = (
+            agg["accepted_tokens"] / agg["draft_tokens"]
+            if agg["draft_tokens"] else 0.0)
+        return agg
+
+    def check_invariants(self):
+        """Page books of every live replica must balance."""
+        for r in self.replicas:
+            if r.live:
+                r.engine.scheduler.check_invariants()

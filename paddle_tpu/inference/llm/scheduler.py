@@ -30,7 +30,7 @@ recompiles nothing.
 import time
 from dataclasses import dataclass, field
 
-from .block_manager import NoFreeBlocksError, prefix_block_hashes
+from .block_manager import NoFreeBlocksError
 
 WAITING, RUNNING, FINISHED = "waiting", "running", "finished"
 
@@ -250,9 +250,8 @@ class Scheduler:
             n = len(req.all_ids)
             # at least the last token must be computed (its logits seed
             # the first generated token), so cap reuse at n-1 tokens
-            hashes = prefix_block_hashes(
-                req.all_ids, bm.block_size,
-                limit=(n - 1) // bm.block_size)
+            hashes = bm.prefix_chain_hashes(
+                req.all_ids, limit=(n - 1) // bm.block_size)
             k = bm.match_prefix(hashes)
             margin = self.watermark_blocks if self.running else 0
             if not bm.can_allocate(n, margin=margin,

@@ -95,9 +95,13 @@ class _GenerativeAdapter:
 
     def __init__(self, engine):
         from .llm import AsyncLLMEngine, LLMEngine
+        from .llm.fleet import Fleet
 
+        # a Fleet mirrors the engine surface AsyncLLMEngine drives, so
+        # replicated serving needs no adapter of its own
         self._async = (AsyncLLMEngine(engine)
-                       if isinstance(engine, LLMEngine) else engine)
+                       if isinstance(engine, (LLMEngine, Fleet))
+                       else engine)
 
     @staticmethod
     def _scalar(inputs, i, cast, default):
@@ -156,6 +160,9 @@ class PredictorServer:
     ``engine=LLMEngine(model)`` instead of a predictor: requests carry
     token ids (+ optional max_new_tokens scalar) and concurrent
     connections batch inside the engine (see _GenerativeAdapter).
+    ``fleet=Fleet(model, replicas=N)`` serves N health-checked replicas
+    behind the same socket — affinity routing, failover and drains all
+    happen below the wire protocol, invisible to clients.
 
     Trust boundary: the protocol is unauthenticated (reference C API is an
     in-process library), so the listener defaults to loopback.  Pass
@@ -164,11 +171,16 @@ class PredictorServer:
     """
 
     def __init__(self, predictor=None, host="127.0.0.1", port=0,
-                 max_bytes=_MAX_TENSOR_BYTES, engine=None, faults=None):
-        if (predictor is None) == (engine is None):
-            raise ValueError("pass exactly one of predictor= or engine=")
-        self._predictor = (predictor if engine is None
-                           else _GenerativeAdapter(engine))
+                 max_bytes=_MAX_TENSOR_BYTES, engine=None, faults=None,
+                 fleet=None):
+        backends = [b for b in (predictor, engine, fleet)
+                    if b is not None]
+        if len(backends) != 1:
+            raise ValueError(
+                "pass exactly one of predictor=, engine= or fleet=")
+        self._predictor = (predictor if predictor is not None
+                           else _GenerativeAdapter(engine if engine
+                                                   is not None else fleet))
         self._max_bytes = max_bytes
         # fault injection at the socket layer: a FaultInjector whose
         # "socket"-site faults make the server drop or truncate a

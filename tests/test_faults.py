@@ -643,6 +643,61 @@ class TestEventLogDeterminism:
 
 
 # ---------------------------------------------------------------------------
+class TestLifecycleGauges:
+    def test_gauges_track_a_scripted_workload_exactly(self):
+        """queue_depth / inflight / free_pages / last_step_ms follow a
+        hand-scripted workload value for value: depth counts admissions
+        not yet running, inflight the running set, free_pages the
+        allocatable pool (LRU-parked cached pages included), and
+        last_step_ms is None until the first step ever runs."""
+        m = _make_model()
+        eng = _tiny_engine(m, max_batch=2, token_budget=16)
+        total = eng.num_blocks
+
+        def gauges():
+            ls = eng.lifecycle_stats()
+            return (ls["queue_depth"], ls["inflight"],
+                    ls["free_pages"], ls["last_step_ms"])
+
+        assert gauges() == (0, 0, total, None)
+        # three short requests (each fits one page for its whole
+        # lifetime: prompt + 3 generated <= 8) against max_batch=2
+        for toks, n in (([1] * 4, 3), ([2] * 5, 3), ([3] * 3, 3)):
+            eng.add_request(toks, max_new_tokens=n)
+        assert gauges() == (3, 0, total, None)   # queued, nothing ran
+        eng.step()      # admits exactly max_batch=2; third one waits
+        q, infl, free, ms = gauges()
+        assert (q, infl, free) == (1, 2, total - 2)
+        assert isinstance(ms, float) and ms > 0.0
+        eng.step()      # decode step: occupancy unchanged
+        assert gauges()[:3] == (1, 2, total - 2)
+        while eng.has_unfinished():
+            eng.step()
+        q, infl, free, ms = gauges()
+        assert (q, infl, free) == (0, 0, total)  # every page returned
+        assert isinstance(ms, float) and ms > 0.0
+
+    def test_fleet_gauges_aggregate_live_replicas_only(self):
+        from paddle_tpu.inference.llm import Fleet
+
+        m = _make_model()
+        fleet = Fleet(m, replicas=2, block_size=8, max_batch=2,
+                      max_model_len=64, token_budget=16)
+        total = fleet.replicas[0].engine.num_blocks
+        ls = fleet.lifecycle_stats()
+        assert ls["free_pages"] == 2 * total
+        assert ls["last_step_ms"] is None
+        assert ls["replicas_live"] == 2
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            fleet.kill_replica(1)
+        ls = fleet.lifecycle_stats()
+        # the dead replica's pages are gone from the aggregate view
+        assert ls["free_pages"] == total
+        assert ls["replicas_live"] == 1
+
+
+# ---------------------------------------------------------------------------
 class _WedgedStubEngine:
     """step() blocks until released — probes close()'s join timeout."""
 
@@ -718,6 +773,54 @@ class TestAsyncLifecycle:
             assert out.finish_reason in ("aborted", "length")
         with pytest.raises(RuntimeError, match="stopped"):
             a.submit([9, 9])
+
+    def test_submit_racing_drain_gets_terminal_result(self):
+        """Regression: a submit that loses the race against drain()
+        must still produce a per-request FinishReason (shed) — never a
+        silent drop — and admission must reopen once the drain ends."""
+        from paddle_tpu.inference.llm import AsyncLLMEngine, FinishReason
+
+        eng = _tiny_engine(_make_model())
+        a = AsyncLLMEngine(eng)
+        try:
+            r1 = a.submit([1, 2, 3], max_new_tokens=40)
+            t = threading.Thread(target=a.drain)
+            t.start()
+            deadline = time.monotonic() + 30
+            while not a._draining and time.monotonic() < deadline:
+                time.sleep(0.001)
+            assert a._draining
+            # r1 (40 tokens) holds the drain open; this submit races it
+            r2 = a.submit([4, 5, 6], max_new_tokens=4)
+            out2 = a.result(r2, timeout=120)
+            assert out2.finish_reason == FinishReason.SHED
+            out1 = a.result(r1, timeout=120)     # in-flight work finishes
+            assert out1.ok
+            t.join(timeout=120)
+            assert not t.is_alive()
+            out3 = a.generate([7, 8, 9], max_new_tokens=3, timeout=120)
+            assert out3.ok                       # admission reopened
+        finally:
+            a.close(join_timeout=120)
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_drain_timeout_aborts_stragglers_async(self):
+        """drain(timeout_s=) bounds the quiesce: a request still
+        running at the deadline is aborted with a reported reason, and
+        the engine comes back empty with its pages reclaimed."""
+        from paddle_tpu.inference.llm import AsyncLLMEngine
+
+        eng = _tiny_engine(_make_model())
+        a = AsyncLLMEngine(eng)
+        try:
+            rid = a.submit([1, 2, 3], max_new_tokens=50)
+            a.drain(timeout_s=0.01)
+            out = a.result(rid, timeout=120)
+            assert out.finish_reason in ("aborted", "length")
+            assert not a._draining
+        finally:
+            a.close(join_timeout=120)
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
 
     def test_close_raises_when_worker_wedges(self):
         from paddle_tpu.inference.llm import AsyncLLMEngine
