@@ -43,6 +43,18 @@ on a CPU host that regime is small batch (``--max-batch 1`` is the
 single-stream latency case speculative decoding exists for; at large
 batch the XLA-CPU step cost grows with rows and the win shrinks).
 
+``--replicas N --disaggregate`` serves the fleet SPLIT into
+prefill-role and decode-role replicas: every request prefills on a
+prefill replica and hands off at the prefill→decode boundary by
+migrating its KV pages (host-staged gather/scatter, token-exact, zero
+new compiles) to a decode replica.  The row gates on token-exactness
+vs a single engine, zero leaked pages on EVERY pool, shared
+executables and zero post-warmup compiles, and reports migrated
+sequences/bytes plus handoff-latency p50/p95.  ``--migrate-chaos
+SEED`` additionally injects a seeded migration-fault schedule (fail
+mid-export / mid-import / delay) — handoffs that fault fall back and
+retry, and the exactness + leak gates must STILL hold.
+
 Prints ONE JSON line (bench.py convention).  ``--artifact PATH``
 additionally writes the row as a JSON artifact in every mode
 (MULTICHIP-style under --tp).
@@ -55,6 +67,8 @@ Usage: python benchmarks/bench_serving.py [--requests 32 --rate 256
         [--artifact MULTICHIP_serving.json]
        python benchmarks/bench_serving.py --spec 4 --max-batch 1
         [--requests 16 --max-new 48 --artifact BENCH_spec.json]
+       python benchmarks/bench_serving.py --replicas 2 --disaggregate
+        [--migrate-chaos 7 --artifact BENCH_disagg.json]
 """
 
 import argparse
@@ -172,7 +186,8 @@ def _fleet_trace(n_requests, rate, max_new, seed=0, tenants=4,
     return arrivals, prompts, new_tokens
 
 
-def _build_fleet(replicas, args, max_model_len=64, faults=None):
+def _build_fleet(replicas, args, max_model_len=64, faults=None,
+                 disaggregate=False):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import Fleet
     from paddle_tpu.models.gpt import gpt_tiny
@@ -187,7 +202,7 @@ def _build_fleet(replicas, args, max_model_len=64, faults=None):
     return Fleet(m, replicas=replicas, block_size=8,
                  max_batch=args.max_batch, max_model_len=max_model_len,
                  token_budget=args.token_budget, faults=faults,
-                 parallel_step=True)
+                 disaggregate=disaggregate, parallel_step=True)
 
 
 def run(engine, arrivals, prompts, new_tokens, deadline_ms=None,
@@ -349,6 +364,19 @@ def main():
     ap.add_argument("--kill-at", type=int, default=None, metavar="STEP",
                     help="(--replicas) kill replica N-1 at this fleet "
                          "step in the failover leg")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="(--replicas) split the fleet into prefill-"
+                         "role and decode-role replicas; every request "
+                         "hands off at the prefill→decode boundary by "
+                         "migrating its KV pages, gated token-exact "
+                         "with zero leaks and zero new compiles")
+    ap.add_argument("--migrate-chaos", type=int, default=None,
+                    metavar="SEED",
+                    help="(--disaggregate) seeded migration-fault "
+                         "schedule (fail mid-export / mid-import / "
+                         "delay) injected into the handoff path; the "
+                         "token-exact and zero-leak gates must still "
+                         "hold")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="(--chaos) per-request deadline_ms attached "
                          "to every admission")
@@ -385,6 +413,8 @@ def main():
     if args.replicas > 0:
         # --chaos combines with --replicas as the fleet-chaos seed, so
         # the fleet dispatch must win over the single-engine chaos one
+        if args.disaggregate:
+            return _main_disagg(args, jax)
         return _main_fleet(args, jax)
     if args.spec > 0:
         return _main_spec(args, jax)
@@ -885,6 +915,110 @@ def _main_fleet(args, jax):
         raise SystemExit(
             "fleet replay violated its contract: "
             f"token_exact={token_exact} failover_ok={fail_ok} "
+            f"executables_shared={executables_shared} "
+            f"new_compiles={len(new_compiles)}")
+
+
+def _main_disagg(args, jax):
+    """Replay the multi-tenant trace on a DISAGGREGATED fleet (prefill-
+    role + decode-role replicas; every sequence migrates its KV pages
+    at the prefill→decode boundary) and on one unified engine.  Gates:
+    the disaggregated replay is token-exact (migration must never
+    change a token), EVERY replica's pool ends with zero leaked pages,
+    the replicas share one executable signature set, and an armed
+    CompileWatcher sees zero post-warmup compiles (the migration path
+    is host-staged — nothing on it may trace).  ``--migrate-chaos``
+    injects a seeded migration-fault schedule into the same replay;
+    faulted handoffs fall back (decode in place, retry next step) and
+    every gate must still hold."""
+    from paddle_tpu.framework.cost import run_census
+    from paddle_tpu.inference.llm import FaultInjector
+
+    if args.replicas < 2:
+        raise SystemExit("--disaggregate needs --replicas >= 2")
+    max_model_len = max(64, 32 + args.max_new)
+    arrivals, prompts, new_tokens = _fleet_trace(
+        args.requests, args.rate, args.max_new, args.seed)
+    arrivals = np.zeros_like(arrivals)
+
+    fi = None
+    if args.migrate_chaos is not None:
+        # dense schedule: short replays still see several fired faults
+        # (a scheduled fault only fires when a handoff is attempted at
+        # that step — consume-once semantics)
+        fi = FaultInjector.random_fleet(
+            args.migrate_chaos, steps=4096, replicas=args.replicas,
+            p_migration=0.25)
+    fleet = _build_fleet(args.replicas, args, max_model_len, faults=fi,
+                         disaggregate=True)
+    _lint_census(args, fleet.replicas[0].engine)
+    sigs = {tuple(sorted(e["label"]
+                         for e in run_census(r.engine).entries))
+            for r in fleet.replicas}
+    executables_shared = (len(sigs) == 1 and len(
+        {id(r.engine._decode) for r in fleet.replicas}) == 1)
+    watcher = fleet.warmup()
+    res = run(fleet, arrivals, prompts, new_tokens)
+    new_compiles = watcher.new_compiles()
+    fleet.check_invariants()
+    leaked = sum(r.engine.num_blocks
+                 - r.engine.block_manager.num_free_blocks
+                 for r in fleet.replicas)
+
+    token_exact = True
+    scaling = None
+    if not args.no_baseline:
+        base = _build_engine(args.max_batch, args.seed,
+                             max_model_len=max_model_len,
+                             token_budget=args.token_budget)
+        base_res = run(base, arrivals, prompts, new_tokens)
+        scaling = res["tokens_per_s"] / base_res["tokens_per_s"]
+        token_exact = res["outputs"] == base_res["outputs"]
+
+    mms = fleet.migration_ms
+    ls = res["lifecycle"]
+    row = {
+        "metric": "llm_serving_disagg",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "replicas": args.replicas,
+        "roles": {str(k): v for k, v in fleet.roles().items()},
+        "scaling_vs_1": (round(scaling, 3)
+                         if scaling is not None else None),
+        "token_exact": token_exact,
+        "executables_shared": executables_shared,
+        "new_compiles": len(new_compiles),
+        "leaked_pages": leaked,
+        "migrated": ls["migrated"],
+        "migrated_bytes": ls["migrated_bytes"],
+        "migration_failed": ls["migration_failed"],
+        "handoff_p50_ms": (round(float(np.percentile(mms, 50)), 3)
+                           if mms else None),
+        "handoff_p95_ms": (round(float(np.percentile(mms, 95)), 3)
+                           if mms else None),
+        "migrate_chaos_seed": args.migrate_chaos,
+        "migration_fault_events": (len(fi.events)
+                                   if fi is not None else 0),
+        "tpot_p50_ms": (round(res["tpot_p50_ms"], 2)
+                        if res["tpot_p50_ms"] is not None else None),
+        "e2e_p50_ms": (round(res["e2e_p50_ms"], 2)
+                       if res["e2e_p50_ms"] is not None else None),
+        "e2e_p95_ms": (round(res["e2e_p95_ms"], 2)
+                       if res["e2e_p95_ms"] is not None else None),
+        "requests": args.requests,
+        "max_batch": args.max_batch,
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    ok = (token_exact and leaked == 0 and executables_shared
+          and not new_compiles)
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "disaggregated replay violated its contract: "
+            f"token_exact={token_exact} leaked_pages={leaked} "
             f"executables_shared={executables_shared} "
             f"new_compiles={len(new_compiles)}")
 

@@ -80,8 +80,8 @@ from .analysis import (
 __all__ = [
     "CostEstimate", "Census", "estimate_jaxpr", "estimate_jitted",
     "xla_cost_analysis", "check_collectives", "run_census",
-    "engine_memory_model", "derive_max_batch", "parse_bytes",
-    "DEVICE_PROFILES",
+    "engine_memory_model", "derive_max_batch", "migration_estimate",
+    "parse_bytes", "DEVICE_PROFILES",
 ]
 
 
@@ -636,6 +636,42 @@ def derive_max_batch(memory_budget, weights_bytes, seq_bytes):
             f"max_model_len sequence ({_fmt_bytes(int(seq_bytes))} of "
             "pages) — raise the budget or shrink max_model_len")
     return int(free // int(seq_bytes))
+
+
+def migration_estimate(engine, num_tokens, num_pages, profile="tpu-v4",
+                       link_bytes_per_s=None):
+    """Static migrate-vs-recompute estimate for one sequence's KV
+    handoff (the fleet MigrationPolicy's decision inputs).
+
+    Moving the sequence costs its GLOBAL K+V page payload
+    (``num_pages`` pages at ``page_bytes * tp``) over the
+    replica-to-replica link; recomputing it costs a fresh prefill of
+    ``num_tokens`` tokens through the weights (2 flops per parameter
+    per token — the standard dense-decoder estimate; attention flops
+    are second-order at serving lengths).  Both counts are
+    hardware-independent; ``profile`` (a DEVICE_PROFILES key) only
+    converts them to seconds, with ``link_bytes_per_s`` overriding the
+    profile's ICI rate for the transfer term.
+
+    Returns {bytes_moved, migrate_s, recompute_flops, recompute_s,
+    prefer} with ``prefer`` in ("migrate", "recompute")."""
+    prof = DEVICE_PROFILES[profile]
+    tp = getattr(engine, "tp", 1)
+    model = engine_memory_model(engine)
+    bytes_moved = int(num_pages) * model["page_bytes"] * tp
+    n_params = sum(int(np.prod(leaf.shape)) if leaf.shape else 1
+                   for leaf in jtu.tree_leaves(engine.params))
+    flops = 2.0 * n_params * int(num_tokens)
+    link = (float(link_bytes_per_s) if link_bytes_per_s
+            else prof["ici_bytes_per_s"])
+    migrate_s = bytes_moved / link
+    recompute_s = flops / prof["flops_per_s"]
+    return {"bytes_moved": int(bytes_moved),
+            "migrate_s": migrate_s,
+            "recompute_flops": int(flops),
+            "recompute_s": recompute_s,
+            "prefer": ("migrate" if migrate_s <= recompute_s
+                       else "recompute")}
 
 
 # --------------------------------------------------------------------------

@@ -33,7 +33,13 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   hysteresis), token-exact failover of a dead
                   replica's requests onto survivors, fleet-level
                   bounded admission and rolling drain/restart; the
-                  replicas share one compiled executable set
+                  replicas share one compiled executable set.  KV page
+                  migration (MigrationPolicy) hands running sequences
+                  between replicas mid-generation token-exactly —
+                  drain and engine-alive failover migrate instead of
+                  recomputing, and ``disaggregate=True`` splits
+                  prefill-role from decode-role replicas with handoff
+                  at the prefill→decode boundary
 
 See docs/LLM_SERVING.md for design notes and a quickstart.
 """
@@ -45,12 +51,19 @@ from .block_manager import (  # noqa: F401
     prefix_block_hashes,
 )
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
-from .fleet import Fleet, HealthConfig, Replica, Router  # noqa: F401
+from .fleet import (  # noqa: F401
+    Fleet,
+    HealthConfig,
+    MigrationPolicy,
+    Replica,
+    Router,
+)
 from .faults import (  # noqa: F401
     Fault,
     FaultInjector,
     FinishReason,
     InjectedFault,
+    MigrationError,
     PoolLostError,
     RetryPolicy,
     StepWatchdog,
@@ -79,9 +92,9 @@ __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
            "NgramDrafter", "SpeculativeConfig", "rollback_draft_reservation",
-           "Fleet", "HealthConfig", "Replica", "Router",
+           "Fleet", "HealthConfig", "MigrationPolicy", "Replica", "Router",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",
-           "PoolLostError", "RetryPolicy", "StepWatchdog",
+           "MigrationError", "PoolLostError", "RetryPolicy", "StepWatchdog",
            "paged_decode_attention", "paged_decode_attention_xla",
            "paged_prefill_attention", "paged_prefill_attention_xla",
            "paged_verify_attention", "paged_verify_attention_xla"]

@@ -365,6 +365,61 @@ class BlockManager:
         self._tables[child_id] = list(table)
         self._tokens[child_id] = self._tokens[parent_id]
 
+    # ----------------------------------------------------------- migration --
+    def export_seq(self, seq_id):
+        """Serialize ``seq_id``'s page chain for migration to another
+        pool: block ids in table order (the gather order of the page
+        payload), the total token count, per-page token occupancy, and
+        each page's prefix-cache chain hash (None for tail pages and
+        pages that never registered).  Strictly read-only — refcounts
+        are NOT part of the wire format: a page shared here (adopted
+        from the cache, or COW-shared with a fork sibling) is exported
+        by value, and the importing pool collapses it to a private copy
+        with refcount 1."""
+        if seq_id not in self._tables:
+            raise KeyError(f"sequence {seq_id!r} owns no pages here")
+        table = self._tables[seq_id]
+        n = self._tokens[seq_id]
+        bs = self.block_size
+        return {
+            "num_tokens": int(n),
+            "block_ids": list(table),
+            "page_tokens": [max(0, min(bs, n - i * bs))
+                            for i in range(len(table))],
+            "hashes": [self._block_hash.get(b) for b in table],
+        }
+
+    def import_seq(self, seq_id, export):
+        """Allocate a PRIVATE page chain for an exported sequence and
+        return the new block table (same order as the export's
+        ``block_ids``, so the caller scatters the gathered payload
+        positionally).  Every page comes fresh with refcount 1 —
+        shared refcounts collapse on migration by design.  All-or-
+        nothing: on any failure (pool exhausted, injected OOM) nothing
+        is mutated.  Hash registration is deliberately a SEPARATE step
+        (:meth:`register_imported`): the caller copies page contents
+        between pools after allocation, and a fault in that window must
+        reclaim via :meth:`free` without ever having exposed an
+        unfilled page through the prefix cache."""
+        n = int(export["num_tokens"])
+        need = len(export["block_ids"])
+        if need != self.blocks_needed(n):
+            raise ValueError(
+                f"corrupt export: {need} pages cannot hold {n} tokens "
+                f"at page size {self.block_size}")
+        return self.allocate(seq_id, n)
+
+    def register_imported(self, seq_id, hashes):
+        """Re-register a migrated-in sequence's FULL pages in this
+        pool's prefix cache, positionally from the export's ``hashes``
+        (None entries — tail pages, never-registered pages — are
+        skipped; first-writer-wins exactly like
+        :meth:`register_full_block`).  Call only after the page
+        contents actually landed in this pool."""
+        for i, h in enumerate(hashes):
+            if h is not None:
+                self.register_full_block(seq_id, i, h)
+
     def _release(self, blk):
         """Refcount hit zero: park hashed pages on the LRU list (still
         adoptable), return unhashed pages to the raw free list."""

@@ -79,6 +79,7 @@ from .block_manager import BlockManager
 from .faults import (
     FinishReason,
     InjectedFault,
+    MigrationError,
     PoolLostError,
     RetryPolicy,
     StepWatchdog,
@@ -88,7 +89,7 @@ from .paged_attention import (
     paged_prefill_attention,
     paged_verify_attention,
 )
-from .scheduler import FINISHED, Request, Scheduler, bucket_size
+from .scheduler import FINISHED, RUNNING, Request, Scheduler, bucket_size
 from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
 
 # Megatron-style sharding of the stacked block params over the 'mp' axis
@@ -284,6 +285,9 @@ class LLMEngine:
         page_bytes = (2 * self.num_layers * self.block_size
                       * (self.num_heads // self.tp) * self.head_dim
                       * jnp.dtype(self.dtype).itemsize)
+        # per-chip K+V bytes of one page — the migration cost model's
+        # bytes-moved unit (global payload = page_bytes * tp)
+        self.page_bytes = int(page_bytes)
         if self.memory_budget is not None:
             seq_bytes = self.max_pages * page_bytes
             admissible = derive_max_batch(self.memory_budget,
@@ -1034,6 +1038,116 @@ class LLMEngine:
                 "reused_blocks": bm.prefix_reused_blocks,
                 "evictions": bm.prefix_evictions,
                 "cached_blocks": bm.num_cached_blocks}
+
+    # ------------------------------------------------------------ migration --
+    def _gather_pages(self, block_ids):
+        """Host-staged page gather: ``jax.device_get`` of the pools
+        (whole-array transfer — no jit, no gather executable, nothing
+        for an armed CompileWatcher to see), then a numpy row select.
+        Returns (k_pages, v_pages) as [L, P, bs, Nkv, D] numpy arrays
+        in ``block_ids`` order — the GLOBAL view even when the pools
+        are head-sharded (jax assembles addressable shards)."""
+        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
+        k = np.asarray(jax.device_get(self._kc))[:, idx]  # noqa: H001 (migration is a host-staged transfer by design)
+        v = np.asarray(jax.device_get(self._vc))[:, idx]  # noqa: H001
+        return k, v
+
+    def _scatter_pages(self, block_ids, k_pages, v_pages):
+        """Host-staged page scatter: pull the pools to host, write the
+        migrated pages into their destination rows, and ``device_put``
+        fresh pool arrays back (re-sharded under TP).  The rebuilt
+        arrays are ordinary committed buffers — the next step's jitted
+        call donates them exactly like the ones they replace, so
+        migration composes with donation and compiles nothing."""
+        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
+        kh = np.array(jax.device_get(self._kc))  # noqa: H001 (migration is a host-staged transfer by design)
+        vh = np.array(jax.device_get(self._vc))  # noqa: H001
+        kh[:, idx] = k_pages
+        vh[:, idx] = v_pages
+        if self.tp > 1:
+            self._kc = jax.device_put(kh, self._cache_sharding)
+            self._vc = jax.device_put(vh, self._cache_sharding)
+        else:
+            self._kc = jax.device_put(kh)
+            self._vc = jax.device_put(vh)
+
+    def export_request(self, request_id):
+        """Serialize one RUNNING request for migration to a peer
+        engine: the live Request object, the BlockManager's page-chain
+        export, and the host-gathered K/V page payload.  Read-only —
+        the request keeps serving here until :meth:`release_request`,
+        so a failed import on the destination costs nothing."""
+        req = self._requests.get(request_id)
+        if req is None:
+            raise KeyError(f"unknown request {request_id!r}")
+        if req.status != RUNNING or \
+                not self.block_manager.has_seq(request_id):
+            raise ValueError(
+                f"request {request_id!r} is {req.status}; only running "
+                f"sequences with resident pages export (waiting/"
+                f"preempted ones requeue from scratch instead)")
+        seq = self.block_manager.export_seq(request_id)
+        k, v = self._gather_pages(seq["block_ids"])
+        self.events.append((self._step_index, "export", request_id,
+                            len(seq["block_ids"])))
+        return {"request": req, "seq": seq, "k_pages": k, "v_pages": v}
+
+    def import_request(self, req, seq, k_pages, v_pages,
+                       fault_hook=None):
+        """Adopt a migrated-in request mid-generation: allocate a
+        private page chain, scatter the payload into this engine's
+        pools, re-register full pages in this prefix cache, and insert
+        the request into the running set — decode resumes next step,
+        token-exactly (``num_cached`` / ``output_ids`` / the
+        per-request sampling stream ride the Request object).
+
+        All-or-nothing: any failure after allocation (``fault_hook`` —
+        the injected mid-import fault — a shape mismatch, anything)
+        frees exactly the pages allocated here and re-raises, leaving
+        this engine untouched.  Raises MigrationError up front when the
+        running set is full (the decode batch is sized by max_batch)."""
+        rid = req.request_id
+        if rid in self._requests:
+            raise ValueError(f"request {rid!r} already live here")
+        if len(self.scheduler.running) >= self.max_batch:
+            raise MigrationError(
+                f"destination running set is full "
+                f"({self.max_batch} sequences)", reason="capacity")
+        expect = (self.num_layers, len(seq["block_ids"]),
+                  self.block_size, self.num_heads, self.head_dim)
+        if tuple(k_pages.shape) != expect or \
+                tuple(v_pages.shape) != expect:
+            raise ValueError(
+                f"page payload {k_pages.shape} does not fit this pool "
+                f"(expected {expect}) — migration requires identically "
+                f"configured engines")
+        table = self.block_manager.import_seq(rid, seq)
+        try:
+            if fault_hook is not None:
+                fault_hook()
+            self._scatter_pages(table, k_pages, v_pages)
+            self.block_manager.register_imported(rid, seq["hashes"])
+        except BaseException:
+            # exact reclamation: every page import_seq allocated goes
+            # back; nothing was registered before the payload landed
+            self.block_manager.free(rid)
+            raise
+        req.status = RUNNING
+        req.draft_tokens = []
+        self._requests[rid] = req
+        self.scheduler.running.append(req)
+        self.events.append((self._step_index, "import", rid,
+                            len(table)))
+
+    def release_request(self, request_id):
+        """Forget a migrated-away request WITHOUT emitting an output:
+        pages are reclaimed refcount-correctly (prefix-cache
+        registrations survive on the LRU list) and ownership is now the
+        importing engine's.  The mirror of :meth:`import_request` —
+        call it only after the import succeeded."""
+        req = self._requests.pop(request_id)
+        self.scheduler.abort(req)
+        self.events.append((self._step_index, "release", request_id))
 
     def _decode_step(self, reqs, finished):
         """Plain decode: one token per running sequence."""

@@ -75,6 +75,21 @@ class PoolLostError(RuntimeError):
     cache is gone and the engine cannot recover in place."""
 
 
+class MigrationError(RuntimeError):
+    """A KV page migration attempt failed mid-flight (injected or
+    real).  The contract is exact reclamation on BOTH pools: the source
+    sequence is untouched and still serving, and any pages the
+    destination allocated are freed — so the fleet can always fall back
+    to the pre-migration behavior (from-scratch replay on failover,
+    finish-in-place on drain) without leaking a page on either side.
+    ``reason`` tags the failure point ("export" | "import" | the
+    wrapped exception's class name) for deterministic event logs."""
+
+    def __init__(self, message, reason="migration"):
+        super().__init__(message)
+        self.reason = reason
+
+
 @dataclass
 class Fault:
     """One scheduled fault.
@@ -99,10 +114,19 @@ class Fault:
                     fail over), "heartbeat" (the victim misses this
                     fleet step's heartbeat — a DATA signal, no real
                     sleep, so replays stay wall-clock-free),
-                    "drain" (rolling drain of the victim begins).
+                    "drain" (rolling drain of the victim begins);
+            migration: "export" (the page gather fails before any
+                    state moves — source keeps serving), "import"
+                    (the destination fails AFTER allocating pages —
+                    it must reclaim them exactly; the source is
+                    untouched), "delay" (sleep delay_s inside the
+                    handoff window — exercises handoff-latency
+                    accounting; 0 by default so replays stay
+                    wall-clock-free).  Consumed by Fleet._migrate,
+                    at most one fault per fleet step.
     step:   engine step index ("step"/"alloc"/"client" sites), fleet
-            step index ("replica" site), or response index ("socket"
-            site) the fault fires at.
+            step index ("replica"/"migration" sites), or response
+            index ("socket" site) the fault fires at.
     count:  "transient" only — how many attempts fail before success.
     delay_s: "delay" only — injected stall length.
     victim: "raise" — index into the launch's request rows; the
@@ -151,13 +175,18 @@ class FaultInjector:
         self.schedule = list(schedule)
         for f in self.schedule:
             if f.site not in ("step", "alloc", "socket", "client",
-                              "replica"):
+                              "replica", "migration"):
                 raise ValueError(f"unknown fault site {f.site!r}")
             if f.site == "replica" and \
                     f.kind not in ("kill", "heartbeat", "drain"):
                 raise ValueError(
                     f"unknown replica fault kind {f.kind!r} "
                     f"(kill | heartbeat | drain)")
+            if f.site == "migration" and \
+                    f.kind not in ("export", "import", "delay"):
+                raise ValueError(
+                    f"unknown migration fault kind {f.kind!r} "
+                    f"(export | import | delay)")
         self.events = []
         self._step = -1          # current engine step index
         self._attempts = {}      # (site, step) -> attempts so far
@@ -195,26 +224,33 @@ class FaultInjector:
 
     @classmethod
     def random_fleet(cls, seed, steps=256, *, replicas, p_kill=0.0,
-                     p_heartbeat=0.0, p_drain=0.0, max_kills=None,
-                     max_drains=1):
+                     p_heartbeat=0.0, p_drain=0.0, p_migration=0.0,
+                     max_kills=None, max_drains=1, migration_delay_s=0.0):
         """Materialize a seeded fleet-chaos schedule ("replica"-site
-        faults only): per fleet step, Bernoulli draws for a replica
-        kill, a missed heartbeat, and a rolling drain, each with a
-        uniformly drawn victim.  Victims are drawn unconditionally so
-        the schedule is a pure function of ``seed`` regardless of the
-        caps.  ``max_kills`` defaults to ``replicas - 1`` — a chaos
-        schedule that can kill every replica has no survivors left to
-        assert token-exactness on."""
+        faults plus "migration"-site handoff faults): per fleet step,
+        Bernoulli draws for a replica kill, a missed heartbeat, and a
+        rolling drain, each with a uniformly drawn victim.  Victims are
+        drawn unconditionally so the schedule is a pure function of
+        ``seed`` regardless of the caps.  ``max_kills`` defaults to
+        ``replicas - 1`` — a chaos schedule that can kill every replica
+        has no survivors left to assert token-exactness on.
+        ``p_migration`` draws migration faults (export / import /
+        delay, uniformly) from a SEPARATE stream derived from the same
+        seed, so adding migration chaos never perturbs the replica
+        schedule an existing seed pins down."""
         if int(replicas) < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         if max_kills is None:
             max_kills = max(0, int(replicas) - 1)
         rng = np.random.RandomState(int(seed))
+        mrng = np.random.RandomState((int(seed) ^ 0x9E3779B9) & 0x7FFFFFFF)
         schedule = []
         kills = drains = 0
         for s in range(int(steps)):
             draws = rng.uniform(size=3)
             victims = rng.randint(int(replicas), size=3)
+            mdraw = mrng.uniform()
+            mkind = ("export", "import", "delay")[int(mrng.randint(3))]
             if draws[0] < p_kill and kills < max_kills:
                 kills += 1
                 schedule.append(Fault("replica", "kill", step=s,
@@ -226,6 +262,9 @@ class FaultInjector:
                 drains += 1
                 schedule.append(Fault("replica", "drain", step=s,
                                       victim=int(victims[2])))
+            if mdraw < p_migration:
+                schedule.append(Fault("migration", mkind, step=s,
+                                      delay_s=migration_delay_s))
         return cls(schedule=schedule, seed=seed)
 
     # ------------------------------------------------------- engine hooks --
@@ -273,6 +312,25 @@ class FaultInjector:
                 continue
             self._attempts[key] = 1
             self.events.append((s, "replica", f.kind, f.victim))
+            fired.append(f)
+        return fired
+
+    def migration_faults(self, step=None):
+        """Fleet hook: the "migration"-site faults due at ``step``
+        (default: the current fleet step), each consumed — and recorded
+        in ``events`` as ``(step, "migration", kind, 0)`` — exactly
+        once, so only the FIRST migration attempted at a faulted step
+        is hit and a drained schedule replays to an identical log.  A
+        scheduled fault at a step with no migration attempt never
+        fires (the handoff it targeted did not exist)."""
+        s = self._step if step is None else int(step)
+        fired = []
+        for f in self._by_site.get(("migration", s), ()):
+            key = ("migration", s, f.kind)
+            if self._attempts.get(key):
+                continue
+            self._attempts[key] = 1
+            self.events.append((s, "migration", f.kind, 0))
             fired.append(f)
         return fired
 

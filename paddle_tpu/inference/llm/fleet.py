@@ -53,10 +53,33 @@ Design:
   id IS the replica-level id (no mapping to corrupt).
 - **Bounded admission + rolling drain**: ``max_queue`` sheds at the
   fleet level when capacity drops (FinishReason.shed, immediately);
-  ``drain_replica(i)`` reroutes the victim's waiting requests, lets
-  its running ones finish in place, and parks it ``drained`` for a
-  zero-downtime ``restart_replica(i)`` (a dead replica restarts with a
-  fresh engine that adopts the shared executables — zero compiles).
+  ``drain_replica(i)`` reroutes the victim's waiting requests,
+  migrates its running ones to peers (policy-gated; finish-in-place
+  fallback), and parks it ``drained`` for a zero-downtime
+  ``restart_replica(i)`` (a dead replica restarts with a fresh engine
+  that adopts the shared executables — zero compiles).
+- **KV page migration** (``_migrate``): a RUNNING sequence's page
+  chain moves between replicas mid-generation — host-staged
+  ``device_get``/``device_put`` of the source pages into fresh private
+  pages on the destination (engine.export_request/import_request), the
+  live Request object transplanted so ``output_ids`` / ``num_cached``
+  / the per-request sampling stream ride along and decode resumes
+  token-exactly with zero new compiles.  ``MigrationPolicy`` picks
+  migrate-vs-recompute from framework/cost.py's bytes-moved vs
+  tokens-recomputed estimate; any migration fault falls back to the
+  pre-migration behavior (from-scratch replay on failover, finish in
+  place on drain) with exact page reclamation on BOTH pools.  Drain
+  and *engine-alive* failover (health-signal death: the engine object
+  still holds its pages) migrate; process death still replays from
+  scratch — pages die with the process.
+- **Disaggregated prefill/decode** (``disaggregate=True``): low
+  replica indices specialize as prefill-role, the rest decode-role.
+  New requests route to prefill replicas; the moment a sequence
+  crosses the prefill→decode boundary (final chunk committed) it hands
+  off to a decode replica via the SAME migration path.  With no
+  routable replica of the wanted role the fleet degrades to unified
+  serving rather than stalling — specialization is a placement
+  preference, never a correctness constraint.
 
 ``parallel_step=True`` steps live replicas in one thread each (real
 overlap on multi-core hosts; on a single core the GIL serializes the
@@ -68,12 +91,14 @@ event log is identical in both modes.
 import threading
 import time
 import warnings
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
 
 from .engine import LLMEngine, RequestOutput
-from .faults import FinishReason
+from .faults import FinishReason, MigrationError
+from .scheduler import RUNNING
 
 # replica lifecycle states (three-state health machine + drain states)
 HEALTHY = "healthy"
@@ -127,6 +152,82 @@ class HealthConfig:
             f"got {type(health).__name__}")
 
 
+@dataclass
+class MigrationPolicy:
+    """Migrate-vs-recompute for one running sequence's KV handoff.
+
+    ``mode``
+        "auto" (default) compares framework/cost.py's
+        ``migration_estimate`` — the sequence's page bytes over the
+        replica-to-replica link vs a fresh prefill of its
+        ``num_cached`` tokens through the weights — and picks the
+        cheaper side; "always" / "never" force the choice.
+    ``profile``
+        DEVICE_PROFILES key converting byte/FLOP counts to seconds
+        (default "cpu" — what the serving stack runs on today).
+    ``link_gbps``
+        Replica-to-replica bandwidth in GB/s for the transfer term;
+        None uses the profile's ICI rate.
+
+    Failure handling is NOT a knob: a migration that faults always
+    falls back to the pre-migration behavior (from-scratch replay on
+    failover, finish-in-place on drain, retry-next-step on the
+    disaggregated handoff) — both pools exactly as before the attempt.
+    """
+
+    mode: str = "auto"
+    profile: str = "cpu"
+    link_gbps: float = None
+
+    def __post_init__(self):
+        if self.mode not in ("auto", "always", "never"):
+            raise ValueError(
+                f"mode must be 'auto'|'always'|'never', got "
+                f"{self.mode!r}")
+        from ...framework.cost import DEVICE_PROFILES
+        if self.profile not in DEVICE_PROFILES:
+            raise ValueError(
+                f"unknown device profile {self.profile!r} "
+                f"(one of {sorted(DEVICE_PROFILES)})")
+        if self.link_gbps is not None and not float(self.link_gbps) > 0:
+            raise ValueError(
+                f"link_gbps must be > 0, got {self.link_gbps!r}")
+
+    @classmethod
+    def resolve(cls, migration):
+        """Fleet-kwarg sugar: None | mode str | dict |
+        MigrationPolicy."""
+        if migration is None:
+            return cls()
+        if isinstance(migration, cls):
+            return migration
+        if isinstance(migration, str):
+            return cls(mode=migration)
+        if isinstance(migration, dict):
+            return cls(**migration)
+        raise TypeError(
+            f"migration= takes None/str/dict/MigrationPolicy, "
+            f"got {type(migration).__name__}")
+
+    def estimate(self, engine, request):
+        """The cost model's view of migrating ``request`` off
+        ``engine`` right now (bytes moved, recompute FLOPs, seconds
+        under the profile, and which side it prefers)."""
+        from ...framework.cost import migration_estimate
+        pages = len(engine.block_manager.block_table(request.request_id))
+        return migration_estimate(
+            engine, num_tokens=request.num_cached, num_pages=pages,
+            profile=self.profile,
+            link_bytes_per_s=(None if self.link_gbps is None
+                              else float(self.link_gbps) * 1e9))
+
+    def decide(self, engine, request):
+        """"migrate" or "recompute" for one RUNNING request."""
+        if self.mode != "auto":
+            return "migrate" if self.mode == "always" else "recompute"
+        return self.estimate(engine, request)["prefer"]
+
+
 class Replica:
     """One engine plus its fleet-side health and affinity state."""
 
@@ -134,14 +235,17 @@ class Replica:
         self.index = index
         self.engine = engine
         self.state = HEALTHY
+        self.role = None         # "prefill"/"decode" when disaggregated
         self.miss_streak = 0
         self.ok_streak = 0
-        # shadow set of prefix-chain hashes dispatched to this replica:
+        # shadow LRU of prefix-chain hashes dispatched to this replica:
         # routing must see pages that are still PREFILLING (the live
         # cache only knows completed pages), at the cost of counting
         # pages the cache may since have evicted — affinity is a
-        # placement heuristic, correctness never depends on it
-        self.warm_hashes = set()
+        # placement heuristic, correctness never depends on it.  An
+        # OrderedDict (value-less) so Router.touch can bound it LRU-
+        # style instead of growing without limit across long replays.
+        self.warm_hashes = OrderedDict()
         self._last_wedged = 0
 
     @property
@@ -165,8 +269,13 @@ class Router:
     """Prefix-affinity placement with deterministic least-loaded
     fallback (see the module docstring for the policy)."""
 
-    def __init__(self, replicas):
+    def __init__(self, replicas, warm_cap=4096):
+        if not isinstance(warm_cap, (int, np.integer)) or \
+                isinstance(warm_cap, bool) or warm_cap < 1:
+            raise ValueError(
+                f"warm_cap must be a positive int, got {warm_cap!r}")
         self.replicas = replicas
+        self.warm_cap = int(warm_cap)
         self.routed = 0
         self.affinity_hits = 0
 
@@ -203,11 +312,26 @@ class Router:
                 best, best_key = r, k
         return best, -best_key[0]
 
+    def touch(self, replica, keys):
+        """Mark ``keys`` warm on ``replica`` (most-recent position).
+        The warm map is an LRU bounded at ``warm_cap`` hashes — the
+        same content hashes the prefix cache keys pages on — so a
+        long replay holds a few pools' worth of history, not every
+        prompt it ever routed."""
+        warm = replica.warm_hashes
+        for h in keys:
+            if h in warm:
+                warm.move_to_end(h)
+            else:
+                warm[h] = None
+        while len(warm) > self.warm_cap:
+            warm.popitem(last=False)
+
     def record(self, replica, keys, hit):
         self.routed += 1
         if hit:
             self.affinity_hits += 1
-        replica.warm_hashes.update(keys)
+        self.touch(replica, keys)
 
     def forget(self, replica):
         """Drop the replica's affinity state (death / drain / restart
@@ -230,6 +354,11 @@ class _FleetRequest:
     kwargs: dict
     replica: int
     requeues: int = 0
+    # set by Fleet.abort_request BEFORE the engine emits the aborted
+    # output: a failover/drain/migration racing the abort sees the
+    # claim and neither resurrects the request on a peer nor
+    # double-finishes it
+    aborting: bool = False
 
 
 class Fleet:
@@ -249,21 +378,31 @@ class Fleet:
     drive a fleet exactly like a single engine.
 
     ``faults=`` takes a FaultInjector whose "replica"-site schedule the
-    fleet consumes at each step boundary (kill / heartbeat / drain);
+    fleet consumes at each step boundary (kill / heartbeat / drain),
+    and whose "migration"-site schedule fires against migration
+    attempts (fail mid-export / mid-import / delay);
     ``engine_faults=`` optionally gives each replica its own injector
     for engine-level chaos.  ``max_queue`` bounds TOTAL waiting depth
     across routable replicas — past it (or with no routable replica
-    left) requests shed at the fleet gate.  All remaining keyword
-    arguments are forwarded to every replica's LLMEngine.
+    left) requests shed at the fleet gate.  ``migration=`` takes a
+    MigrationPolicy (or mode str / dict) gating KV page handoff on
+    drain and engine-alive failover; ``disaggregate=True`` splits the
+    fleet into prefill-role and decode-role replicas with migration-
+    based handoff at the prefill→decode boundary.  All remaining
+    keyword arguments are forwarded to every replica's LLMEngine.
     """
 
     def __init__(self, model, replicas=2, *, health=None, faults=None,
                  max_queue=None, parallel_step=False, engine_faults=None,
-                 **engine_kwargs):
+                 migration=None, disaggregate=False, **engine_kwargs):
         if not isinstance(replicas, (int, np.integer)) or \
                 isinstance(replicas, bool) or replicas < 1:
             raise ValueError(
                 f"replicas must be a positive int, got {replicas!r}")
+        if disaggregate and int(replicas) < 2:
+            raise ValueError(
+                "disaggregate=True needs at least 2 replicas (one "
+                "prefill-role, one decode-role)")
         if max_queue is not None:
             if not isinstance(max_queue, (int, np.integer)) \
                     or isinstance(max_queue, bool) or max_queue < 1:
@@ -278,6 +417,8 @@ class Fleet:
                 f"engine_faults needs one entry per replica "
                 f"({replicas}), got {len(engine_faults)}")
         self.health = HealthConfig.resolve(health)
+        self.migration = MigrationPolicy.resolve(migration)
+        self.disaggregate = bool(disaggregate)
         self.faults = faults
         self.max_queue = max_queue
         self.parallel_step = bool(parallel_step)
@@ -287,6 +428,12 @@ class Fleet:
         self._shared_fns = None
         self.replicas = [Replica(i, self._build_engine(i))
                          for i in range(int(replicas))]
+        if self.disaggregate:
+            # low indices take prefill (they see every new prompt and
+            # keep the warm prefix caches); the rest decode
+            n_prefill = max(1, int(replicas) // 2)
+            for r in self.replicas:
+                r.role = "prefill" if r.index < n_prefill else "decode"
         self.router = Router(self.replicas)
         self._live = {}          # fleet rid -> _FleetRequest
         self._early = []         # outputs finished without a step
@@ -299,7 +446,12 @@ class Fleet:
         # of a chaos schedule compare equal
         self.events = []
         self.stats = {"requeued": 0, "killed": 0, "drains": 0,
-                      "restarts": 0, "shed": 0, "lost": 0}
+                      "restarts": 0, "shed": 0, "lost": 0,
+                      "migrated": 0, "migration_recomputed": 0,
+                      "migration_failed": 0, "migrated_bytes": 0}
+        # wall-clock handoff latencies (ms) — benches read this; it
+        # never enters the event log, so seed replays stay identical
+        self.migration_ms = []
 
     # ----------------------------------------------------------- replicas --
     def _build_engine(self, index):
@@ -329,16 +481,28 @@ class Fleet:
     def replica_states(self):
         return {r.index: r.state for r in self.replicas}
 
-    def _routable(self, exclude=None):
+    def roles(self):
+        """{replica index: role} — "prefill"/"decode" under
+        ``disaggregate=True``, None for every replica otherwise."""
+        return {r.index: r.role for r in self.replicas}
+
+    def _routable(self, exclude=None, role=None):
         """Routing pool: healthy replicas; if none, degraded ones (a
         degraded fleet sheds only when it must).  Never includes
-        ``exclude`` or draining/drained/dead replicas."""
-        pool = [r for r in self.replicas
-                if r.state == HEALTHY and r is not exclude]
-        if not pool:
-            pool = [r for r in self.replicas
-                    if r.state == DEGRADED and r is not exclude]
-        return pool
+        ``exclude`` or draining/drained/dead replicas.  ``role``
+        prefers replicas of that role (disaggregated mode) but falls
+        back to ANY routable replica when the role has none left —
+        specialization degrades to unified serving, never to an
+        outage."""
+        wants = ((role, None) if role is not None else (None,))
+        for want in wants:
+            for state in (HEALTHY, DEGRADED):
+                pool = [r for r in self.replicas
+                        if r.state == state and r is not exclude
+                        and (want is None or r.role == want)]
+                if pool:
+                    return pool
+        return []
 
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16,
@@ -352,7 +516,10 @@ class Fleet:
         if request_id is None:
             request_id = self._next_id
             self._next_id += 1
-        pool = self._routable()
+        # disaggregated fleets prefill where the prompt work belongs;
+        # the handoff to a decode replica happens at the boundary
+        pool = self._routable(
+            role="prefill" if self.disaggregate else None)
         depth = sum(r.engine.scheduler.queue_depth() for r in pool)
         if self._draining or not pool or \
                 (self.max_queue is not None and depth >= self.max_queue):
@@ -378,11 +545,20 @@ class Fleet:
 
     def abort_request(self, request_id):
         """Cancel a live request wherever it currently runs; the
-        aborted output is forwarded by a following step()."""
+        aborted output is forwarded by a following step().  Ownership
+        is claimed HERE, before the owning engine can emit: once
+        ``aborting`` is set, a racing ``_failover``/``drain_replica``
+        neither requeues the request on a peer (which would resurrect
+        cancelled work) nor lets it finish twice — if the owner dies
+        before delivering, the fleet emits the one terminal ABORTED
+        output itself."""
         fr = self._live.get(request_id)
-        if fr is None:
+        if fr is None or fr.aborting:
             return False
-        return self.replicas[fr.replica].engine.abort_request(request_id)
+        ok = self.replicas[fr.replica].engine.abort_request(request_id)
+        if ok:
+            fr.aborting = True
+        return ok
 
     def has_unfinished(self):
         return bool(self._early) or bool(self._live)
@@ -392,7 +568,9 @@ class Fleet:
         """One fleet iteration: consume due replica-site faults, step
         every live replica (threads under ``parallel_step``), forward
         outputs still owned by their emitting replica, update health
-        beats, and promote emptied draining replicas to drained.
+        beats, hand prefilled sequences to decode replicas (in
+        disaggregated mode), and promote emptied draining replicas to
+        drained.
         Returns the finished RequestOutputs (fleet-shed and failover
         casualties included)."""
         self._step_index += 1
@@ -422,6 +600,8 @@ class Fleet:
                 finished.append(fo)
             if r.state in (HEALTHY, DEGRADED):
                 self._beat(r)
+        if self.disaggregate:
+            self._handoff_prefilled()
         for r in self.replicas:
             if r.state == DRAINING and not r.engine.has_unfinished():
                 r.state = DRAINED
@@ -487,7 +667,11 @@ class Fleet:
                                     r.index, miss))
             elif r.state == DEGRADED and \
                     r.miss_streak >= self.health.dead_after:
-                self._mark_dead(r, tag=miss)
+                # health-signal death: the engine OBJECT still holds
+                # its pages (its steps were completing — only the
+                # heartbeat failed), so failover may migrate them
+                # instead of replaying every sequence from scratch
+                self._mark_dead(r, tag=miss, engine_alive=True)
         else:
             r.ok_streak += 1
             r.miss_streak = 0
@@ -512,10 +696,13 @@ class Fleet:
             raise ValueError(f"unknown replica fault kind {f.kind!r}")
 
     # ----------------------------------------------------------- failover --
-    def _mark_dead(self, r, tag, detail=None):
-        """Process-death semantics: the engine is never touched again
-        (its pages die with it), affinity state is dropped, and every
-        request it owned fails over to a survivor."""
+    def _mark_dead(self, r, tag, detail=None, engine_alive=False):
+        """Take a replica out of service and fail its requests over.
+        ``engine_alive=False`` is process-death semantics: the engine
+        is never touched again (its pages die with it) and every
+        request replays from scratch.  ``engine_alive=True`` (health-
+        signal death: the object still holds its pages) lets failover
+        migrate running sequences' KV pages to survivors first."""
         if r.state == DEAD:
             return
         r.state = DEAD
@@ -526,21 +713,39 @@ class Fleet:
             f"fleet replica {r.index} died ({tag})"
             + (f": {detail}" if detail else ""),
             RuntimeWarning, stacklevel=3)
-        self._failover(r)
+        self._failover(r, engine_alive=engine_alive)
 
-    def _failover(self, dead):
-        """Requeue every request the dead replica owned — original
-        prompt, original kwargs (seed included), SAME request id — on
-        the best surviving replica, replayed from scratch.  Exactness
-        of the replay is the engine's batch-order-independence
-        guarantee: greedy and per-request-seeded outputs do not depend
-        on which batch (or replica) computes them.  With no routable
-        survivor the request finishes FinishReason.error."""
+    def _failover(self, dead, engine_alive=False):
+        """Move every request the dead replica owned to a survivor.
+
+        Per victim, in order: (1) a request already claimed by
+        ``abort_request`` finishes ABORTED at the fleet level — the
+        dead engine can no longer deliver its queued aborted output,
+        and cancelled work is never resurrected on a peer; (2) with
+        ``engine_alive`` and the MigrationPolicy agreeing, its RUNNING
+        sequences MIGRATE — pages move, zero tokens recompute; (3)
+        everything else requeues from scratch — original prompt,
+        original kwargs (seed included), SAME request id.  Exactness
+        either way: migration transplants the exact KV pages and
+        Request state, and replay leans on the engine's batch-order-
+        independence guarantee (greedy and per-request-seeded outputs
+        do not depend on which batch or replica computes them).  With
+        no routable survivor the request finishes FinishReason.error."""
         victims = [rid for rid, fr in self._live.items()
                    if fr.replica == dead.index]
         for rid in victims:
             fr = self._live[rid]
-            pool = self._routable()
+            if fr.aborting:
+                del self._live[rid]
+                self.events.append((self._step_index, "finish", rid,
+                                    FinishReason.ABORTED))
+                self._early.append(RequestOutput(
+                    rid, fr.prompt_ids, [], FinishReason.ABORTED, 0))
+                continue
+            if engine_alive and self._try_migrate(rid, dead):
+                continue
+            pool = self._routable(
+                role="prefill" if self.disaggregate else None)
             if not pool:
                 del self._live[rid]
                 self.stats["lost"] += 1
@@ -561,6 +766,139 @@ class Fleet:
             self.events.append((self._step_index, "failover", rid,
                                 dead.index, target.index))
 
+    # ---------------------------------------------------------- migration --
+    def _pick_migration_target(self, src, fr, req, role=None):
+        """Destination for one migrating sequence, or None.  Strict
+        ``role`` pools (the disaggregated handoff wants decode-role
+        specifically); otherwise the routing pool with a same-role
+        preference.  Candidates are pre-filtered on capacity — a full
+        running set or a pool without enough free pages can never
+        import — then the Router breaks ties (affinity, least-loaded,
+        lowest index: deterministic)."""
+        if role is not None:
+            pool = [d for d in self.replicas
+                    if d.role == role and d.routable and d is not src]
+        else:
+            pool = self._routable(exclude=src)
+            if self.disaggregate:
+                same = [d for d in pool if d.role == src.role]
+                pool = same or pool
+        need = len(src.engine.block_manager.block_table(req.request_id))
+        pool = [d for d in pool
+                if len(d.engine.scheduler.running) < d.engine.max_batch
+                and d.engine.block_manager.num_free_blocks >= need]
+        if not pool:
+            return None
+        keys = self.router.affinity_keys(fr.prompt_ids)
+        target, _ = self.router.pick(keys, pool)
+        return target
+
+    def _try_migrate(self, rid, src, use_policy=True, role=None):
+        """Policy-gated migration of one request off ``src``.  Returns
+        True when the request now lives on a peer; False means the
+        caller falls back to its pre-migration behavior (requeue from
+        scratch, finish in place, or retry next step).  Only RUNNING
+        sequences with resident pages migrate — waiting/preempted ones
+        have no pages to move."""
+        fr = self._live.get(rid)
+        if fr is None or fr.replica != src.index or fr.aborting:
+            return False
+        req = src.engine._requests.get(rid)
+        if req is None or req.status != RUNNING or \
+                not src.engine.block_manager.has_seq(rid):
+            return False
+        if use_policy and self.migration.decide(src.engine, req) \
+                == "recompute":
+            self.stats["migration_recomputed"] += 1
+            self.events.append((self._step_index, "migrate_skip", rid,
+                                "recompute"))
+            return False
+        dst = self._pick_migration_target(src, fr, req, role=role)
+        if dst is None:
+            return False
+        try:
+            self._migrate(rid, src, dst)
+        except MigrationError as e:
+            self.stats["migration_failed"] += 1
+            self.events.append((self._step_index, "migrate_fail", rid,
+                                src.index, dst.index, e.reason))
+            return False
+        return True
+
+    def _migrate(self, rid, src, dst):
+        """Move one RUNNING sequence's KV pages ``src`` -> ``dst`` and
+        resume decode mid-generation, token-exactly: the page payload,
+        ``num_cached``, ``output_ids`` and the per-request sampling
+        stream all ride along, so not one token recomputes and not one
+        changes.  The transfer is host-staged device_get/device_put —
+        no jit anywhere on the path, so an armed CompileWatcher sees
+        zero new compiles.
+
+        Raises MigrationError on any failure with BOTH pools exactly
+        as before the call: export is read-only (the sequence keeps
+        serving on ``src`` until release), and the destination's
+        import is all-or-nothing.  Due "migration"-site faults are
+        consumed here — at most one fires per fleet step, against the
+        first migration attempted."""
+        fr = self._live[rid]
+        due = {}
+        if self.faults is not None:
+            due = {f.kind: f for f in self.faults.migration_faults()}
+        t0 = time.perf_counter()
+        delay = due.get("delay")
+        if delay is not None and delay.delay_s:
+            time.sleep(delay.delay_s)
+        if "export" in due:
+            raise MigrationError(
+                f"injected migration fault (export) for request {rid}",
+                reason="export")
+        state = src.engine.export_request(rid)
+        hook = None
+        if "import" in due:
+            def hook():
+                raise MigrationError(
+                    f"injected migration fault (import) for request "
+                    f"{rid}", reason="import")
+        try:
+            dst.engine.import_request(state["request"], state["seq"],
+                                      state["k_pages"],
+                                      state["v_pages"],
+                                      fault_hook=hook)
+        except MigrationError:
+            raise
+        except Exception as e:   # NoFreeBlocks, injected OOM, shape --
+            raise MigrationError(
+                f"import on replica {dst.index} failed: {e}",
+                reason=type(e).__name__) from e
+        src.engine.release_request(rid)
+        pages = len(state["seq"]["block_ids"])
+        nbytes = pages * src.engine.page_bytes * src.engine.tp
+        fr.replica = dst.index
+        self.stats["migrated"] += 1
+        self.stats["migrated_bytes"] += nbytes
+        self.migration_ms.append((time.perf_counter() - t0) * 1e3)
+        self.router.touch(dst, self.router.affinity_keys(fr.prompt_ids))
+        self.events.append((self._step_index, "migrate", rid,
+                            src.index, dst.index, pages))
+
+    def _handoff_prefilled(self):
+        """Disaggregated mode: every sequence on a prefill replica
+        that has crossed the prefill→decode boundary (final chunk
+        committed, first token emitted) hands off to a decode replica
+        via the migration path — no policy gate, the role split IS the
+        policy.  A sequence that cannot move right now (no routable
+        decode replica, destination full, injected fault) simply
+        retries next step while decoding where it is: specialization
+        degrades to unified serving rather than stalling."""
+        for r in self.replicas:
+            if r.role != "prefill" or not r.live:
+                continue
+            for req in list(r.engine.scheduler.running):
+                if not req.prefill_done:
+                    continue
+                self._try_migrate(req.request_id, r, use_policy=False,
+                                  role="decode")
+
     def kill_replica(self, index):
         """Simulate replica process death (the chaos surface behind
         "replica"/"kill" faults).  Returns False if already dead."""
@@ -575,10 +913,14 @@ class Fleet:
         """Rolling drain for zero-downtime restart: the replica leaves
         the routing pool, its WAITING requests reroute to peers (their
         pages were never computed — nothing is lost), its RUNNING ones
-        finish in place, and once empty it parks ``drained``.  With no
-        routable peer the waiting requests stay put and the drain just
-        takes longer — a drain never drops work.  Returns False if the
-        replica is dead or already drained."""
+        MIGRATE to peers (policy-gated KV page handoff — drain latency
+        stops being proportional to the longest running generation),
+        and once empty it parks ``drained``.  A sequence that cannot
+        migrate (policy says recompute, no peer has room, the attempt
+        faults) finishes in place; with no routable peer the waiting
+        requests stay put too and the drain just takes longer — a
+        drain never drops work.  Returns False if the replica is dead
+        or already drained."""
         r = self.replicas[index]
         if r.state in (DEAD, DRAINED):
             return False
@@ -592,9 +934,10 @@ class Fleet:
                    for req in list(r.engine.scheduler.waiting)]
         for rid in waiting:
             fr = self._live.get(rid)
-            if fr is None or fr.replica != r.index:
+            if fr is None or fr.replica != r.index or fr.aborting:
                 continue
-            pool = self._routable(exclude=r)
+            pool = self._routable(
+                exclude=r, role="prefill" if self.disaggregate else None)
             if not pool:
                 break            # no peer: the drain serves them itself
             # reassign ownership FIRST, then abort the old copy — the
@@ -611,6 +954,8 @@ class Fleet:
             self.stats["requeued"] += 1
             self.events.append((self._step_index, "reroute", rid,
                                 r.index, target.index))
+        for req in list(r.engine.scheduler.running):
+            self._try_migrate(req.request_id, r)
         return True
 
     def restart_replica(self, index):
@@ -715,6 +1060,11 @@ class Fleet:
                    drains=self.stats["drains"],
                    restarts=self.stats["restarts"],
                    lost=self.stats["lost"],
+                   migrated=self.stats["migrated"],
+                   migration_recomputed=self.stats[
+                       "migration_recomputed"],
+                   migration_failed=self.stats["migration_failed"],
+                   migrated_bytes=self.stats["migrated_bytes"],
                    replicas=len(self.replicas),
                    replicas_live=sum(1 for r in self.replicas if r.live))
         return agg

@@ -183,7 +183,11 @@ class TestHealthChecker:
             warnings.simplefilter("ignore", RuntimeWarning)
             outs = _drive(fleet)
         assert fleet.replica_states()[1] == "dead"
-        assert fleet.stats["requeued"] > 0
+        # heartbeat death is ENGINE-ALIVE: the object still holds its
+        # pages, so running sequences migrate (zero recompute) and only
+        # never-admitted ones replay from scratch
+        assert fleet.stats["migrated"] + fleet.stats["requeued"] > 0
+        assert fleet.stats["migrated"] >= 1
         assert all(outs[r].ok for r in rids)
         # degraded -> dead walked the full hysteresis ladder
         kinds = [e[1] for e in fleet.events
