@@ -773,7 +773,7 @@ class CompileWatcher:
 
     Two idioms::
 
-        with CompileWatcher(eng._chunk, eng._decode):
+        with CompileWatcher(eng._ragged):
             serve_traffic()             # raises if anything compiled
 
         watcher = eng.warmup()          # armed at warmup exit

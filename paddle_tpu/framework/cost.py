@@ -790,10 +790,10 @@ def run_census(engine, *, memory_budget=None, profile="tpu-v4",
         findings.append(Finding(
             "B001", ERROR, "census",
             f"warmup grid compiles {len(entries)} executables "
-            f"(threshold {max_executables}) — {fam}. The verify "
-            "family grows multiplicatively (decode buckets x draft "
-            "buckets); collapsing the grid into one ragged executable "
-            "family (ROADMAP item 1) is the fix, and this census "
-            "count is its regression baseline"))
+            f"(threshold {max_executables}) — {fam}. The shipped grid "
+            "is ONE ragged family, O(log token_budget) buckets; growth "
+            "past the threshold means a new executable kind (or an "
+            "unbucketed shape) leaked past the ragged collapse this "
+            "census count is the regression baseline for"))
 
     return Census(entries, families, memory, findings, profile)

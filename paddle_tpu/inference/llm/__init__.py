@@ -9,9 +9,12 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
 - scheduler:      iteration-level continuous batching with a per-step
                   token budget, chunked prefill mixed with decodes,
                   preempt-on-OOM and power-of-two shape bucketing
-- paged_attention: block-table attention dispatch for decode AND
-                  prefill chunks (Pallas kernels on TPU, masked-XLA
-                  gather fallback everywhere)
+- paged_attention: block-table ragged attention dispatch — ONE entry
+                  point (paged_ragged_attention) covers decode, verify,
+                  and prefill-chunk rows via per-row descriptors
+                  (Pallas ragged kernel on TPU, masked-XLA gather
+                  fallback everywhere); the per-phase entry points
+                  remain as thin wrappers over it
 - spec:           model-free speculative decoding — prompt-lookup
                   n-gram drafter (NgramDrafter / SpeculativeConfig);
                   the engine scores K drafts + 1 bonus position per
@@ -73,11 +76,14 @@ from .paged_attention import (  # noqa: F401
     paged_decode_attention_xla,
     paged_prefill_attention,
     paged_prefill_attention_xla,
+    paged_ragged_attention,
+    paged_ragged_attention_xla,
     paged_verify_attention,
     paged_verify_attention_xla,
 )
 from .scheduler import (  # noqa: F401
     PrefillChunk,
+    RaggedRow,
     Request,
     ScheduledBatch,
     Scheduler,
@@ -90,11 +96,13 @@ from .spec import (  # noqa: F401
 
 __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
-           "ScheduledBatch", "LLMEngine", "AsyncLLMEngine", "RequestOutput",
+           "RaggedRow", "ScheduledBatch", "LLMEngine", "AsyncLLMEngine",
+           "RequestOutput",
            "NgramDrafter", "SpeculativeConfig", "rollback_draft_reservation",
            "Fleet", "HealthConfig", "MigrationPolicy", "Replica", "Router",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",
            "MigrationError", "PoolLostError", "RetryPolicy", "StepWatchdog",
            "paged_decode_attention", "paged_decode_attention_xla",
            "paged_prefill_attention", "paged_prefill_attention_xla",
+           "paged_ragged_attention", "paged_ragged_attention_xla",
            "paged_verify_attention", "paged_verify_attention_xla"]

@@ -4,51 +4,45 @@ The serving counterpart of incubate.nn.FusedMultiTransformer: the same
 stacked-params lax.scan decoder, but the KV cache is one paged pool
 ([L, num_blocks, block_size, Nkv, D] per K and V) shared by every
 in-flight request, so the engine runs MANY requests of ragged lengths
-through exactly two families of jitted executables:
+through exactly ONE family of jitted executables:
 
-- chunk: one sequence, one prefill CHUNK of at most ``token_budget``
-  prompt tokens padded to a power-of-two chunk bucket; writes the
-  chunk's K/V through the block table and attends over every earlier
-  position THROUGH THE POOL, so prior chunks and prefix-cache hits are
-  read back instead of recomputed.  The final chunk returns the first
-  generated token.  The executable family is bounded by the chunk
-  buckets (floor 8, cap token_budget) — NOT by prompt length, so a 4k
-  prompt compiles nothing a 64-token prompt didn't.
-- decode: the whole running set padded to a power-of-two batch bucket;
-  gathers K/V through block tables (Pallas paged kernel on TPU, masked
-  XLA gather elsewhere), appends one token per sequence.
+- ragged: the step's query tokens — prefill chunks, plain decodes, and
+  speculative-verify rows alike — packed back-to-back into one flat
+  token batch padded to a power-of-two TOKEN bucket (floor 8, cap
+  token_budget), with per-row ``(query_start, query_len, context_len)``
+  descriptors (scheduler.RaggedRow) saying which tokens belong to whom.
+  Each token writes its K/V through its row's block table and attends
+  over every earlier position THROUGH THE POOL (prior chunks and
+  prefix-cache hits are read back, not recomputed; on TPU the Pallas
+  ragged kernel, masked XLA gather elsewhere).  A decode row is a
+  one-token chunk; a verify row carries its n-gram DRAFT tokens (see
+  spec.py) plus one bonus position, with greedy acceptance (longest
+  draft prefix matching the target argmax) keeping speculative output
+  bitwise identical to plain decode; a prefill chunk's final slice
+  yields the request's first generated token.  The executable family
+  is O(log token_budget) — it grows with neither prompt length, batch
+  size, nor draft depth, and one device step genuinely MIXES phases:
+  decodes keep flowing inside the same launch that advances a long
+  prompt's chunks.
 
-- verify (``speculative=``): speculative decoding's scoring step — the
-  decode body over a flattened [Bb * (Kb+1), 1] row batch, so each
-  running sequence gets its n-gram DRAFT tokens (see spec.py) plus one
-  bonus position scored through the pool in a single launch.  Greedy
-  acceptance (longest draft prefix matching the target argmax) makes
-  speculative output bitwise identical to plain decode; sampled
-  requests consume one gumbel draw per emitted token, so seeded
-  streams match too.  The family is bucketed over (batch, K) powers of
-  two and covered by warmup/CompileWatcher like everything else.
-
-One scheduler step may launch both: the decode batch first, then each
-scheduled prefill chunk (the scheduler's token budget keeps decodes
-flowing between a long prompt's chunks instead of stalling them).
 Prefix caching rides on the block manager: every page a sequence
 completes is registered under its prefix-chain hash, and admission
 adopts matching pages at zero compute.
 
-Both executables donate the cache buffers (the pool is updated in place
-in HBM) and contain no host round-trip between launch and the sampled
+The executable donates the cache buffers (the pool is updated in place
+in HBM) and contains no host round-trip between launch and the sampled
 token ids — the only sync is fetching the step's token vector to drive
 the scheduler (plus the logits ROWS of requests that actually sample;
-greedy-only batches transfer exactly the [Bb] token vector).  Compiles
-are bounded by the bucket grids; steady-state serving reuses warm
-executables regardless of traffic mix.
+greedy-only batches transfer exactly the per-token argmax vector).
+Compiles are bounded by the token buckets; steady-state serving reuses
+warm executables regardless of traffic mix.
 
-Tensor parallelism (``mesh=`` / ``tensor_parallel=``): the same two
-executables span a device mesh with an ``'mp'`` axis.  Params shard
+Tensor parallelism (``mesh=`` / ``tensor_parallel=``): the same
+executable spans a device mesh with an ``'mp'`` axis.  Params shard
 Megatron-style — qkv/fc_in column-parallel, proj/fc_out row-parallel
 with an explicit psum — and the paged K/V pools shard along the HEAD
 axis ([L, NB, bs, Nkv/mp, D] per device), so each device runs its head
-slice of paged_prefill/decode_attention against its LOCAL pool shard.
+slice of paged_ragged_attention against its LOCAL pool shard.
 The whole step body runs under ``jax.shard_map`` (the paged Pallas
 kernels index the pool through scalar-prefetched block tables, which
 GSPMD cannot partition, so the kernel always sees a fully local pool),
@@ -84,11 +78,7 @@ from .faults import (
     RetryPolicy,
     StepWatchdog,
 )
-from .paged_attention import (
-    paged_decode_attention,
-    paged_prefill_attention,
-    paged_verify_attention,
-)
+from .paged_attention import paged_ragged_attention
 from .scheduler import FINISHED, RUNNING, Request, Scheduler, bucket_size
 from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
 
@@ -331,7 +321,7 @@ class LLMEngine:
         self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
                       "chunk_launches": 0, "tokens_generated": 0,
                       "spec_steps": 0, "draft_tokens": 0,
-                      "accepted_tokens": 0,
+                      "accepted_tokens": 0, "mixed_steps": 0,
                       # lifecycle/fault counters (lifecycle_stats())
                       "aborted": 0, "deadline_missed": 0, "shed": 0,
                       "retries": 0, "quarantined": 0, "step_faults": 0}
@@ -429,53 +419,37 @@ class LLMEngine:
             w = params["embed"]["word_embeddings.weight"]
             return x @ w.T.astype(self.dtype)
 
-        def chunk_fn(params, ids, kc, vc, block_table, start, length):
-            """ids [1, Cb] — one sequence's prefill chunk padded to the
-            bucket, occupying absolute positions start..start+length-1.
-            Writes the chunk's K/V through the block table, attends
-            causally over positions 0..start+length-1 THROUGH THE POOL
-            (prior chunks and prefix-cache hits are read back, not
-            recomputed), and returns (next_id, logits at the chunk's
-            last row, kc, vc) — meaningful only for the final chunk."""
+        def ragged_fn(params, ids, kc, vc, block_tables, positions,
+                      rows, row_start, row_qlen, row_pos0):
+            """THE executable: one ragged token batch covers every
+            serving phase.  ids [Tb] — the step's query tokens packed
+            back-to-back and padded to the token bucket; positions [Tb]
+            is each token's absolute position (-1 for padding: page
+            writes drop, outputs are never read); rows [Tb] maps each
+            token to its block-table row.  row_start/row_qlen/row_pos0
+            [R = max_batch] are the per-row ragged descriptors the
+            Pallas kernel consumes (see paged_attention.py for the
+            dual-descriptor contract; R is FIXED, so only the token
+            axis buckets).
+
+            A decode row is one query token, a speculative-verify row
+            is 1 + K draft tokens, a prefill chunk is a C-token slice —
+            identical causal semantics: after the per-layer scatter
+            (every query's K/V lands before attention reads), the token
+            at position p attends over pool positions 0..p through its
+            row's table.  Every per-element reduction (projections,
+            attention scores, softmax, layernorm, head) matches the
+            retired per-phase graphs', so outputs are bitwise the
+            chunk/decode/verify steps the old engine ran — the retired
+            decode/verify bodies' pre-scale dance (q times
+            ``scale * sqrt(hd)``, exactly 1.0) is dropped outright.
+            Returns (argmax [Tb], logits [Tb, V], kc, vc)."""
             emb = params["embed"]
-            cb = ids.shape[1]
-            tok = jnp.arange(cb)
-            # padded rows past ``length`` clamp to a valid position; their
-            # page writes drop and their outputs are never read
-            pos = jnp.minimum(start + tok, self.max_model_len - 1)
-            x = (emb["word_embeddings.weight"][ids]
-                 + emb["position_embeddings.weight"][pos][None])
-            x = x.astype(self.dtype)
-            slots = jnp.where(tok < length,
-                              block_table[pos // bs] * bs + pos % bs,
-                              nb * bs)
-
-            def layer(carry, xs):
-                x = carry
-                p_l, kc_l, vc_l = xs
-                q, k, v = attn_proj(p_l, x)
-                kc_l = scatter_pages(kc_l, slots, k[0])
-                vc_l = scatter_pages(vc_l, slots, v[0])
-                out = paged_prefill_attention(q, kc_l, vc_l,
-                                              block_table, start)
-                out = out.astype(x.dtype).reshape(1, cb, nh_l * hd)
-                return mlp_residual(p_l, x, out), (kc_l, vc_l)
-
-            x, (kc, vc) = jax.lax.scan(layer, x,
-                                       (params["blocks"], kc, vc))
-            logits = head_logits(params, x[0, length - 1])
-            return jnp.argmax(logits, -1), logits, kc, vc
-
-        def decode_fn(params, ids, kc, vc, block_tables, positions):
-            """ids [Bb, 1]; positions [Bb] = cached length per row, -1 for
-            padded rows.  Returns (next_ids [Bb], logits [Bb, V], kc, vc)."""
-            emb = params["embed"]
+            tb = ids.shape[0]
             p_safe = jnp.maximum(positions, 0)
             x = (emb["word_embeddings.weight"][ids]
-                 + emb["position_embeddings.weight"][p_safe][:, None])
-            x = x.astype(self.dtype)
-            bb = ids.shape[0]
-            rows = jnp.arange(bb)
+                 + emb["position_embeddings.weight"][p_safe])
+            x = x.astype(self.dtype)[None]           # [1, Tb, hidden]
             slot = (block_tables[rows, p_safe // bs] * bs + p_safe % bs)
             slots = jnp.where(positions >= 0, slot, nb * bs)
             ctx = p_safe + jnp.where(positions >= 0, 1, 0)
@@ -483,79 +457,19 @@ class LLMEngine:
             def layer(carry, xs):
                 x = carry
                 p_l, kc_l, vc_l = xs
-                q, k, v = attn_proj(p_l, x)
-                kc_l = scatter_pages(kc_l, slots, k[:, 0])
-                vc_l = scatter_pages(vc_l, slots, v[:, 0])
-                # mirror the decode_attention IR pass rewrite exactly
-                # (framework/ir.py): pre-scale q, kernel divides sqrt(D)
-                scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
-                q = q * (scale * jnp.sqrt(jnp.asarray(hd, q.dtype)))
-                out = paged_decode_attention(q[:, 0], kc_l, vc_l,
-                                             block_tables, ctx)
-                out = out.astype(x.dtype).reshape(bb, 1, nh_l * hd)
+                q, k, v = attn_proj(p_l, x)       # [1, Tb, nh_l, hd]
+                kc_l = scatter_pages(kc_l, slots, k[0])
+                vc_l = scatter_pages(vc_l, slots, v[0])
+                out = paged_ragged_attention(q[0], kc_l, vc_l,
+                                             block_tables, ctx, rows,
+                                             row_start, row_qlen,
+                                             row_pos0)
+                out = out.astype(x.dtype).reshape(1, tb, nh_l * hd)
                 return mlp_residual(p_l, x, out), (kc_l, vc_l)
 
             x, (kc, vc) = jax.lax.scan(layer, x,
                                        (params["blocks"], kc, vc))
-            logits = head_logits(params, x[:, 0])
-            return jnp.argmax(logits, -1), logits, kc, vc
-
-        def verify_fn(params, ids, kc, vc, block_tables, positions, lens):
-            """Speculative verify: score Kb+1 positions per sequence in
-            ONE device step.  ids [Bb, Kb+1] — row b holds the last
-            committed token then that row's draft tokens (zero-padded);
-            positions [Bb] = cached length per row (-1 for padded rows);
-            lens [Bb] = live query tokens per row (1 + num drafts, 0 for
-            padding).
-
-            The body is the decode graph with Kb+1 query tokens per
-            sequence: query (b, j) sits at position positions[b]+j, so
-            after the per-layer scatter (every query's K/V lands before
-            attention reads) its causal window covers exactly the
-            committed prefix plus drafts 0..j-1 — bitwise the decode
-            step the engine would have run after committing j draft
-            tokens, because every per-element reduction (projections,
-            attention scores, softmax, layernorm, head) matches the
-            single-token decode graph's.  Future drafts sit in the pool
-            but are masked by each query's context length; attention
-            gathers each sequence's pages once for all Kb+1 queries
-            (see paged_verify_attention).  Returns (argmax [Bb, Kb+1],
-            logits [Bb, Kb+1, V], kc, vc)."""
-            emb = params["embed"]
-            bb, kb1 = ids.shape
-            offs = jnp.arange(kb1, dtype=jnp.int32)[None, :]
-            pos = jnp.where(offs < lens[:, None],
-                            positions[:, None] + offs, -1)   # [Bb, Kb1]
-            p_safe = jnp.maximum(pos, 0)
-            x = (emb["word_embeddings.weight"][ids]
-                 + emb["position_embeddings.weight"][p_safe])
-            x = x.astype(self.dtype)
-            flat_pos = p_safe.reshape(-1)
-            rows = jnp.repeat(jnp.arange(bb), kb1)
-            slot = (block_tables[rows, flat_pos // bs] * bs
-                    + flat_pos % bs)
-            slots = jnp.where(pos.reshape(-1) >= 0, slot, nb * bs)
-            ctx = jnp.where(pos >= 0, p_safe + 1, 0)         # [Bb, Kb1]
-
-            def layer(carry, xs):
-                x = carry
-                p_l, kc_l, vc_l = xs
-                q, k, v = attn_proj(p_l, x)      # [Bb, Kb1, nh_l, hd]
-                kc_l = scatter_pages(kc_l, slots,
-                                     k.reshape(bb * kb1, nh_l, hd))
-                vc_l = scatter_pages(vc_l, slots,
-                                     v.reshape(bb * kb1, nh_l, hd))
-                # same pre-scale dance as decode_fn (mirrors the IR pass)
-                scale = 1.0 / jnp.sqrt(jnp.asarray(hd, x.dtype))
-                q = q * (scale * jnp.sqrt(jnp.asarray(hd, q.dtype)))
-                out = paged_verify_attention(q, kc_l, vc_l,
-                                             block_tables, ctx)
-                out = out.astype(x.dtype).reshape(bb, kb1, nh_l * hd)
-                return mlp_residual(p_l, x, out), (kc_l, vc_l)
-
-            x, (kc, vc) = jax.lax.scan(layer, x,
-                                       (params["blocks"], kc, vc))
-            logits = head_logits(params, x)          # [Bb, Kb1, V]
+            logits = head_logits(params, x[0])       # [Tb, V]
             return jnp.argmax(logits, -1), logits, kc, vc
 
         if tp > 1:
@@ -583,15 +497,10 @@ class LLMEngine:
                     out_shardings=(rsh, rsh, csh, csh),
                     donate_argnums=(2, 3))
 
-            self._chunk = tp_wrap(chunk_fn, 3)    # table, start, length
-            self._decode = tp_wrap(decode_fn, 2)  # tables, positions
-            self._verify = (tp_wrap(verify_fn, 3)  # tables, positions, lens
-                            if self.spec else None)
+            # tables, positions, rows, row_start, row_qlen, row_pos0
+            self._ragged = tp_wrap(ragged_fn, 6)
         else:
-            self._chunk = jax.jit(chunk_fn, donate_argnums=(2, 3))
-            self._decode = jax.jit(decode_fn, donate_argnums=(2, 3))
-            self._verify = (jax.jit(verify_fn, donate_argnums=(2, 3))
-                            if self.spec else None)
+            self._ragged = jax.jit(ragged_fn, donate_argnums=(2, 3))
 
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
@@ -747,35 +656,20 @@ class LLMEngine:
     def _bucket_grid(self):
         """The complete executable family: every (kind, bucket) pair
         serving can ever launch.  Single source of truth for warmup(),
-        executable_grid(), and the static-analysis sweep."""
-        cb = min(8, self.token_budget)
+        executable_grid(), and the static-analysis sweep.
+
+        ONE family now — "ragged" over total query tokens, powers of
+        two from 8 up to the token budget.  The batch axis is fixed at
+        max_batch rows of descriptors, the draft depth folds into the
+        token count, so the grid is O(log token_budget) where the
+        retired per-phase grid was O(log chunks + log batches
+        + log batches * log K)."""
+        tb = min(8, self.token_budget)
         while True:
-            yield ("chunk", cb)
-            if cb >= self.token_budget:
+            yield ("ragged", tb)
+            if tb >= self.token_budget:
                 break
-            cb = min(cb * 2, self.token_budget)
-        bb = 1
-        while True:
-            yield ("decode", bb)
-            if bb >= self.max_batch:
-                break
-            bb = min(bb * 2, self.max_batch)
-        if self.spec is None:
-            return
-        # verify family: (batch bucket, draft bucket) pairs — K is
-        # bucketed to powers of two too, so the family stays
-        # O(log(max_batch) * log(K)) and warmup covers every launch
-        kb = 1
-        while True:
-            bb = 1
-            while True:
-                yield ("verify", (bb, kb))
-                if bb >= self.max_batch:
-                    break
-                bb = min(bb * 2, self.max_batch)
-            if kb >= self.spec.num_tokens:
-                break
-            kb = min(kb * 2, self.spec.num_tokens)
+            tb = min(tb * 2, self.token_budget)
 
     def executable_grid(self):
         """Yield ``(kind, bucket, jitted_fn, abstract_args)`` covering
@@ -786,22 +680,13 @@ class LLMEngine:
         kc = sds(self._kc.shape, self._kc.dtype)
         vc = sds(self._vc.shape, self._vc.dtype)
         i32 = jnp.int32
-        for kind, b in self._bucket_grid():
-            if kind == "chunk":
-                args = (self.params, sds((1, b), i32), kc, vc,
-                        sds((self.max_pages,), i32), sds((), i32),
-                        sds((), i32))
-                yield kind, b, self._chunk, args
-            elif kind == "verify":
-                bb, kb = b
-                args = (self.params, sds((bb, kb + 1), i32), kc, vc,
-                        sds((bb, self.max_pages), i32), sds((bb,), i32),
-                        sds((bb,), i32))
-                yield kind, b, self._verify, args
-            else:
-                args = (self.params, sds((b, 1), i32), kc, vc,
-                        sds((b, self.max_pages), i32), sds((b,), i32))
-                yield kind, b, self._decode, args
+        rmax = self.max_batch
+        for kind, tb in self._bucket_grid():
+            args = (self.params, sds((tb,), i32), kc, vc,
+                    sds((rmax, self.max_pages), i32), sds((tb,), i32),
+                    sds((tb,), i32), sds((rmax,), i32),
+                    sds((rmax,), i32), sds((rmax,), i32))
+            yield kind, tb, self._ragged, args
 
     def memory_model(self, memory_budget=None):
         """Static per-chip HBM breakdown — weight bytes (sharding-
@@ -815,54 +700,49 @@ class LLMEngine:
     def warmup(self):
         """Compile every bucketed executable before traffic arrives.
 
-        No-op on cache contents: the dummy chunk covers zero tokens and
-        the dummy decode rows are padding (position -1), so every page
-        write lands on the dropped out-of-range slot.  Serving processes
-        call this at startup so no client pays a compile stall.  The
-        chunk family is O(log token_budget) — prompt length never enters
-        the executable count.  Under TP the same walk compiles the
-        sharded executables over the mesh (the bucket grid is identical:
-        shapes are global, only shardings differ).
+        No-op on cache contents: every dummy row is dead (row_qlen 0,
+        position -1), so every page write lands on the dropped
+        out-of-range slot.  Serving processes call this at startup so
+        no client pays a compile stall.  The ragged family is
+        O(log token_budget) — neither prompt length, batch size, nor
+        draft depth enters the executable count.  Under TP the same
+        walk compiles the sharded executables over the mesh (the bucket
+        grid is identical: shapes are global, only shardings differ).
 
         Returns a :class:`~paddle_tpu.framework.analysis.CompileWatcher`
-        armed over the freshly-warm chunk/decode executables, so callers
-        can assert the serving window compiles nothing::
+        armed over the freshly-warm ragged executable, so callers can
+        assert the serving window compiles nothing; the watcher also
+        carries ``compile_ms`` — wall-clock per warmed bucket (compile
+        + one dummy run), keyed ``"ragged[<bucket>]"`` and mirrored on
+        ``engine.warmup_compile_ms`` — so the family collapse is a
+        measured claim::
 
             watcher = eng.warmup()
             serve_traffic()
             watcher.assert_no_new_compiles()
+            watcher.compile_ms       # {"ragged[8]": ..., ...}
         """
+        timings = {}
+        rmax = self.max_batch
         with profiler.RecordEvent("llm_engine::warmup"):
-            for kind, b in self._bucket_grid():
-                if kind == "chunk":
-                    ids = jnp.zeros((1, b), jnp.int32)
-                    table = jnp.zeros(self.max_pages, jnp.int32)
-                    _, _, self._kc, self._vc = self._chunk(
-                        self.params, ids, self._kc, self._vc, table,
-                        jnp.int32(0), jnp.int32(0))
-                elif kind == "verify":
-                    bb, kb = b
-                    ids = jnp.zeros((bb, kb + 1), jnp.int32)
-                    tables = jnp.zeros((bb, self.max_pages), jnp.int32)
-                    positions = jnp.full((bb,), -1, jnp.int32)
-                    lens = jnp.zeros((bb,), jnp.int32)
-                    _, _, self._kc, self._vc = self._verify(
-                        self.params, ids, self._kc, self._vc, tables,
-                        positions, lens)
-                else:
-                    ids = jnp.zeros((b, 1), jnp.int32)
-                    tables = jnp.zeros((b, self.max_pages), jnp.int32)
-                    positions = jnp.full((b,), -1, jnp.int32)
-                    _, _, self._kc, self._vc = self._decode(
-                        self.params, ids, self._kc, self._vc, tables,
-                        positions)
+            for kind, tb in self._bucket_grid():
+                t0 = time.perf_counter()
+                ids = jnp.zeros((tb,), jnp.int32)
+                tables = jnp.zeros((rmax, self.max_pages), jnp.int32)
+                positions = jnp.full((tb,), -1, jnp.int32)
+                rows = jnp.zeros((tb,), jnp.int32)
+                zr = jnp.zeros((rmax,), jnp.int32)
+                _, _, self._kc, self._vc = self._ragged(
+                    self.params, ids, self._kc, self._vc, tables,
+                    positions, rows, zr, zr, zr)
+                jax.block_until_ready(self._kc)
+                timings[f"{kind}[{tb}]"] = \
+                    (time.perf_counter() - t0) * 1e3
         from ...framework.analysis import CompileWatcher
-        fns = [self._chunk, self._decode]
-        labels = ["chunk", "decode"]
-        if self._verify is not None:
-            fns.append(self._verify)
-            labels.append("verify")
-        return CompileWatcher(*fns, labels=tuple(labels))
+        self.warmup_compile_ms = dict(timings)
+        watcher = CompileWatcher(self._ragged, labels=("ragged",))
+        watcher.compile_ms = dict(timings)
+        return watcher
 
     # --------------------------------------------------------------- step --
     def step(self):
@@ -895,45 +775,7 @@ class LLMEngine:
         if batch.kind == "idle":
             return finished
         self.stats["steps"] += 1
-        reqs = batch.requests
-        if reqs:
-            self.stats["decode_steps"] += 1
-            if any(r.draft_tokens for r in reqs):
-                self._verify_step(reqs, finished)
-            else:
-                self._decode_step(reqs, finished)
-        if batch.chunks:
-            self.stats["prefill_steps"] += 1
-        for ch in batch.chunks:
-            req = ch.request
-            if req.status == FINISHED:
-                continue        # quarantined earlier this same step
-            self.stats["chunk_launches"] += 1
-            cb = bucket_size(ch.length, self.token_budget, floor=8)
-            ids = np.zeros((1, cb), np.int32)
-            ids[0, :ch.length] = \
-                req.all_ids[ch.start:ch.start + ch.length]
-            table = np.zeros(self.max_pages, np.int32)
-            bt = self.block_manager.block_table(req.request_id)
-            table[:len(bt)] = bt
-
-            def launch_chunk(ids=ids, table=table, ch=ch):
-                with profiler.RecordEvent("llm_engine::prefill_chunk"):
-                    return self._chunk(
-                        self.params, jnp.asarray(ids), self._kc,
-                        self._vc, jnp.asarray(table),
-                        jnp.int32(ch.start), jnp.int32(ch.length))
-
-            out = self._launch("chunk", [req], launch_chunk)
-            if out is None:
-                continue        # quarantined; pages already reclaimed
-            nxt, logits, self._kc, self._vc = out
-            req.num_cached = ch.start + ch.length
-            self._register_full_blocks(req)
-            if ch.is_final:
-                # logits is a device [V] vector; the commit fetches it
-                # only when this request samples
-                self._commit_tokens([(req, nxt, logits)], finished)
+        self._ragged_step(batch, finished)
         if self.tp > 1:
             # ONE host-side allocator drives every shard (tables ride
             # replicated), so page accounting must be shard-invariant:
@@ -1005,10 +847,12 @@ class LLMEngine:
                       f"failed {kind} step: {msg}", RuntimeWarning,
                       stacklevel=3)
         for req in reqs:
-            if kind != "chunk":
-                # decode rows reserved 1 slot, verify rows 1 + K; give
-                # them back so survivors' books read exactly num_cached
-                rollback_draft_reservation(self.block_manager, req)
+            # decode rows reserved 1 slot, verify rows 1 + K; give them
+            # back so survivors' books read exactly num_cached.  Chunk
+            # rows of the ragged launch hold a PROMPT allocation, not a
+            # step reservation — rollback_draft_reservation no-ops on
+            # them (mid-prefill sequences are never prefill_done)
+            rollback_draft_reservation(self.block_manager, req)
         for req in victims:
             self.scheduler.abort(req)
             self.stats["quarantined"] += 1
@@ -1149,84 +993,135 @@ class LLMEngine:
         self.scheduler.abort(req)
         self.events.append((self._step_index, "release", request_id))
 
-    def _decode_step(self, reqs, finished):
-        """Plain decode: one token per running sequence."""
-        bb = bucket_size(len(reqs), self.max_batch)
-        ids = np.zeros((bb, 1), np.int32)
-        positions = np.full(bb, -1, np.int32)
-        tables = np.zeros((bb, self.max_pages), np.int32)
-        for i, r in enumerate(reqs):
-            ids[i, 0] = r.all_ids[-1]
-            positions[i] = r.num_cached
-            bt = self.block_manager.block_table(r.request_id)
-            tables[i, :len(bt)] = bt
+    def _ragged_step(self, batch, finished):
+        """ONE unified launch for the whole scheduled step: every row —
+        plain decode, speculative verify, prefill chunk — packs into a
+        single flat token batch padded to the total-token bucket, and
+        commits replay the retired engine's order exactly (decode/verify
+        rows in scheduler order first, then chunks in schedule order),
+        so seeded RNG streams and page bookkeeping are bitwise
+        unchanged."""
+        rows = [row for row in batch.rows
+                if row.request.status != FINISHED]
+        if not rows:
+            return
+        has_decode = any(row.kind != "chunk" for row in rows)
+        has_chunk = any(row.kind == "chunk" for row in rows)
+        if has_decode:
+            self.stats["decode_steps"] += 1
+        if has_chunk:
+            self.stats["prefill_steps"] += 1
+            self.stats["chunk_launches"] += \
+                sum(1 for row in rows if row.kind == "chunk")
+        if has_decode and has_chunk:
+            self.stats["mixed_steps"] += 1
 
-        def launch_decode():
-            with profiler.RecordEvent("llm_engine::decode"):
-                return self._decode(
-                    self.params, jnp.asarray(ids), self._kc, self._vc,
-                    jnp.asarray(tables), jnp.asarray(positions))
+        total = sum(row.length for row in rows)
+        tb = bucket_size(total, self.token_budget, floor=8)
+        rmax = self.max_batch
+        ids = np.zeros(tb, np.int32)
+        positions = np.full(tb, -1, np.int32)
+        tok_rows = np.zeros(tb, np.int32)
+        tables = np.zeros((rmax, self.max_pages), np.int32)
+        row_start = np.zeros(rmax, np.int32)
+        row_qlen = np.zeros(rmax, np.int32)
+        row_pos0 = np.zeros(rmax, np.int32)
+        starts = []
+        s = 0
+        for ri, row in enumerate(rows):
+            req = row.request
+            starts.append(s)
+            if row.kind == "chunk":
+                toks = req.all_ids[row.start:row.start + row.length]
+            else:
+                toks = [req.all_ids[-1]] + list(req.draft_tokens)
+            ids[s:s + row.length] = toks
+            positions[s:s + row.length] = np.arange(
+                row.start, row.start + row.length)
+            tok_rows[s:s + row.length] = ri
+            bt = self.block_manager.block_table(req.request_id)
+            tables[ri, :len(bt)] = bt
+            row_start[ri] = s
+            row_qlen[ri] = row.length
+            row_pos0[ri] = row.start
+            s += row.length
 
-        out = self._launch("decode", reqs, launch_decode)
-        if out is None:
-            return              # quarantined; survivors retry next step
-        nxt, logits, self._kc, self._vc = out
-        nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per decode step)
-        row_logits = self._fetch_sampling_rows(reqs, logits)
-        entries = []
-        for i, r in enumerate(reqs):
-            r.num_cached += 1
-            if r.num_cached % self.block_size == 0:
-                self._register_full_blocks(r)
-            entries.append((r, nxt[i], row_logits.get(i)))
-        self._commit_tokens(entries, finished)
-
-    def _verify_step(self, reqs, finished):
-        """Speculative decode: score every row's drafts (plus the bonus
-        position) in one verify launch, then commit the accepted run."""
-        self.stats["spec_steps"] += 1
-        kb = bucket_size(max(len(r.draft_tokens) for r in reqs),
-                         self.spec.num_tokens)
-        bb = bucket_size(len(reqs), self.max_batch)
-        ids = np.zeros((bb, kb + 1), np.int32)
-        positions = np.full(bb, -1, np.int32)
-        lens = np.zeros(bb, np.int32)
-        tables = np.zeros((bb, self.max_pages), np.int32)
-        for i, r in enumerate(reqs):
-            d = len(r.draft_tokens)
-            ids[i, 0] = r.all_ids[-1]
-            if d:
-                ids[i, 1:1 + d] = r.draft_tokens
-            positions[i] = r.num_cached
-            lens[i] = 1 + d
-            bt = self.block_manager.block_table(r.request_id)
-            tables[i, :len(bt)] = bt
-
-        def launch_verify():
-            with profiler.RecordEvent("llm_engine::verify"):
-                return self._verify(
+        def launch_ragged():
+            with profiler.RecordEvent("llm_engine::ragged"):
+                return self._ragged(
                     self.params, jnp.asarray(ids), self._kc, self._vc,
                     jnp.asarray(tables), jnp.asarray(positions),
-                    jnp.asarray(lens))
+                    jnp.asarray(tok_rows), jnp.asarray(row_start),
+                    jnp.asarray(row_qlen), jnp.asarray(row_pos0))
 
-        out = self._launch("verify", reqs, launch_verify)
+        out = self._launch("ragged", [row.request for row in rows],
+                           launch_ragged)
         if out is None:
             return              # quarantined; reservations rolled back
         nxt, logits, self._kc, self._vc = out
-        nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per verify step)
-        row_logits = self._fetch_sampling_rows(reqs, logits)
-        for i, r in enumerate(reqs):
-            self._commit_verified(r, nxt[i], row_logits.get(i), finished)
+        nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per step)
+        row_logits = self._fetch_sampling_rows(rows, starts, logits)
 
-    def _fetch_sampling_rows(self, reqs, logits):
-        """Fetch ONLY the logits rows of requests that sample: greedy
-        batches transfer just the token vector, and a mixed batch pays
-        for its sampling rows, not the whole [Bb, ...] logits."""
-        samp = [i for i, r in enumerate(reqs) if r.temperature > 0.0]
-        if not samp:
+        # commit phase A: decode/verify rows, in scheduler order — the
+        # same _commit_verified-if-any-drafts-else-vectorized split the
+        # retired per-phase steps made, so gumbel draw order (and thus
+        # seeded output) is bitwise preserved
+        nonchunk = [(ri, row) for ri, row in enumerate(rows)
+                    if row.kind != "chunk"]
+        if any(row.request.draft_tokens for _, row in nonchunk):
+            self.stats["spec_steps"] += 1
+            for ri, row in nonchunk:
+                s0 = starts[ri]
+                self._commit_verified(row.request,
+                                      nxt[s0:s0 + row.length],
+                                      row_logits.get(ri), finished)
+        elif nonchunk:
+            entries = []
+            for ri, row in nonchunk:
+                req = row.request
+                req.num_cached += 1
+                if req.num_cached % self.block_size == 0:
+                    self._register_full_blocks(req)
+                lg = row_logits.get(ri)
+                entries.append((req, nxt[starts[ri]],
+                                None if lg is None else lg[0]))
+            self._commit_tokens(entries, finished)
+        # commit phase B: chunks in schedule order; only the final
+        # chunk's last token emits
+        for ri, row in enumerate(rows):
+            if row.kind != "chunk":
+                continue
+            req, ch = row.request, row.chunk
+            req.num_cached = ch.start + ch.length
+            self._register_full_blocks(req)
+            if ch.is_final:
+                lg = row_logits.get(ri)
+                self._commit_tokens(
+                    [(req, nxt[starts[ri] + row.length - 1],
+                      None if lg is None else lg[0])], finished)
+
+    def _fetch_sampling_rows(self, rows, starts, logits):
+        """Fetch ONLY the logits of tokens that sample: greedy batches
+        transfer just the argmax vector, and a mixed batch pays for its
+        sampling tokens, not the whole [Tb, V] logits.  Returns
+        {row_index: [n, V] host array} — a decode row's single token, a
+        verify row's 1 + K tokens, a FINAL chunk's last token."""
+        idx, spans = [], {}
+        for ri, row in enumerate(rows):
+            if row.request.temperature <= 0.0:
+                continue
+            if row.kind == "chunk":
+                if not row.chunk.is_final:
+                    continue
+                lo, n = starts[ri] + row.length - 1, 1
+            else:
+                lo, n = starts[ri], row.length
+            spans[ri] = (len(idx), n)
+            idx.extend(range(lo, lo + n))
+        if not spans:
             return {}
-        sel = np.asarray(logits[np.asarray(samp, np.int32)])  # noqa: H001 (fetches only the sampling rows)
-        return dict(zip(samp, sel))
+        sel = np.asarray(logits[np.asarray(idx, np.int32)])  # noqa: H001 (fetches only the sampling rows)
+        return {ri: sel[o:o + n] for ri, (o, n) in spans.items()}
 
     def _sample_token(self, req, logits):
         """Gumbel-max sample of one host logits row from the request's

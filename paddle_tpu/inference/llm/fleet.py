@@ -463,9 +463,9 @@ class Fleet:
         eng = LLMEngine(self._model, faults=self._engine_faults[index],
                         **self._engine_kwargs)
         if self._shared_fns is None:
-            self._shared_fns = (eng._chunk, eng._decode, eng._verify)
+            self._shared_fns = (eng._ragged,)
         else:
-            eng._chunk, eng._decode, eng._verify = self._shared_fns
+            (eng._ragged,) = self._shared_fns
         return eng
 
     def warmup(self):

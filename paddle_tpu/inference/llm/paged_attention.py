@@ -1,54 +1,55 @@
-"""Paged attention — backend dispatch for decode AND chunked prefill.
+"""Paged attention — backend dispatch, now ONE ragged entry point.
 
-One signature per phase, two implementations with identical semantics:
+Every serving phase is the same computation: a query token at absolute
+position ``p`` attends over pool positions ``0..p`` through its row's
+block table.  A decode row is a one-token chunk, a speculative-verify
+row is a K+1-token chunk, a prefill chunk is a C-token chunk — so the
+engine launches a single ragged kernel over the step's packed query
+tokens, and the three legacy per-phase entry points below are kept as
+thin re-expressions over it (they remain the public API for tests and
+benchmarks).
 
-- TPU: the Pallas kernels (ops/pallas/paged_attention_kernel.py) DMA
-  exactly the pages a sequence owns via scalar-prefetched block tables.
-- everywhere else (and under jit on CPU test rigs): gather the pages
-  into the dense ragged layout and run the masked attention — for
-  decode, bitwise the same math FusedMultiTransformer's decode hits
-  through the IR pass; for prefill chunks, bitwise the same masked
-  causal chain FusedMultiTransformer's prefill runs.  That shared math
-  is what makes the engine-vs-dense token-exactness tests meaningful.
+Two implementations with identical semantics:
 
-Like the ragged kernel, the 1/sqrt(D) scale is applied inside.
+- TPU: the Pallas ragged kernel (ops/pallas/ragged_attention_kernel.py)
+  DMAs exactly the pages a row owns via scalar-prefetched block tables
+  and per-row ``(query_start, query_len, context_len)`` descriptors.
+- everywhere else (and under jit on CPU test rigs): gather each token's
+  pages into the dense ragged layout and run the masked attention —
+  bitwise the same per-element reductions as the retired per-phase
+  fallbacks (same einsum contraction order, f32 softmax, -1e30 mask),
+  which is what keeps the engine-vs-dense token-exactness tests
+  meaningful across the refactor.
 
-Chunked prefill changes what "prefill attention" means: a chunk's
-queries sit at absolute positions [start, start + C) and must see every
-EARLIER token's K/V — prior chunks and prefix-cache hits included — so
-prefill now reads the paged pool through the block table exactly like
-decode does, instead of attending over its own chunk only.
+Like the kernel, the 1/sqrt(D) scale is applied inside.  Note the
+engine no longer pre-scales query heads before calling in — the old
+decode/verify paths multiplied by ``scale * sqrt(head_dim)`` (exactly
+1.0 for every power-of-two head_dim the models here use) and the
+ragged path drops that identity dance outright.
 
-Speculative verify gets a third entry point with DECODE semantics per
-row: each sequence carries K drafts + 1 bonus position as K+1
-single-token query rows, with per-row context ``lengths`` enforcing
-causality (row j sees positions <= pos+j, so the later drafts already
-scattered into the pool stay masked).  On the XLA path the K+1 rows
-fold into the GQA group axis so the sequence's pages are gathered ONCE
-(the flattened form would re-gather the same pages K+1 times — on CPU
-that redundant traffic eats most of the speculation win); every
-per-element reduction is the same as single-token decode's, so scores
-stay bitwise identical to the decode step the engine would have run.
-On the Pallas path verify flattens into the proven decode kernel — the
-kernel DMAs only the pages a row owns, so redundancy there is cheap
-and no new kernel is needed.
+Speculative verify no longer materializes ``jnp.repeat(block_tables,
+K+1, axis=0)`` (a [B*(K+1), max_pages] int32 copy every verify step):
+under the ragged kernel a sequence's K+1 verify tokens share one row
+descriptor and ONE block-table row.  ``paged_verify_attention_xla`` —
+the fold-T-into-the-GQA-axis gather-once fallback — stays as the
+non-TPU path and keeps its regression test.
 
-Tensor parallelism: both entry points are head-count generic, and
-attention never mixes heads — so the TP engine calls them UNCHANGED
-from inside ``jax.shard_map`` with per-shard shapes (q [.., Nq/mp, D],
-pool [NB, bs, Nkv/mp, D], block tables replicated).  Each shard runs
-its head slice against its LOCAL pool shard; no collective is needed
-until the row-parallel output projection.  This is also why the Pallas
-path survives the mesh: the kernel's scalar-prefetched block-table
-indexing cannot be GSPMD-partitioned, but under shard_map it only ever
-sees fully local operands.
+Tensor parallelism: the ragged entry point is head-count generic and
+attention never mixes heads — the TP engine calls it UNCHANGED from
+inside ``jax.shard_map`` with per-shard shapes (q [T, Nq/mp, D], pool
+[NB, bs, Nkv/mp, D], block tables and row descriptors replicated).
+Each shard runs its head slice against its LOCAL pool shard; no
+collective is needed until the row-parallel output projection.  This
+is also why the Pallas path survives the mesh: scalar-prefetched
+block-table indexing cannot be GSPMD-partitioned, but under shard_map
+it only ever sees fully local operands.
 """
 
 import jax
 import jax.numpy as jnp
 
 from ...framework.flags import get_flags
-from ...ops.pallas import paged_attention_kernel as _kernel
+from ...ops.pallas import ragged_attention_kernel as _kernel
 from ...ops.pallas.decode_attention_kernel import decode_attention_xla
 
 
@@ -56,6 +57,64 @@ def _use_pallas():
     return (jax.default_backend() == "tpu"
             and get_flags("FLAGS_use_pallas_kernels")
             ["FLAGS_use_pallas_kernels"])
+
+
+def paged_ragged_attention_xla(q, k_pages, v_pages, block_tables, ctx,
+                               rows):
+    """Masked-XLA fallback for the ragged batch, per-token form.
+
+    q [T, Nq, D] packed query tokens; ``rows`` [T] maps each token to
+    its block-table row, ``ctx`` [T] is each token's visible context
+    length (0 for dead/padding tokens -> exact-zero output).  Gathers
+    every token's pages and runs decode_attention_xla's exact masked
+    chain (same einsum contraction order, f32 softmax, -1e30 mask), so
+    each output token is bitwise the single-token decode the engine
+    would have run at that position.
+    """
+    t, nq, d = q.shape
+    r, num_pages = block_tables.shape
+    _, bs, nkv, _ = k_pages.shape
+    s_max = num_pages * bs
+    k = k_pages[block_tables].reshape(r, s_max, nkv, d)[rows]
+    v = v_pages[block_tables].reshape(r, s_max, nkv, d)[rows]
+    g = nq // nkv
+    qg = q.reshape(t, nkv, g, d)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    logits = jnp.einsum("tngd,tsnd->tngs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = jnp.arange(s_max)[None, None, None, :] < \
+        ctx[:, None, None, None]
+    logits = jnp.where(mask, logits, jnp.float32(-1e30))
+    p = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("tngs,tsnd->tngd", p, v.astype(jnp.float32))
+    out = jnp.where(ctx[:, None, None, None] > 0, out, 0.0)
+    return out.reshape(t, nq, d).astype(q.dtype)
+
+
+def paged_ragged_attention(q, k_pages, v_pages, block_tables, ctx, rows,
+                           row_start, row_qlen, row_pos0,
+                           interpret=False):
+    """Ragged paged attention over T packed query tokens -> [T, Nq, D].
+
+    Carries BOTH descriptor forms because the two backends want
+    different shapes of the same fact: the XLA fallback is per-token
+    (``ctx`` [T], ``rows`` [T]) while the Pallas kernel is per-row
+    (``row_start``/``row_qlen``/``row_pos0``, each [R], against
+    block_tables [R, P]).  The caller packs rows back-to-back; token
+    ``i`` of row ``r`` sits at absolute position ``row_pos0[r] + i``,
+    so ``ctx`` for it must be ``row_pos0[r] + i + 1`` and 0 outside
+    every row.  Tokens outside every row come back as exact zeros on
+    both paths.
+    """
+    t, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    if ((_use_pallas() or interpret)
+            and _kernel.supports(bs, d, nq, nkv, t)):
+        return _kernel.paged_ragged_attention_pallas(
+            q, k_pages, v_pages, block_tables, row_start, row_qlen,
+            row_pos0, interpret=interpret)
+    return paged_ragged_attention_xla(q, k_pages, v_pages, block_tables,
+                                      ctx, rows)
 
 
 def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, lengths):
@@ -69,12 +128,23 @@ def paged_decode_attention_xla(q, k_pages, v_pages, block_tables, lengths):
 
 def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
                            interpret=False):
-    """q [B, Nq, D] x paged pool -> [B, Nq, D]; lengths masks per row."""
-    _, bs, nkv, d = k_pages.shape
+    """q [B, Nq, D] x paged pool -> [B, Nq, D]; lengths masks per row.
+
+    Re-expressed over the ragged kernel: batch row b is the one-token
+    row (start=b, qlen=1 if live, pos0=lengths[b]-1).  Batches smaller
+    than the ragged chunk width (B % 8 != 0) take the XLA fallback —
+    the engine never does, its token buckets floor at 8.
+    """
+    b, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
     if ((_use_pallas() or interpret)
-            and _kernel.supports(bs, d, q.shape[1], nkv)):
-        return _kernel.paged_decode_attention_pallas(
-            q, k_pages, v_pages, block_tables, lengths, interpret=interpret)
+            and _kernel.supports(bs, d, nq, nkv, b)):
+        return _kernel.paged_ragged_attention_pallas(
+            q, k_pages, v_pages, block_tables,
+            jnp.arange(b, dtype=jnp.int32),
+            (lengths > 0).astype(jnp.int32),
+            jnp.maximum(lengths - 1, 0).astype(jnp.int32),
+            interpret=interpret)
     return paged_decode_attention_xla(q, k_pages, v_pages, block_tables,
                                       lengths)
 
@@ -118,16 +188,20 @@ def paged_verify_attention_xla(q, k_pages, v_pages, block_tables, ctx):
 def paged_verify_attention(q, k_pages, v_pages, block_tables, ctx,
                            interpret=False):
     """q [B, T, Nq, D] verify rows x paged pool -> [B, T, Nq, D]; ctx
-    masks per row.  Pallas path flattens into the decode kernel (it
-    DMAs only owned pages, so per-row gather is cheap there); XLA path
-    gathers once per sequence."""
+    masks per row.  Pallas path: sequence b becomes ragged row
+    (start=b*T, qlen=#live slots, pos0=ctx[b,0]-1) — the live slots of
+    a verify row are always a prefix — sharing ONE block-table row, so
+    no per-token table replication is materialized.  XLA path gathers
+    once per sequence via paged_verify_attention_xla."""
     b, t, nq, d = q.shape
     _, bs, nkv, _ = k_pages.shape
     if ((_use_pallas() or interpret)
-            and _kernel.supports(bs, d, nq, nkv)):
-        flat = _kernel.paged_decode_attention_pallas(
-            q.reshape(b * t, nq, d), k_pages, v_pages,
-            jnp.repeat(block_tables, t, axis=0), ctx.reshape(b * t),
+            and _kernel.supports(bs, d, nq, nkv, b * t)):
+        flat = _kernel.paged_ragged_attention_pallas(
+            q.reshape(b * t, nq, d), k_pages, v_pages, block_tables,
+            jnp.arange(b, dtype=jnp.int32) * t,
+            (ctx > 0).astype(jnp.int32).sum(axis=1),
+            jnp.maximum(ctx[:, 0] - 1, 0).astype(jnp.int32),
             interpret=interpret)
         return flat.reshape(b, t, nq, d)
     return paged_verify_attention_xla(q, k_pages, v_pages, block_tables,
@@ -166,12 +240,19 @@ def paged_prefill_attention_xla(q, k_pages, v_pages, block_table, start):
 def paged_prefill_attention(q, k_pages, v_pages, block_table, start,
                             interpret=False):
     """q [1, C, Nq, D] chunk x paged pool -> [1, C, Nq, D] causal
-    attention over positions 0..start+C-1 through the block table."""
-    _, bs, nkv, d = k_pages.shape
+    attention over positions 0..start+C-1 through the block table.
+    Pallas path: the chunk is the single ragged row (start=0, qlen=C,
+    pos0=start); ``start`` may be traced."""
+    _, c, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
     if ((_use_pallas() or interpret)
-            and _kernel.prefill_supports(bs, d, q.shape[2], nkv,
-                                         q.shape[1])):
-        return _kernel.paged_prefill_attention_pallas(
-            q, k_pages, v_pages, block_table, start, interpret=interpret)
+            and _kernel.supports(bs, d, nq, nkv, c)):
+        out = _kernel.paged_ragged_attention_pallas(
+            q[0], k_pages, v_pages, block_table[None],
+            jnp.zeros((1,), jnp.int32),
+            jnp.full((1,), c, jnp.int32),
+            jnp.reshape(jnp.asarray(start, jnp.int32), (1,)),
+            interpret=interpret)
+        return out[None]
     return paged_prefill_attention_xla(q, k_pages, v_pages, block_table,
                                        start)

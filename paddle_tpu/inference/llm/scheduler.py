@@ -15,13 +15,14 @@ while a 4k-token prompt prefills).  Admission consults the prefix cache
 first: pages whose chain hash is already resident are adopted at zero
 compute, so only the un-cached suffix consumes budget.
 
-Shape discipline for XLA: a jitted executable exists per (kind, bucket)
-only — chunk lengths are bucketed to powers of two capped by the token
-budget (NOT by prompt length: the prefill executable family no longer
-grows with max_model_len) and decode batch sizes to powers of two
-capped by max_batch, so warmup compiles
-O(log(max_batch) + log(token_budget)) programs and steady state
-recompiles nothing.
+Shape discipline for XLA: the step's work — decode rows, speculative
+verify rows, prefill chunks alike — packs into ONE ragged token batch
+(each row a ``RaggedRow`` descriptor), and a jitted executable exists
+per TOTAL-TOKEN bucket only: totals are bucketed to powers of two
+capped by the token budget, so warmup compiles O(log(token_budget))
+programs and steady state recompiles nothing.  Because the executable
+no longer encodes the phase, a single device step genuinely mixes
+prefill chunks with decode/verify rows instead of segregating them.
 """
 # noqa-module: H001 (iteration-level scheduling is host-side by design —
 # the scheduler reads finished-token counts and page availability between
@@ -93,10 +94,27 @@ class PrefillChunk:
 
 
 @dataclass
+class RaggedRow:
+    """One row of the step's ragged token batch: ``length`` query
+    tokens for ``request`` at absolute positions [start, start +
+    length).  kind is "decode" (length 1), "verify" (1 + K drafts), or
+    "chunk" (a PrefillChunk slice, carried in ``chunk``)."""
+    request: object
+    kind: str                   # "decode" | "verify" | "chunk"
+    start: int
+    length: int
+    chunk: object = None        # the PrefillChunk for kind == "chunk"
+
+
+@dataclass
 class ScheduledBatch:
     kind: str                   # "mixed" | "decode" | "idle"
-    requests: list              # decode rows this step
+    requests: list              # decode/verify rows this step
     chunks: list = field(default_factory=list)   # PrefillChunks this step
+    # the same work as one ragged token batch: decode/verify rows first
+    # (in ``requests`` order), then chunk rows (in ``chunks`` order) —
+    # the commit order the engine's RNG-stream exactness depends on
+    rows: list = field(default_factory=list)
 
 
 class Scheduler:
@@ -276,10 +294,15 @@ class Scheduler:
             chunks.append(PrefillChunk(req, req.num_cached, c))
             budget -= c
 
+        rows = [RaggedRow(r, "verify" if r.draft_tokens else "decode",
+                          r.num_cached, 1 + len(r.draft_tokens))
+                for r in decodes]
+        rows += [RaggedRow(ch.request, "chunk", ch.start, ch.length,
+                           chunk=ch) for ch in chunks]
         if chunks:
-            return ScheduledBatch("mixed", decodes, chunks)
+            return ScheduledBatch("mixed", decodes, chunks, rows)
         if decodes:
-            return ScheduledBatch("decode", decodes)
+            return ScheduledBatch("decode", decodes, rows=rows)
         return ScheduledBatch("idle", [])
 
     def check_invariants(self):

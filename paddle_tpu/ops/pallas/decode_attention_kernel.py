@@ -81,22 +81,27 @@ def _decode_kernel(q_ref, k_ref, v_ref, len_ref, o_ref, *, block_s):
 
 
 def _engine_cases(engine):
-    """Dense-cache decode at the engine's decode buckets (S_max is the
-    paged pool's token horizon, per-shard head counts under tp)."""
+    """Dense-cache decode at power-of-two batch buckets up to the
+    engine's max_batch (S_max is the paged pool's token horizon,
+    per-shard head counts under tp).  The serving engine's own bucket
+    grid is a single ragged-token family now, so the batch buckets are
+    enumerated directly here rather than read off ``_bucket_grid()``."""
     nkv = max(engine.num_heads // engine.tp, 1)
     d = engine.head_dim
     s_max = engine.max_pages * engine.block_size
     if not supports(s_max, d, nkv, nkv):
         return
     sds = jax.ShapeDtypeStruct
-    for kind, bkt in engine._bucket_grid():
-        if kind != "decode":
-            continue
+    bkt = 1
+    while True:
         q = sds((bkt, nkv, d), engine.dtype)
         kc = sds((bkt, s_max, nkv, d), engine.dtype)
         yield registry.KernelCase(
             f"decode[{bkt}]", decode_attention_pallas,
             (q, kc, kc, sds((bkt,), jnp.int32)), None)
+        if bkt >= engine.max_batch:
+            break
+        bkt = min(bkt * 2, engine.max_batch)
 
 
 @registry.register_kernel(

@@ -1,0 +1,269 @@
+"""Pallas ragged paged attention — ONE kernel for every serving phase.
+
+Ragged Paged Attention (arxiv 2604.15464) folds chunked prefill, plain
+decode, and speculative verify into a single launch over a flat ragged
+batch: the step's query tokens are packed back-to-back along one token
+axis, and each batch row is described by a ``(query_start, query_len,
+context_len)`` descriptor instead of by its own executable.  A decode
+row is simply a one-token chunk; a verify row is a K+1-token chunk; a
+prefill chunk is a C-token chunk — the causal rule is identical for all
+of them, because the token at absolute position ``p`` sees exactly the
+``p + 1`` pool positions ``0..p``.  Shapes:
+
+    q             [T, Nq, D]      T packed query tokens (GQA: G =
+                                  Nq//Nkv query heads per KV head)
+    k_pages       [NB, bs, Nkv, D] the whole paged pool, NB pages of
+    v_pages       [NB, bs, Nkv, D] bs tokens each
+    block_tables  [R, P] int32    page id of row r's p-th page
+    row_start     [R]    int32    first flat token of row r
+    row_qlen      [R]    int32    query tokens of row r (0: dead row)
+    row_pos0      [R]    int32    absolute position of row r's first
+                                  query token
+
+Host contract (the engine packs exactly this): ``row_start`` is
+non-decreasing, ``row_start[r] + row_qlen[r] <= T``, and a dead row
+(``row_qlen == 0``) owns no tokens.  Token ``i`` of row ``r`` sits at
+absolute position ``row_pos0[r] + i`` and attends over pool positions
+``0 .. row_pos0[r] + i`` through row r's block table.  Tokens outside
+every row (padding) come back as EXACT ZEROS.
+
+Kernel layout: grid (Nkv, R, P), block tables and row descriptors as
+scalar-prefetch operands so the BlockSpec index map dereferences
+``block_tables[r, p]`` — each (kv head, row) pair walks only the pages
+that row owns, with the online-softmax state held in VMEM scratch over
+the padded flat token axis.  The page axis is innermost, so scratch
+carries across a row's pages; the row axis is next, so a later row's
+init pass reclaims whatever an earlier row's tail chunk spilled past
+its own tokens (the flat axis is padded by one chunk of slack for
+that spill); the output block is indexed by the kv head only and is
+zeroed once per head, which is what makes dead tokens exact zeros.
+Unlike the retired per-phase kernels, NOTHING is replicated on the
+host: speculative verify used to materialize
+``jnp.repeat(block_tables, K+1, axis=0)`` — here every row's K+1
+tokens share one descriptor and one block-table row.
+
+Like the other kernels, the 1/sqrt(D) scale is applied INSIDE; the
+masked-XLA fallback (inference/llm/paged_attention.py) computes
+bitwise-defined identical semantics everywhere the kernel is gated
+off, and is what the engine-vs-dense token-exactness tests pin.
+
+Under tensor parallelism the pool is sharded along the Nkv axis and
+the kernel runs inside ``jax.shard_map`` with PER-SHARD head counts
+and the full local pool; the scalar-prefetched descriptors (which
+GSPMD could not partition through the index map) arrive replicated.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import registry
+
+_NEG_INF = -1e30
+# query tokens processed per inner chunk: one f32 sublane tile when
+# G == 1, a multiple of it otherwise — the flat axis is padded by one
+# chunk so a row's tail chunk can spill without leaving the block
+_TQ = 8
+
+
+def supports(block_size, head_dim, num_q_heads, num_kv_heads,
+             total_tokens):
+    """Shape gate: lane-sized head_dim, sublane-tiled pages, whole GQA
+    groups, and a flat token axis the _TQ chunk walk divides."""
+    return (head_dim <= 128 and block_size % 8 == 0
+            and num_q_heads % num_kv_heads == 0
+            and total_tokens % _TQ == 0 and total_tokens > 0)
+
+
+def _ragged_kernel(bt_ref, start_ref, qlen_ref, pos0_ref,
+                   q_ref, k_ref, v_ref, o_ref,
+                   o_scr, m_scr, l_scr, *, block_size, group, nc):
+    """One (kv_head, row, page) program.
+
+    Row r's tokens live at flat rows [start*G, (start+qlen)*G) of the
+    padded [TG, D] query/output blocks; the chunk walk visits them
+    ``_TQ`` tokens at a time with a dynamic trip count (dead rows cost
+    zero chunks, a decode row exactly one).  A tail chunk may spill
+    into the next row's region: spilled scratch is re-initialized by
+    that row's own p == 0 pass before it is read, and spilled output
+    is never written at all (the finalize store blends against the
+    token-validity mask), so the zero-filled padding region stays
+    exactly zero.
+    """
+    r = pl.program_id(1)
+    p = pl.program_id(2)
+    num_pages = pl.num_programs(2)
+    d = q_ref.shape[2]
+    tqg = _TQ * group
+    start = start_ref[r]
+    qlen = qlen_ref[r]
+    pos0 = pos0_ref[r]
+
+    @pl.when((r == 0) & (p == 0))
+    def _zero_output():
+        # the one full-block store: every token the finalize blend
+        # skips — padding, dead rows, spill — reads back exact zeros
+        o_ref[...] = jnp.zeros(o_ref.shape, o_ref.dtype)
+
+    def each_chunk(body):
+        """Run ``body(c)`` for every chunk holding live tokens of this
+        row — trip count is data-dependent, structure is static."""
+        def step(c, carry):
+            @pl.when(c * _TQ < qlen)
+            def _():
+                body(c)
+            return carry
+        jax.lax.fori_loop(0, nc, step, 0)
+
+    @pl.when(p == 0)
+    def _init():
+        def init_chunk(c):
+            off = (start + c * _TQ) * group
+            o_scr[pl.ds(off, tqg), :] = jnp.zeros((tqg, d), jnp.float32)
+            m_scr[pl.ds(off, tqg), :] = jnp.full((tqg, 1), _NEG_INF,
+                                                 jnp.float32)
+            l_scr[pl.ds(off, tqg), :] = jnp.zeros((tqg, 1), jnp.float32)
+        each_chunk(init_chunk)
+
+    base = p * block_size
+
+    # pages at or past the row's deepest context hold nothing any of
+    # its tokens may see; page 0 is always visible (every live token's
+    # causal window contains position 0), so valid tokens accumulate
+    # real state before any fully-masked page can touch them
+    @pl.when(base < pos0 + qlen)
+    def _accumulate():
+        k = k_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+
+        def acc_chunk(c):
+            off = (start + c * _TQ) * group
+            q = q_ref[0, pl.ds(off, tqg), :].astype(jnp.float32)
+            s = q @ k.T / jnp.sqrt(jnp.asarray(d, jnp.float32))
+            # flat row i of the chunk is query token c*_TQ + i//G of
+            # this batch row, at absolute position pos0 + that index
+            ti = c * _TQ + jax.lax.broadcasted_iota(
+                jnp.int32, (tqg, block_size), 0) // group
+            kpos = base + jax.lax.broadcasted_iota(
+                jnp.int32, (tqg, block_size), 1)
+            s = jnp.where((kpos <= pos0 + ti) & (ti < qlen), s,
+                          _NEG_INF)
+            m_prev = m_scr[pl.ds(off, tqg), :]
+            l_prev = l_scr[pl.ds(off, tqg), :]
+            o_prev = o_scr[pl.ds(off, tqg), :]
+            m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+            pe = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_prev - m_new)
+            o_scr[pl.ds(off, tqg), :] = o_prev * alpha + pe @ v
+            m_scr[pl.ds(off, tqg), :] = m_new
+            l_scr[pl.ds(off, tqg), :] = \
+                l_prev * alpha + pe.sum(axis=1, keepdims=True)
+        each_chunk(acc_chunk)
+
+    @pl.when(p == num_pages - 1)
+    def _finalize():
+        def fin_chunk(c):
+            off = (start + c * _TQ) * group
+            ti = c * _TQ + jax.lax.broadcasted_iota(
+                jnp.int32, (tqg, 1), 0) // group
+            o = o_scr[pl.ds(off, tqg), :] \
+                / jnp.maximum(l_scr[pl.ds(off, tqg), :], 1e-30)
+            cur = o_ref[0, pl.ds(off, tqg), :]
+            o_ref[0, pl.ds(off, tqg), :] = \
+                jnp.where(ti < qlen, o.astype(o_ref.dtype), cur)
+        each_chunk(fin_chunk)
+
+
+def _engine_cases(engine):
+    """Every launch the serving engine makes IS this kernel now: one
+    case per token bucket of the collapsed ``_bucket_grid()`` family,
+    with the fixed [max_batch, max_pages] descriptor rails.  The
+    scalar_bounds let K003 prove the block-table prefetch indirection
+    in-bounds (page ids in [0, num_blocks - 1]) and bound the row
+    descriptors by the token bucket / model horizon."""
+    nkv = max(engine.num_heads // engine.tp, 1)
+    d = engine.head_dim
+    sds = jax.ShapeDtypeStruct
+    kp = sds((engine.num_blocks, engine.block_size, nkv, d),
+             engine.dtype)
+    rmax = engine.max_batch
+    for kind, tb in engine._bucket_grid():
+        if kind != "ragged":
+            continue
+        if not supports(engine.block_size, d, nkv, nkv, tb):
+            continue
+        bounds = {0: (0, engine.num_blocks - 1), 1: (0, tb),
+                  2: (0, tb), 3: (0, engine.max_model_len - 1)}
+        yield registry.KernelCase(
+            f"ragged[{tb}]", paged_ragged_attention_pallas,
+            (sds((tb, nkv, d), engine.dtype), kp, kp,
+             sds((rmax, engine.max_pages), jnp.int32),
+             sds((rmax,), jnp.int32), sds((rmax,), jnp.int32),
+             sds((rmax,), jnp.int32)), bounds)
+
+
+@registry.register_kernel(
+    "paged_ragged_attention",
+    fallback="paddle_tpu.inference.llm.paged_attention:"
+             "paged_ragged_attention_xla",
+    parity="tests/test_pallas_kernels.py::TestRaggedAttention::"
+           "test_mixed_batch_parity",
+    engine_shapes=_engine_cases,
+    supports=supports)
+def paged_ragged_attention_pallas(q, k_pages, v_pages, block_tables,
+                                  row_start, row_qlen, row_pos0,
+                                  interpret=False):
+    """Ragged paged attention over T packed query tokens.
+
+    Returns [T, Nq, D]; tokens outside every row are exact zeros.  See
+    the module docstring for the row-descriptor layout and the host
+    packing contract.
+    """
+    t, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    r, num_pages = block_tables.shape
+    g = nq // nkv
+    nc = t // _TQ
+    tg = (t + _TQ) * g          # one chunk of spill slack
+    # [T, Nkv, G, D] -> [Nkv, T*G, D]: flat row i of head j is query
+    # token i // G, padded so a tail chunk never leaves the block
+    qg = q.reshape(t, nkv, g, d).transpose(1, 0, 2, 3)
+    qg = jnp.pad(qg.reshape(nkv, t * g, d), ((0, 0), (0, _TQ * g),
+                                             (0, 0)))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nkv, r, num_pages),
+        in_specs=[
+            pl.BlockSpec((1, tg, d),
+                         lambda j, rr, p, bt, st, ql, p0: (j, 0, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda j, rr, p, bt, st, ql, p0:
+                         (bt[rr, p], 0, j, 0)),
+            pl.BlockSpec((1, bs, 1, d),
+                         lambda j, rr, p, bt, st, ql, p0:
+                         (bt[rr, p], 0, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, tg, d),
+                               lambda j, rr, p, bt, st, ql, p0:
+                               (j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tg, d), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_kernel, block_size=bs, group=g,
+                          nc=nc),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nkv, tg, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), row_start.astype(jnp.int32),
+      row_qlen.astype(jnp.int32), row_pos0.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out[:, :t * g].reshape(nkv, t, g, d).transpose(
+        1, 0, 2, 3).reshape(t, nq, d)

@@ -46,7 +46,7 @@ KernelCase = namedtuple("KernelCase",
 KERNEL_MODULES = (
     "attention_kernel",
     "decode_attention_kernel",
-    "paged_attention_kernel",
+    "ragged_attention_kernel",
     "layernorm_kernel",
 )
 

@@ -206,9 +206,21 @@ class TestCleanRuns:
         not compile (or retrace into) any serving executable."""
         eng = _make_engine(speculative=2)
         A.analyze_engine(eng)
-        assert eng._chunk._cache_size() == 0
-        assert eng._decode._cache_size() == 0
-        assert eng._verify._cache_size() == 0
+        assert eng._ragged._cache_size() == 0
+
+    def test_compile_watcher_names_weak_typed_key(self, compile_watcher):
+        """A bare python scalar handed to a jitted fn builds a
+        weak-typed executable; the watcher's report must carry the
+        weak_type=True bit so the leak is attributable from the
+        error alone."""
+        f = jax.jit(lambda x, s: x * s)
+        f(jnp.ones(3), jnp.asarray(2, jnp.int32))   # strong-typed warm
+        with pytest.raises(A.RecompileError) as ei:
+            with compile_watcher(f, labels=("f",)):
+                f(jnp.ones(3), 2)            # python-scalar leak
+        msg = str(ei.value)
+        assert "New cache keys" in msg
+        assert "weak_type=True" in msg
 
 
 # ---------------------------------------------------------------------------
@@ -303,7 +315,7 @@ class TestGraphLintCLI:
         doc = json.loads(capsys.readouterr().out)
         assert rc == 0 and doc["errors"] == 0
         cen = doc["census"]
-        assert cen["compile_count"] == 5
+        assert cen["compile_count"] == 2
         assert cen["memory"]["weights_bytes"] > 0
         assert all("roofline" in e for e in cen["entries"])
 

@@ -102,28 +102,30 @@ class TestFlopParity:
         assert est.flops_xla_parity == pytest.approx(xla["flops"],
                                                      rel=0.001)
 
-    def test_engine_decode_buckets_within_5pct(self):
+    def test_engine_ragged_buckets_within_5pct(self):
         eng = _make_engine()
-        for kind, bucket, fn, args in eng.executable_grid():
-            if kind != "decode":
-                continue
-            est = C.estimate_jitted(fn, *args, loop_aware=False)
-            xla = C.xla_cost_analysis(fn, *args)
-            rel = abs(est.flops - xla["flops"]) / max(xla["flops"], 1)
-            assert rel <= 0.05, (kind, bucket, est.flops, xla["flops"])
-
-    def test_engine_verify_buckets_within_5pct(self):
-        eng = _make_engine(speculative=2)
         checked = 0
         for kind, bucket, fn, args in eng.executable_grid():
-            if kind != "verify" or checked >= 2:
-                continue
+            assert kind == "ragged"
             est = C.estimate_jitted(fn, *args, loop_aware=False)
             xla = C.xla_cost_analysis(fn, *args)
             rel = abs(est.flops - xla["flops"]) / max(xla["flops"], 1)
             assert rel <= 0.05, (kind, bucket, est.flops, xla["flops"])
             checked += 1
         assert checked == 2
+
+    def test_engine_speculative_grid_identical(self):
+        """speculative=K no longer adds a verify family: draft scoring
+        rides the same ragged buckets, so the grid is the tp=1 grid."""
+        eng = _make_engine(speculative=2)
+        grid = [(kind, bucket)
+                for kind, bucket, _, _ in eng.executable_grid()]
+        assert grid == [("ragged", 8), ("ragged", 16)]
+        for kind, bucket, fn, args in eng.executable_grid():
+            est = C.estimate_jitted(fn, *args, loop_aware=False)
+            xla = C.xla_cost_analysis(fn, *args)
+            rel = abs(est.flops - xla["flops"]) / max(xla["flops"], 1)
+            assert rel <= 0.05, (kind, bucket, est.flops, xla["flops"])
 
     def test_roofline_classification(self):
         est = C.CostEstimate()
@@ -224,29 +226,30 @@ class TestCensus:
         warmup(): every bucket it enumerates compiles exactly once."""
         eng = _make_engine()
         cen = C.run_census(eng)
-        assert cen.families == {"chunk": 2, "decode": 3}
-        w = CompileWatcher(eng._chunk, eng._decode)
+        assert cen.families == {"ragged": 2}
+        w = CompileWatcher(eng._ragged)
         eng.warmup()
         observed = sum(n for _, n in w.new_compiles())
-        assert cen.compile_count == observed == 5
+        assert cen.compile_count == observed == 2
 
     def test_golden_census_matches_warmup_compiles_speculative(self):
+        # speculative no longer adds a family: same 2 ragged buckets
         eng = _make_engine(speculative=2)
         cen = C.run_census(eng)
-        assert cen.families["verify"] == 6
-        w = CompileWatcher(eng._chunk, eng._decode, eng._verify)
+        assert cen.families == {"ragged": 2}
+        w = CompileWatcher(eng._ragged)
         eng.warmup()
         observed = sum(n for _, n in w.new_compiles())
-        assert cen.compile_count == observed == 11
+        assert cen.compile_count == observed == 2
 
     def test_golden_census_matches_warmup_compiles_tp2(self):
         assert len(jax.devices()) >= 2
         eng = _make_engine(tp=2)
         cen = C.run_census(eng)
-        w = CompileWatcher(eng._chunk, eng._decode)
+        w = CompileWatcher(eng._ragged)
         eng.warmup()
         observed = sum(n for _, n in w.new_compiles())
-        assert cen.compile_count == observed == 5
+        assert cen.compile_count == observed == 2
         # tp=2 buckets must carry per-axis collective payloads
         assert all(e["cost"]["collective_bytes"].get("mp", 0) > 0
                    for e in cen.entries)
@@ -258,9 +261,7 @@ class TestCensus:
         eng = _make_engine(speculative=2)
         cen = C.run_census(eng)
         assert cen.findings == [], [f.format() for f in cen.findings]
-        assert eng._chunk._cache_size() == 0
-        assert eng._decode._cache_size() == 0
-        assert eng._verify._cache_size() == 0
+        assert eng._ragged._cache_size() == 0
 
     def test_census_tp2_clean(self):
         cen = C.run_census(_make_engine(tp=2))
@@ -286,15 +287,15 @@ class TestCensus:
         assert [f for f in cen.findings if f.rule == "M001"] == []
 
     def test_b001_fires_on_grid_blowup(self):
-        cen = C.run_census(_make_engine(), max_executables=2)
+        cen = C.run_census(_make_engine(), max_executables=1)
         b001 = [f for f in cen.findings if f.rule == "B001"]
-        assert b001 and "5" in b001[0].message
+        assert b001 and "2 executables" in b001[0].message
 
     def test_census_to_json_roundtrip(self):
         import json
 
         doc = json.loads(C.run_census(_make_engine()).to_json())
-        assert doc["compile_count"] == 5
+        assert doc["compile_count"] == 2
         assert {"flops", "hbm_bytes", "peak_bytes", "roofline"} <= set(
             doc["entries"][0]["cost"]) | {"roofline"} | set(
             doc["entries"][0])

@@ -337,8 +337,7 @@ class TestRollingDrain:
     def test_replicas_share_one_executable_set(self):
         m = _make_model()
         fleet = _tiny_fleet(m, replicas=3)
-        fns = {(id(r.engine._chunk), id(r.engine._decode))
-               for r in fleet.replicas}
+        fns = {id(r.engine._ragged) for r in fleet.replicas}
         assert len(fns) == 1
         watcher = fleet.warmup()
         for p in _prompts(n=4):

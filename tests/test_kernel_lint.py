@@ -272,7 +272,7 @@ class TestRegistryContract:
     def test_registry_covers_all_shipped_kernels(self):
         entries = registry.load_all()
         assert {"flash_attention", "decode_attention",
-                "paged_decode_attention", "paged_prefill_attention",
+                "paged_ragged_attention",
                 "layernorm"} <= set(entries)
         for e in entries.values():
             assert callable(registry.resolve_fallback(e))
@@ -300,9 +300,7 @@ class TestCleanSweeps:
     def test_sweep_leaves_executable_caches_cold(self):
         eng = _make_engine(speculative=2)
         KL.lint_registry(eng)
-        assert eng._chunk._cache_size() == 0
-        assert eng._decode._cache_size() == 0
-        assert eng._verify._cache_size() == 0
+        assert eng._ragged._cache_size() == 0
 
     def test_sweep_traces_every_registered_kernel(self):
         """Coverage, not absence: restricting to a never-firing rule set
@@ -358,24 +356,28 @@ class TestSupportsConsistency:
                     SDS((3,), jnp.int32))
                 self._no_errors(fs, f"decode s_max={s_max} d={d}")
 
-    def test_paged_decode_sweep(self):
-        from paddle_tpu.ops.pallas.paged_attention_kernel import (
-            paged_decode_attention_pallas, supports)
+    def test_paged_ragged_sweep(self):
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import (
+            paged_ragged_attention_pallas, supports)
 
         for bs in (8, 16, 32):
             for d in (16, 128):
-                if not supports(bs, d, 4, 2):
+                t = 16
+                if not supports(bs, d, 4, 2, t):
                     continue
                 nb, pages = 8, 4
                 fs = KL.analyze_kernel(
-                    paged_decode_attention_pallas,
-                    SDS((2, 4, d), jnp.float32),
+                    paged_ragged_attention_pallas,
+                    SDS((t, 4, d), jnp.float32),
                     SDS((nb, bs, 2, d), jnp.float32),
                     SDS((nb, bs, 2, d), jnp.float32),
-                    SDS((2, pages), jnp.int32),
-                    SDS((2,), jnp.int32),
-                    scalar_bounds={0: (0, nb - 1), 1: (0, pages * bs)})
-                self._no_errors(fs, f"paged bs={bs} d={d}")
+                    SDS((4, pages), jnp.int32),
+                    SDS((4,), jnp.int32),
+                    SDS((4,), jnp.int32),
+                    SDS((4,), jnp.int32),
+                    scalar_bounds={0: (0, nb - 1), 1: (0, t), 2: (0, t),
+                                   3: (0, pages * bs - 1)})
+                self._no_errors(fs, f"ragged bs={bs} d={d}")
 
     def test_layernorm_sweep(self):
         from paddle_tpu.ops.pallas.layernorm_kernel import (

@@ -305,25 +305,41 @@ class TestPagedAttention:
         import jax.numpy as jnp
 
         from paddle_tpu.inference.llm import paged_decode_attention_xla
-        from paddle_tpu.ops.pallas.paged_attention_kernel import (
-            paged_decode_attention_pallas,
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import (
+            paged_ragged_attention_pallas,
             supports,
         )
 
-        assert supports(8, 16, 4, 2)
-        q, kp, vp, bt, lens = self._inputs(seed=7)
-        args = tuple(map(jnp.asarray, (q, kp, vp, bt, lens)))
-        out = paged_decode_attention_pallas(*args, interpret=True)
-        ref = paged_decode_attention_xla(*args)
+        b, pages, bs, nq, nkv, d = 8, 4, 8, 4, 2, 16
+        assert supports(bs, d, nq, nkv, b)
+        rng = np.random.RandomState(7)
+        nb = b * pages
+        q = rng.randn(b, nq, d).astype(np.float32)
+        kp = rng.randn(nb, bs, nkv, d).astype(np.float32)
+        vp = rng.randn(nb, bs, nkv, d).astype(np.float32)
+        bt = rng.permutation(nb).reshape(b, pages).astype(np.int32)
+        lens = np.array([5, 0, 30, 1, 2, 8, 32, 17], np.int32)
+        # decode rows as ragged descriptors: one query token per live
+        # row, attending over its whole prefix
+        out = paged_ragged_attention_pallas(
+            jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+            jnp.asarray(bt),
+            jnp.arange(b, dtype=jnp.int32),
+            jnp.asarray((lens > 0).astype(np.int32)),
+            jnp.asarray(np.maximum(lens - 1, 0)),
+            interpret=True)
+        ref = paged_decode_attention_xla(*map(jnp.asarray,
+                                              (q, kp, vp, bt, lens)))
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
     def test_supports_gate(self):
-        from paddle_tpu.ops.pallas.paged_attention_kernel import supports
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import supports
 
-        assert not supports(8, 256, 4, 2)   # head_dim too wide
-        assert not supports(6, 16, 4, 2)    # page not sublane-aligned
-        assert not supports(8, 16, 3, 2)    # ragged GQA group
+        assert not supports(8, 256, 4, 2, 8)   # head_dim too wide
+        assert not supports(6, 16, 4, 2, 8)    # page not sublane-aligned
+        assert not supports(8, 16, 3, 2, 8)    # ragged GQA group
+        assert not supports(8, 16, 4, 2, 12)   # off-chunk token count
 
 
 # ---------------------------------------------------------------------------
@@ -559,15 +575,53 @@ class TestPrefixCaching:
         assert eng.stats["chunk_launches"] >= 5
         assert eng.block_manager.num_free_blocks == eng.num_blocks
 
+    def test_single_step_mixes_prefill_chunk_and_decode_rows(self):
+        """THE acceptance property of the ragged collapse: one device
+        step carries a prefill chunk AND decode rows in one launch.
+        Asserted two ways — the engine's mixed_steps stat, and a
+        schedule spy that saw a ScheduledBatch whose row descriptors
+        span both kinds — and the mixed trace stays token-exact."""
+        from paddle_tpu.inference.llm import LLMEngine
+
+        m = _make_model()
+        rng = np.random.RandomState(5)
+        short_p = rng.randint(0, 128, (3,)).astype(np.int32)
+        long_p = rng.randint(0, 128, (40,)).astype(np.int32)
+        refs = _fmt_reference(m, [short_p, long_p], max_new=8)
+        eng = LLMEngine(m, block_size=8, max_batch=2, max_model_len=64,
+                        token_budget=16)
+        mixed_batches = []
+        orig = eng.scheduler.schedule
+
+        def spy():
+            b = orig()
+            kinds = {"chunk" if r.kind == "chunk" else "tok"
+                     for r in b.rows}
+            if len(kinds) == 2:
+                mixed_batches.append(b)
+            return b
+
+        eng.scheduler.schedule = spy
+        outs = eng.generate([short_p, long_p], max_new_tokens=8)
+        for out, ref in zip(outs, refs):
+            np.testing.assert_array_equal(out, ref)
+        assert eng.stats["mixed_steps"] >= 1
+        assert mixed_batches, "no step mixed a chunk with decode rows"
+        assert any(r.kind == "decode" for b in mixed_batches
+                   for r in b.rows)
+        assert any(r.kind == "chunk" for b in mixed_batches
+                   for r in b.rows)
+        assert eng.stats["mixed_steps"] == len(mixed_batches)
+
     def test_warmup_family_covers_serving_no_new_compiles(self):
         from paddle_tpu.inference.llm import LLMEngine
 
         m = _make_model()
         eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
                         token_budget=16)
-        watcher = eng.warmup()     # armed over chunk + decode
-        # chunk family is O(log token_budget): buckets 8, 16
-        assert eng._chunk._cache_size() == 2
+        watcher = eng.warmup()     # armed over the ragged family
+        # ONE family, O(log token_budget): buckets 8, 16
+        assert eng._ragged._cache_size() == 2
         rng = np.random.RandomState(8)
         prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
                    for n in (3, 17, 40, 9)]
@@ -580,9 +634,11 @@ class TestPrefixCaching:
 
     def test_compile_watcher_catches_injected_retrace(self,
                                                       compile_watcher):
-        """A python-scalar bucket leak (plain int where warmup used
-        jnp.int32) gives the executable a new weak-typed signature —
-        the silent retrace class the watcher exists to catch."""
+        """The ragged signature is all-array (the retired chunk/decode
+        scalar args are gone, and with them the classic python-scalar
+        weak-type leak), so the surviving silent-retrace class is a
+        token count that slips past the bucket grid — the watcher must
+        name the off-bucket cache key, not just report a count."""
         import jax.numpy as jnp
 
         from paddle_tpu.framework.analysis import RecompileError
@@ -592,18 +648,21 @@ class TestPrefixCaching:
         eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
                         token_budget=16)
         eng.warmup()
-        ids = jnp.zeros((1, 8), jnp.int32)
-        table = jnp.zeros(eng.max_pages, jnp.int32)
-        with pytest.raises(RecompileError, match="chunk") as ei:
-            with compile_watcher(eng._chunk, eng._decode,
-                                 labels=("chunk", "decode")):
-                _, _, eng._kc, eng._vc = eng._chunk(
-                    eng.params, ids, eng._kc, eng._vc, table, 0, 0)
+        ids = jnp.zeros((12,), jnp.int32)      # 12 is not a bucket
+        tables = jnp.zeros((eng.max_batch, eng.max_pages), jnp.int32)
+        positions = jnp.full((12,), -1, jnp.int32)
+        rows = jnp.zeros((12,), jnp.int32)
+        zr = jnp.zeros((eng.max_batch,), jnp.int32)
+        with pytest.raises(RecompileError, match="ragged") as ei:
+            with compile_watcher(eng._ragged, labels=("ragged",)):
+                _, _, eng._kc, eng._vc = eng._ragged(
+                    eng.params, ids, eng._kc, eng._vc, tables,
+                    positions, rows, zr, zr, zr)
         # the report names the offending cache KEY, not just a count —
-        # and the key shows the weak_type bit the plain ints flipped
+        # the off-grid token axis is visible in the new signature
         msg = str(ei.value)
         assert "New cache keys" in msg
-        assert "weak_type=True" in msg
+        assert "int32[12]" in msg
 
 
 # ---------------------------------------------------------------------------
@@ -665,7 +724,7 @@ class TestTensorParallel:
         tp = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
                        token_budget=16, tensor_parallel=4)
         watcher = tp.warmup()
-        assert tp._chunk._cache_size() == 2  # buckets 8, 16 — as tp=1
+        assert tp._ragged._cache_size() == 2  # buckets 8, 16 — as tp=1
         rng = np.random.RandomState(12)
         prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
                    for n in (3, 17, 40, 9)]
@@ -1004,6 +1063,50 @@ def test_spec_bench_smoke(tmp_path):
         art = json.load(f)
     assert art["ok"] is True and art["rc"] == 0
     assert art["bench"]["metric"] == "llm_serving_spec"
+
+
+# ---------------------------------------------------------------------------
+def test_mixed_bench_smoke(tmp_path):
+    """benchmarks/bench_serving.py --mixed runs end to end on tiny
+    parameters and passes its own gates: token-exact vs the serial
+    (unmixable) engine, >= 1 genuinely mixed step, zero leaked pages,
+    zero post-warmup compiles, and a warmup family strictly below the
+    retired per-phase grid's golden count — with warmup_ms /
+    compile_count embedded in the artifact."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    artifact = str(tmp_path / "BENCH_mixed.json")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    rc = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "benchmarks", "bench_serving.py"),
+         "--mixed", "--requests", "6", "--max-new", "6",
+         "--max-batch", "4", "--token-budget", "16",
+         "--artifact", artifact],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert rc.returncode == 0, rc.stderr[-1500:]
+    row = json.loads(rc.stdout.strip().splitlines()[-1])
+    assert row["metric"] == "llm_serving_mixed"
+    assert row["token_exact"] is True
+    assert row["mixed_steps"] >= 1
+    assert row["baseline_mixed_steps"] == 0
+    assert row["leaked_pages"] == 0
+    assert row["new_compiles"] == 0
+    assert row["compile_count"] < row["old_golden_compile_count"]
+    # the per-bucket warmup timing satellite: every compiled bucket
+    # reports a wall-clock figure in every artifact
+    assert set(row["warmup_ms"]) == {"ragged[8]", "ragged[16]"}
+    assert all(v > 0 for v in row["warmup_ms"].values())
+    with open(artifact) as f:
+        art = json.load(f)
+    assert art["ok"] is True and art["rc"] == 0
+    assert art["bench"]["metric"] == "llm_serving_mixed"
+    assert art["bench"]["compile_count"] == 2
 
 
 # ---------------------------------------------------------------------------

@@ -209,9 +209,12 @@ class TestDecodeAttention:
                                    atol=2e-5)
 
 
-class TestPagedAttention:
-    """Interpret-mode parity for the block-table-indirection kernels —
-    the registry's K005 contract points at these two tests by name."""
+class TestRaggedAttention:
+    """Interpret-mode parity battery for the unified ragged paged
+    attention kernel — the registry's K005 contract points at
+    ``test_mixed_batch_parity`` by name.  Every case checks the Pallas
+    kernel against the bitwise-defined masked-XLA fallback
+    (``paged_ragged_attention_xla``) on the SAME descriptors."""
 
     def _pool(self, NB=6, BS=8, NKV=2, D=16, seed=0):
         rng = np.random.RandomState(seed)
@@ -219,77 +222,154 @@ class TestPagedAttention:
         v = jnp.asarray(rng.rand(NB, BS, NKV, D).astype(np.float32))
         return k, v
 
-    def test_decode_parity_ragged_gqa(self):
-        """Ragged batch through scattered block tables: an empty slot
-        (length 0 must emit zeros, not average garbage pages), a partial
-        last page (13 = 8 + 5), exact page boundaries, and GQA folding
-        (4 query heads sharing 2 KV heads)."""
+    def _token_descriptors(self, T, row_start, row_qlen, row_pos0):
+        """The per-token (ctx, rows) form of the per-row descriptors —
+        the dual-descriptor contract of paged_ragged_attention."""
+        ctx = np.zeros(T, np.int32)
+        rows = np.zeros(T, np.int32)
+        for r in range(len(row_start)):
+            s, n, p0 = int(row_start[r]), int(row_qlen[r]), \
+                int(row_pos0[r])
+            ctx[s:s + n] = p0 + np.arange(1, n + 1)
+            rows[s:s + n] = r
+        return jnp.asarray(ctx), jnp.asarray(rows)
+
+    def _check(self, q, kp, vp, bt, row_start, row_qlen, row_pos0):
         from paddle_tpu.inference.llm.paged_attention import (
+            paged_ragged_attention_xla,
+        )
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import (
+            paged_ragged_attention_pallas,
+        )
+
+        ctx, rows = self._token_descriptors(q.shape[0], row_start,
+                                            row_qlen, row_pos0)
+        got = paged_ragged_attention_pallas(
+            q, kp, vp, bt, jnp.asarray(row_start, jnp.int32),
+            jnp.asarray(row_qlen, jnp.int32),
+            jnp.asarray(row_pos0, jnp.int32), interpret=True)
+        ref = paged_ragged_attention_xla(q, kp, vp, bt, ctx, rows)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        return np.asarray(got)
+
+    def test_mixed_batch_parity(self):
+        """One launch, all three phases at once through scattered
+        non-identity tables with GQA folding (4 query heads on 2 KV
+        heads): a decode row deep in its sequence, a prefill chunk that
+        STRADDLES a page boundary (positions 5..10 over 8-token pages),
+        a speculative-verify row (3 consecutive positions), and a dead
+        row — whose tokens, like the bucket padding, must come back as
+        EXACT zeros, not averaged garbage pages."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 16
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import (
+            supports,
+        )
+        assert supports(BS, D, NQ, NKV, T)
+        kp, vp = self._pool(NB, BS, NKV, D, seed=30)
+        rng = np.random.RandomState(31)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[5, 2, 0], [4, 1, 3], [0, 3, 5],
+                                   [2, 2, 2]], np.int32))
+        row_start = [0, 1, 7, 0]
+        row_qlen = [1, 6, 3, 0]          # decode, chunk, verify, dead
+        row_pos0 = [9, 5, 3, 0]
+        got = self._check(q, kp, vp, bt, row_start, row_qlen, row_pos0)
+        dead = np.ones(T, bool)
+        for s, n in zip(row_start, row_qlen):
+            dead[s:s + n] = False
+        assert np.all(got[dead] == 0.0), "padding tokens not exact zero"
+
+    def test_pure_decode_rows(self):
+        """A full batch of one-token rows (the plain decode step),
+        including an empty sequence (qlen 0 -> exact zeros) and a
+        partial last page (13 = 8 + 5)."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 8
+        kp, vp = self._pool(NB, BS, NKV, D, seed=32)
+        rng = np.random.RandomState(33)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(rng.randint(0, NB, size=(T, 3)).astype(np.int32))
+        lens = np.array([0, 13, 24, 5, 1, 8, 16, 9], np.int32)
+        row_start = np.arange(T, dtype=np.int32)
+        row_qlen = (lens > 0).astype(np.int32)
+        row_pos0 = np.maximum(lens - 1, 0).astype(np.int32)
+        got = self._check(q, kp, vp, bt, row_start, row_qlen, row_pos0)
+        np.testing.assert_allclose(got[0], 0.0)      # empty slot
+
+        # the legacy public entry point must route through the ragged
+        # kernel and agree with ITS fallback bitwise-meaningfully too
+        from paddle_tpu.inference.llm.paged_attention import (
+            paged_decode_attention,
             paged_decode_attention_xla,
         )
-        from paddle_tpu.ops.pallas.paged_attention_kernel import (
-            paged_decode_attention_pallas,
-            supports,
+        via = paged_decode_attention(q, kp, vp, bt, jnp.asarray(lens),
+                                     interpret=True)
+        ref = paged_decode_attention_xla(q, kp, vp, bt, jnp.asarray(lens))
+        np.testing.assert_allclose(np.asarray(via), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_pure_prefill_row_page_straddle(self):
+        """A single chunk occupying the whole token axis, starting
+        mid-page (positions 5..12 with 8-token pages): causality inside
+        the chunk AND readback of earlier pages through the table."""
+        NB, BS, NQ, NKV, D, C = 6, 8, 4, 2, 16, 8
+        kp, vp = self._pool(NB, BS, NKV, D, seed=40)
+        rng = np.random.RandomState(41)
+        q = jnp.asarray(rng.rand(C, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[3, 1, 4, 0]], np.int32))
+        for start in (0, 5):     # page-aligned and straddling starts
+            self._check(q, kp, vp, bt, [0], [C], [start])
+
+        # the legacy chunk entry point (traced start included) rides
+        # the ragged kernel and must match its own XLA fallback
+        from paddle_tpu.inference.llm.paged_attention import (
+            paged_prefill_attention,
+            paged_prefill_attention_xla,
+        )
+        f = jax.jit(lambda s: paged_prefill_attention(
+            q[None], kp, vp, bt[0], s, interpret=True))
+        got = f(jnp.asarray(5, jnp.int32))
+        ref = paged_prefill_attention_xla(q[None], kp, vp, bt[0], 5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_verify_rows_no_table_replication(self):
+        """Speculative verify through the ragged kernel: each
+        sequence's K+1 tokens share ONE block-table row (the retired
+        path materialized jnp.repeat(block_tables, K+1, axis=0)), and
+        per-token causality masks the later drafts already scattered
+        into the pool."""
+        from paddle_tpu.inference.llm.paged_attention import (
+            paged_verify_attention,
+            paged_verify_attention_xla,
         )
 
         NB, BS, NQ, NKV, D = 6, 8, 4, 2, 16
-        assert supports(BS, D, NQ, NKV)
-        kp, vp = self._pool(NB, BS, NKV, D, seed=30)
-        rng = np.random.RandomState(31)
-        q = jnp.asarray(rng.rand(4, NQ, D).astype(np.float32))
-        # non-identity tables: sequences own disjoint scattered pages
+        B, TV = 4, 4                       # B*TV = 16 flat tokens
+        kp, vp = self._pool(NB, BS, NKV, D, seed=50)
+        rng = np.random.RandomState(51)
+        q = jnp.asarray(rng.rand(B, TV, NQ, D).astype(np.float32))
         bt = jnp.asarray(np.array([[5, 2, 0], [4, 1, 3], [0, 3, 5],
                                    [2, 2, 2]], np.int32))
-        lens = jnp.asarray(np.array([0, 13, 24, 5], np.int32))
-
-        got = paged_decode_attention_pallas(q, kp, vp, bt, lens,
-                                            interpret=True)
-        ref = paged_decode_attention_xla(q, kp, vp, bt, lens)
+        # live prefixes of 4/2/0/3 verify slots at staggered depths
+        ctx = np.zeros((B, TV), np.int32)
+        ctx[0, :4] = 9 + np.arange(4)
+        ctx[1, :2] = 13 + np.arange(2)
+        ctx[3, :3] = 5 + np.arange(3)
+        ctx = jnp.asarray(ctx)
+        got = paged_verify_attention(q, kp, vp, bt, ctx, interpret=True)
+        ref = paged_verify_attention_xla(q, kp, vp, bt, ctx)
         np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                                   atol=2e-5)
-        np.testing.assert_allclose(np.asarray(got)[0], 0.0)  # empty slot
+                                   atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(got)[2], 0.0)  # dead row
 
-        # length 5 < one page: row 3 must equal dense decode over its
-        # first page only (the other table entries may not leak in)
-        from paddle_tpu.ops.pallas.decode_attention_kernel import (
-            decode_attention_xla,
-        )
-        dense = decode_attention_xla(
-            q[3:4], kp[2][None], vp[2][None],
-            jnp.asarray(np.array([5], np.int32)))
-        np.testing.assert_allclose(np.asarray(got)[3], np.asarray(dense)[0],
-                                   atol=2e-5)
-
-    def test_prefill_parity_partial_page(self):
-        """Chunked causal prefill whose chunk straddles a page boundary:
-        positions 5..12 with 8-token pages end 5 tokens into page 1, and
-        the GQA query tile folds (chunk*group) rows per KV head."""
-        from paddle_tpu.inference.llm.paged_attention import (
-            paged_prefill_attention_xla,
-        )
-        from paddle_tpu.ops.pallas.paged_attention_kernel import (
-            paged_prefill_attention_pallas,
-            prefill_supports,
-        )
-
-        NB, BS, NQ, NKV, D, C = 6, 8, 4, 2, 16, 8
-        assert prefill_supports(BS, D, NQ, NKV, C)
-        kp, vp = self._pool(NB, BS, NKV, D, seed=40)
-        rng = np.random.RandomState(41)
-        q = jnp.asarray(rng.rand(1, C, NQ, D).astype(np.float32))
-        bt = jnp.asarray(np.array([3, 1, 4, 0], np.int32))
-
-        for start in (0, 5):          # page-aligned and straddling starts
-            got = paged_prefill_attention_pallas(q, kp, vp, bt, start,
-                                                 interpret=True)
-            ref = paged_prefill_attention_xla(q, kp, vp, bt, start)
-            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                                       atol=2e-5, err_msg=f"start={start}")
-
-        # the traced-start path (start as a jitted scalar) must also match
-        f = jax.jit(lambda s: paged_prefill_attention_pallas(
-            q, kp, vp, bt, s, interpret=True))
-        got = f(jnp.asarray(5, jnp.int32))
-        ref = paged_prefill_attention_xla(q, kp, vp, bt, 5)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
-                                   atol=2e-5)
+    def test_gqa_group_of_four(self):
+        """8 query heads on 2 KV heads (G = 4): the flat (token, group)
+        axis folds 4 query rows per token and must still mask per
+        TOKEN, not per flat row."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 8, 2, 16, 8
+        kp, vp = self._pool(NB, BS, NKV, D, seed=60)
+        rng = np.random.RandomState(61)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[1, 4, 2], [3, 0, 5]], np.int32))
+        self._check(q, kp, vp, bt, [0, 3], [3, 5], [6, 0])
