@@ -104,7 +104,8 @@ def _force_device_count(n):
 def _build_engine(max_batch, seed=0, max_model_len=64,
                   prefix_caching=True, token_budget=64, tp=1,
                   speculative=None, faults=None, retry=None,
-                  max_queue=None):
+                  max_queue=None, quantize=None, memory_budget=None,
+                  num_blocks=None):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.models.gpt import gpt_tiny
@@ -118,7 +119,9 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
                      token_budget=token_budget,
                      tensor_parallel=tp if tp > 1 else None,
                      speculative=speculative, faults=faults,
-                     retry=retry, max_queue=max_queue)
+                     retry=retry, max_queue=max_queue,
+                     quantize=quantize, memory_budget=memory_budget,
+                     num_blocks=num_blocks)
 
 
 def _trace(n_requests, rate, max_new, seed=0):
@@ -428,6 +431,16 @@ def main():
                          "warmup, mixed at least one step, and warmed "
                          "strictly fewer executables than the retired "
                          "per-phase grid's golden census (5 at tp=1)")
+    ap.add_argument("--quant", default=None, choices=["int8"],
+                    help="GATED acceptance row for quantized serving: "
+                         "derive an HBM budget that admits batch B at "
+                         "full precision, then demand the int8 engine "
+                         "(weight-only int8 GEMM + int8 KV pool) run "
+                         "batch 2B under the SAME budget with zero "
+                         "preemptions, token-count-exact outputs, zero "
+                         "leaks, zero post-warmup compiles, and finite "
+                         "perplexity/top-k quality deltas vs the f32 "
+                         "engine")
     ap.add_argument("--lint", action="store_true",
                     help="run the static cost census (graph-lint cost) "
                          "AND the Pallas kernel verifier (graph-lint "
@@ -461,6 +474,8 @@ def main():
         return _main_chaos(args, jax)
     if args.mixed:
         return _main_mixed(args, jax)
+    if args.quant is not None:
+        return _main_quant(args, jax)
 
     arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
                                            args.max_new, args.seed)
@@ -914,6 +929,150 @@ def _main_mixed(args, jax):
             f"mixed_steps={mixed_steps} "
             f"compile_count={res['compile_count']} "
             f"(old golden {_OLD_GOLDEN_TP1_COMPILES})")
+
+
+def _main_quant(args, jax):
+    """--quant int8: the quantized-serving acceptance row.
+
+    Builds a declared per-chip HBM budget from the full-precision
+    engine's own memory model (weights + 2.5 max-length sequences of
+    pages — admissible batch 2), then replays an all-at-t=0 trace of
+    2x that batch on both engines:
+
+    - the FULL-PRECISION leg gets exactly the pages that budget can
+      hold beside its f32 weights, so running 2x the admissible batch
+      forces preemptions (the pool is smaller than the trace's peak
+      working set);
+    - the INT8 leg (weight-only int8 GEMM + int8 KV pool) runs under
+      the SAME budget via ``memory_budget=`` — the engine derives its
+      admissible batch from the quantized residency model, which must
+      come out >= 2x the f32 one, and the defaulted pool then holds
+      the whole trace: the gate demands ZERO preemptions.
+
+    GATED, not just measured — rc 1 unless: baseline preempts and the
+    quantized leg doesn't; the quantized admissible max_batch >= 2x
+    the f32 one; every request on both legs finishes by length with
+    exactly prompt + max_new tokens (int8 KV is approximate, so the
+    gate is token-COUNT-exact, not token-exact); zero leaked pages on
+    both legs; an armed CompileWatcher sees zero post-warmup compiles;
+    and the quality harness (perplexity + top-k agreement vs the f32
+    engine, inference/llm/quality.py) returns finite numbers, which
+    the row documents."""
+    import math
+
+    from paddle_tpu.inference.llm.quality import quality_report
+
+    max_model_len = 64
+    prompt_len, max_new = 8, 40
+    rng = np.random.RandomState(args.seed)
+
+    # full-precision probe: the budget is phrased in ITS residency
+    # model so the experiment is self-calibrating, not magic numbers
+    probe = _build_engine(2, args.seed, max_model_len=max_model_len,
+                          token_budget=args.token_budget)
+    mm = probe.memory_model()
+    budget = mm["weights_bytes"] + int(2.5 * mm["seq_bytes"])
+    base_batch = (budget - mm["weights_bytes"]) // mm["seq_bytes"]
+    n_req = 2 * base_batch
+    prompts = [rng.randint(0, 128, (prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+    new_tokens = [max_new] * n_req
+    arrivals = np.zeros(n_req)
+
+    # f32 leg: all the pages the budget can hold beside f32 weights,
+    # asked to run 2x the batch the budget admits -> must preempt
+    base_pool = (budget - mm["weights_bytes"]) // mm["page_bytes"]
+    base = _build_engine(n_req, args.seed, max_model_len=max_model_len,
+                         token_budget=args.token_budget,
+                         num_blocks=base_pool)
+    base_res = run(base, arrivals, prompts, new_tokens)
+    base_leaked = base.num_blocks - base.block_manager.num_free_blocks
+
+    # int8 leg: SAME budget, declared -> the engine derives its own
+    # admissible batch from the quantized residency model
+    eng = _build_engine(n_req, args.seed, max_model_len=max_model_len,
+                        token_budget=args.token_budget,
+                        quantize=args.quant, memory_budget=budget)
+    _lint_census(args, eng)
+    watcher = eng.warmup()
+    eng._bench_warmup_ms = {k: round(v, 3) for k, v in
+                            watcher.compile_ms.items()}
+    res = run(eng, arrivals, prompts, new_tokens)
+    new_compiles = watcher.new_compiles()
+    leaked = eng.num_blocks - eng.block_manager.num_free_blocks
+    qmm = eng.memory_model()
+    admissible_q = qmm["derived_max_batch"]
+
+    def _count_exact(r):
+        return all(
+            r["reasons"][i] == "length"
+            and len(r["outputs"][i]) == prompt_len + new_tokens[i]
+            for i in range(n_req))
+
+    count_exact = _count_exact(res) and _count_exact(base_res)
+    quality = quality_report(probe, eng, [p.tolist() for p in prompts],
+                             max_new_tokens=16)
+    quality_finite = all(
+        math.isfinite(quality[k]) for k in
+        ("perplexity_ref", "perplexity_test", "perplexity_delta",
+         "top1_agreement", "topk_agreement", "greedy_agreement"))
+
+    row = {
+        "metric": "llm_serving_quant",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "quant": args.quant,
+        "memory_budget_bytes": budget,
+        "base_max_batch": int(base_batch),
+        "quant_max_batch": int(eng.max_batch),
+        "quant_admissible_max_batch": int(admissible_q),
+        "base_preemptions": base_res["preemptions"],
+        "preemptions": res["preemptions"],
+        "base_page_bytes": mm["page_bytes"],
+        "quant_page_bytes": qmm["page_bytes"],
+        "base_weights_bytes": mm["weights_bytes"],
+        "quant_weights_bytes": qmm["weights_bytes"],
+        "token_count_exact": count_exact,
+        "leaked_pages": leaked,
+        "base_leaked_pages": base_leaked,
+        "new_compiles": len(new_compiles),
+        "vs_baseline": round(res["tokens_per_s"]
+                             / base_res["tokens_per_s"], 3),
+        "perplexity_ref": round(quality["perplexity_ref"], 4),
+        "perplexity_test": round(quality["perplexity_test"], 4),
+        "perplexity_delta": round(quality["perplexity_delta"], 4),
+        "top1_agreement": round(quality["top1_agreement"], 4),
+        "topk_agreement": round(quality["topk_agreement"], 4),
+        "greedy_agreement": round(quality["greedy_agreement"], 4),
+        "requests": n_req,
+        "max_new": max_new,
+        "warmup_ms": res["warmup_ms"],
+        "compile_count": res["compile_count"],
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    ok = (base_res["preemptions"] > 0
+          and res["preemptions"] == 0
+          and eng.max_batch == n_req
+          and admissible_q >= 2 * base_batch
+          and count_exact
+          and leaked == 0 and base_leaked == 0
+          and not new_compiles
+          and quality_finite)
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "quant replay violated its contract: "
+            f"base_preemptions={base_res['preemptions']} "
+            f"preemptions={res['preemptions']} "
+            f"quant_max_batch={eng.max_batch} (need {n_req}) "
+            f"admissible={admissible_q} (need >= {2 * base_batch}) "
+            f"token_count_exact={count_exact} "
+            f"leaked={leaked}/{base_leaked} "
+            f"new_compiles={len(new_compiles)} "
+            f"quality_finite={quality_finite}")
 
 
 def _main_fleet(args, jax):

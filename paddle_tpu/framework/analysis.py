@@ -346,6 +346,12 @@ _BAD_DTYPES = ("float64", "complex128")
 
 
 def check_dtypes(closed, label=""):
+    # int8 leaves are NOT findings: a weight-only-quantized or int8-KV
+    # graph legitimately carries int8 params/pools beside bf16/f32
+    # activations (the dequant multiply is the intent).  What T001 does
+    # flag in a quantized graph is the classic dequant accident — a
+    # convert_element_type that widens an int8 operand straight to
+    # float64 (a python-float scale leaking through the multiply).
     findings = []
     for path, j in walk_jaxprs(closed):
         names = _VarNames()
@@ -369,6 +375,17 @@ def check_dtypes(closed, label=""):
                 bad(ov, _loc(label, path + (f"eqn {i} "
                                             f"({eqn.primitive.name})",)),
                     "result")
+            if eqn.primitive.name == "convert_element_type" and \
+                    str(getattr(eqn.invars[0].aval, "dtype", "")) \
+                    == "int8" and \
+                    str(eqn.params.get("new_dtype", "")) in _BAD_DTYPES:
+                findings.append(Finding(
+                    "T001", ERROR,
+                    _loc(label, path + (f"eqn {i} (convert_element_"
+                                        f"type)",)),
+                    f"int8 '{names(eqn.invars[0])}' widens directly to "
+                    f"{eqn.params['new_dtype']} — dequantize in the "
+                    "activation dtype, not double precision"))
         if not path:  # weak-typed top-level outputs: a python scalar
             for k, ov in enumerate(j.outvars):  # flowed through to here
                 aval = getattr(ov, "aval", None)
@@ -944,7 +961,8 @@ def _cli_build_engine(ns):
                      max_model_len=ns.max_model_len,
                      token_budget=ns.token_budget,
                      tensor_parallel=ns.tp if ns.tp > 1 else None,
-                     speculative=ns.spec if ns.spec > 0 else None)
+                     speculative=ns.spec if ns.spec > 0 else None,
+                     quantize=getattr(ns, "quantize", None))
 
 
 def _cli_engine(ns):
@@ -1044,6 +1062,11 @@ def main(argv=None):
                              help="include the speculative verify "
                                   "family (K = max draft tokens; "
                                   "0 = off)")
+    engine_args.add_argument("--quantize", default=None,
+                             choices=["int8"],
+                             help="lint the quantized serving profile "
+                                  "(weight-only int8 GEMM + int8 "
+                                  "paged KV pool)")
 
     eng = sub.add_parser("engine", parents=[common, engine_args],
                          help="lint the LLM engine's warmup "

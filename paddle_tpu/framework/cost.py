@@ -593,10 +593,16 @@ def engine_memory_model(engine, memory_budget=None):
         nbytes = int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
         weights += nbytes // tp if _sharded(spec) else nbytes
 
+    # an int8-quantized pool stores 1 byte per element plus one f32
+    # scale per (head, slot) — head_dim + 4 bytes per slot instead of
+    # head_dim * itemsize, matching the engine's own page_bytes
+    kv_quant = bool(getattr(engine, "_kv_quant", False))
     itemsize = jnp.dtype(engine.dtype).itemsize
+    slot = (engine.head_dim + 4 if kv_quant
+            else engine.head_dim * itemsize)
     nh_local = engine.num_heads // tp
     page = (2 * engine.num_layers * engine.block_size * nh_local
-            * engine.head_dim * itemsize)          # K + V, per chip
+            * slot)                                # K + V, per chip
     pool = engine.num_blocks * page
     seq = engine.max_pages * page
     budget = parse_bytes(memory_budget
@@ -604,6 +610,7 @@ def engine_memory_model(engine, memory_budget=None):
                          else getattr(engine, "memory_budget", None))
     model = {
         "tp": tp,
+        "kv_quantized": kv_quant,
         "weights_bytes": int(weights),
         "page_bytes": int(page),
         "kv_pool_bytes": int(pool),

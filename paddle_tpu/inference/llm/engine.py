@@ -78,7 +78,16 @@ from .faults import (
     RetryPolicy,
     StepWatchdog,
 )
-from .paged_attention import paged_ragged_attention
+from .paged_attention import (
+    paged_ragged_attention,
+    paged_ragged_attention_quant,
+)
+from .quant import (
+    ServingQuantConfig,
+    quantize_block_weights,
+    quantize_kv_rows,
+    scale_key,
+)
 from .scheduler import FINISHED, RUNNING, Request, Scheduler, bucket_size
 from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
 
@@ -86,6 +95,10 @@ from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
 # (leading dim is the layer stack): qkv/fc_in split their OUTPUT columns,
 # proj/fc_out split their INPUT rows (the psum pair per layer); every
 # other leaf (layernorms, biases of row-parallel matmuls) is replicated.
+# Weight-only int8 scale leaves follow their weight's OUTPUT axis: the
+# column-parallel weights' per-column scales shard with the columns,
+# the row-parallel weights' scales stay replicated (their output axis
+# is unsharded), so shard-then-dequant equals dequant-then-shard.
 _TP_BLOCK_SPECS = {
     "attn.qkv.weight": P(None, None, "mp"),
     "attn.qkv.bias": P(None, "mp"),
@@ -93,6 +106,8 @@ _TP_BLOCK_SPECS = {
     "mlp.fc_in.weight": P(None, None, "mp"),
     "mlp.fc_in.bias": P(None, "mp"),
     "mlp.fc_out.weight": P(None, "mp", None),
+    "attn.qkv.weight_scale": P(None, None, "mp"),
+    "mlp.fc_in.weight_scale": P(None, None, "mp"),
 }
 
 
@@ -175,13 +190,21 @@ class LLMEngine:
     requested one, the defaulted page pool is sized to the clamped
     batch, and ``graph-lint cost`` flags any bucket whose estimated
     peak exceeds the budget (M001).
+    ``quantize="int8"`` (or a dict / ServingQuantConfig / QuantConfig)
+    turns on int8 serving: the four block GEMM weights store int8 with
+    per-output-channel scales dequantized at the operand load, and the
+    paged K/V pool stores int8 slots with per-(page, head, slot)
+    scales dequantized inside the ragged attention kernel.  Both
+    residency terms shrink, so under a ``memory_budget=`` the derived
+    admissible max_batch grows (see inference/llm/quant.py); int8 KV
+    output is approximate — quality.py measures the delta.
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None,
                  max_model_len=None, max_batch=8, dtype=None,
                  enable_prefix_caching=True, token_budget=64,
                  mesh=None, tensor_parallel=None, seed=None,
-                 speculative=None, memory_budget=None,
+                 speculative=None, memory_budget=None, quantize=None,
                  faults=None, retry=None, max_queue=None,
                  step_timeout_s=None, clock=None):
         # ----------------------------------------- lifecycle hardening ----
@@ -231,6 +254,11 @@ class LLMEngine:
                                      cfg.max_position_embeddings))
         self.max_pages = -(-self.max_model_len // self.block_size)
         self.dtype = jnp.dtype(dtype) if dtype else jnp.float32
+        # int8 serving (None | "int8" | dict | ServingQuantConfig |
+        # QuantConfig): weight-only int8 GEMM and/or the int8 KV pool
+        self.quant = ServingQuantConfig.resolve(quantize)
+        self._w_quant = bool(self.quant and self.quant.weights)
+        self._kv_quant = bool(self.quant and self.quant.kv_cache)
         # speculative decoding (None | K | dict | SpeculativeConfig):
         # an n-gram drafter plus the bucketed verify executable family
         self.spec = SpeculativeConfig.resolve(speculative)
@@ -263,6 +291,13 @@ class LLMEngine:
                 if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
                 else jnp.asarray(x))
         params = jax.tree_util.tree_map(cast, d["params"])
+        if self._w_quant:
+            # int8 weight storage BEFORE the budget math below, so the
+            # admissible-batch derivation prices 1 byte/param (+ the
+            # f32 per-output-channel scale leaves) for the four GEMMs
+            params = dict(params)
+            params["blocks"] = quantize_block_weights(
+                dict(params["blocks"]))
 
         # ---------------------------------------------- HBM budget --------
         # pages + weights bound max_batch (ROADMAP item 3): under a
@@ -272,9 +307,13 @@ class LLMEngine:
         from ...framework.cost import derive_max_batch, parse_bytes
         self.memory_budget = parse_bytes(memory_budget)
         weights_per_chip = _params_bytes_per_chip(params, self.tp)
+        # an int8 slot costs head_dim bytes of values plus one f32
+        # scale per (slot, head); full precision costs head_dim *
+        # itemsize.  Same count for K and V.
+        slot_bytes = (self.head_dim + 4 if self._kv_quant
+                      else self.head_dim * jnp.dtype(self.dtype).itemsize)
         page_bytes = (2 * self.num_layers * self.block_size
-                      * (self.num_heads // self.tp) * self.head_dim
-                      * jnp.dtype(self.dtype).itemsize)
+                      * (self.num_heads // self.tp) * slot_bytes)
         # per-chip K+V bytes of one page — the migration cost model's
         # bytes-moved unit (global payload = page_bytes * tp)
         self.page_bytes = int(page_bytes)
@@ -313,6 +352,11 @@ class LLMEngine:
                                    drafter=self.drafter)
         cache_shape = (self.num_layers, self.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
+        self._kv_dtype = jnp.int8 if self._kv_quant else self.dtype
+        # per-(layer, page, head, slot) dequant scales for the int8
+        # pool; head axis shards with the pool under TP
+        scale_shape = (self.num_layers, self.num_blocks,
+                       self.num_heads, self.block_size)
 
         self._requests = {}
         self._next_id = 0
@@ -346,6 +390,11 @@ class LLMEngine:
                 params["blocks"]["attn.qkv.weight"][:, :, perm]
             params["blocks"]["attn.qkv.bias"] = \
                 params["blocks"]["attn.qkv.bias"][:, perm]
+            if self._w_quant:
+                # per-output-channel scales ride their columns through
+                # the same head-major regrouping
+                qs = scale_key("attn.qkv.weight")
+                params["blocks"][qs] = params["blocks"][qs][:, :, perm]
 
         # param/cache sharding layout (replicated pseudo-specs at tp == 1
         # are never built — the single-device path skips device_put)
@@ -356,6 +405,8 @@ class LLMEngine:
             "head": {k: P() for k in params["head"]},
         }
         self._cache_spec = P(None, None, None, "mp", None)
+        self._scale_spec = P(None, None, "mp", None)
+        self._ks = self._vs = None
         if tp > 1:
             named = lambda spec: NamedSharding(self.mesh, spec)  # noqa: E731
             self._param_shardings = jax.tree_util.tree_map(
@@ -367,26 +418,52 @@ class LLMEngine:
                 jax.device_put, params, self._param_shardings)
             # build the pool SHARDED (never materialized on one device —
             # the point of TP serving is a pool larger than one chip)
-            zeros = jax.jit(lambda: jnp.zeros(cache_shape, self.dtype),
+            zeros = jax.jit(lambda: jnp.zeros(cache_shape,
+                                              self._kv_dtype),
                             out_shardings=self._cache_sharding)
             self._kc = zeros()
             self._vc = zeros()
+            if self._kv_quant:
+                self._scale_sharding = named(self._scale_spec)
+                szeros = jax.jit(
+                    lambda: jnp.zeros(scale_shape, jnp.float32),
+                    out_shardings=self._scale_sharding)
+                self._ks = szeros()
+                self._vs = szeros()
         else:
             self.params = params
-            self._kc = jnp.zeros(cache_shape, self.dtype)
-            self._vc = jnp.zeros(cache_shape, self.dtype)
+            self._kc = jnp.zeros(cache_shape, self._kv_dtype)
+            self._vc = jnp.zeros(cache_shape, self._kv_dtype)
+            if self._kv_quant:
+                self._ks = jnp.zeros(scale_shape, jnp.float32)
+                self._vs = jnp.zeros(scale_shape, jnp.float32)
 
         def psum_mp(y):
             """Row-parallel reduction; identity on the single-device path
             (keeps the tp=1 graph bitwise identical to the pre-TP one)."""
             return jax.lax.psum(y, "mp") if tp > 1 else y
 
+        if self._w_quant:
+            act_dtype = self.dtype
+
+            def wmat(p_l, key):
+                # dequant fused into the GEMM operand load: XLA folds
+                # the convert+multiply into the weight stream, so the
+                # matmul runs in the activation dtype while HBM pays
+                # 1 byte/param (+ the per-column f32 scale row)
+                return (p_l[key].astype(act_dtype)
+                        * p_l[scale_key(key)].astype(act_dtype))
+        else:
+            def wmat(p_l, key):
+                return p_l[key]
+
         def attn_proj(p_l, x):
             """LN -> fused QKV, the FusedMultiTransformer block head.
             Under TP the local qkv columns are this shard's head group
             (see _qkv_head_permutation), so nh_l heads come out."""
             hh = _layernorm(x, p_l["ln_1.weight"], p_l["ln_1.bias"], eps)
-            qkv = hh @ p_l["attn.qkv.weight"] + p_l["attn.qkv.bias"]
+            qkv = hh @ wmat(p_l, "attn.qkv.weight") \
+                + p_l["attn.qkv.bias"]
             b, t = x.shape[0], x.shape[1]
             qkv = qkv.reshape(b, t, 3, nh_l, hd)
             return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -394,12 +471,12 @@ class LLMEngine:
         def mlp_residual(p_l, x, att_out):
             # row-parallel proj/fc_out: partial matmul + psum, bias added
             # once AFTER the reduction (replicated)
-            x = x + psum_mp(att_out @ p_l["attn.proj.weight"]) \
+            x = x + psum_mp(att_out @ wmat(p_l, "attn.proj.weight")) \
                 + p_l["attn.proj.bias"]
             h2 = _layernorm(x, p_l["ln_2.weight"], p_l["ln_2.bias"], eps)
-            ff = jax.nn.gelu(h2 @ p_l["mlp.fc_in.weight"]
+            ff = jax.nn.gelu(h2 @ wmat(p_l, "mlp.fc_in.weight")
                              + p_l["mlp.fc_in.bias"], approximate=True)
-            return x + psum_mp(ff @ p_l["mlp.fc_out.weight"]) \
+            return x + psum_mp(ff @ wmat(p_l, "mlp.fc_out.weight")) \
                 + p_l["mlp.fc_out.bias"]
 
         def scatter_pages(cache, slots, values):
@@ -412,6 +489,24 @@ class LLMEngine:
             flat = flat.at[slots].set(values.astype(cache.dtype),
                                       mode="drop")
             return flat.reshape(nb, bs, nh_l, hd)
+
+        def scatter_pages_quant(cache, scales, slots, values):
+            """Quantize-at-append: each written [nh_l, hd] token row
+            quantizes per head (absmax / 127) and lands as int8 values
+            plus one f32 scale per (slot, head).  Padding slots carry
+            the out-of-range id ``nb * bs`` — both scatters drop them
+            (the scale index lands past the flat scale pool exactly
+            when the slot lands past the flat cache)."""
+            q, s = quantize_kv_rows(values)      # int8 [N,nh_l,hd], [N,nh_l]
+            flat = cache.reshape(nb * bs, nh_l, hd)
+            flat = flat.at[slots].set(q, mode="drop")
+            page, off = slots // bs, slots % bs
+            sidx = (page[:, None] * (nh_l * bs)
+                    + jnp.arange(nh_l)[None, :] * bs + off[:, None])
+            sflat = scales.reshape(nb * nh_l * bs)
+            sflat = sflat.at[sidx].set(s, mode="drop")
+            return (flat.reshape(nb, bs, nh_l, hd),
+                    sflat.reshape(nb, nh_l, bs))
 
         def head_logits(params, x):
             x = _layernorm(x, params["head"]["weight"],
@@ -472,6 +567,48 @@ class LLMEngine:
             logits = head_logits(params, x[0])       # [Tb, V]
             return jnp.argmax(logits, -1), logits, kc, vc
 
+        def ragged_fn_quant(params, ids, kc, vc, ks, vs, block_tables,
+                            positions, rows, row_start, row_qlen,
+                            row_pos0):
+            """ragged_fn with the int8 KV pool: identical packing and
+            causal semantics, but the per-layer scatter quantizes each
+            written token row (int8 values + per-head f32 scale) and
+            attention dequantizes at read time INSIDE the kernel —
+            no bf16 copy of the pool is ever materialized.  Returns
+            (argmax [Tb], logits [Tb, V], kc, vc, ks, vs)."""
+            emb = params["embed"]
+            tb = ids.shape[0]
+            p_safe = jnp.maximum(positions, 0)
+            x = (emb["word_embeddings.weight"][ids]
+                 + emb["position_embeddings.weight"][p_safe])
+            x = x.astype(self.dtype)[None]           # [1, Tb, hidden]
+            slot = (block_tables[rows, p_safe // bs] * bs + p_safe % bs)
+            slots = jnp.where(positions >= 0, slot, nb * bs)
+            ctx = p_safe + jnp.where(positions >= 0, 1, 0)
+
+            def layer(carry, xs):
+                x = carry
+                p_l, kc_l, vc_l, ks_l, vs_l = xs
+                q, k, v = attn_proj(p_l, x)       # [1, Tb, nh_l, hd]
+                kc_l, ks_l = scatter_pages_quant(kc_l, ks_l, slots,
+                                                 k[0])
+                vc_l, vs_l = scatter_pages_quant(vc_l, vs_l, slots,
+                                                 v[0])
+                out = paged_ragged_attention_quant(
+                    q[0], kc_l, vc_l, ks_l, vs_l, block_tables, ctx,
+                    rows, row_start, row_qlen, row_pos0)
+                out = out.astype(x.dtype).reshape(1, tb, nh_l * hd)
+                return mlp_residual(p_l, x, out), (kc_l, vc_l, ks_l,
+                                                   vs_l)
+
+            x, (kc, vc, ks, vs) = jax.lax.scan(
+                layer, x, (params["blocks"], kc, vc, ks, vs))
+            logits = head_logits(params, x[0])       # [Tb, V]
+            return jnp.argmax(logits, -1), logits, kc, vc, ks, vs
+
+        step_fn = ragged_fn_quant if self._kv_quant else ragged_fn
+        n_pools = 4 if self._kv_quant else 2
+
         if tp > 1:
             # shard_map: each device runs the SAME program on its local
             # head slice — local qkv/fc columns, local pool shard, the
@@ -480,27 +617,39 @@ class LLMEngine:
             # pins NamedShardings so host operands are placed without
             # resharding and the donated pool keeps its layout.
             c_spec, rep = self._cache_spec, P()
+            if self._kv_quant:
+                pool_specs = (c_spec, c_spec,
+                              self._scale_spec, self._scale_spec)
+                pool_shards = (self._cache_sharding,
+                               self._cache_sharding,
+                               self._scale_sharding,
+                               self._scale_sharding)
+            else:
+                pool_specs = (c_spec, c_spec)
+                pool_shards = (self._cache_sharding,
+                               self._cache_sharding)
 
             def tp_wrap(fn, n_extra):
                 extra = (rep,) * n_extra
                 sm = jax.shard_map(
                     fn, mesh=self.mesh,
-                    in_specs=(self._param_specs, rep, c_spec, c_spec)
+                    in_specs=(self._param_specs, rep) + pool_specs
                     + extra,
-                    out_specs=(rep, rep, c_spec, c_spec),
+                    out_specs=(rep, rep) + pool_specs,
                     check_rep=False)
-                csh, rsh = self._cache_sharding, self._rep
+                rsh = self._rep
                 return jax.jit(
                     sm,
-                    in_shardings=(self._param_shardings, rsh, csh, csh)
-                    + (rsh,) * n_extra,
-                    out_shardings=(rsh, rsh, csh, csh),
-                    donate_argnums=(2, 3))
+                    in_shardings=(self._param_shardings, rsh)
+                    + pool_shards + (rsh,) * n_extra,
+                    out_shardings=(rsh, rsh) + pool_shards,
+                    donate_argnums=tuple(range(2, 2 + n_pools)))
 
             # tables, positions, rows, row_start, row_qlen, row_pos0
-            self._ragged = tp_wrap(ragged_fn, 6)
+            self._ragged = tp_wrap(step_fn, 6)
         else:
-            self._ragged = jax.jit(ragged_fn, donate_argnums=(2, 3))
+            self._ragged = jax.jit(
+                step_fn, donate_argnums=tuple(range(2, 2 + n_pools)))
 
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
@@ -677,16 +826,28 @@ class LLMEngine:
         pools — framework.analysis traces these without executing (or
         donating) anything, so a lint pass never touches cache state."""
         sds = jax.ShapeDtypeStruct
-        kc = sds(self._kc.shape, self._kc.dtype)
-        vc = sds(self._vc.shape, self._vc.dtype)
+        pools = tuple(sds(c.shape, c.dtype) for c in self._pools())
         i32 = jnp.int32
         rmax = self.max_batch
         for kind, tb in self._bucket_grid():
-            args = (self.params, sds((tb,), i32), kc, vc,
+            args = (self.params, sds((tb,), i32)) + pools + (
                     sds((rmax, self.max_pages), i32), sds((tb,), i32),
                     sds((tb,), i32), sds((rmax,), i32),
                     sds((rmax,), i32), sds((rmax,), i32))
             yield kind, tb, self._ragged, args
+
+    def _pools(self):
+        """The donated pool operands of one ragged launch, in call
+        order: (kc, vc) or, under int8 KV, (kc, vc, ks, vs)."""
+        if self._kv_quant:
+            return (self._kc, self._vc, self._ks, self._vs)
+        return (self._kc, self._vc)
+
+    def _set_pools(self, pools):
+        if self._kv_quant:
+            self._kc, self._vc, self._ks, self._vs = pools
+        else:
+            self._kc, self._vc = pools
 
     def memory_model(self, memory_budget=None):
         """Static per-chip HBM breakdown — weight bytes (sharding-
@@ -732,9 +893,10 @@ class LLMEngine:
                 positions = jnp.full((tb,), -1, jnp.int32)
                 rows = jnp.zeros((tb,), jnp.int32)
                 zr = jnp.zeros((rmax,), jnp.int32)
-                _, _, self._kc, self._vc = self._ragged(
-                    self.params, ids, self._kc, self._vc, tables,
+                out = self._ragged(
+                    self.params, ids, *self._pools(), tables,
                     positions, rows, zr, zr, zr)
+                self._set_pools(out[2:])
                 jax.block_until_ready(self._kc)
                 timings[f"{kind}[{tb}]"] = \
                     (time.perf_counter() - t0) * 1e3
@@ -896,6 +1058,14 @@ class LLMEngine:
         v = np.asarray(jax.device_get(self._vc))[:, idx]  # noqa: H001
         return k, v
 
+    def _gather_scale_pages(self, block_ids):
+        """Scale-pool counterpart of :meth:`_gather_pages` for the int8
+        KV pool: [L, P, Nkv, bs] numpy arrays in ``block_ids`` order."""
+        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
+        ks = np.asarray(jax.device_get(self._ks))[:, idx]  # noqa: H001 (migration is a host-staged transfer by design)
+        vs = np.asarray(jax.device_get(self._vs))[:, idx]  # noqa: H001
+        return ks, vs
+
     def _scatter_pages(self, block_ids, k_pages, v_pages):
         """Host-staged page scatter: pull the pools to host, write the
         migrated pages into their destination rows, and ``device_put``
@@ -914,6 +1084,20 @@ class LLMEngine:
         else:
             self._kc = jax.device_put(kh)
             self._vc = jax.device_put(vh)
+
+    def _scatter_scale_pages(self, block_ids, k_scales, v_scales):
+        """Scale-pool counterpart of :meth:`_scatter_pages`."""
+        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
+        ksh = np.array(jax.device_get(self._ks))  # noqa: H001 (migration is a host-staged transfer by design)
+        vsh = np.array(jax.device_get(self._vs))  # noqa: H001
+        ksh[:, idx] = k_scales
+        vsh[:, idx] = v_scales
+        if self.tp > 1:
+            self._ks = jax.device_put(ksh, self._scale_sharding)
+            self._vs = jax.device_put(vsh, self._scale_sharding)
+        else:
+            self._ks = jax.device_put(ksh)
+            self._vs = jax.device_put(vsh)
 
     def export_request(self, request_id):
         """Serialize one RUNNING request for migration to a peer
@@ -934,10 +1118,15 @@ class LLMEngine:
         k, v = self._gather_pages(seq["block_ids"])
         self.events.append((self._step_index, "export", request_id,
                             len(seq["block_ids"])))
-        return {"request": req, "seq": seq, "k_pages": k, "v_pages": v}
+        state = {"request": req, "seq": seq, "k_pages": k, "v_pages": v}
+        if self._kv_quant:
+            ks, vs = self._gather_scale_pages(seq["block_ids"])
+            state["k_scales"] = ks
+            state["v_scales"] = vs
+        return state
 
     def import_request(self, req, seq, k_pages, v_pages,
-                       fault_hook=None):
+                       fault_hook=None, k_scales=None, v_scales=None):
         """Adopt a migrated-in request mid-generation: allocate a
         private page chain, scatter the payload into this engine's
         pools, re-register full pages in this prefix cache, and insert
@@ -965,11 +1154,30 @@ class LLMEngine:
                 f"page payload {k_pages.shape} does not fit this pool "
                 f"(expected {expect}) — migration requires identically "
                 f"configured engines")
+        if self._kv_quant:
+            if k_scales is None or v_scales is None:
+                raise ValueError(
+                    "this engine's KV pool is int8 — the migration "
+                    "payload must carry k_scales/v_scales (export from "
+                    "an identically quantized engine)")
+            sexpect = (self.num_layers, len(seq["block_ids"]),
+                       self.num_heads, self.block_size)
+            if tuple(k_scales.shape) != sexpect or \
+                    tuple(v_scales.shape) != sexpect:
+                raise ValueError(
+                    f"scale payload {k_scales.shape} does not fit this "
+                    f"pool (expected {sexpect})")
+        elif k_scales is not None or v_scales is not None:
+            raise ValueError(
+                "scale payload offered to a full-precision pool — "
+                "migration requires identically configured engines")
         table = self.block_manager.import_seq(rid, seq)
         try:
             if fault_hook is not None:
                 fault_hook()
             self._scatter_pages(table, k_pages, v_pages)
+            if self._kv_quant:
+                self._scatter_scale_pages(table, k_scales, v_scales)
             self.block_manager.register_imported(rid, seq["hashes"])
         except BaseException:
             # exact reclamation: every page import_seq allocated goes
@@ -1049,7 +1257,7 @@ class LLMEngine:
         def launch_ragged():
             with profiler.RecordEvent("llm_engine::ragged"):
                 return self._ragged(
-                    self.params, jnp.asarray(ids), self._kc, self._vc,
+                    self.params, jnp.asarray(ids), *self._pools(),
                     jnp.asarray(tables), jnp.asarray(positions),
                     jnp.asarray(tok_rows), jnp.asarray(row_start),
                     jnp.asarray(row_qlen), jnp.asarray(row_pos0))
@@ -1058,7 +1266,8 @@ class LLMEngine:
                            launch_ragged)
         if out is None:
             return              # quarantined; reservations rolled back
-        nxt, logits, self._kc, self._vc = out
+        nxt, logits = out[0], out[1]
+        self._set_pools(out[2:])
         nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per step)
         row_logits = self._fetch_sampling_rows(rows, starts, logits)
 

@@ -863,7 +863,9 @@ class Fleet:
             dst.engine.import_request(state["request"], state["seq"],
                                       state["k_pages"],
                                       state["v_pages"],
-                                      fault_hook=hook)
+                                      fault_hook=hook,
+                                      k_scales=state.get("k_scales"),
+                                      v_scales=state.get("v_scales"))
         except MigrationError:
             raise
         except Exception as e:   # NoFreeBlocks, injected OOM, shape --

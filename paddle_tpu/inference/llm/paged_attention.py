@@ -77,6 +77,17 @@ def paged_ragged_attention_xla(q, k_pages, v_pages, block_tables, ctx,
     s_max = num_pages * bs
     k = k_pages[block_tables].reshape(r, s_max, nkv, d)[rows]
     v = v_pages[block_tables].reshape(r, s_max, nkv, d)[rows]
+    return _ragged_masked_chain(q, k, v, ctx)
+
+
+def _ragged_masked_chain(q, k, v, ctx):
+    """The shared per-token masked attention chain: q [T, Nq, D]
+    against gathered k/v [T, S_max, Nkv, D] with per-token visible
+    context ``ctx`` [T].  Extracted verbatim from the full-precision
+    fallback so the int8 fallback reuses the exact same per-element
+    reductions after its dequant gather."""
+    t, nq, d = q.shape
+    s_max, nkv = k.shape[1], k.shape[2]
     g = nq // nkv
     qg = q.reshape(t, nkv, g, d)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -89,6 +100,53 @@ def paged_ragged_attention_xla(q, k_pages, v_pages, block_tables, ctx,
     out = jnp.einsum("tngs,tsnd->tngd", p, v.astype(jnp.float32))
     out = jnp.where(ctx[:, None, None, None] > 0, out, 0.0)
     return out.reshape(t, nq, d).astype(q.dtype)
+
+
+def paged_ragged_attention_quant_xla(q, k_pages, v_pages, k_scales,
+                                     v_scales, block_tables, ctx, rows):
+    """Masked-XLA fallback for the INT8 ragged batch.
+
+    ``k_pages``/``v_pages`` [NB, bs, Nkv, D] int8 and
+    ``k_scales``/``v_scales`` [NB, Nkv, bs] float32 — one symmetric
+    dequant scale per (page, kv head, slot), as written by the
+    engine's quantized append.  Gathers each token's pages AND scale
+    rows, dequantizes in f32 (the same ``int8 * scale`` product the
+    Pallas kernel computes per loaded slot), then runs the identical
+    masked chain as :func:`paged_ragged_attention_xla`."""
+    t, nq, d = q.shape
+    r, num_pages = block_tables.shape
+    _, bs, nkv, _ = k_pages.shape
+    s_max = num_pages * bs
+
+    def deq(pages, scales):
+        pg = pages[block_tables].astype(jnp.float32)   # [R,P,bs,Nkv,D]
+        sc = scales[block_tables].astype(jnp.float32)  # [R,P,Nkv,bs]
+        pg = pg * sc.transpose(0, 1, 3, 2)[..., None]
+        return pg.reshape(r, s_max, nkv, d)[rows]
+
+    return _ragged_masked_chain(q, deq(k_pages, k_scales),
+                                deq(v_pages, v_scales), ctx)
+
+
+def paged_ragged_attention_quant(q, k_pages, v_pages, k_scales,
+                                 v_scales, block_tables, ctx, rows,
+                                 row_start, row_qlen, row_pos0,
+                                 interpret=False):
+    """Backend dispatch for the int8-KV ragged batch — the quantized
+    twin of :func:`paged_ragged_attention`, carrying both descriptor
+    forms plus the two page-scale pools.  TPU (or ``interpret=True``)
+    runs the in-kernel-dequant Pallas kernel; everywhere else the
+    dequant-gather masked-XLA fallback."""
+    t, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    if ((_use_pallas() or interpret)
+            and _kernel.supports(bs, d, nq, nkv, t)):
+        return _kernel.paged_ragged_attention_quant_pallas(
+            q, k_pages, v_pages, k_scales, v_scales, block_tables,
+            row_start, row_qlen, row_pos0, interpret=interpret)
+    return paged_ragged_attention_quant_xla(
+        q, k_pages, v_pages, k_scales, v_scales, block_tables, ctx,
+        rows)
 
 
 def paged_ragged_attention(q, k_pages, v_pages, block_tables, ctx, rows,

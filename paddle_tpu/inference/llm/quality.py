@@ -1,0 +1,161 @@
+"""Quality gate for approximate serving modes (int8 KV cache).
+
+Weight-only int8 keeps the matmul in the activation dtype, but an int8
+K/V pool changes what attention READS — outputs are no longer
+token-exact vs the full-precision engine.  This module quantifies the
+gap so ``bench_serving.py --quant int8`` can gate on it instead of
+hand-waving:
+
+- :func:`engine_logits` — dense teacher-forced forward straight over
+  ``engine.params`` (dequantizing ``<key>_scale`` weight leaves and
+  emulating the pool's per-(token, head) KV round-trip when the engine
+  is KV-quantized), so both engines score the SAME token sequence.
+- :func:`quality_report` — greedy-agreement over real ``generate``
+  runs plus teacher-forced perplexity and top-1/top-k next-token
+  agreement between the reference and test engines.
+
+Runs on tp=1 engines (the harness reads params on host); the quality
+question is about quantization, not sharding — tp is exact by
+construction (see quant.py's scale-sharding note).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...incubate.nn import _layernorm
+from .quant import dequantize_kv_rows, quantize_kv_rows, scale_key
+
+
+def _wmat(p_l, key, dtype):
+    """Weight leaf -> dense matrix, dequantizing when a ``<key>_scale``
+    sibling exists (same fused dequant the engine's GEMMs run)."""
+    w = p_l[key]
+    sk = scale_key(key)
+    if sk in p_l:
+        return w.astype(dtype) * p_l[sk].astype(dtype)
+    return w
+
+
+def engine_logits(engine, token_ids):
+    """Teacher-forced logits [T, V] for one token sequence, computed
+    densely from ``engine.params`` with the engine's own numerics:
+    quantized weights dequant at the operand load, and — when the
+    engine runs an int8 KV pool — k/v pass through the exact
+    per-(token, head) int8 round-trip the pool applies, so the dense
+    score reflects what the paged kernel actually attends over."""
+    if getattr(engine, "tp", 1) != 1:
+        raise ValueError("engine_logits runs on tp=1 engines")
+    params = jax.device_get(engine.params)
+    blocks = params["blocks"]
+    emb = params["embed"]
+    dtype, eps = engine.dtype, engine.eps
+    nh, hd = engine.num_heads, engine.head_dim
+    ids = jnp.asarray(token_ids, jnp.int32)
+    t = ids.shape[0]
+
+    x = (emb["word_embeddings.weight"][ids]
+         + emb["position_embeddings.weight"][jnp.arange(t)])
+    x = x.astype(dtype)[None]                       # [1, T, hidden]
+    kv_quant = bool(getattr(engine, "_kv_quant", False))  # noqa: H001 (python engine flag, not a tensor)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(hd, jnp.float32))
+    mask = jnp.tril(jnp.ones((t, t), bool))
+
+    num_layers = blocks["ln_1.weight"].shape[0]
+    for li in range(num_layers):
+        p_l = {k: v[li] for k, v in blocks.items()}
+        hh = _layernorm(x, p_l["ln_1.weight"], p_l["ln_1.bias"], eps)
+        qkv = hh @ _wmat(p_l, "attn.qkv.weight", dtype) \
+            + p_l["attn.qkv.bias"]
+        qkv = qkv.reshape(1, t, 3, nh, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if kv_quant:
+            k = dequantize_kv_rows(*quantize_kv_rows(k)).astype(k.dtype)
+            v = dequantize_kv_rows(*quantize_kv_rows(v)).astype(v.dtype)
+        logits = jnp.einsum("btnd,bsnd->bnts", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * scale
+        logits = jnp.where(mask[None, None], logits, jnp.float32(-1e30))
+        p = jax.nn.softmax(logits, axis=-1)
+        att = jnp.einsum("bnts,bsnd->btnd", p, v.astype(jnp.float32))
+        att = att.reshape(1, t, nh * hd).astype(dtype)
+        x = x + att @ _wmat(p_l, "attn.proj.weight", dtype) \
+            + p_l["attn.proj.bias"]
+        h2 = _layernorm(x, p_l["ln_2.weight"], p_l["ln_2.bias"], eps)
+        ff = jax.nn.gelu(h2 @ _wmat(p_l, "mlp.fc_in.weight", dtype)
+                         + p_l["mlp.fc_in.bias"], approximate=True)
+        x = x + ff @ _wmat(p_l, "mlp.fc_out.weight", dtype) \
+            + p_l["mlp.fc_out.bias"]
+
+    x = _layernorm(x, params["head"]["weight"], params["head"]["bias"],
+                   eps)
+    w = emb["word_embeddings.weight"]
+    return np.asarray((x @ w.T.astype(dtype))[0], np.float32)  # noqa: H001 (offline quality harness, host by contract)
+
+
+def _perplexity(logits, ids):
+    """exp(mean NLL) of each next token under the previous position's
+    logits — scored over positions 1..T-1."""
+    lp = jax.nn.log_softmax(jnp.asarray(logits[:-1], jnp.float32), -1)
+    nll = -lp[jnp.arange(len(ids) - 1), jnp.asarray(ids[1:])]
+    return float(jnp.exp(jnp.mean(nll)))  # noqa: H001 (offline quality harness, host by contract)
+
+
+def quality_report(ref_engine, test_engine, prompts, *,
+                   max_new_tokens=16, top_k=5):
+    """Compare a quantized engine against its full-precision twin.
+
+    Three views, all over the same prompts:
+
+    - ``greedy_agreement``: both engines ``generate`` greedily; the
+      fraction of generated positions where the tokens match (the
+      user-visible difference).
+    - ``perplexity_ref`` / ``perplexity_test`` / ``perplexity_delta``:
+      teacher-forced over the REFERENCE continuations, so both engines
+      score identical sequences (delta = test - ref; positive means
+      quantization made the model more surprised by its own fp
+      outputs).
+    - ``top1_agreement`` / ``topk_agreement``: per-position argmax
+      match, and the fraction of positions where the reference argmax
+      appears in the test engine's top ``top_k``.
+    """
+    ref_out = ref_engine.generate(prompts,
+                                  max_new_tokens=max_new_tokens)
+    test_out = test_engine.generate(prompts,
+                                    max_new_tokens=max_new_tokens)
+
+    greedy_hits = greedy_total = 0
+    ppl_ref, ppl_test = [], []
+    top1_hits = topk_hits = pos_total = 0
+    for prompt, ro, to in zip(prompts, ref_out, test_out):
+        ro, to = np.asarray(ro), np.asarray(to)  # noqa: H001 (generate outputs are host arrays)
+        gen_r, gen_t = ro[len(prompt):], to[len(prompt):]
+        n = min(len(gen_r), len(gen_t))
+        greedy_hits += int(np.sum(gen_r[:n] == gen_t[:n]))  # noqa: H001 (offline quality harness, host by contract)
+        greedy_total += n
+
+        lr = engine_logits(ref_engine, ro)
+        lt = engine_logits(test_engine, ro)
+        ppl_ref.append(_perplexity(lr, ro))
+        ppl_test.append(_perplexity(lt, ro))
+        # score the generated region: positions whose NEXT token was
+        # generated, i.e. logits rows len(prompt)-1 .. len(ro)-2
+        rows = np.arange(len(prompt) - 1, len(ro) - 1)
+        ref_arg = np.argmax(lr[rows], -1)
+        test_arg = np.argmax(lt[rows], -1)
+        top1_hits += int(np.sum(ref_arg == test_arg))  # noqa: H001 (offline quality harness, host by contract)
+        order = np.argsort(lt[rows], -1)[:, ::-1][:, :top_k]
+        topk_hits += int(np.sum(order == ref_arg[:, None]))  # noqa: H001 (offline quality harness, host by contract)
+        pos_total += len(rows)
+
+    pr, pt = float(np.mean(ppl_ref)), float(np.mean(ppl_test))
+    return {
+        "prompts": len(prompts),
+        "positions": int(pos_total),
+        "greedy_agreement": greedy_hits / max(greedy_total, 1),
+        "perplexity_ref": pr,
+        "perplexity_test": pt,
+        "perplexity_delta": pt - pr,
+        "top1_agreement": top1_hits / max(pos_total, 1),
+        "topk_agreement": topk_hits / max(pos_total, 1),
+        "top_k": int(top_k),
+    }

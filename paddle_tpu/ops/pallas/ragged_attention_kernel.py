@@ -79,8 +79,7 @@ def supports(block_size, head_dim, num_q_heads, num_kv_heads,
 
 
 def _ragged_kernel(bt_ref, start_ref, qlen_ref, pos0_ref,
-                   q_ref, k_ref, v_ref, o_ref,
-                   o_scr, m_scr, l_scr, *, block_size, group, nc):
+                   *refs, block_size, group, nc, quant=False):
     """One (kv_head, row, page) program.
 
     Row r's tokens live at flat rows [start*G, (start+qlen)*G) of the
@@ -92,7 +91,17 @@ def _ragged_kernel(bt_ref, start_ref, qlen_ref, pos0_ref,
     is never written at all (the finalize store blends against the
     token-validity mask), so the zero-filled padding region stays
     exactly zero.
+
+    ``quant=True`` (static) adds two page-scale operands after the K/V
+    blocks — int8 pages dequantize AT THE OPERAND LOAD into the same
+    f32 accumulation the full-precision path runs, one multiply per
+    loaded slot row; no dequantized copy of the pool ever exists.
     """
+    if quant:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+         o_scr, m_scr, l_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, o_scr, m_scr, l_scr = refs
     r = pl.program_id(1)
     p = pl.program_id(2)
     num_pages = pl.num_programs(2)
@@ -138,6 +147,10 @@ def _ragged_kernel(bt_ref, start_ref, qlen_ref, pos0_ref,
     def _accumulate():
         k = k_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
         v = v_ref[0, :, 0, :].astype(jnp.float32)           # [bs, D]
+        if quant:
+            # per-(slot, head) dequant scales of this page/head block
+            k = k * ks_ref[0, 0, :][:, None]
+            v = v * vs_ref[0, 0, :][:, None]
 
         def acc_chunk(c):
             off = (start + c * _TQ) * group
@@ -265,5 +278,104 @@ def paged_ragged_attention_pallas(q, k_pages, v_pages, block_tables,
     )(block_tables.astype(jnp.int32), row_start.astype(jnp.int32),
       row_qlen.astype(jnp.int32), row_pos0.astype(jnp.int32),
       qg, k_pages, v_pages)
+    return out[:, :t * g].reshape(nkv, t, g, d).transpose(
+        1, 0, 2, 3).reshape(t, nq, d)
+
+
+def _quant_engine_cases(engine):
+    """Launch shapes of the int8-KV ragged family — yielded only for a
+    KV-quantized engine (a full-precision engine never launches this
+    kernel, so its sweep stays the bf16 entry's).  Same descriptor
+    rails and scalar bounds as ``_engine_cases``; the pools are int8
+    and each carries its [NB, Nkv, bs] f32 page-scale operand."""
+    if not getattr(engine, "_kv_quant", False):
+        return
+    nkv = max(engine.num_heads // engine.tp, 1)
+    d = engine.head_dim
+    sds = jax.ShapeDtypeStruct
+    kp = sds((engine.num_blocks, engine.block_size, nkv, d), jnp.int8)
+    sp = sds((engine.num_blocks, nkv, engine.block_size), jnp.float32)
+    rmax = engine.max_batch
+    for kind, tb in engine._bucket_grid():
+        if kind != "ragged":
+            continue
+        if not supports(engine.block_size, d, nkv, nkv, tb):
+            continue
+        bounds = {0: (0, engine.num_blocks - 1), 1: (0, tb),
+                  2: (0, tb), 3: (0, engine.max_model_len - 1)}
+        yield registry.KernelCase(
+            f"ragged_quant[{tb}]", paged_ragged_attention_quant_pallas,
+            (sds((tb, nkv, d), engine.dtype), kp, kp, sp, sp,
+             sds((rmax, engine.max_pages), jnp.int32),
+             sds((rmax,), jnp.int32), sds((rmax,), jnp.int32),
+             sds((rmax,), jnp.int32)), bounds)
+
+
+@registry.register_kernel(
+    "paged_ragged_attention_quant",
+    fallback="paddle_tpu.inference.llm.paged_attention:"
+             "paged_ragged_attention_quant_xla",
+    parity="tests/test_pallas_kernels.py::TestRaggedAttentionQuant::"
+           "test_mixed_batch_parity",
+    engine_shapes=_quant_engine_cases,
+    supports=supports)
+def paged_ragged_attention_quant_pallas(q, k_pages, v_pages, k_scales,
+                                        v_scales, block_tables,
+                                        row_start, row_qlen, row_pos0,
+                                        interpret=False):
+    """Ragged paged attention over an INT8 pool with in-kernel dequant.
+
+    Same contract as :func:`paged_ragged_attention_pallas`, plus
+    ``k_scales``/``v_scales`` [NB, Nkv, bs] float32 — one symmetric
+    dequant scale per (page, kv head, slot), written by the engine's
+    quantized append (inference/llm/quant.py).  Each (kv head, row,
+    page) program loads its int8 [bs, D] page block and its [bs] scale
+    row, dequantizes in f32 registers, and runs the identical
+    online-softmax walk — HBM reads stay 1 byte per pool element."""
+    t, nq, d = q.shape
+    _, bs, nkv, _ = k_pages.shape
+    r, num_pages = block_tables.shape
+    g = nq // nkv
+    nc = t // _TQ
+    tg = (t + _TQ) * g          # one chunk of spill slack
+    qg = q.reshape(t, nkv, g, d).transpose(1, 0, 2, 3)
+    qg = jnp.pad(qg.reshape(nkv, t * g, d), ((0, 0), (0, _TQ * g),
+                                             (0, 0)))
+
+    page_spec = pl.BlockSpec((1, bs, 1, d),
+                             lambda j, rr, p, bt, st, ql, p0:
+                             (bt[rr, p], 0, j, 0))
+    scale_spec = pl.BlockSpec((1, 1, bs),
+                              lambda j, rr, p, bt, st, ql, p0:
+                              (bt[rr, p], j, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nkv, r, num_pages),
+        in_specs=[
+            pl.BlockSpec((1, tg, d),
+                         lambda j, rr, p, bt, st, ql, p0: (j, 0, 0)),
+            page_spec,
+            page_spec,
+            scale_spec,
+            scale_spec,
+        ],
+        out_specs=pl.BlockSpec((1, tg, d),
+                               lambda j, rr, p, bt, st, ql, p0:
+                               (j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tg, d), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+            pltpu.VMEM((tg, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_ragged_kernel, block_size=bs, group=g,
+                          nc=nc, quant=True),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nkv, tg, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), row_start.astype(jnp.int32),
+      row_qlen.astype(jnp.int32), row_pos0.astype(jnp.int32),
+      qg, k_pages, v_pages, k_scales, v_scales)
     return out[:, :t * g].reshape(nkv, t, g, d).transpose(
         1, 0, 2, 3).reshape(t, nq, d)

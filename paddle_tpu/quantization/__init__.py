@@ -331,8 +331,12 @@ class QuantizedLinear(Layer):
         self.out_dtype = w.dtype
 
     def forward(self, x):
-        w = (self.qweight._data.astype(jnp.float32) * self.scales._data) \
-            .astype(self.out_dtype)
+        # dequantize straight into the stored activation dtype — a
+        # float32 round-trip would both upcast the GEMM (defeating a
+        # bf16 out_dtype) and block XLA from fusing the dequant into
+        # the weight operand load
+        w = (self.qweight._data.astype(self.out_dtype)
+             * self.scales._data.astype(self.out_dtype))
         data = x._data if isinstance(x, Tensor) else x
         out = data @ w
         if self.bias is not None:

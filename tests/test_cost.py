@@ -254,6 +254,23 @@ class TestCensus:
         assert all(e["cost"]["collective_bytes"].get("mp", 0) > 0
                    for e in cen.entries)
 
+    def test_golden_census_matches_warmup_compiles_quant(self):
+        """int8 serving keeps the ONE ragged executable family: the
+        quantized engine's census must enumerate the same bucket count
+        and match warmup's observed compiles exactly (the int8 pools
+        and scale operands change signatures, not the grid)."""
+        eng = _make_engine(quantize="int8")
+        cen = C.run_census(eng)
+        assert cen.families == {"ragged": 2}
+        w = CompileWatcher(eng._ragged)
+        eng.warmup()
+        observed = sum(n for _, n in w.new_compiles())
+        assert cen.compile_count == observed == 2
+
+    def test_census_quant_clean(self):
+        cen = C.run_census(_make_engine(quantize="int8"))
+        assert cen.findings == [], [f.format() for f in cen.findings]
+
     def test_census_shipped_engine_clean_and_cold(self):
         """tier-1 CI gate: zero M001/C001 findings over the shipped
         grid (incl. speculative) and every serving cache stays COLD —
@@ -346,3 +363,24 @@ class TestEngineMemoryBudget:
         mm = eng.memory_model("16GiB")
         assert mm["derived_max_batch"] >= eng.max_batch
         assert mm["kv_pool_bytes"] == mm["page_bytes"] * eng.num_blocks
+
+    def test_quant_residency_doubles_admissible_batch(self):
+        """M001's memory model prices int8 residency: the SAME declared
+        budget that admits batch 2 at f32 must admit >= 4 quantized —
+        both weight bytes (1 byte/param + scale rows on the four GEMM
+        leaves) and page bytes (head_dim + 4 per slot) shrink."""
+        mm32 = C.engine_memory_model(_make_engine())
+        budget = mm32["weights_bytes"] + int(2.5 * mm32["seq_bytes"])
+        base = _make_engine(memory_budget=budget, max_batch=64)
+        quant = _make_engine(memory_budget=budget, max_batch=64,
+                             quantize="int8")
+        assert base.max_batch == 2
+        assert quant.max_batch >= 2 * base.max_batch
+        mm8 = C.engine_memory_model(quant)
+        assert mm8["kv_quantized"] is True
+        assert mm8["derived_max_batch"] >= 2 * base.max_batch
+        # the model's page pricing matches the engine's own accounting
+        assert mm8["page_bytes"] == quant.page_bytes
+        hd = quant.head_dim
+        assert mm8["page_bytes"] * (hd * 4) \
+            == mm32["page_bytes"] * (hd + 4)

@@ -297,6 +297,31 @@ class TestCleanSweeps:
         fs = KL.lint_registry(_make_engine(speculative=2))
         assert fs == [], [f.format() for f in fs]
 
+    def test_registry_sweep_zero_findings_quant_tp1(self):
+        # int8 serving swaps in the quant ragged family: int8 page
+        # blocks plus the (1, 1, bs) scale blocks must all pass
+        # K001-K004 at the engine's real launch shapes
+        fs = KL.lint_registry(_make_engine(quantize="int8"))
+        assert fs == [], [f.format() for f in fs]
+
+    def test_registry_sweep_zero_findings_quant_tp2(self):
+        assert len(jax.devices()) >= 2
+        fs = KL.lint_registry(_make_engine(tp=2, quantize="int8"))
+        assert fs == [], [f.format() for f in fs]
+
+    def test_registry_sweep_zero_findings_quant_speculative(self):
+        fs = KL.lint_registry(_make_engine(speculative=2,
+                                           quantize="int8"))
+        assert fs == [], [f.format() for f in fs]
+
+    def test_quant_entry_skipped_on_unquantized_engine(self):
+        """The quant ragged entry yields NO cases for an engine without
+        an int8 pool — the sweep must skip it, not invent shapes."""
+        entries = registry.load_all()
+        e = entries["paged_ragged_attention_quant"]
+        assert list(e.engine_shapes(_make_engine())) == []
+        assert list(e.engine_shapes(_make_engine(quantize="int8")))
+
     def test_sweep_leaves_executable_caches_cold(self):
         eng = _make_engine(speculative=2)
         KL.lint_registry(eng)
@@ -308,8 +333,12 @@ class TestCleanSweeps:
         shipped kernel contributes at least one case at the default
         engine config."""
         eng = _make_engine()
+        qeng = _make_engine(quantize="int8")
         entries = registry.load_all()
-        cases = {name: list(e.engine_shapes(eng))
+        # profile-gated entries (the quant family) contribute on the
+        # engine profile that actually launches them
+        cases = {name: (list(e.engine_shapes(eng))
+                        or list(e.engine_shapes(qeng)))
                  for name, e in entries.items()
                  if e.engine_shapes is not None}
         assert all(cases.values()), cases
@@ -431,6 +460,18 @@ class TestKernelLintCLI:
     def test_cli_kernels_strict_clean_tp2(self, capsys):
         assert len(jax.devices()) >= 2
         rc = A.main(["kernels", "--tp", "2", "--strict", "--spec", "2"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "0 error(s), 0 warning(s)" in out
+
+    def test_cli_kernels_strict_clean_quant_tp1(self, capsys):
+        rc = A.main(["kernels", "--strict", "--quantize", "int8"])
+        out = capsys.readouterr().out
+        assert rc == 0 and "0 error(s), 0 warning(s)" in out
+
+    def test_cli_kernels_strict_clean_quant_tp2_spec(self, capsys):
+        assert len(jax.devices()) >= 2
+        rc = A.main(["kernels", "--tp", "2", "--strict", "--spec", "2",
+                     "--quantize", "int8"])
         out = capsys.readouterr().out
         assert rc == 0 and "0 error(s), 0 warning(s)" in out
 

@@ -373,3 +373,179 @@ class TestRaggedAttention:
         q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
         bt = jnp.asarray(np.array([[1, 4, 2], [3, 0, 5]], np.int32))
         self._check(q, kp, vp, bt, [0, 3], [3, 5], [6, 0])
+
+
+class TestRaggedAttentionQuant:
+    """Interpret-mode parity battery for the INT8-pool ragged kernel —
+    the registry's K005 contract points at ``test_mixed_batch_parity``
+    by name.  The pools are genuinely quantized (quantize_kv_rows per
+    (token, head) row, the engine's append-time layout) and every case
+    checks the in-kernel-dequant Pallas path against the dequant-gather
+    masked-XLA fallback (``paged_ragged_attention_quant_xla``) on the
+    SAME descriptors."""
+
+    def _qpool(self, NB=6, BS=8, NKV=2, D=16, seed=0):
+        from paddle_tpu.inference.llm.quant import quantize_kv_rows
+
+        rng = np.random.RandomState(seed)
+        out = []
+        for _ in range(2):
+            f = jnp.asarray(rng.randn(NB, BS, NKV, D).astype(np.float32))
+            q, s = quantize_kv_rows(f)           # s: [NB, BS, NKV]
+            out += [q, jnp.transpose(s, (0, 2, 1))]   # pool layout
+        kq, ks, vq, vs = out
+        return kq, vq, ks, vs
+
+    def _token_descriptors(self, T, row_start, row_qlen, row_pos0):
+        ctx = np.zeros(T, np.int32)
+        rows = np.zeros(T, np.int32)
+        for r in range(len(row_start)):
+            s, n, p0 = int(row_start[r]), int(row_qlen[r]), \
+                int(row_pos0[r])
+            ctx[s:s + n] = p0 + np.arange(1, n + 1)
+            rows[s:s + n] = r
+        return jnp.asarray(ctx), jnp.asarray(rows)
+
+    def _check(self, q, kq, vq, ks, vs, bt, row_start, row_qlen,
+               row_pos0):
+        from paddle_tpu.inference.llm.paged_attention import (
+            paged_ragged_attention_quant_xla,
+        )
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import (
+            paged_ragged_attention_quant_pallas,
+        )
+
+        ctx, rows = self._token_descriptors(q.shape[0], row_start,
+                                            row_qlen, row_pos0)
+        got = paged_ragged_attention_quant_pallas(
+            q, kq, vq, ks, vs, bt, jnp.asarray(row_start, jnp.int32),
+            jnp.asarray(row_qlen, jnp.int32),
+            jnp.asarray(row_pos0, jnp.int32), interpret=True)
+        ref = paged_ragged_attention_quant_xla(q, kq, vq, ks, vs, bt,
+                                               ctx, rows)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+        return np.asarray(got)
+
+    def test_mixed_batch_parity(self):
+        """One launch, all three phases at once through scattered
+        non-identity tables with GQA folding, on an int8 pool: a decode
+        row deep in its sequence, a page-straddling prefill chunk, a
+        speculative-verify row, and a dead row whose tokens — like the
+        bucket padding — must come back as EXACT zeros even though the
+        dead rows' scale entries are nonzero garbage."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 16
+        from paddle_tpu.ops.pallas.ragged_attention_kernel import (
+            supports,
+        )
+        assert supports(BS, D, NQ, NKV, T)
+        kq, vq, ks, vs = self._qpool(NB, BS, NKV, D, seed=70)
+        rng = np.random.RandomState(71)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[5, 2, 0], [4, 1, 3], [0, 3, 5],
+                                   [2, 2, 2]], np.int32))
+        row_start = [0, 1, 7, 0]
+        row_qlen = [1, 6, 3, 0]          # decode, chunk, verify, dead
+        row_pos0 = [9, 5, 3, 0]
+        got = self._check(q, kq, vq, ks, vs, bt, row_start, row_qlen,
+                          row_pos0)
+        dead = np.ones(T, bool)
+        for s, n in zip(row_start, row_qlen):
+            dead[s:s + n] = False
+        assert np.all(got[dead] == 0.0), "padding tokens not exact zero"
+
+    def test_decode_rows_partial_page(self):
+        """A full batch of one-token decode rows at depths that leave
+        the last page partially filled (13 = 8 + 5), plus an empty
+        sequence that must emit exact zeros."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 8
+        kq, vq, ks, vs = self._qpool(NB, BS, NKV, D, seed=72)
+        rng = np.random.RandomState(73)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(rng.randint(0, NB, size=(T, 3)).astype(np.int32))
+        lens = np.array([0, 13, 24, 5, 1, 8, 16, 9], np.int32)
+        row_start = np.arange(T, dtype=np.int32)
+        row_qlen = (lens > 0).astype(np.int32)
+        row_pos0 = np.maximum(lens - 1, 0).astype(np.int32)
+        got = self._check(q, kq, vq, ks, vs, bt, row_start, row_qlen,
+                          row_pos0)
+        np.testing.assert_allclose(got[0], 0.0)      # empty slot
+
+    def test_gqa_group_of_four(self):
+        """8 query heads on 2 KV heads (G = 4) over the int8 pool: the
+        per-head scales broadcast across the whole query-head group."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 8, 2, 16, 8
+        kq, vq, ks, vs = self._qpool(NB, BS, NKV, D, seed=74)
+        rng = np.random.RandomState(75)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[1, 4, 2], [3, 0, 5]], np.int32))
+        self._check(q, kq, vq, ks, vs, bt, [0, 3], [3, 5], [6, 0])
+
+    def test_scattered_tables_shared_pages(self):
+        """Two rows aliasing the SAME physical pages through different
+        logical positions (prefix sharing after a fork): dequant reads
+        the one (page, head, slot) scale regardless of which row is
+        looking."""
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 8
+        kq, vq, ks, vs = self._qpool(NB, BS, NKV, D, seed=76)
+        rng = np.random.RandomState(77)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[3, 1, 0], [3, 1, 5]], np.int32))
+        self._check(q, kq, vq, ks, vs, bt, [0, 4], [4, 4], [10, 17])
+
+    def test_dequant_matches_full_precision_within_step(self):
+        """End-to-end sanity on the approximation itself: attention
+        over the int8 pool must land within the per-row quantization
+        error of attention over the dequantized-f32 pool (NOT the exact
+        pre-quantization values — that error is the feature's price)."""
+        from paddle_tpu.inference.llm.paged_attention import (
+            paged_ragged_attention_quant_xla,
+            paged_ragged_attention_xla,
+        )
+        from paddle_tpu.inference.llm.quant import dequantize_kv_rows
+
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 4
+        kq, vq, ks, vs = self._qpool(NB, BS, NKV, D, seed=78)
+        rng = np.random.RandomState(79)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(np.array([[0, 1, 2], [3, 4, 5]], np.int32))
+        ctx, rows = self._token_descriptors(T, [0, 2], [2, 2], [12, 20])
+        got = paged_ragged_attention_quant_xla(q, kq, vq, ks, vs, bt,
+                                               ctx, rows)
+        # dequantize the pools on the host and run the f32 reference
+        kf = dequantize_kv_rows(jnp.transpose(kq, (0, 2, 1, 3)),
+                                ks).transpose(0, 2, 1, 3)
+        vf = dequantize_kv_rows(jnp.transpose(vq, (0, 2, 1, 3)),
+                                vs).transpose(0, 2, 1, 3)
+        ref = paged_ragged_attention_xla(q, kf.astype(jnp.float32),
+                                         vf.astype(jnp.float32), bt,
+                                         ctx, rows)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_dispatcher_interpret_route(self):
+        """``paged_ragged_attention_quant`` with interpret=True takes
+        the Pallas route on CPU and agrees with its fallback."""
+        from paddle_tpu.inference.llm.paged_attention import (
+            paged_ragged_attention_quant,
+            paged_ragged_attention_quant_xla,
+        )
+
+        NB, BS, NQ, NKV, D, T = 6, 8, 4, 2, 16, 8
+        kq, vq, ks, vs = self._qpool(NB, BS, NKV, D, seed=80)
+        rng = np.random.RandomState(81)
+        q = jnp.asarray(rng.rand(T, NQ, D).astype(np.float32))
+        bt = jnp.asarray(rng.randint(0, NB, size=(T, 2)).astype(np.int32))
+        row_start = np.arange(T, dtype=np.int32)
+        row_qlen = np.ones(T, np.int32)
+        row_pos0 = np.asarray([3, 0, 9, 7, 1, 15, 4, 11], np.int32)
+        ctx, rows = self._token_descriptors(
+            T, row_start, row_qlen, row_pos0)
+        got = paged_ragged_attention_quant(
+            q, kq, vq, ks, vs, bt, ctx, rows,
+            jnp.asarray(row_start), jnp.asarray(row_qlen),
+            jnp.asarray(row_pos0), interpret=True)
+        ref = paged_ragged_attention_quant_xla(q, kq, vq, ks, vs, bt,
+                                               ctx, rows)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
