@@ -124,86 +124,36 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
                      num_blocks=num_blocks)
 
 
+# The trace constructors moved to paddle_tpu.sim.workloads (same
+# RandomState draw order — byte-identical replays, pinned by golden
+# tests).  The wrappers import lazily so the bench keeps its property
+# of not touching paddle_tpu/jax before --tp forces the device count.
 def _trace(n_requests, rate, max_new, seed=0):
-    rng = np.random.RandomState(seed)
-    gaps = rng.exponential(1.0 / rate, size=n_requests)
-    arrivals = np.cumsum(gaps)
-    prompts = [rng.randint(0, 128, (int(rng.randint(2, 14)),))
-               .astype(np.int32) for _ in range(n_requests)]
-    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
-                  for _ in range(n_requests)]
-    return arrivals, prompts, new_tokens
+    from paddle_tpu.sim.workloads import poisson_trace
+    return poisson_trace(n_requests, rate, max_new, seed=seed)
 
 
 def _shared_prefix_trace(n_requests, rate, max_new, prefix_len, seed=0):
-    """Every request = one common system prompt + a short unique tail."""
-    rng = np.random.RandomState(seed)
-    gaps = rng.exponential(1.0 / rate, size=n_requests)
-    arrivals = np.cumsum(gaps)
-    prefix = rng.randint(0, 128, (prefix_len,)).astype(np.int32)
-    prompts = [np.concatenate(
-        [prefix, rng.randint(0, 128, (int(rng.randint(4, 13)),))
-         .astype(np.int32)]) for _ in range(n_requests)]
-    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
-                  for _ in range(n_requests)]
-    return arrivals, prompts, new_tokens
+    from paddle_tpu.sim.workloads import shared_prefix_trace
+    return shared_prefix_trace(n_requests, rate, max_new, prefix_len,
+                               seed=seed)
 
 
 def _repetitive_trace(n_requests, rate, max_new, seed=0):
-    """Agentic-style workload for speculative decoding: every prompt is
-    a short template pattern repeated (tool-call loops, boilerplate
-    edits), so the n-gram drafter has history to look up from step one
-    and greedy decode settles into drafable cycles."""
-    rng = np.random.RandomState(seed)
-    gaps = rng.exponential(1.0 / rate, size=n_requests)
-    arrivals = np.cumsum(gaps)
-    prompts = []
-    for _ in range(n_requests):
-        pat = rng.randint(0, 128, (int(rng.randint(3, 7)),))
-        reps = int(rng.randint(2, 4))
-        prompts.append(np.tile(pat, reps).astype(np.int32))
-    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
-                  for _ in range(n_requests)]
-    return arrivals, prompts, new_tokens
+    from paddle_tpu.sim.workloads import repetitive_trace
+    return repetitive_trace(n_requests, rate, max_new, seed=seed)
 
 
 def _mixed_trace(n_requests, max_new, seed=0):
-    """Trace engineered for mixed ragged steps: long and short prompts
-    alternate and everything arrives at t=0, so under a small token
-    budget the long prompts chunk across several device steps while the
-    short ones race ahead into decode — steps that carry a prefill
-    chunk AND decode rows are guaranteed, not incidental."""
-    rng = np.random.RandomState(seed)
-    prompts = []
-    for i in range(n_requests):
-        n = (40 + int(rng.randint(8))) if i % 2 == 0 \
-            else (3 + int(rng.randint(5)))
-        prompts.append(rng.randint(0, 128, (n,)).astype(np.int32))
-    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
-                  for _ in range(n_requests)]
-    return prompts, new_tokens
+    from paddle_tpu.sim.workloads import mixed_trace
+    return mixed_trace(n_requests, max_new, seed=seed)
 
 
 def _fleet_trace(n_requests, rate, max_new, seed=0, tenants=4,
                  prefix_len=16):
-    """Multi-tenant workload for the fleet router: each request is one
-    of ``tenants`` shared tenant prefixes (system prompts, 2 pages at
-    block_size=8) plus a short unique tail, so prefix-affinity routing
-    has real structure to exploit — same-tenant traffic concentrating
-    on one replica turns the shared pages into cache hits instead of
-    recomputes on every replica."""
-    rng = np.random.RandomState(seed)
-    gaps = rng.exponential(1.0 / rate, size=n_requests)
-    arrivals = np.cumsum(gaps)
-    prefixes = [rng.randint(0, 128, (prefix_len,)).astype(np.int32)
-                for _ in range(tenants)]
-    prompts = [np.concatenate(
-        [prefixes[int(rng.randint(tenants))],
-         rng.randint(0, 128, (int(rng.randint(4, 13)),))
-         .astype(np.int32)]) for _ in range(n_requests)]
-    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
-                  for _ in range(n_requests)]
-    return arrivals, prompts, new_tokens
+    from paddle_tpu.sim.workloads import fleet_trace
+    return fleet_trace(n_requests, rate, max_new, seed=seed,
+                       tenants=tenants, prefix_len=prefix_len)
 
 
 def _build_fleet(replicas, args, max_model_len=64, faults=None,
@@ -222,7 +172,8 @@ def _build_fleet(replicas, args, max_model_len=64, faults=None,
     return Fleet(m, replicas=replicas, block_size=8,
                  max_batch=args.max_batch, max_model_len=max_model_len,
                  token_budget=args.token_budget, faults=faults,
-                 disaggregate=disaggregate, parallel_step=True)
+                 disaggregate=disaggregate, parallel_step=True,
+                 router_load_cap=getattr(args, "router_load_cap", None))
 
 
 def run(engine, arrivals, prompts, new_tokens, deadline_ms=None,
@@ -441,6 +392,38 @@ def main():
                          "leaks, zero post-warmup compiles, and finite "
                          "perplexity/top-k quality deltas vs the f32 "
                          "engine")
+    ap.add_argument("--trace", default=None, metavar="NAME",
+                    help="named workload from paddle_tpu.sim.workloads "
+                         "(poisson, shared_prefix, repetitive, fleet, "
+                         "diurnal, agentic, thousand_tenant, rag, "
+                         "hot_tenant).  Alone: a GATED replayability "
+                         "row for that trace (byte-identical rebuild, "
+                         "token-exact double replay, zero leaked "
+                         "pages).  With --replicas: selects the fleet "
+                         "trace.  With --sim: the calibration trace")
+    ap.add_argument("--sim", action="store_true",
+                    help="GATED calibration row for the discrete-event "
+                         "simulator: replay --trace (default: fleet) "
+                         "through the REAL engine on a virtual clock "
+                         "and through SimEngine replicas, and fail "
+                         "unless the frozen event logs match exactly, "
+                         "outputs are token-exact, and the virtual "
+                         "durations agree within the documented band; "
+                         "also reports the sim-side router load-cap "
+                         "policy A/B (docs/SIMULATOR.md)")
+    ap.add_argument("--sim-profile", default="tpu-v4",
+                    choices=["tpu-v4", "tpu-v5e", "cpu"],
+                    help="(--sim) device profile for the roofline "
+                         "step-time model")
+    ap.add_argument("--router-load-cap", type=int, default=None,
+                    metavar="N",
+                    help="(--replicas / --sim) cap warm-affinity "
+                         "routing: a replica more than N requests "
+                         "above the pool's min load loses its "
+                         "affinity credit and traffic spills to the "
+                         "least-loaded replica (the sim-discovered "
+                         "hot-tenant fix; default off = historical "
+                         "routing)")
     ap.add_argument("--lint", action="store_true",
                     help="run the static cost census (graph-lint cost) "
                          "AND the Pallas kernel verifier (graph-lint "
@@ -458,6 +441,8 @@ def main():
 
     import jax
 
+    if args.sim:
+        return _main_sim(args, jax)
     if args.tp > 1:
         return _main_tp(args, jax)
     if args.replicas > 0:
@@ -476,6 +461,8 @@ def main():
         return _main_mixed(args, jax)
     if args.quant is not None:
         return _main_quant(args, jax)
+    if args.trace is not None:
+        return _main_trace(args, jax)
 
     arrivals, prompts, new_tokens = _trace(args.requests, args.rate,
                                            args.max_new, args.seed)
@@ -554,6 +541,165 @@ def _write_artifact(args, row, ok):
         doc["census"] = args._census
     with open(args.artifact, "w") as f:
         json.dump(doc, f)
+
+
+def _main_trace(args, jax):
+    """GATED replayability row for one named workload trace: rebuilding
+    the trace must be byte-identical (same seed, same arrays), two
+    replays on fresh engines must be token-exact, and the replay must
+    leak zero pages.  This is the contract that makes every scenario
+    in paddle_tpu.sim.workloads a reproducible experiment, not a
+    random load generator."""
+    from paddle_tpu.sim.workloads import build_trace
+
+    t1 = build_trace(args.trace, args.requests, args.rate,
+                     args.max_new, seed=args.seed)
+    t2 = build_trace(args.trace, args.requests, args.rate,
+                     args.max_new, seed=args.seed)
+    arrivals, prompts, new_tokens = t1
+    replayable = (np.array_equal(arrivals, t2[0])
+                  and len(prompts) == len(t2[1])
+                  and all(np.array_equal(p, q)
+                          for p, q in zip(prompts, t2[1]))
+                  and new_tokens == t2[2])
+
+    max_model_len = max(64, max(len(p) for p in prompts)
+                        + args.max_new)
+    eng = _build_engine(args.max_batch, args.seed,
+                        max_model_len=max_model_len,
+                        token_budget=args.token_budget)
+    _lint_census(args, eng)
+    res = run(eng, arrivals, prompts, new_tokens)
+    eng2 = _build_engine(args.max_batch, args.seed,
+                         max_model_len=max_model_len,
+                         token_budget=args.token_budget)
+    res2 = run(eng2, arrivals, prompts, new_tokens)
+    token_exact = res["outputs"] == res2["outputs"]
+    leaked = (eng.num_blocks - eng.block_manager.num_free_blocks) \
+        + (eng2.num_blocks - eng2.block_manager.num_free_blocks)
+
+    row = {
+        "metric": "llm_serving_trace",
+        "value": round(res["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "trace": args.trace,
+        "replayable": replayable,
+        "token_exact": token_exact,
+        "leaked_pages": leaked,
+        "requests": args.requests,
+        "tokens": res["tokens"],
+        "prompt_len_max": max(len(p) for p in prompts),
+        "ttft_p50_ms": (round(res["ttft_p50_ms"], 2)
+                        if res["ttft_p50_ms"] is not None else None),
+        "e2e_p95_ms": (round(res["e2e_p95_ms"], 2)
+                       if res["e2e_p95_ms"] is not None else None),
+        "preemptions": res["preemptions"],
+        "prefix_hit_rate": round(res["prefix_cache"]["hit_rate"], 3),
+        "max_batch": args.max_batch,
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    ok = replayable and token_exact and leaked == 0
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            f"trace {args.trace!r} violated its contract: "
+            f"replayable={replayable} token_exact={token_exact} "
+            f"leaked_pages={leaked}")
+
+
+def _main_sim(args, jax):
+    """GATED calibration row for the discrete-event simulator.
+
+    Replays --trace (default: fleet) through the REAL engine/fleet
+    stepped on a virtual clock, then through SimEngine replicas with a
+    ReplayOracle, and fails unless (a) the frozen event-log records —
+    fleet AND every per-engine log — compare equal (decisions-exact),
+    (b) outputs are token-exact, and (c) the virtual durations agree
+    within the documented band.  The row's value is the simulator's
+    replay speed in requests per second of wall clock; it also carries
+    the sim-side hot-tenant router load-cap A/B (the policy finding
+    docs/SIMULATOR.md walks through; confirm on the real engine with
+    --replicas N --trace hot_tenant --router-load-cap)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import gpt_tiny
+    from paddle_tpu.sim import (build_trace, calibrate,
+                                hot_tenant_trace, simulate)
+
+    paddle.seed(args.seed)
+    max_model_len = max(64, 32 + args.max_new)
+    m = gpt_tiny(num_layers=2, max_position_embeddings=max_model_len)
+    m.eval()
+    name = args.trace or "fleet"
+    trace = build_trace(name, args.requests, args.rate, args.max_new,
+                        seed=args.seed)
+    max_model_len = max(max_model_len,
+                        max(len(p) for p in trace[1]) + args.max_new)
+    ek = dict(block_size=8, max_batch=args.max_batch,
+              max_model_len=max_model_len,
+              token_budget=args.token_budget)
+    replicas = args.replicas if args.replicas > 0 else 2
+    band = 0.05                 # documented in docs/SIMULATOR.md
+    cal = calibrate(m, trace, replicas=replicas, engine_kwargs=ek,
+                    profile=args.sim_profile,
+                    fleet_kwargs=dict(
+                        router_load_cap=args.router_load_cap))
+
+    # the policy experiment, in sim: hot-tenant skew saturating one
+    # replica — warm affinity alone vs the load-capped router
+    ptrace = hot_tenant_trace(max(200, args.requests),
+                              rate=20000.0, max_new=12, seed=args.seed)
+    pek = dict(block_size=8, max_batch=4, max_model_len=64,
+               token_budget=32)
+    base_res, _ = simulate(m, ptrace, replicas=4, engine_kwargs=pek,
+                           profile=args.sim_profile)
+    cap_res, _ = simulate(m, ptrace, replicas=4, engine_kwargs=pek,
+                          profile=args.sim_profile,
+                          fleet_kwargs=dict(router_load_cap=2))
+
+    ok = (cal["decisions_exact"] and cal["tokens_exact"]
+          and cal["timing_err"] <= band)
+    row = {
+        "metric": "llm_serving_sim",
+        "value": round(cal["sim"]["requests_per_wall_s"], 1),
+        "unit": "sim requests/s of wall clock",
+        "trace": name,
+        "replicas": replicas,
+        "requests": args.requests,
+        "decisions_exact": cal["decisions_exact"],
+        "tokens_exact": cal["tokens_exact"],
+        "timing_err": round(cal["timing_err"], 6),
+        "timing_band": band,
+        "events": cal["events_real"],
+        "profile": args.sim_profile,
+        "virtual_s": round(cal["sim"]["virtual_s"], 4),
+        "sim_wall_s": round(cal["sim"]["wall_s"], 3),
+        "real_wall_s": round(cal["real"]["wall_s"], 3),
+        "sim_speedup": round(cal["real"]["wall_s"]
+                             / max(cal["sim"]["wall_s"], 1e-9), 1),
+        "router_load_cap": args.router_load_cap,
+        "policy_hot_tenant": {
+            "ttft_p95_ms_affinity": round(
+                base_res["ttft_ms"]["p95"], 2),
+            "ttft_p95_ms_load_cap_2": round(
+                cap_res["ttft_ms"]["p95"], 2),
+            "makespan_s_affinity": round(base_res["virtual_s"], 4),
+            "makespan_s_load_cap_2": round(cap_res["virtual_s"], 4),
+        },
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "sim calibration violated its contract: "
+            f"decisions_exact={cal['decisions_exact']} "
+            f"tokens_exact={cal['tokens_exact']} "
+            f"timing_err={cal['timing_err']:.4f} (band {band})")
 
 
 def _main_spec(args, jax):
@@ -1091,8 +1237,19 @@ def _main_fleet(args, jax):
     from paddle_tpu.inference.llm import Fault, FaultInjector
 
     max_model_len = max(64, 32 + args.max_new)
-    arrivals, prompts, new_tokens = _fleet_trace(
-        args.requests, args.rate, args.max_new, args.seed)
+    if args.trace is not None:
+        # a named workload replaces the default multi-tenant trace —
+        # e.g. --trace hot_tenant for the router load-cap A/B
+        from paddle_tpu.sim.workloads import build_trace
+        arrivals, prompts, new_tokens = build_trace(
+            args.trace, args.requests, args.rate, args.max_new,
+            seed=args.seed)
+        max_model_len = max(max_model_len,
+                            max(len(p) for p in prompts)
+                            + args.max_new)
+    else:
+        arrivals, prompts, new_tokens = _fleet_trace(
+            args.requests, args.rate, args.max_new, args.seed)
     # replication is a THROUGHPUT optimisation: measure the saturated
     # regime (everything queued at t=0), or a Poisson-paced trace is
     # arrival-limited and fleet-vs-one measures the trace
@@ -1207,6 +1364,8 @@ def _main_fleet(args, jax):
         "repeats": reps,
         "kill_at": args.kill_at,
         "chaos_seed": args.chaos,
+        "trace": args.trace or "fleet",
+        "router_load_cap": args.router_load_cap,
         "warmup_ms": res["warmup_ms"],
         "compile_count": res["compile_count"],
         "backend": jax.default_backend(),

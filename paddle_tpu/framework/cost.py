@@ -78,10 +78,10 @@ from .analysis import (
 )
 
 __all__ = [
-    "CostEstimate", "Census", "estimate_jaxpr", "estimate_jitted",
-    "xla_cost_analysis", "check_collectives", "run_census",
-    "engine_memory_model", "derive_max_batch", "migration_estimate",
-    "parse_bytes", "DEVICE_PROFILES",
+    "CostEstimate", "Census", "StepTimeModel", "estimate_jaxpr",
+    "estimate_jitted", "xla_cost_analysis", "check_collectives",
+    "run_census", "engine_memory_model", "derive_max_batch",
+    "migration_estimate", "parse_bytes", "DEVICE_PROFILES",
 ]
 
 
@@ -679,6 +679,67 @@ def migration_estimate(engine, num_tokens, num_pages, profile="tpu-v4",
             "recompute_s": recompute_s,
             "prefer": ("migrate" if migrate_s <= recompute_s
                        else "recompute")}
+
+
+# --------------------------------------------------------------------------
+# per-launch step-time model (the discrete-event simulator's clock)
+# --------------------------------------------------------------------------
+class StepTimeModel:
+    """Roofline step-time estimates per ``(kind, bucket)`` executable —
+    what the discrete-event simulator (paddle_tpu/sim/) advances its
+    virtual clock by in place of running the device.
+
+    Built from an engine's own ``executable_grid()`` by AOT tracing
+    (:func:`estimate_jitted` — nothing executes, dispatch caches stay
+    cold), so the estimates are automatically tp- and quantize-aware:
+    the sharded / int8 grid IS the grid that gets costed.  A launch's
+    time is the roofline bound — ``max(compute, hbm, comms)`` seconds
+    under the device ``profile`` (a DEVICE_PROFILES key or a dict) —
+    plus a flat ``host_overhead_s`` covering scheduling, packing, and
+    dispatch (calibrate it against a measured run; 0 by default).
+    """
+
+    def __init__(self, times_s, profile="tpu-v4", host_overhead_s=0.0):
+        self.times_s = dict(times_s)      # (kind, bucket) -> seconds
+        self.profile = profile
+        self.host_overhead_s = float(host_overhead_s)
+
+    @classmethod
+    def from_engine(cls, engine, profile="tpu-v4", host_overhead_s=0.0,
+                    loop_aware=True):
+        times = {}
+        for kind, bucket, fn, args in engine.executable_grid():
+            est = estimate_jitted(fn, *args, loop_aware=loop_aware)
+            rl = est.roofline(profile)
+            times[(kind, bucket)] = max(rl["times_s"].values())
+        return cls(times, profile=profile,
+                   host_overhead_s=host_overhead_s)
+
+    def step_seconds(self, kind, bucket):
+        """Estimated seconds of one ``(kind, bucket)`` launch."""
+        try:
+            t = self.times_s[(kind, bucket)]
+        except KeyError:
+            raise KeyError(
+                f"no step-time estimate for launch ({kind!r}, "
+                f"{bucket!r}) — this model covers "
+                f"{sorted(self.times_s)}; build it from an engine "
+                f"configured like the one being simulated") from None
+        return t + self.host_overhead_s
+
+    def launches_seconds(self, launches):
+        """Total estimated seconds of one step's launch list (the
+        engine's ``last_launches``: [(kind, bucket), ...])."""
+        return sum(self.step_seconds(k, b) for k, b in launches)
+
+    def to_dict(self):
+        return {
+            "profile": (self.profile if isinstance(self.profile, str)
+                        else "custom"),
+            "host_overhead_s": self.host_overhead_s,
+            "times_s": {f"{k}[{b}]": t
+                        for (k, b), t in sorted(self.times_s.items())},
+        }
 
 
 # --------------------------------------------------------------------------

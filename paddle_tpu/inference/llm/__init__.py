@@ -23,6 +23,10 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   deterministic fault-injection harness (FaultInjector,
                   RetryPolicy, StepWatchdog) — seeded fault schedules
                   at the device-step / allocator / socket boundaries
+- events:         the frozen, versioned event-log record schema
+                  (named fields per kind, wall-clock-free by
+                  construction) shared by engines, fleets and the
+                  discrete-event simulator's calibration gate
 - engine:         LLMEngine (add_request/step/generate, bucketed
                   donated jitted executables; ``tensor_parallel=N``
                   shards params Megatron-style and the paged pool along
@@ -54,6 +58,12 @@ from .block_manager import (  # noqa: F401
     prefix_block_hashes,
 )
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
+from .events import (  # noqa: F401
+    EVENT_FIELDS,
+    SCHEMA_VERSION,
+    assert_wall_clock_free,
+    to_records,
+)
 from .fleet import (  # noqa: F401
     Fleet,
     HealthConfig,
@@ -102,6 +112,8 @@ __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "Fleet", "HealthConfig", "MigrationPolicy", "Replica", "Router",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",
            "MigrationError", "PoolLostError", "RetryPolicy", "StepWatchdog",
+           "EVENT_FIELDS", "SCHEMA_VERSION", "assert_wall_clock_free",
+           "to_records",
            "paged_decode_attention", "paged_decode_attention_xla",
            "paged_prefill_attention", "paged_prefill_attention_xla",
            "paged_ragged_attention", "paged_ragged_attention_xla",

@@ -206,7 +206,8 @@ class LLMEngine:
                  mesh=None, tensor_parallel=None, seed=None,
                  speculative=None, memory_budget=None, quantize=None,
                  faults=None, retry=None, max_queue=None,
-                 step_timeout_s=None, clock=None):
+                 step_timeout_s=None, clock=None,
+                 record_step_gauges=False):
         # ----------------------------------------- lifecycle hardening ----
         # validate the robustness knobs FIRST (mirrors max_new_tokens):
         # a bad config must fail loudly at construction, not mid-traffic
@@ -228,17 +229,31 @@ class LLMEngine:
                 raise ValueError(
                     f"step_timeout_s must be a positive number of "
                     f"seconds, got {step_timeout_s!r}")
-        self.watchdog = (StepWatchdog(step_timeout_s)
-                         if step_timeout_s is not None else None)
         self._clock = clock if clock is not None else time.monotonic
+        # step timing, retry backoff, and the watchdog share the
+        # injected clock when one is given (a simulator's VirtualClock
+        # makes backoff and wedge detection cost VIRTUAL seconds);
+        # wall serving keeps perf_counter / time.sleep
+        self._timer = clock if clock is not None else time.perf_counter
+        self._sleep = getattr(clock, "sleep", time.sleep)
+        self.watchdog = (StepWatchdog(step_timeout_s, clock=self._timer)
+                         if step_timeout_s is not None else None)
         self._early = []         # outputs finished without a device step
         self._draining = False
         self._step_index = -1
         self._last_step_ms = None   # wall ms of the latest step() (gauge)
         # deterministic lifecycle event log: (step, kind, *detail)
         # tuples with no wall-times, so two replays of the same fault
-        # seed produce IDENTICAL logs (the chaos determinism contract)
+        # seed produce IDENTICAL logs (the chaos determinism contract);
+        # events.py freezes the per-kind record schema
         self.events = []
+        # (kind, bucket) of every executable launch the CURRENT step
+        # issued — the simulator's virtual clock advances by the cost
+        # model's estimate of exactly these launches
+        self.last_launches = []
+        # opt-in per-step cumulative lifecycle gauges (lifecycle_stats)
+        self.record_step_gauges = bool(record_step_gauges)
+        self.step_gauges = []
 
         d = model.functional_decompose()
         cfg = model.config
@@ -432,11 +447,7 @@ class LLMEngine:
                 self._vs = szeros()
         else:
             self.params = params
-            self._kc = jnp.zeros(cache_shape, self._kv_dtype)
-            self._vc = jnp.zeros(cache_shape, self._kv_dtype)
-            if self._kv_quant:
-                self._ks = jnp.zeros(scale_shape, jnp.float32)
-                self._vs = jnp.zeros(scale_shape, jnp.float32)
+            self._alloc_pools(cache_shape, scale_shape)
 
         def psum_mp(y):
             """Row-parallel reduction; identity on the single-device path
@@ -800,7 +811,10 @@ class LLMEngine:
                 "queue_depth": self.scheduler.queue_depth(),
                 "inflight": len(self.scheduler.running),
                 "free_pages": self.block_manager.num_free_blocks,
-                "last_step_ms": self._last_step_ms}
+                "last_step_ms": self._last_step_ms,
+                # per-step cumulative counter trajectory (empty unless
+                # record_step_gauges=True; see _record_step_gauges)
+                "step_gauges": self.step_gauges}
 
     def _bucket_grid(self):
         """The complete executable family: every (kind, bucket) pair
@@ -835,6 +849,17 @@ class LLMEngine:
                     sds((tb,), i32), sds((rmax,), i32),
                     sds((rmax,), i32), sds((rmax,), i32))
             yield kind, tb, self._ragged, args
+
+    def _alloc_pools(self, cache_shape, scale_shape):
+        """Allocate the single-device K/V pools.  The seam the
+        discrete-event simulator overrides: SimEngine allocates numpy
+        pools instead, so 100+ virtual replicas cost host RAM (lazily,
+        pages untouched until written) and zero device memory."""
+        self._kc = jnp.zeros(cache_shape, self._kv_dtype)
+        self._vc = jnp.zeros(cache_shape, self._kv_dtype)
+        if self._kv_quant:
+            self._ks = jnp.zeros(scale_shape, jnp.float32)
+            self._vs = jnp.zeros(scale_shape, jnp.float32)
 
     def _pools(self):
         """The donated pool operands of one ragged launch, in call
@@ -912,17 +937,18 @@ class LLMEngine:
         by this step (possibly empty) — including requests that exited
         through a failure path (aborted / deadline / shed / error)
         since the previous step."""
-        t0 = time.perf_counter()
+        t0 = self._timer()
         try:
             return self._step_impl()
         finally:
-            # the last_step_ms health gauge: wall time of the whole
-            # iteration (schedule + launches + commit), kept OUT of the
-            # deterministic event log
-            self._last_step_ms = (time.perf_counter() - t0) * 1e3
+            # the last_step_ms health gauge: time of the whole
+            # iteration (schedule + launches + commit) on the injected
+            # timer, kept OUT of the deterministic event log
+            self._last_step_ms = (self._timer() - t0) * 1e3
 
     def _step_impl(self):
         self._step_index += 1
+        self.last_launches = []
         if self.faults is not None:
             self.faults.begin_step(self._step_index)
         finished = self._drain_early()
@@ -935,6 +961,7 @@ class LLMEngine:
                 (self._step_index, "preempt",
                  self.scheduler.num_preemptions - pre_preempt))
         if batch.kind == "idle":
+            self._record_step_gauges()
             return finished
         self.stats["steps"] += 1
         self._ragged_step(batch, finished)
@@ -944,7 +971,28 @@ class LLMEngine:
             # assert the books balance after each TP step
             self.scheduler.check_invariants()
         finished.extend(self._drain_early())
+        self._record_step_gauges()
         return finished
+
+    def _record_step_gauges(self):
+        """Per-step CUMULATIVE lifecycle counters (opt-in via
+        ``record_step_gauges=``): one wall-clock-free snapshot per
+        step(), so a policy experiment can plot preemption/shed/abort
+        trajectories over the run instead of only end totals.  The
+        list rides ``lifecycle_stats()["step_gauges"]``."""
+        if not self.record_step_gauges:
+            return
+        s = self.stats
+        self.step_gauges.append({
+            "step": self._step_index,
+            "preemptions": self.scheduler.num_preemptions,
+            "shed": s["shed"], "aborted": s["aborted"],
+            "deadline_missed": s["deadline_missed"],
+            "retries": s["retries"], "quarantined": s["quarantined"],
+            "queue_depth": self.scheduler.queue_depth(),
+            "inflight": len(self.scheduler.running),
+            "free_pages": self.block_manager.num_free_blocks,
+        })
 
     # ------------------------------------------------- step isolation ----
     def _launch(self, kind, reqs, launch):
@@ -958,7 +1006,8 @@ class LLMEngine:
         quarantine (callers skip their commit phase)."""
         attempt = 0
         while True:
-            t0 = time.perf_counter()
+            t0 = (self.watchdog.started()
+                  if self.watchdog is not None else None)
             try:
                 if self.faults is not None:
                     self.faults.device_step(kind)
@@ -979,14 +1028,14 @@ class LLMEngine:
                         (self._step_index, "retry", kind, attempt))
                     delay = self.retry.backoff(attempt - 1)
                     if delay > 0:
-                        time.sleep(delay)
+                        self._sleep(delay)
                     continue
                 self._quarantine(kind, reqs, e)
                 return None
             finally:
                 if self.watchdog is not None:
-                    self.watchdog.observe(self._step_index, kind,
-                                          time.perf_counter() - t0)
+                    self.watchdog.observe_since(self._step_index, kind,
+                                                t0)
 
     def _pool_lost(self):
         deleted = getattr(self._kc, "is_deleted", None)
@@ -1226,6 +1275,7 @@ class LLMEngine:
 
         total = sum(row.length for row in rows)
         tb = bucket_size(total, self.token_budget, floor=8)
+        self.last_launches.append(("ragged", tb))
         rmax = self.max_batch
         ids = np.zeros(tb, np.int32)
         positions = np.full(tb, -1, np.int32)
@@ -1254,16 +1304,10 @@ class LLMEngine:
             row_pos0[ri] = row.start
             s += row.length
 
-        def launch_ragged():
-            with profiler.RecordEvent("llm_engine::ragged"):
-                return self._ragged(
-                    self.params, jnp.asarray(ids), *self._pools(),
-                    jnp.asarray(tables), jnp.asarray(positions),
-                    jnp.asarray(tok_rows), jnp.asarray(row_start),
-                    jnp.asarray(row_qlen), jnp.asarray(row_pos0))
-
         out = self._launch("ragged", [row.request for row in rows],
-                           launch_ragged)
+                           lambda: self._ragged_launch(
+                               rows, ids, tables, positions, tok_rows,
+                               row_start, row_qlen, row_pos0))
         if out is None:
             return              # quarantined; reservations rolled back
         nxt, logits = out[0], out[1]
@@ -1308,6 +1352,23 @@ class LLMEngine:
                 self._commit_tokens(
                     [(req, nxt[starts[ri] + row.length - 1],
                       None if lg is None else lg[0])], finished)
+
+    def _ragged_launch(self, rows, ids, tables, positions, tok_rows,
+                       row_start, row_qlen, row_pos0):
+        """Execute ONE packed ragged launch — the device-step seam.
+        Numpy operands in, the executable's output tuple out.  ``rows``
+        is the host-side schedule the operands were packed from: the
+        real engine ignores it; the discrete-event simulator's
+        SimEngine overrides this method and reads ``rows`` to
+        synthesize the argmax vector from its token oracle instead of
+        running the device."""
+        del rows  # the real launch needs only the packed operands
+        with profiler.RecordEvent("llm_engine::ragged"):
+            return self._ragged(
+                self.params, jnp.asarray(ids), *self._pools(),
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.asarray(tok_rows), jnp.asarray(row_start),
+                jnp.asarray(row_qlen), jnp.asarray(row_pos0))
 
     def _fetch_sampling_rows(self, rows, starts, logits):
         """Fetch ONLY the logits of tokens that sample: greedy batches

@@ -410,22 +410,39 @@ class RetryPolicy:
 
 
 class StepWatchdog:
-    """Flags device steps that exceed a wall-clock threshold.
+    """Flags device steps that exceed a clock threshold.
 
     The engine cannot interrupt a wedged XLA launch, but it CAN report
-    one: every launch's wall time is observed, and launches past
+    one: every launch's elapsed time is observed, and launches past
     ``threshold_s`` are recorded in ``wedged`` (and counted), so an
     operator (or the chaos bench artifact) sees the stall without the
     step having to finish inside a profiler window.
+
+    ``clock`` is any :class:`~paddle_tpu.sim.clock.Clock` — a zero-arg
+    callable returning seconds (default ``time.perf_counter``).  The
+    engine injects its own clock, so under a simulator's VirtualClock
+    the watchdog measures VIRTUAL step time — injected delay faults
+    trip it without any wall-clock waiting.  Callers time a launch on
+    the watchdog's clock via ``t0 = wd.started()`` ...
+    ``wd.observe_since(step, kind, t0)``.
     """
 
-    def __init__(self, threshold_s):
+    def __init__(self, threshold_s, clock=None):
         if threshold_s <= 0:
             raise ValueError(
                 f"watchdog threshold must be > 0, got {threshold_s}")
         self.threshold_s = float(threshold_s)
+        self.clock = clock if clock is not None else time.perf_counter
         self.wedged = []          # (step_index, kind, elapsed_s)
         self.num_wedged = 0
+
+    def started(self):
+        """Timestamp on the watchdog's own clock; pass the value to
+        :meth:`observe_since` when the launch returns."""
+        return self.clock()
+
+    def observe_since(self, step_index, kind, t0):
+        return self.observe(step_index, kind, self.clock() - t0)
 
     def observe(self, step_index, kind, elapsed_s):
         if elapsed_s > self.threshold_s:

@@ -269,13 +269,27 @@ class Router:
     """Prefix-affinity placement with deterministic least-loaded
     fallback (see the module docstring for the policy)."""
 
-    def __init__(self, replicas, warm_cap=4096):
+    def __init__(self, replicas, warm_cap=4096, load_cap=None):
         if not isinstance(warm_cap, (int, np.integer)) or \
                 isinstance(warm_cap, bool) or warm_cap < 1:
             raise ValueError(
                 f"warm_cap must be a positive int, got {warm_cap!r}")
+        if load_cap is not None and (
+                not isinstance(load_cap, (int, np.integer))
+                or isinstance(load_cap, bool) or load_cap < 0):
+            raise ValueError(
+                f"load_cap must be None or a non-negative int, "
+                f"got {load_cap!r}")
         self.replicas = replicas
         self.warm_cap = int(warm_cap)
+        # load-capped warm affinity (None = pure affinity-first, the
+        # historical policy, byte-identical routing): with a cap, a
+        # replica more than ``load_cap`` requests above the pool's
+        # least-loaded one scores 0 — hot-tenant traffic spills to
+        # idle replicas instead of herding onto one warm replica
+        # (policy finding from the discrete-event simulator; see
+        # docs/SIMULATOR.md)
+        self.load_cap = None if load_cap is None else int(load_cap)
         self.routed = 0
         self.affinity_hits = 0
 
@@ -306,8 +320,15 @@ class Router:
         cold case) fall back to least-loaded, then lowest index.
         Returns (replica, score); pool must be non-empty."""
         best = best_key = None
+        floor = (min(r.load() for r in pool)
+                 if self.load_cap is not None else 0)
         for r in pool:
-            k = (-self.score(r, keys), r.load(), r.index)
+            load = r.load()
+            score = self.score(r, keys)
+            if self.load_cap is not None and \
+                    load - floor > self.load_cap:
+                score = 0        # overloaded: no warm-affinity credit
+            k = (-score, load, r.index)
             if best is None or k < best_key:
                 best, best_key = r, k
         return best, -best_key[0]
@@ -388,13 +409,21 @@ class Fleet:
     MigrationPolicy (or mode str / dict) gating KV page handoff on
     drain and engine-alive failover; ``disaggregate=True`` splits the
     fleet into prefill-role and decode-role replicas with migration-
-    based handoff at the prefill→decode boundary.  All remaining
-    keyword arguments are forwarded to every replica's LLMEngine.
+    based handoff at the prefill→decode boundary.
+    ``router_load_cap=N`` caps warm-affinity routing: a replica more
+    than N requests above the pool's least-loaded loses its affinity
+    credit, so hot-tenant skew spills instead of herding (None keeps
+    the historical pure-affinity policy, routing-identical).
+    ``engine_factory=`` substitutes the per-replica engine constructor
+    (the discrete-event simulator's SimEngine seam).  All remaining
+    keyword arguments are forwarded to every replica's engine.
     """
 
     def __init__(self, model, replicas=2, *, health=None, faults=None,
                  max_queue=None, parallel_step=False, engine_faults=None,
-                 migration=None, disaggregate=False, **engine_kwargs):
+                 migration=None, disaggregate=False,
+                 router_load_cap=None, engine_factory=None,
+                 **engine_kwargs):
         if not isinstance(replicas, (int, np.integer)) or \
                 isinstance(replicas, bool) or replicas < 1:
             raise ValueError(
@@ -425,6 +454,17 @@ class Fleet:
         self._model = model
         self._engine_kwargs = dict(engine_kwargs)
         self._engine_faults = list(engine_faults)
+        # the fleet's own waits and timers ride the engines' injected
+        # clock when one is given (simulator runs on a VirtualClock);
+        # wall serving keeps monotonic/perf_counter/sleep
+        clk = engine_kwargs.get("clock")
+        self._clock = clk if clk is not None else time.monotonic
+        self._timer = clk if clk is not None else time.perf_counter
+        self._sleep = getattr(clk, "sleep", time.sleep)
+        # engine construction seam: the simulator substitutes its
+        # SimEngine subclass without the fleet knowing the difference
+        self._engine_factory = (engine_factory if engine_factory
+                                is not None else LLMEngine)
         self._shared_fns = None
         self.replicas = [Replica(i, self._build_engine(i))
                          for i in range(int(replicas))]
@@ -434,7 +474,7 @@ class Fleet:
             n_prefill = max(1, int(replicas) // 2)
             for r in self.replicas:
                 r.role = "prefill" if r.index < n_prefill else "decode"
-        self.router = Router(self.replicas)
+        self.router = Router(self.replicas, load_cap=router_load_cap)
         self._live = {}          # fleet rid -> _FleetRequest
         self._early = []         # outputs finished without a step
         self._next_id = 0
@@ -452,6 +492,11 @@ class Fleet:
         # wall-clock handoff latencies (ms) — benches read this; it
         # never enters the event log, so seed replays stay identical
         self.migration_ms = []
+        # fleet-side per-step cumulative gauges, recorded when the
+        # replica engines record theirs (record_step_gauges=True)
+        self.record_step_gauges = bool(
+            engine_kwargs.get("record_step_gauges"))
+        self.step_gauges = []
 
     # ----------------------------------------------------------- replicas --
     def _build_engine(self, index):
@@ -460,8 +505,9 @@ class Fleet:
         engines (and restarts) adopt them BEFORE any trace, so the
         fleet compiles each (kind, bucket) exactly once and every
         replica shares one executable signature set by construction."""
-        eng = LLMEngine(self._model, faults=self._engine_faults[index],
-                        **self._engine_kwargs)
+        eng = self._engine_factory(
+            self._model, faults=self._engine_faults[index],
+            **self._engine_kwargs)
         if self._shared_fns is None:
             self._shared_fns = (eng._ragged,)
         else:
@@ -610,7 +656,25 @@ class Fleet:
         self._hb_missed.clear()
         finished.extend(self._early)
         self._early = []
+        self._record_step_gauges()
         return finished
+
+    def _record_step_gauges(self):
+        """Fleet counterpart of the engine's per-step cumulative
+        gauges: one wall-clock-free snapshot of the fleet counters
+        (migration/requeue/shed trajectories) per fleet step."""
+        if not self.record_step_gauges:
+            return
+        s = self.stats
+        self.step_gauges.append({
+            "step": self._step_index,
+            "migrated": s["migrated"], "requeued": s["requeued"],
+            "shed": s["shed"], "killed": s["killed"],
+            "lost": s["lost"],
+            "preemptions": sum(r.engine.scheduler.num_preemptions
+                               for r in self.replicas),
+            "replicas_live": sum(1 for r in self.replicas if r.live),
+        })
 
     def _step_replicas(self, live):
         """Step each live replica, catching per-replica failures.
@@ -844,10 +908,10 @@ class Fleet:
         due = {}
         if self.faults is not None:
             due = {f.kind: f for f in self.faults.migration_faults()}
-        t0 = time.perf_counter()
+        t0 = self._timer()
         delay = due.get("delay")
         if delay is not None and delay.delay_s:
-            time.sleep(delay.delay_s)
+            self._sleep(delay.delay_s)
         if "export" in due:
             raise MigrationError(
                 f"injected migration fault (export) for request {rid}",
@@ -878,7 +942,7 @@ class Fleet:
         fr.replica = dst.index
         self.stats["migrated"] += 1
         self.stats["migrated_bytes"] += nbytes
-        self.migration_ms.append((time.perf_counter() - t0) * 1e3)
+        self.migration_ms.append((self._timer() - t0) * 1e3)
         self.router.touch(dst, self.router.affinity_keys(fr.prompt_ids))
         self.events.append((self._step_index, "migrate", rid,
                             src.index, dst.index, pages))
@@ -987,12 +1051,12 @@ class Fleet:
         reopens on return."""
         self._draining = True
         deadline = (None if timeout_s is None
-                    else time.monotonic() + float(timeout_s))
+                    else self._clock() + float(timeout_s))
         outs = []
         try:
             while self.has_unfinished():
                 if deadline is not None and \
-                        time.monotonic() >= deadline:
+                        self._clock() >= deadline:
                     for rid in list(self._live):
                         self.abort_request(rid)
                 outs.extend(self.step())
@@ -1048,13 +1112,14 @@ class Fleet:
                 if ms is not None:
                     slowest = ms if slowest is None else max(slowest, ms)
             for k, v in ls.items():
-                if k == "last_step_ms":
-                    continue
+                if k in ("last_step_ms", "step_gauges"):
+                    continue     # not summable; fleet carries its own
                 if k in ("queue_depth", "inflight", "free_pages") \
                         and not r.live:
                     continue     # gauges of a dead replica are gone
                 agg[k] = agg.get(k, 0) + v
         agg["last_step_ms"] = slowest
+        agg["step_gauges"] = self.step_gauges
         agg["shed"] = agg.get("shed", 0) + self.stats["shed"]
         agg.update(self.router.stats())
         agg.update(requeued=self.stats["requeued"],

@@ -1,0 +1,59 @@
+"""paddle_tpu.sim — million-user scenario engine.
+
+Workload traces + a discrete-event fleet simulator that runs the REAL
+serving host code (Scheduler / BlockManager / Router / HealthConfig /
+MigrationPolicy) on a virtual clock, with device steps replaced by
+framework.cost roofline step-time estimates and generated tokens by a
+token oracle:
+
+- clock:      the tiny Clock protocol and VirtualClock the engines
+              accept via ``clock=``
+- workloads:  named, seeded, replayable traces — the bench builders
+              (poisson / shared_prefix / repetitive / fleet / mixed)
+              moved here verbatim, plus diurnal, agentic,
+              thousand_tenant, rag and hot_tenant scenarios; all
+              emit the same (arrivals, prompts, new_tokens) tuples
+              bench_serving.py replays
+- simulator:  SimEngine, run_virtual, simulate, calibrate — 100–1000
+              virtual replicas and 1e5–1e6 requests in seconds on one
+              core, calibrated decision-exactly against the real
+              engine's frozen event log
+
+See docs/SIMULATOR.md for the catalog, calibration method and
+policy-experiment cookbook.
+"""
+
+from .clock import SYSTEM_CLOCK, Clock, VirtualClock  # noqa: F401
+from .simulator import (  # noqa: F401
+    ReplayOracle,
+    SimEngine,
+    SyntheticOracle,
+    calibrate,
+    run_virtual,
+    sim_engine_factory,
+    simulate,
+)
+from .workloads import (  # noqa: F401
+    TRACES,
+    agentic_trace,
+    build_trace,
+    diurnal_trace,
+    fleet_trace,
+    hot_tenant_trace,
+    mixed_trace,
+    poisson_trace,
+    rag_trace,
+    repetitive_trace,
+    shared_prefix_trace,
+    thousand_tenant_trace,
+)
+
+__all__ = [
+    "Clock", "VirtualClock", "SYSTEM_CLOCK",
+    "SimEngine", "SyntheticOracle", "ReplayOracle",
+    "sim_engine_factory", "run_virtual", "simulate", "calibrate",
+    "TRACES", "build_trace", "poisson_trace", "shared_prefix_trace",
+    "repetitive_trace", "fleet_trace", "mixed_trace", "diurnal_trace",
+    "agentic_trace", "thousand_tenant_trace", "rag_trace",
+    "hot_tenant_trace",
+]

@@ -1,0 +1,161 @@
+"""Frozen event-log record schema (paddle_tpu.inference.llm.events).
+
+The contract under test: every event the engine and fleet emit fits
+the versioned named-field schema, records carry no wall-clock values
+(int/str/None only), and two seeded replays of the same scenario
+produce IDENTICAL record lists — the property the discrete-event
+simulator's calibration gate diffs against.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.llm import (
+    EVENT_FIELDS,
+    SCHEMA_VERSION,
+    Fault,
+    FaultInjector,
+    assert_wall_clock_free,
+    to_records,
+)
+from paddle_tpu.inference.llm.events import (
+    ENGINE_EVENT_FIELDS,
+    FLEET_EVENT_FIELDS,
+)
+
+
+def _make_model(seed=0):
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    paddle.seed(seed)
+    m = gpt_tiny(num_layers=2)
+    m.eval()
+    return m
+
+
+def _sim_engine(m, **kw):
+    from paddle_tpu.sim import SimEngine
+
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    kw.setdefault("token_budget", 16)
+    return SimEngine(m, **kw)
+
+
+def _busy_scenario(eng):
+    """Drive one engine through add/shed/abort/preempt/finish paths."""
+    rng = np.random.RandomState(0)
+    rids = []
+    for i in range(8):
+        rids.append(eng.add_request(
+            rng.randint(0, 128, (6 + i,)).astype(np.int32),
+            max_new_tokens=6))
+    eng.abort_request(rids[0])
+    for _ in range(64):
+        eng.step()
+        if not eng.has_unfinished():
+            break
+    return eng
+
+
+# ----------------------------------------------------------------------
+# schema shape
+# ----------------------------------------------------------------------
+def test_schema_is_versioned_and_named():
+    assert SCHEMA_VERSION == 1
+    assert set(EVENT_FIELDS) == \
+        set(ENGINE_EVENT_FIELDS) | set(FLEET_EVENT_FIELDS)
+    # the two shared kinds carry identical fields at both levels
+    for kind in set(ENGINE_EVENT_FIELDS) & set(FLEET_EVENT_FIELDS):
+        assert ENGINE_EVENT_FIELDS[kind] == FLEET_EVENT_FIELDS[kind]
+    for kind, fields in EVENT_FIELDS.items():
+        assert isinstance(fields, tuple), kind
+        assert all(isinstance(f, str) for f in fields), kind
+
+
+def test_to_records_rejects_unknown_kind_and_bad_arity():
+    with pytest.raises(ValueError, match="not in the frozen schema"):
+        to_records([(0, "warp_core_breach", 1)])
+    with pytest.raises(ValueError, match="declares"):
+        to_records([(0, "finish", 1)])     # finish needs (rid, reason)
+
+
+def test_records_carry_named_fields():
+    recs = to_records([(3, "add", 7),
+                       (4, "finish", 7, "stop"),
+                       (5, "migrate", 7, 0, 1, 4)])
+    assert recs[0] == {"schema_version": 1, "step": 3, "kind": "add",
+                       "request_id": 7}
+    assert recs[1]["reason"] == "stop"
+    assert recs[2] == {"schema_version": 1, "step": 5,
+                       "kind": "migrate", "request_id": 7, "src": 0,
+                       "dst": 1, "pages": 4}
+
+
+def test_wall_clock_free_guard_catches_floats():
+    with pytest.raises(AssertionError, match="wall-clock"):
+        assert_wall_clock_free([{"schema_version": 1, "step": 0,
+                                 "kind": "add", "request_id": 0.0125}])
+    with pytest.raises(AssertionError):
+        assert_wall_clock_free([{"schema_version": 1, "step": 0,
+                                 "kind": "add", "request_id": True}])
+
+
+# ----------------------------------------------------------------------
+# live logs fit the frozen schema, wall-clock-free, replay-identical
+# ----------------------------------------------------------------------
+def test_engine_log_fits_schema_and_replays_identically():
+    m = _make_model()
+    logs = []
+    for _ in range(2):
+        # tiny pool + tiny queue: preempt and shed paths both fire
+        eng = _busy_scenario(_sim_engine(m, num_blocks=10, max_queue=4))
+        recs = to_records(eng.events)
+        assert_wall_clock_free(recs)
+        kinds = {r["kind"] for r in recs}
+        assert {"add", "finish", "abort"} <= kinds
+        assert "shed" in kinds or "preempt" in kinds
+        logs.append(recs)
+    assert logs[0] == logs[1]
+
+
+def test_fleet_log_fits_schema_and_replays_identically():
+    from paddle_tpu.sim import VirtualClock, sim_engine_factory
+    from paddle_tpu.inference.llm import Fleet
+
+    m = _make_model()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 128, (8,)).astype(np.int32)
+               for _ in range(10)]
+    logs = []
+    for _ in range(2):
+        fi = FaultInjector(schedule=[
+            Fault("replica", "kill", step=4, victim=1)])
+        fleet = Fleet(m, replicas=2, faults=fi,
+                      engine_factory=sim_engine_factory(),
+                      clock=VirtualClock(), block_size=8, max_batch=4,
+                      max_model_len=64, token_budget=16)
+        for p in prompts:
+            fleet.add_request(p, max_new_tokens=4)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            for _ in range(64):
+                fleet.step()
+                if not fleet.has_unfinished():
+                    break
+        recs = to_records(fleet.events)
+        assert_wall_clock_free(recs)
+        kinds = {r["kind"] for r in recs}
+        assert {"route", "finish", "dead"} <= kinds
+        assert "failover" in kinds or "migrate" in kinds
+        # the per-engine logs fit the same schema
+        for r in fleet.replicas:
+            engine_recs = to_records(r.engine.events)
+            assert_wall_clock_free(engine_recs)
+            recs = recs + engine_recs
+        logs.append(recs)
+    assert logs[0] == logs[1]

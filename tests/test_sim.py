@@ -1,0 +1,292 @@
+"""Discrete-event fleet simulator (paddle_tpu.sim).
+
+Gates under test, in order of importance:
+
+1. CALIBRATION — the simulator replays a trace the real engine ran
+   and matches its decision record EXACTLY (frozen event logs compare
+   equal, token streams identical) with virtual timing inside the
+   documented band.  Single-engine and the ISSUE-mandated
+   2-replica/200-request fleet smoke both run in tier 1.
+2. Virtual time is real time to the host code: deadlines expire and
+   the watchdog flags wedges purely from the injected clock.
+3. Per-step gauges are exact: every cumulative counter snapshot equals
+   what the event log implies at that step.
+4. Policy experiments reproduce: the load-capped warm-affinity finding
+   (hot-tenant herding) and chaos determinism under faults.
+5. Scale (slow tier): 100 replicas x 1e5 requests in < 60 s wall with
+   zero page-accounting violations.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.llm import (
+    Fault,
+    FaultInjector,
+    FinishReason,
+    StepWatchdog,
+    to_records,
+)
+from paddle_tpu.sim import (
+    ReplayOracle,
+    SimEngine,
+    SyntheticOracle,
+    VirtualClock,
+    calibrate,
+    hot_tenant_trace,
+    poisson_trace,
+    simulate,
+    thousand_tenant_trace,
+)
+
+TIMING_BAND = 0.05   # documented calibration band (docs/SIMULATOR.md)
+
+
+def _make_model(seed=0):
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    paddle.seed(seed)
+    m = gpt_tiny(num_layers=2)
+    m.eval()
+    return m
+
+
+def _ek(**kw):
+    ek = dict(block_size=8, max_batch=4, max_model_len=64,
+              token_budget=16)
+    ek.update(kw)
+    return ek
+
+
+# ----------------------------------------------------------------------
+# virtual clock
+# ----------------------------------------------------------------------
+def test_virtual_clock_advances_and_rejects_negative():
+    clk = VirtualClock()
+    assert clk.now == 0.0
+    assert clk() == 0.0            # callable like time.monotonic
+    clk.advance(1.5)
+    clk.sleep(0.25)                # sleep consumes virtual time only
+    assert clk() == pytest.approx(1.75)
+    with pytest.raises(ValueError):
+        clk.advance(-0.1)
+
+
+def test_deadlines_expire_in_virtual_time():
+    m = _make_model()
+    clk = VirtualClock()
+    eng = SimEngine(m, clock=clk, **_ek())
+    rng = np.random.RandomState(0)
+    rid = eng.add_request(rng.randint(0, 128, (40,)).astype(np.int32),
+                          max_new_tokens=8, deadline_ms=50.0)
+    clk.advance(0.2)               # 200 virtual ms: way past deadline
+    outs = eng.step()
+    assert [o.request_id for o in outs] == [rid]
+    assert outs[0].finish_reason == FinishReason.DEADLINE
+    assert any(e[1] == "deadline" for e in eng.events)
+
+
+def test_watchdog_flags_wedges_on_the_virtual_clock():
+    clk = VirtualClock()
+    wd = StepWatchdog(0.5, clock=clk)
+    t0 = wd.started()
+    clk.advance(0.1)
+    assert not wd.observe_since(0, "ragged", t0)
+    t1 = wd.started()
+    clk.advance(2.0)               # a "wedged" launch, zero wall time
+    assert wd.observe_since(1, "ragged", t1)
+    assert wd.num_wedged == 1
+    assert wd.wedged[0][2] == pytest.approx(2.0)
+
+
+def test_sim_engine_is_greedy_only():
+    m = _make_model()
+    eng = SimEngine(m, **_ek())
+    with pytest.raises(ValueError, match="greedy"):
+        eng.add_request(np.arange(4, dtype=np.int32),
+                        temperature=0.7)
+    with pytest.raises(ValueError, match="virtual device"):
+        SimEngine(m, tensor_parallel=2, **_ek())
+
+
+def test_oracles_are_deterministic():
+    class _Req:
+        request_id = 7
+
+    o1 = SyntheticOracle(avoid=(3,))
+    o2 = SyntheticOracle(avoid=(3,))
+    toks = [o1.next_token(_Req, p) for p in range(32)]
+    assert toks == [o2.next_token(_Req, p) for p in range(32)]
+    assert all(0 <= t < 128 and t != 3 for t in toks)
+    ro = ReplayOracle({7: [10, 11, 12]})
+    assert ro.next_token(_Req, 0) == 11
+    assert ro.next_token(_Req, 1) == 12
+    assert ro.next_token(_Req, 5) == 0      # past the recorded run
+
+
+# ----------------------------------------------------------------------
+# calibration — THE headline gate
+# ----------------------------------------------------------------------
+def test_single_engine_calibration_is_decision_exact():
+    m = _make_model()
+    trace = poisson_trace(24, 400.0, 8, seed=0)
+    cal = calibrate(m, trace, engine_kwargs=_ek(num_blocks=24))
+    assert cal["tokens_exact"]
+    assert cal["decisions_exact"]
+    assert cal["timing_err"] <= TIMING_BAND
+    assert cal["events_real"] == cal["events_sim"] > 0
+    assert cal["real"]["requests"] == cal["sim"]["requests"] == 24
+
+
+def test_fleet_calibration_smoke_2_replicas_200_requests():
+    """ISSUE gate: a 2-replica, 200-request sim vs a real mini-run,
+    in-process, decision-exact."""
+    m = _make_model()
+    trace = thousand_tenant_trace(200, 2000.0, 4, seed=1)
+    cal = calibrate(m, trace, replicas=2,
+                    engine_kwargs=_ek(max_batch=8, token_budget=64),
+                    fleet_kwargs=dict(router_load_cap=2))
+    assert cal["tokens_exact"]
+    assert cal["decisions_exact"]
+    assert cal["timing_err"] <= TIMING_BAND
+    assert cal["real"]["requests"] == cal["sim"]["requests"] == 200
+    # the sim leg must actually be cheap relative to the real leg
+    assert cal["sim"]["wall_s"] < cal["real"]["wall_s"]
+
+
+# ----------------------------------------------------------------------
+# per-step gauges are event-log exact
+# ----------------------------------------------------------------------
+def test_step_gauges_match_the_event_log_exactly():
+    m = _make_model()
+    clk = VirtualClock()
+    # 9-page pool: 3 admitted runners outgrow it (preempt); the
+    # 3-deep queue sheds the rest of the burst at the gate
+    eng = SimEngine(m, clock=clk, record_step_gauges=True,
+                    **_ek(num_blocks=9, max_queue=3))
+    rng = np.random.RandomState(2)
+    # burst admission: max_batch=4 run, 3 wait, the rest shed at the
+    # gate; the 10-page pool forces preemptions among the runners
+    for i in range(10):
+        eng.add_request(rng.randint(0, 128, (12,)).astype(np.int32),
+                        max_new_tokens=14)
+    steps = 0
+    while eng.has_unfinished():
+        eng.step()
+        steps += 1
+    gauges = eng.lifecycle_stats()["step_gauges"]
+    assert len(gauges) == steps
+    recs = to_records(eng.events)
+    assert sum(1 for r in recs if r["kind"] == "shed") > 0
+    assert any(r["kind"] == "preempt" for r in recs)
+    for g in gauges:
+        upto = [r for r in recs if r["step"] <= g["step"]]
+        assert g["preemptions"] == sum(r["count"] for r in upto
+                                       if r["kind"] == "preempt")
+        assert g["shed"] == sum(1 for r in upto if r["kind"] == "shed")
+        assert g["aborted"] == sum(1 for r in upto
+                                   if r["kind"] == "abort")
+        assert g["deadline_missed"] == sum(1 for r in upto
+                                           if r["kind"] == "deadline")
+
+
+# ----------------------------------------------------------------------
+# policy experiments
+# ----------------------------------------------------------------------
+def _route_counts(target):
+    counts = {}
+    for r in to_records(target.events):
+        if r["kind"] == "route":
+            counts[r["replica"]] = counts.get(r["replica"], 0) + 1
+    return counts
+
+
+def test_load_capped_affinity_beats_herding_on_hot_tenant():
+    """The sim-discovered policy finding: under a saturating
+    hot-tenant burst, pure warm-affinity routing herds ~90% of traffic
+    onto one replica; router_load_cap=2 spills the excess and cuts
+    p95 TTFT (confirmed on the real engine by
+    bench_serving.py --replicas 4 --trace hot_tenant
+    --router-load-cap 2)."""
+    m = _make_model()
+    trace = hot_tenant_trace(300, 20000.0, 12, seed=0)
+    ek = _ek(token_budget=32)
+    res_aff, t_aff = simulate(m, trace, replicas=4, engine_kwargs=ek)
+    res_cap, t_cap = simulate(m, trace, replicas=4, engine_kwargs=ek,
+                              fleet_kwargs=dict(router_load_cap=2))
+    assert res_aff["requests"] == res_cap["requests"] == 300
+    # capped routing spreads: the hottest replica takes a much smaller
+    # share than under pure affinity
+    assert max(_route_counts(t_cap).values()) < \
+        0.5 * max(_route_counts(t_aff).values())
+    # ...and the tail latency improves by a wide margin
+    assert res_cap["ttft_ms"]["p95"] < 0.5 * res_aff["ttft_ms"]["p95"]
+    assert res_cap["virtual_s"] < res_aff["virtual_s"]
+
+
+def test_chaos_runs_are_deterministic_and_leak_free():
+    """Fault-injected fleet sims replay bit-identically (fresh
+    injector per run — FaultInjector is stateful) and the migration /
+    failover numpy paths leave zero leaked pages."""
+    m = _make_model()
+    trace = poisson_trace(40, 2000.0, 6, seed=3)
+
+    def run():
+        fi = FaultInjector(schedule=[
+            Fault("replica", "drain", step=6, victim=1),
+            Fault("replica", "kill", step=14, victim=2)])
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            res, fleet = simulate(
+                m, trace, replicas=3,
+                engine_kwargs=_ek(max_batch=8, token_budget=64),
+                fleet_kwargs=dict(faults=fi, migration="always"),
+                invariants_every=4)
+        logs = [to_records(fleet.events)] + \
+            [to_records(r.engine.events) for r in fleet.replicas]
+        return res, fleet, logs
+
+    res1, fleet1, logs1 = run()
+    res2, _, logs2 = run()
+    assert logs1 == logs2
+    kinds = {r["kind"] for lg in logs1 for r in lg}
+    assert "dead" in kinds
+    assert "draining" in kinds
+    assert {"export", "import"} & kinds or "reroute" in kinds
+    assert res1["requests"] == res2["requests"] == 40
+    for r in fleet1.replicas:
+        if r.live:
+            eng = r.engine
+            assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+
+# ----------------------------------------------------------------------
+# scale — slow tier
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_hundred_replica_hundred_thousand_request_sweep():
+    """ISSUE acceptance: 100 replicas x 1e5 requests in < 60 s wall on
+    one core, zero page-accounting violations (invariants checked
+    every 256 fleet steps AND at the end)."""
+    import time as _time
+
+    m = _make_model()
+    trace = thousand_tenant_trace(100_000, 400_000.0, 4, seed=7)
+    t0 = _time.perf_counter()
+    res, fleet = simulate(
+        m, trace, replicas=100,
+        engine_kwargs=dict(block_size=8, max_batch=8,
+                           max_model_len=64, token_budget=64),
+        fleet_kwargs=dict(router_load_cap=2),
+        latency=False, invariants_every=256)
+    wall = _time.perf_counter() - t0
+    assert res["requests"] == 100_000
+    assert wall < 60.0, f"sweep took {wall:.1f}s"
+    for r in fleet.replicas:
+        assert r.engine.block_manager.num_free_blocks == \
+            r.engine.num_blocks
+    stats = fleet.lifecycle_stats()
+    assert stats["replicas_live"] == 100
