@@ -382,6 +382,18 @@ def main():
                          "warmup, mixed at least one step, and warmed "
                          "strictly fewer executables than the retired "
                          "per-phase grid's golden census (5 at tp=1)")
+    ap.add_argument("--sampling-mix", action="store_true",
+                    help="GATED acceptance row for the production "
+                         "request surface: replay a burst mixing "
+                         "greedy, top-p/top-k/penalty sampled, "
+                         "grammar-constrained, and n=2 COW-forked "
+                         "requests through ONE engine and fail unless "
+                         "an armed CompileWatcher sees zero "
+                         "post-warmup compiles, zero pages leak, "
+                         "every request (fork children included) "
+                         "finishes ok, and constrained outputs replay "
+                         "legally through their grammar; reports TPOT "
+                         "p50/p95 per mode")
     ap.add_argument("--quant", default=None, choices=["int8"],
                     help="GATED acceptance row for quantized serving: "
                          "derive an HBM budget that admits batch B at "
@@ -459,6 +471,8 @@ def main():
         return _main_chaos(args, jax)
     if args.mixed:
         return _main_mixed(args, jax)
+    if args.sampling_mix:
+        return _main_sampling_mix(args, jax)
     if args.quant is not None:
         return _main_quant(args, jax)
     if args.trace is not None:
@@ -1075,6 +1089,143 @@ def _main_mixed(args, jax):
             f"mixed_steps={mixed_steps} "
             f"compile_count={res['compile_count']} "
             f"(old golden {_OLD_GOLDEN_TP1_COMPILES})")
+
+
+def _main_sampling_mix(args, jax):
+    """--sampling-mix: the production-request-surface acceptance row.
+
+    Replays a burst that mixes all four request modes through ONE
+    engine — greedy, top-p/top-k/penalty sampled, grammar-constrained,
+    and n=2 COW forks — and GATES on the request surface's contract:
+
+    - an armed CompileWatcher sees ZERO post-warmup compiles (every
+      sampling/constraint/fork knob rides batched device operands, so
+      the golden census stays one ragged family),
+    - the pool ends with zero leaked pages (fork families free their
+      COW'd pages refcount-exactly),
+    - every request (children included) finishes ok, constrained
+      outputs replay legally through their grammar, and each fork
+      parent produced exactly its advertised child.
+
+    The row reports TPOT p50/p95 PER MODE, so a regression that slows
+    only one mode (say, vocab-channel packing on constrained rows)
+    cannot hide inside the aggregate.
+    """
+    from paddle_tpu.inference.llm.structured import json_array_grammar
+
+    max_model_len = 48 + max(args.max_new, 12)
+    _, prompts, new_tokens = _trace(args.requests, args.rate,
+                                    args.max_new, args.seed)
+    eng = _build_engine(args.max_batch, args.seed,
+                        max_model_len=max_model_len,
+                        token_budget=args.token_budget)
+    _lint_census(args, eng)
+    watcher = eng.warmup()
+
+    grammar = json_array_grammar(eng.vocab_size, open_id=10,
+                                 close_id=11, comma_id=12,
+                                 item_ids=(20, 21, 22), eos_id=1,
+                                 max_items=4)
+    modes = ("greedy", "top_p", "constrained", "fork")
+    mode_of, fork_parents = {}, []
+    for i, p in enumerate(prompts):
+        mode = modes[i % len(modes)]
+        kw = {"max_new_tokens": new_tokens[i]}
+        if mode == "top_p":
+            kw.update(temperature=0.8, top_p=0.9, top_k=40,
+                      repetition_penalty=1.1, seed=100 + i)
+        elif mode == "constrained":
+            kw.update(grammar=grammar, eos_token_id=1,
+                      max_new_tokens=max(new_tokens[i], 12))
+        elif mode == "fork":
+            kw.update(temperature=0.7, seed=1000 + i, n=2)
+        rid = eng.add_request(p, **kw)
+        mode_of[rid] = mode
+        if mode == "fork":
+            fork_parents.append(rid)
+
+    # drive to completion directly (not through run()) so every token
+    # timestamp carries its request's mode tag
+    t0 = time.perf_counter()
+    first, last, counts, outs = {}, {}, {}, {}
+    while eng.has_unfinished():
+        finished = eng.step()
+        now = time.perf_counter() - t0
+        grown = {}
+        for fo in finished:
+            outs[fo.request_id] = fo
+            grown[fo.request_id] = len(fo.output_ids)
+        for rid, req in eng._requests.items():
+            grown.setdefault(rid, len(req.output_ids))
+        for rid, n in grown.items():
+            # fork children ("<parent>.<k>") inherit the fork tag
+            mode_of.setdefault(rid, "fork")
+            if n > counts.get(rid, 0):
+                counts[rid] = n
+                first.setdefault(rid, now)
+                last[rid] = now
+    elapsed = time.perf_counter() - t0
+
+    tpots = {m: [] for m in modes}
+    for rid, fo in outs.items():
+        n = len(fo.output_ids)
+        if n >= 2 and rid in first:
+            tpots[mode_of[rid]].append(
+                1e3 * (last[rid] - first[rid]) / (n - 1))
+    per_mode = {
+        m: {"requests": sum(1 for r in outs if mode_of[r] == m),
+            "tpot_p50_ms": (round(float(np.percentile(v, 50)), 2)
+                            if v else None),
+            "tpot_p95_ms": (round(float(np.percentile(v, 95)), 2)
+                            if v else None)}
+        for m, v in tpots.items()}
+
+    new_compiles = watcher.new_compiles()
+    leaked = eng.num_blocks - eng.block_manager.num_free_blocks
+    all_ok = bool(outs) and all(fo.ok for fo in outs.values())
+
+    def _legal(fo):
+        s = grammar.start_state()
+        for t in fo.output_ids:
+            s = grammar.advance(s, int(t))
+            if s is None:
+                return False
+        return True
+
+    constrained_ok = all(
+        _legal(fo) for rid, fo in outs.items()
+        if mode_of[rid] == "constrained")
+    forks_ok = all(f"{rid}.1" in outs for rid in fork_parents)
+
+    total_tokens = sum(len(fo.output_ids) for fo in outs.values())
+    row = {
+        "metric": "llm_serving_sampling_mix",
+        "value": round(total_tokens / max(elapsed, 1e-9), 2),
+        "unit": "tokens/s",
+        "per_mode": per_mode,
+        "new_compiles": len(new_compiles),
+        "leaked_pages": leaked,
+        "all_ok": all_ok,
+        "constrained_ok": constrained_ok,
+        "forks_ok": forks_ok,
+        "requests": args.requests,
+        "fork_children": sum(1 for r in outs if "." in str(r)),
+        "max_batch": args.max_batch,
+        "compile_count": len(watcher.compile_ms),
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    ok = (not new_compiles and leaked == 0 and all_ok
+          and constrained_ok and forks_ok)
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "sampling mix violated its contract: "
+            f"new_compiles={len(new_compiles)} leaked_pages={leaked} "
+            f"all_ok={all_ok} constrained_ok={constrained_ok} "
+            f"forks_ok={forks_ok}")
 
 
 def _main_quant(args, jax):

@@ -152,12 +152,15 @@ def _nbytes(aval):
 
 
 # one flop per output element (XLA's HloCostAnalysis convention for
-# elementwise arithmetic; comparisons, selects and pure data movement
-# count zero)
+# elementwise arithmetic — including predicates, selects and dtype
+# converts, which HloCostAnalysis also prices at one op per element;
+# pure data movement like broadcast/reshape/slice counts zero)
 _ELEMENTWISE_FLOP = {
     "add", "sub", "mul", "div", "rem", "max", "min", "neg", "abs",
     "floor", "ceil", "round", "sign", "nextafter", "add_any",
     "atan2", "complex", "real", "imag", "conj", "clamp", "square",
+    "lt", "le", "gt", "ge", "eq", "ne", "select_n", "and", "or",
+    "xor", "not", "is_finite", "convert_element_type",
 }
 
 # counted in the separate `transcendentals` bucket, NOT flops —
@@ -172,9 +175,15 @@ _TRANSCENDENTAL = {
 # reductions: ~one op per input element folded away
 _REDUCTIONS = {
     "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
-    "reduce_and", "reduce_or", "cumsum", "cummax", "cummin", "cumprod",
-    "cumlogsumexp",
+    "reduce_and", "reduce_or",
 }
+
+# cumulative scans: XLA decomposes these into a logarithmic ladder of
+# strided adds plus pad/select/convert bookkeeping; HloCostAnalysis on
+# the optimized module prices the ladder at ~(13 + log2(L)/2) ops per
+# element of the scanned array (L = scanned-axis length) — an
+# empirical fit, exact for L in {128, 256} and within 2% down to L=16
+_CUMULATIVE = {"cumsum", "cummax", "cummin", "cumprod", "cumlogsumexp"}
 
 # call-like primitives whose cost is their sub-jaxpr's cost
 _CALL_PRIMS = {
@@ -224,6 +233,16 @@ def _eqn_flops(eqn):
     if name in _REDUCTIONS:
         return sum(_elems(iv.aval) for iv in eqn.invars
                    if hasattr(iv, "aval")), 0
+    if name in _CUMULATIVE:
+        out = eqn.outvars[0].aval
+        axis = eqn.params.get("axis", 0)
+        length = max(2, out.shape[axis] if out.shape else 1)
+        return int(_elems(out) * (13 + math.log2(length) / 2)), 0
+    if name == "sort":
+        # XLA's estimate: N log2 N comparisons over the whole array
+        # (co-sorted operands ride the same comparisons for free)
+        n = max(2, _elems(eqn.invars[0].aval))
+        return int(n * math.ceil(math.log2(n))), 0
     if name in ("scatter-add", "scatter_add", "scatter-mul"):
         return _elems(eqn.invars[-1].aval), 0
     return 0, 0

@@ -23,6 +23,19 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   deterministic fault-injection harness (FaultInjector,
                   RetryPolicy, StepWatchdog) — seeded fault schedules
                   at the device-step / allocator / socket boundaries
+- sampling:       the per-request sampling suite — a jit-compatible
+                  per-row logits pipeline (top-k/top-p/min-p,
+                  repetition/presence/frequency penalties, logit bias)
+                  riding the one ragged executable as batched device
+                  operands, plus host-side stop strings and logprobs
+- structured:     grammar/JSON-constrained decoding — vocab masks
+                  compiled per grammar state on the host, applied in
+                  the device step through the sampling bias channel,
+                  exact under speculative verify
+- http_server:    HttpLLMServer — HTTP/SSE front end (beside the
+                  socket PredictorServer) streaming token deltas with
+                  the full sampling/constraint parameter set on the
+                  wire, backed by an engine or a Fleet
 - events:         the frozen, versioned event-log record schema
                   (named fields per kind, wall-clock-free by
                   construction) shared by engines, fleets and the
@@ -58,6 +71,23 @@ from .block_manager import (  # noqa: F401
     prefix_block_hashes,
 )
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
+from .http_server import HttpLLMServer  # noqa: F401
+from .sampling import (  # noqa: F401
+    FILTERED,
+    StopStringWatcher,
+    apply_logits_pipeline,
+    neutral_row_params,
+    token_counts,
+    top_logprobs,
+    validate_sampling,
+)
+from .structured import (  # noqa: F401
+    ConstraintState,
+    DfaTokenGrammar,
+    Grammar,
+    grammar_from_spec,
+    json_array_grammar,
+)
 from .events import (  # noqa: F401
     EVENT_FIELDS,
     SCHEMA_VERSION,
@@ -107,7 +137,12 @@ from .spec import (  # noqa: F401
 __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "RaggedRow", "ScheduledBatch", "LLMEngine", "AsyncLLMEngine",
-           "RequestOutput",
+           "RequestOutput", "HttpLLMServer",
+           "FILTERED", "StopStringWatcher", "apply_logits_pipeline",
+           "neutral_row_params", "token_counts", "top_logprobs",
+           "validate_sampling",
+           "ConstraintState", "DfaTokenGrammar", "Grammar",
+           "grammar_from_spec", "json_array_grammar",
            "NgramDrafter", "SpeculativeConfig", "rollback_draft_reservation",
            "Fleet", "HealthConfig", "MigrationPolicy", "Replica", "Router",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",

@@ -88,7 +88,16 @@ from .quant import (
     quantize_kv_rows,
     scale_key,
 )
+from .sampling import (
+    StopStringWatcher,
+    apply_logits_pipeline,
+    neutral_row_params,
+    token_counts,
+    top_logprobs,
+    validate_sampling,
+)
 from .scheduler import FINISHED, RUNNING, Request, Scheduler, bucket_size
+from .structured import ConstraintState
 from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
 
 # Megatron-style sharding of the stacked block params over the 'mp' axis
@@ -148,13 +157,19 @@ class RequestOutput:
     step's message in ``error``."""
 
     def __init__(self, request_id, prompt_ids, output_ids, finish_reason,
-                 num_preemptions, error=None):
+                 num_preemptions, error=None, logprobs=None,
+                 matched_stop=None):
         self.request_id = request_id
         self.prompt_ids = np.asarray(prompt_ids)  # noqa: H001 (host output contract)
         self.output_ids = np.asarray(output_ids)  # noqa: H001 (host output contract)
         self.finish_reason = finish_reason
         self.num_preemptions = num_preemptions
         self.error = error
+        # per-token [(chosen_logprob, [(tid, lp), ...]), ...] when the
+        # request asked for logprobs=N; the stop string that ended a
+        # stop-string finish (None otherwise)
+        self.logprobs = logprobs
+        self.matched_stop = matched_stop
 
     @property
     def ok(self):
@@ -207,7 +222,7 @@ class LLMEngine:
                  speculative=None, memory_budget=None, quantize=None,
                  faults=None, retry=None, max_queue=None,
                  step_timeout_s=None, clock=None,
-                 record_step_gauges=False):
+                 record_step_gauges=False, detokenizer=None):
         # ----------------------------------------- lifecycle hardening ----
         # validate the robustness knobs FIRST (mirrors max_new_tokens):
         # a bad config must fail loudly at construction, not mid-traffic
@@ -262,6 +277,15 @@ class LLMEngine:
         self.head_dim = cfg.head_dim
         self.hidden = cfg.hidden_size
         self.eps = cfg.layer_norm_epsilon
+        self.vocab_size = int(cfg.vocab_size)  # noqa: H001 (config attr, not a tensor)
+        # ids -> text, for stop-string matching (sampling.py); requests
+        # carrying stop= are rejected up front when no detokenizer is
+        # configured, so the failure is a loud add_request ValueError
+        if detokenizer is not None and not callable(detokenizer):
+            raise ValueError(
+                f"detokenizer must be a callable(ids) -> str, "
+                f"got {detokenizer!r}")
+        self.detokenizer = detokenizer
         self.block_size = int(block_size)
         self.max_batch = int(max_batch)
         self.max_model_len = int(min(max_model_len or  # noqa: H001 (static config int)
@@ -377,6 +401,10 @@ class LLMEngine:
         self._next_id = 0
         self.seed = 0 if seed is None else int(seed)
         self._rng = np.random.RandomState(self.seed)
+        # per-bucket cached all-zero [tb, V] bias/counts channel, so
+        # the common no-pipeline step re-uses one device array instead
+        # of uploading a fresh vocab-sized zero block every launch
+        self._neutral_chan = {}
         self.stats = {"steps": 0, "prefill_steps": 0, "decode_steps": 0,
                       "chunk_launches": 0, "tokens_generated": 0,
                       "spec_steps": 0, "draft_tokens": 0,
@@ -525,8 +553,20 @@ class LLMEngine:
             w = params["embed"]["word_embeddings.weight"]
             return x @ w.T.astype(self.dtype)
 
+        def copy_cow_pages(pool, cow_src, cow_dst):
+            """Copy-on-write page payloads for fork siblings diverging
+            off a shared partial tail: dst pages get src contents
+            BEFORE this step's token writes land.  Padding entries
+            carry dst == num_blocks (out of range) and drop.  Under TP
+            each shard copies its own head slice — indices ride
+            replicated, pools are local."""
+            return pool.at[:, cow_dst].set(pool[:, cow_src],
+                                           mode="drop")
+
         def ragged_fn(params, ids, kc, vc, block_tables, positions,
-                      rows, row_start, row_qlen, row_pos0):
+                      rows, row_start, row_qlen, row_pos0, cow_src,
+                      cow_dst, top_k, top_p, min_p, rep_pen, pres_pen,
+                      freq_pen, bias, counts):
             """THE executable: one ragged token batch covers every
             serving phase.  ids [Tb] — the step's query tokens packed
             back-to-back and padded to the token bucket; positions [Tb]
@@ -549,7 +589,18 @@ class LLMEngine:
             chunk/decode/verify steps the old engine ran — the retired
             decode/verify bodies' pre-scale dance (q times
             ``scale * sqrt(hd)``, exactly 1.0) is dropped outright.
+
+            The request-surface operands (sampling.py): ``cow_src`` /
+            ``cow_dst`` [R] are fork COW page copies applied up front;
+            the six [R] knob vectors plus the [Tb, V] bias/counts
+            channels drive the per-row logits pipeline applied AFTER
+            the head — the returned argmax and logits are the
+            PROCESSED ones, so greedy-under-mask and speculative
+            acceptance see exactly what the sampler samples from.
+            Neutral operand values are bitwise identities.
             Returns (argmax [Tb], logits [Tb, V], kc, vc)."""
+            kc = copy_cow_pages(kc, cow_src, cow_dst)
+            vc = copy_cow_pages(vc, cow_src, cow_dst)
             emb = params["embed"]
             tb = ids.shape[0]
             p_safe = jnp.maximum(positions, 0)
@@ -576,17 +627,28 @@ class LLMEngine:
             x, (kc, vc) = jax.lax.scan(layer, x,
                                        (params["blocks"], kc, vc))
             logits = head_logits(params, x[0])       # [Tb, V]
+            logits = apply_logits_pipeline(
+                logits, rows, top_k, top_p, min_p, rep_pen, pres_pen,
+                freq_pen, bias, counts)
             return jnp.argmax(logits, -1), logits, kc, vc
 
         def ragged_fn_quant(params, ids, kc, vc, ks, vs, block_tables,
                             positions, rows, row_start, row_qlen,
-                            row_pos0):
+                            row_pos0, cow_src, cow_dst, top_k, top_p,
+                            min_p, rep_pen, pres_pen, freq_pen, bias,
+                            counts):
             """ragged_fn with the int8 KV pool: identical packing and
             causal semantics, but the per-layer scatter quantizes each
             written token row (int8 values + per-head f32 scale) and
             attention dequantizes at read time INSIDE the kernel —
-            no bf16 copy of the pool is ever materialized.  Returns
+            no bf16 copy of the pool is ever materialized.  COW copies
+            cover the scale pools too (int8 payload + scales move
+            together).  Returns
             (argmax [Tb], logits [Tb, V], kc, vc, ks, vs)."""
+            kc = copy_cow_pages(kc, cow_src, cow_dst)
+            vc = copy_cow_pages(vc, cow_src, cow_dst)
+            ks = copy_cow_pages(ks, cow_src, cow_dst)
+            vs = copy_cow_pages(vs, cow_src, cow_dst)
             emb = params["embed"]
             tb = ids.shape[0]
             p_safe = jnp.maximum(positions, 0)
@@ -615,6 +677,9 @@ class LLMEngine:
             x, (kc, vc, ks, vs) = jax.lax.scan(
                 layer, x, (params["blocks"], kc, vc, ks, vs))
             logits = head_logits(params, x[0])       # [Tb, V]
+            logits = apply_logits_pipeline(
+                logits, rows, top_k, top_p, min_p, rep_pen, pres_pen,
+                freq_pen, bias, counts)
             return jnp.argmax(logits, -1), logits, kc, vc, ks, vs
 
         step_fn = ragged_fn_quant if self._kv_quant else ragged_fn
@@ -656,8 +721,11 @@ class LLMEngine:
                     out_shardings=(rsh, rsh) + pool_shards,
                     donate_argnums=tuple(range(2, 2 + n_pools)))
 
-            # tables, positions, rows, row_start, row_qlen, row_pos0
-            self._ragged = tp_wrap(step_fn, 6)
+            # tables, positions, rows, row_start, row_qlen, row_pos0,
+            # cow_src, cow_dst, then the eight sampling operands (six
+            # per-row knob vectors + the two [Tb, V] channels) — all
+            # replicated, like every host-packed descriptor
+            self._ragged = tp_wrap(step_fn, 16)
         else:
             self._ragged = jax.jit(
                 step_fn, donate_argnums=tuple(range(2, 2 + n_pools)))
@@ -665,7 +733,10 @@ class LLMEngine:
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                     temperature=0.0, request_id=None, seed=None,
-                    deadline_ms=None):
+                    deadline_ms=None, top_k=0, top_p=1.0, min_p=0.0,
+                    repetition_penalty=1.0, presence_penalty=0.0,
+                    frequency_penalty=0.0, logit_bias=None, logprobs=0,
+                    stop=None, grammar=None, n=1):
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]  # noqa: H001 (host request boundary)
         if not prompt:
             raise ValueError("empty prompt")
@@ -675,6 +746,31 @@ class LLMEngine:
         if temperature < 0.0:
             raise ValueError(
                 f"temperature must be >= 0, got {temperature}")
+        logit_bias, stop = validate_sampling(
+            top_k, top_p, min_p, repetition_penalty, presence_penalty,
+            frequency_penalty, logit_bias, logprobs, stop, n,
+            vocab_size=self.vocab_size)
+        if stop and self.detokenizer is None:
+            raise ValueError(
+                "stop strings need a detokenizer — construct the "
+                "engine with detokenizer=callable(ids) -> str")
+        if grammar is not None and not all(
+                hasattr(grammar, a)
+                for a in ("start_state", "allowed", "advance")):
+            raise ValueError(
+                f"grammar must implement start_state/allowed/advance "
+                f"(see inference.llm.structured.Grammar), "
+                f"got {grammar!r}")
+        if n > 1:
+            if seed is None:
+                raise ValueError(
+                    "n > 1 parallel sampling needs an explicit seed — "
+                    "each fork k samples under seed + k, which is what "
+                    "makes fork-vs-replay exactness checkable")
+            if n > self.max_batch:
+                raise ValueError(
+                    f"n={n} exceeds max_batch {self.max_batch}: the "
+                    f"whole fork family must fit one running set")
         if deadline_ms is not None and \
                 (isinstance(deadline_ms, bool)
                  or not isinstance(deadline_ms, (int, float, np.integer,
@@ -698,7 +794,16 @@ class LLMEngine:
                       seed=None if seed is None else int(seed),
                       deadline=(None if deadline_ms is None
                                 else now + float(deadline_ms) / 1e3),
+                      top_k=int(top_k), top_p=float(top_p),
+                      min_p=float(min_p),
+                      repetition_penalty=float(repetition_penalty),
+                      presence_penalty=float(presence_penalty),
+                      frequency_penalty=float(frequency_penalty),
+                      logit_bias=logit_bias, logprobs=int(logprobs),
+                      stop=stop, grammar=grammar, n=int(n),
                       arrival_time=now)
+        if grammar is not None:
+            req._constraint = ConstraintState(grammar)
         # bounded admission: past the configured waiting-queue depth
         # (or while draining) the request is SHED — it finishes
         # immediately with FinishReason.shed instead of growing an
@@ -748,7 +853,9 @@ class LLMEngine:
         self._requests.pop(req.request_id, None)
         self._early.append(RequestOutput(
             req.request_id, req.prompt_ids, req.output_ids, reason,
-            req.num_preemptions, error=error))
+            req.num_preemptions, error=error,
+            logprobs=req.logprobs_content if req.logprobs else None,
+            matched_stop=req.matched_stop))
 
     def _expire_deadlines(self, finished):
         """Scheduler-enforced deadlines: pop every request past its
@@ -841,13 +948,21 @@ class LLMEngine:
         donating) anything, so a lint pass never touches cache state."""
         sds = jax.ShapeDtypeStruct
         pools = tuple(sds(c.shape, c.dtype) for c in self._pools())
-        i32 = jnp.int32
-        rmax = self.max_batch
+        i32, f32 = jnp.int32, jnp.float32
+        rmax, v = self.max_batch, self.vocab_size
         for kind, tb in self._bucket_grid():
             args = (self.params, sds((tb,), i32)) + pools + (
                     sds((rmax, self.max_pages), i32), sds((tb,), i32),
                     sds((tb,), i32), sds((rmax,), i32),
-                    sds((rmax,), i32), sds((rmax,), i32))
+                    sds((rmax,), i32), sds((rmax,), i32),
+                    # cow_src, cow_dst
+                    sds((rmax,), i32), sds((rmax,), i32),
+                    # top_k, top_p, min_p, rep/pres/freq penalties
+                    sds((rmax,), i32), sds((rmax,), f32),
+                    sds((rmax,), f32), sds((rmax,), f32),
+                    sds((rmax,), f32), sds((rmax,), f32),
+                    # bias + counts channels bucket with the token axis
+                    sds((tb, v), f32), sds((tb, v), f32))
             yield kind, tb, self._ragged, args
 
     def _alloc_pools(self, cache_shape, scale_shape):
@@ -918,9 +1033,16 @@ class LLMEngine:
                 positions = jnp.full((tb,), -1, jnp.int32)
                 rows = jnp.zeros((tb,), jnp.int32)
                 zr = jnp.zeros((rmax,), jnp.int32)
+                # neutral sampling operands: no-COW (dst = num_blocks
+                # drops the copy), identity knobs, zero channels
+                cow_dst = jnp.full((rmax,), self.num_blocks, jnp.int32)
+                knobs = tuple(jnp.asarray(k)
+                              for k in neutral_row_params(rmax))
+                chan = jnp.zeros((tb, self.vocab_size), jnp.float32)
                 out = self._ragged(
                     self.params, ids, *self._pools(), tables,
-                    positions, rows, zr, zr, zr)
+                    positions, rows, zr, zr, zr, zr, cow_dst,
+                    *knobs, chan, chan)
                 self._set_pools(out[2:])
                 jax.block_until_ready(self._kc)
                 timings[f"{kind}[{tb}]"] = \
@@ -1304,10 +1426,79 @@ class LLMEngine:
             row_pos0[ri] = row.start
             s += row.length
 
+        # COW page copies + sampling operands — neutral identities
+        # unless this batch carries fork COWs or pipeline rows, so
+        # legacy traffic runs the same executable on the same values it
+        # always did.  The [tb, V] channels are the only vocab-sized
+        # operands; the all-zero channel is cached per bucket so the
+        # common (no-pipeline) step never re-uploads it.
+        cow_src = np.zeros(rmax, np.int32)
+        cow_dst = np.full(rmax, self.num_blocks, np.int32)
+        for i, (csrc, cdst) in enumerate(batch.cows):
+            cow_src[i] = csrc
+            cow_dst[i] = cdst
+        knobs = neutral_row_params(rmax)
+        top_k, top_p, min_p, rep_pen, pres_pen, freq_pen = knobs
+        pipe_rows = [(ri, row) for ri, row in enumerate(rows)
+                     if row.request.uses_pipeline]
+        bias = counts = None
+        if pipe_rows:
+            v = self.vocab_size
+            bias = np.zeros((tb, v), np.float32)
+            counts = np.zeros((tb, v), np.float32)
+            for ri, row in pipe_rows:
+                req = row.request
+                top_k[ri] = req.top_k
+                top_p[ri] = req.top_p
+                min_p[ri] = req.min_p
+                rep_pen[ri] = req.repetition_penalty
+                pres_pen[ri] = req.presence_penalty
+                freq_pen[ri] = req.frequency_penalty
+                if row.kind == "chunk":
+                    if not row.chunk.is_final:
+                        continue       # no position samples this step
+                    qpos = [starts[ri] + row.length - 1]
+                    prefixes = [()]
+                else:
+                    # verify position j sees the draft prefix
+                    # drafts[:j] as already-generated text — counts and
+                    # grammar state advance PER POSITION, which is what
+                    # makes constrained/penalized speculation exact
+                    drafts = list(req.draft_tokens)
+                    qpos = list(range(starts[ri],
+                                      starts[ri] + row.length))
+                    prefixes = [tuple(drafts[:j])
+                                for j in range(len(qpos))]
+                penal = (req.repetition_penalty != 1.0
+                         or req.presence_penalty != 0.0
+                         or req.frequency_penalty != 0.0)
+                states = None
+                if req._constraint is not None and len(qpos) > 1:
+                    states = req._constraint.peek(prefixes[-1])
+                for j, p in enumerate(qpos):
+                    if penal:
+                        counts[p] = token_counts(
+                            list(req.all_ids) + list(prefixes[j]), v)
+                    if req.logit_bias:
+                        for t, b in req.logit_bias.items():
+                            bias[p, t] += b
+                    if req._constraint is not None:
+                        st = req._constraint.state if j == 0 \
+                            else states[j - 1]
+                        if st is not None:
+                            req._constraint.bias_row(bias[p], state=st)
+        if bias is None:
+            chan = self._neutral_chan.get(tb)
+            if chan is None:
+                chan = jnp.zeros((tb, self.vocab_size), jnp.float32)
+                self._neutral_chan[tb] = chan
+            bias = counts = chan
+
         out = self._launch("ragged", [row.request for row in rows],
                            lambda: self._ragged_launch(
                                rows, ids, tables, positions, tok_rows,
-                               row_start, row_qlen, row_pos0))
+                               row_start, row_qlen, row_pos0,
+                               cow_src, cow_dst, knobs, bias, counts))
         if out is None:
             return              # quarantined; reservations rolled back
         nxt, logits = out[0], out[1]
@@ -1349,36 +1540,51 @@ class LLMEngine:
             self._register_full_blocks(req)
             if ch.is_final:
                 lg = row_logits.get(ri)
+                # n>1 forks split HERE — prompt fully cached, before
+                # the first token commits — so every family member
+                # samples its first token from this shared final-chunk
+                # logits row under its own seeded stream
+                fam = self._fork_family(req)
+                tok = nxt[starts[ri] + row.length - 1]
                 self._commit_tokens(
-                    [(req, nxt[starts[ri] + row.length - 1],
-                      None if lg is None else lg[0])], finished)
+                    [(r, tok, None if lg is None else lg[0])
+                     for r in fam], finished)
 
     def _ragged_launch(self, rows, ids, tables, positions, tok_rows,
-                       row_start, row_qlen, row_pos0):
+                       row_start, row_qlen, row_pos0, cow_src, cow_dst,
+                       knobs, bias, counts):
         """Execute ONE packed ragged launch — the device-step seam.
         Numpy operands in, the executable's output tuple out.  ``rows``
         is the host-side schedule the operands were packed from: the
         real engine ignores it; the discrete-event simulator's
         SimEngine overrides this method and reads ``rows`` to
         synthesize the argmax vector from its token oracle instead of
-        running the device."""
+        running the device.  ``knobs`` is the six-tuple of per-row
+        sampling vectors; ``bias``/``counts`` the [tb, V] channels
+        (possibly the cached neutral device array)."""
         del rows  # the real launch needs only the packed operands
         with profiler.RecordEvent("llm_engine::ragged"):
             return self._ragged(
                 self.params, jnp.asarray(ids), *self._pools(),
                 jnp.asarray(tables), jnp.asarray(positions),
                 jnp.asarray(tok_rows), jnp.asarray(row_start),
-                jnp.asarray(row_qlen), jnp.asarray(row_pos0))
+                jnp.asarray(row_qlen), jnp.asarray(row_pos0),
+                jnp.asarray(cow_src), jnp.asarray(cow_dst),
+                *(jnp.asarray(k) for k in knobs),
+                jnp.asarray(bias), jnp.asarray(counts))
 
     def _fetch_sampling_rows(self, rows, starts, logits):
         """Fetch ONLY the logits of tokens that sample: greedy batches
         transfer just the argmax vector, and a mixed batch pays for its
         sampling tokens, not the whole [Tb, V] logits.  Returns
         {row_index: [n, V] host array} — a decode row's single token, a
-        verify row's 1 + K tokens, a FINAL chunk's last token."""
+        verify row's 1 + K tokens, a FINAL chunk's last token.
+        Greedy rows that asked for ``logprobs`` fetch too — the
+        report is computed on the host from the processed row."""
         idx, spans = [], {}
         for ri, row in enumerate(rows):
-            if row.request.temperature <= 0.0:
+            if row.request.temperature <= 0.0 \
+                    and not row.request.logprobs:
                 continue
             if row.kind == "chunk":
                 if not row.chunk.is_final:
@@ -1405,6 +1611,63 @@ class LLMEngine:
             rng = self._rng
         return int(np.argmax(z + rng.gumbel(size=z.shape)))  # noqa: H001 (host sampling math)
 
+    def _check_stop(self, req):
+        """Stop-string check after an emitted token (host work by
+        design — sampling.StopStringWatcher).  Returns the matched
+        string (also recorded on the request) or None."""
+        if not req.stop:
+            return None
+        if req._stop_watcher is None:
+            req._stop_watcher = StopStringWatcher(
+                req.stop, self.detokenizer)
+        hit = req._stop_watcher.check(req.output_ids)
+        if hit is not None:
+            req.matched_stop = hit
+        return hit
+
+    def _fork_family(self, req):
+        """Split an ``n>1`` request into its fork family, returning the
+        members in sampling order (parent first).  Called at final-
+        chunk commit, AFTER the whole prompt's K/V landed but BEFORE
+        the first token samples: BlockManager.fork refcounts the
+        parent's pages (zero data copied now — a child's first private
+        page materializes later as a COW pair inside the ragged
+        executable), and child ``k`` samples under ``seed + k``, which
+        is exactly the stream an independent replay with that seed
+        would use — the fork-vs-replay exactness gate."""
+        if req.n <= 1 or req._forked:
+            return [req]
+        req._forked = True
+        fam = [req]
+        for k in range(1, req.n):
+            cid = f"{req.request_id}.{k}"
+            self.block_manager.fork(req.request_id, cid)
+            child = Request(
+                request_id=cid, prompt_ids=req.prompt_ids,
+                max_new_tokens=req.max_new_tokens,
+                eos_token_id=req.eos_token_id,
+                temperature=req.temperature,
+                seed=req.seed + k, deadline=req.deadline,
+                top_k=req.top_k, top_p=req.top_p, min_p=req.min_p,
+                repetition_penalty=req.repetition_penalty,
+                presence_penalty=req.presence_penalty,
+                frequency_penalty=req.frequency_penalty,
+                logit_bias=req.logit_bias, logprobs=req.logprobs,
+                stop=req.stop, grammar=req.grammar,
+                n=1, parent_id=req.request_id, fork_index=k,
+                arrival_time=req.arrival_time,
+                num_cached=req.num_cached,
+                num_prefill_tokens=req.num_prefill_tokens,
+                status=RUNNING)
+            if req.grammar is not None:
+                child._constraint = ConstraintState(req.grammar)
+            self._requests[cid] = child
+            self.scheduler.running.append(child)
+            self.events.append(
+                (self._step_index, "fork", req.request_id, cid))
+            fam.append(child)
+        return fam
+
     def _commit_tokens(self, entries, finished):
         """Commit one token per (req, argmax, logits) entry, in order.
         Engine-stream sampling rows share ONE vectorized gumbel draw:
@@ -1430,7 +1693,14 @@ class LLMEngine:
                 tok = int(argmax_token)  # noqa: H001 (host token, already fetched)
             req.output_ids.append(tok)
             self.stats["tokens_generated"] += 1
-            if (req.eos_token_id is not None
+            if req.logprobs and logits is not None:
+                req.logprobs_content.append(
+                    top_logprobs(logits, req.logprobs, tok))
+            if req._constraint is not None:
+                req._constraint.advance(tok)  # noqa: H001 (intentional host grammar-state advance)
+            if self._check_stop(req) is not None:
+                self._finish(req, "stop", finished)
+            elif (req.eos_token_id is not None
                     and tok == req.eos_token_id):
                 self._finish(req, "stop", finished)
             elif len(req.output_ids) >= req.max_new_tokens:
@@ -1460,9 +1730,21 @@ class LLMEngine:
             req.output_ids.append(tok)
             emitted += 1
             self.stats["tokens_generated"] += 1
+            if req.logprobs and logits_row is not None:
+                req.logprobs_content.append(
+                    top_logprobs(logits_row[j], req.logprobs, tok))
+            if req._constraint is not None:
+                # the emitted token came from MASKED logits (position
+                # j's mask was packed from the state after drafts[:j],
+                # which is exactly the path walked so far), so the
+                # transition always exists
+                req._constraint.advance(tok)  # noqa: H001 (intentional host grammar-state advance)
             matched = j < d and tok == drafts[j]
             if matched:
                 self.stats["accepted_tokens"] += 1
+            if self._check_stop(req) is not None:
+                reason = "stop"
+                break
             if req.eos_token_id is not None and tok == req.eos_token_id:
                 reason = "stop"
                 break
@@ -1502,20 +1784,30 @@ class LLMEngine:
         del self._requests[req.request_id]
         self.events.append(
             (self._step_index, "finish", req.request_id, reason))
-        finished.append(RequestOutput(req.request_id, req.prompt_ids,
-                                      req.output_ids, reason,
-                                      req.num_preemptions))
+        finished.append(RequestOutput(
+            req.request_id, req.prompt_ids, req.output_ids, reason,
+            req.num_preemptions,
+            logprobs=req.logprobs_content if req.logprobs else None,
+            matched_stop=req.matched_stop))
 
     # ----------------------------------------------------------- generate --
     def generate(self, prompts, max_new_tokens=32, eos_token_id=None,
-                 temperature=0.0, seed=None, deadline_ms=None):
+                 temperature=0.0, seed=None, deadline_ms=None,
+                 top_k=0, top_p=1.0, min_p=0.0, repetition_penalty=1.0,
+                 presence_penalty=0.0, frequency_penalty=0.0,
+                 logit_bias=None, logprobs=0, stop=None, grammar=None,
+                 n=1):
         """Batch convenience: returns one [T+new] int array per prompt
-        (ragged list, request order preserved).  ``seed`` gives every
-        request of this call its own deterministic sampling stream
-        (independent of arrival interleaving); default None keeps the
-        engine-level RNG.  ``deadline_ms`` applies per request; a
-        request past it finishes with FinishReason.deadline and
-        returns whatever tokens it emitted."""
+        (ragged list, request order preserved) — or, for ``n > 1``,
+        one LIST of n arrays per prompt (parent first, then forks
+        1..n-1).  ``seed`` gives every request of this call its own
+        deterministic sampling stream (independent of arrival
+        interleaving); default None keeps the engine-level RNG.
+        ``deadline_ms`` applies per request; a request past it finishes
+        with FinishReason.deadline and returns whatever tokens it
+        emitted.  The sampling suite (top_k/top_p/min_p, penalties,
+        logit_bias, logprobs, stop, grammar) applies to every request
+        of the call — see :mod:`.sampling` for semantics."""
         # validate shared knobs BEFORE any request is queued, so a bad
         # call leaves the engine empty instead of half-submitted
         if max_new_tokens < 1:
@@ -1532,6 +1824,10 @@ class LLMEngine:
             raise ValueError(
                 f"deadline_ms must be a positive number of "
                 f"milliseconds, got {deadline_ms!r}")
+        validate_sampling(top_k, top_p, min_p, repetition_penalty,
+                          presence_penalty, frequency_penalty,
+                          logit_bias, logprobs, stop, n,
+                          vocab_size=self.vocab_size)
         if isinstance(prompts, np.ndarray) and prompts.ndim == 2:
             prompts = list(prompts)
         elif not isinstance(prompts, (list, tuple)):
@@ -1539,13 +1835,30 @@ class LLMEngine:
         order = [self.add_request(p, max_new_tokens=max_new_tokens,
                                   eos_token_id=eos_token_id,
                                   temperature=temperature, seed=seed,
-                                  deadline_ms=deadline_ms)
+                                  deadline_ms=deadline_ms,
+                                  top_k=top_k, top_p=top_p, min_p=min_p,
+                                  repetition_penalty=repetition_penalty,
+                                  presence_penalty=presence_penalty,
+                                  frequency_penalty=frequency_penalty,
+                                  logit_bias=logit_bias,
+                                  logprobs=logprobs, stop=stop,
+                                  grammar=grammar, n=n)
                  for p in prompts]
         outs = {}
         while self.has_unfinished():
             for fo in self.step():
                 outs[fo.request_id] = fo
-        return [outs[rid].all_ids.astype(np.int64) for rid in order]
+        if n == 1:
+            return [outs[rid].all_ids.astype(np.int64) for rid in order]
+        fams = []
+        for rid in order:
+            group = [outs[rid].all_ids.astype(np.int64)]
+            for k in range(1, n):
+                cid = f"{rid}.{k}"
+                if cid in outs:        # absent only if shed pre-fork
+                    group.append(outs[cid].all_ids.astype(np.int64))
+            fams.append(group)
+        return fams
 
 
 class AsyncLLMEngine:

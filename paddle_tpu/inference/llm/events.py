@@ -28,7 +28,9 @@ __all__ = [
     "EVENT_FIELDS", "to_records", "assert_wall_clock_free",
 ]
 
-SCHEMA_VERSION = 1
+# v2 added the "fork" kind (n>1 parallel sampling splits a request
+# into its COW fork family at final-chunk commit)
+SCHEMA_VERSION = 2
 
 # detail-field names per engine event kind, in tuple order after
 # (step, kind).  Frozen: changing arity or adding kinds bumps
@@ -45,6 +47,7 @@ ENGINE_EVENT_FIELDS = {
     "export": ("request_id", "pages"),
     "import": ("request_id", "pages"),
     "release": ("request_id",),
+    "fork": ("request_id", "child_id"),
 }
 
 # fleet event kinds ("shed"/"finish" are shared with the engine and

@@ -553,11 +553,27 @@ class Fleet:
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16,
                     eos_token_id=None, temperature=0.0, request_id=None,
-                    seed=None, deadline_ms=None):
+                    seed=None, deadline_ms=None, top_k=0, top_p=1.0,
+                    min_p=0.0, repetition_penalty=1.0,
+                    presence_penalty=0.0, frequency_penalty=0.0,
+                    logit_bias=None, logprobs=0, stop=None,
+                    grammar=None, n=1):
         """Route one request to a replica (affinity first, least-loaded
         fallback).  Sheds at the fleet gate — FinishReason.shed, output
         delivered by the next step() — while draining, when no replica
-        is routable, or past ``max_queue`` total waiting depth."""
+        is routable, or past ``max_queue`` total waiting depth.
+
+        The full sampling suite rides through to the owning engine and
+        SURVIVES failover: the kwargs are kept verbatim (grammar as the
+        stateless Grammar object), so resubmission on a peer rebuilds a
+        fresh request — constraint state replays from the start along
+        with the tokens.  ``n > 1`` is engine-level (a fork family
+        can't failover atomically) and is rejected here."""
+        if n != 1:
+            raise ValueError(
+                "n>1 parallel sampling is engine-level (COW forks "
+                "can't migrate as a family); submit to an engine, or "
+                "n separate seeded fleet requests")
         prompt = tuple(int(t) for t in np.asarray(prompt_ids).reshape(-1))
         if request_id is None:
             request_id = self._next_id
@@ -576,7 +592,13 @@ class Fleet:
             return request_id
         kwargs = dict(max_new_tokens=max_new_tokens,
                       eos_token_id=eos_token_id, temperature=temperature,
-                      seed=seed, deadline_ms=deadline_ms)
+                      seed=seed, deadline_ms=deadline_ms,
+                      top_k=top_k, top_p=top_p, min_p=min_p,
+                      repetition_penalty=repetition_penalty,
+                      presence_penalty=presence_penalty,
+                      frequency_penalty=frequency_penalty,
+                      logit_bias=logit_bias, logprobs=logprobs,
+                      stop=stop, grammar=grammar)
         keys = self.router.affinity_keys(prompt)
         target, score = self.router.pick(keys, pool)
         # the replica-level id IS the fleet-level id: a validation error

@@ -54,6 +54,22 @@ class Request:
     temperature: float = 0.0
     seed: int = None            # per-request sampling stream (None: engine RNG)
     deadline: float = None      # absolute clock() deadline (None: no limit)
+    # ----- request-surface knobs (inference/llm/sampling.py) -----
+    # neutral defaults are exact identities in the device pipeline, so
+    # a request that sets none of them is bitwise the legacy request
+    top_k: int = 0              # 0 disables
+    top_p: float = 1.0          # 1.0 disables
+    min_p: float = 0.0          # 0.0 disables
+    repetition_penalty: float = 1.0
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+    logit_bias: dict = None     # {token_id: additive bias} or None
+    logprobs: int = 0           # top-N alternatives per emitted token
+    stop: tuple = ()            # stop strings (need a detokenizer)
+    grammar: object = None      # structured.Grammar (constrained decoding)
+    n: int = 1                  # parallel samples (COW fork after prefill)
+    parent_id: object = None    # fork family root (None for the parent)
+    fork_index: int = 0         # 0 for the parent, 1..n-1 for children
     arrival_time: float = field(default_factory=time.monotonic)
     output_ids: list = field(default_factory=list)
     num_cached: int = 0         # tokens whose K/V sit in the paged cache
@@ -64,12 +80,29 @@ class Request:
     # draft tokens proposed for THIS step's verify launch (speculative
     # decoding); empty means the row rides the plain decode executable
     draft_tokens: list = field(default_factory=list)
+    # per-token logprobs content [(chosen_lp, [(tid, lp), ...]), ...]
+    logprobs_content: list = field(default_factory=list)
+    matched_stop: str = None    # the stop string that finished us
     _sample_rng: object = field(default=None, repr=False, compare=False)
+    _constraint: object = field(default=None, repr=False, compare=False)
+    _stop_watcher: object = field(default=None, repr=False, compare=False)
+    _forked: bool = field(default=False, repr=False, compare=False)
 
     @property
     def all_ids(self):
         """prompt + generated so far (the recompute unit after preempt)."""
         return list(self.prompt_ids) + self.output_ids
+
+    @property
+    def uses_pipeline(self):
+        """True when this request needs non-neutral device pipeline
+        operands packed (any filter/penalty/bias/constraint active)."""
+        return (self.top_k > 0 or self.top_p < 1.0 or self.min_p > 0.0
+                or self.repetition_penalty != 1.0
+                or self.presence_penalty != 0.0
+                or self.frequency_penalty != 0.0
+                or bool(self.logit_bias)
+                or self._constraint is not None)
 
     @property
     def prefill_done(self):
@@ -115,6 +148,10 @@ class ScheduledBatch:
     # (in ``requests`` order), then chunk rows (in ``chunks`` order) —
     # the commit order the engine's RNG-stream exactness depends on
     rows: list = field(default_factory=list)
+    # copy-on-write (src_block, dst_block) pairs this step's appends
+    # triggered (a fork sibling diverging off a shared partial tail
+    # page) — the engine copies the page CONTENTS inside the launch
+    cows: list = field(default_factory=list)
 
 
 class Scheduler:
@@ -200,6 +237,12 @@ class Scheduler:
         bm = self.block_manager
         budget = self.token_budget
         decodes, chunks = [], []
+        # COW pairs keyed by request id: a fork sibling's first private
+        # append off a shared partial tail page.  Keyed (not a flat
+        # list) so a later preemption in this same pass can revoke the
+        # victim's pair — its dst page went back to the pool and could
+        # be re-allocated this very step.
+        cowmap = {}
 
         # -- decode phase: one slot per fully-prefilled running sequence,
         # plus up to K draft slots each when a drafter is attached.  One
@@ -225,11 +268,16 @@ class Scheduler:
             try:
                 if drafts:
                     try:
-                        bm.append_slots(req.request_id, 1 + len(drafts))
+                        _slots, cws = bm.append_slots(
+                            req.request_id, 1 + len(drafts))
+                        if cws:
+                            cowmap[req.request_id] = cws[0]
                     except NoFreeBlocksError:
                         drafts = []   # degrade to plain decode first
                 if not drafts:
-                    bm.append_slot(req.request_id)
+                    _slot, cw = bm.append_slot(req.request_id)
+                    if cw is not None:
+                        cowmap[req.request_id] = cw
             except NoFreeBlocksError as e:
                 victim = self.running[-1]
                 if victim is req and len(self.running) == 1 and \
@@ -242,6 +290,7 @@ class Scheduler:
                         "raise num_blocks or lower max_model_len")
                 if victim.prefill_done:
                     spare += 1  # its reserved decode token is freed
+                cowmap.pop(victim.request_id, None)
                 self._preempt(victim)
                 continue        # retry req (or fall off the end)
             req.draft_tokens = drafts
@@ -261,10 +310,16 @@ class Scheduler:
             chunks.append(PrefillChunk(req, req.num_cached, c))
             budget -= c
 
-        # -- admission: waiting requests, prefix cache consulted first
-        while (self.waiting and len(self.running) < self.max_batch
-               and budget > 0):
+        # -- admission: waiting requests, prefix cache consulted first.
+        # Un-forked n>1 parents in the running set RESERVE their n-1
+        # future fork slots here, so the fork (which bypasses
+        # admission) can never push the running set past max_batch.
+        reserved = sum(r.n - 1 for r in self.running
+                       if r.n > 1 and not r._forked)
+        while self.waiting and budget > 0:
             req = self.waiting[0]
+            if len(self.running) + reserved + req.n > self.max_batch:
+                break
             n = len(req.all_ids)
             # at least the last token must be computed (its logits seed
             # the first generated token), so cap reuse at n-1 tokens
@@ -288,6 +343,8 @@ class Scheduler:
             req.num_prefill_tokens = n
             req.status = RUNNING
             self.running.append(req)
+            if req.n > 1 and not req._forked:
+                reserved += req.n - 1
             self.prompt_tokens += n
             self.prefix_hit_tokens += req.num_cached
             c = min(budget, n - req.num_cached)
@@ -299,10 +356,14 @@ class Scheduler:
                 for r in decodes]
         rows += [RaggedRow(ch.request, "chunk", ch.start, ch.length,
                            chunk=ch) for ch in chunks]
+        cows = [cowmap[r.request_id] for r in decodes
+                if r.request_id in cowmap]
         if chunks:
-            return ScheduledBatch("mixed", decodes, chunks, rows)
+            return ScheduledBatch("mixed", decodes, chunks, rows,
+                                  cows=cows)
         if decodes:
-            return ScheduledBatch("decode", decodes, rows=rows)
+            return ScheduledBatch("decode", decodes, rows=rows,
+                                  cows=cows)
         return ScheduledBatch("idle", [])
 
     def check_invariants(self):

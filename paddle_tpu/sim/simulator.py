@@ -147,19 +147,38 @@ class SimEngine(LLMEngine):
 
     def add_request(self, prompt_ids, max_new_tokens=16,
                     eos_token_id=None, temperature=0.0, request_id=None,
-                    seed=None, deadline_ms=None):
+                    seed=None, deadline_ms=None, **kwargs):
         if temperature and float(temperature) > 0.0:
             raise ValueError(
                 f"SimEngine serves greedy traffic only (the oracle "
                 f"replaces argmax, not sampling); got "
                 f"temperature={temperature}")
+        for knob in ("logprobs", "grammar"):
+            if kwargs.get(knob):
+                raise ValueError(
+                    f"SimEngine's oracle bypasses the logits pipeline; "
+                    f"{knob}= is not simulable")
         return super().add_request(
             prompt_ids, max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id, temperature=temperature,
-            request_id=request_id, seed=seed, deadline_ms=deadline_ms)
+            request_id=request_id, seed=seed, deadline_ms=deadline_ms,
+            **kwargs)
 
     def _ragged_launch(self, rows, ids, tables, positions, tok_rows,
-                       row_start, row_qlen, row_pos0):
+                       row_start, row_qlen, row_pos0, cow_src=None,
+                       cow_dst=None, knobs=None, bias=None, counts=None):
+        # fork COW data copies land in numpy (dst == num_blocks is the
+        # dropped padding slot, same contract as the device executable)
+        if cow_dst is not None:
+            live = np.asarray(cow_dst) < self.num_blocks
+            if live.any():
+                src = np.asarray(cow_src)[live]
+                dst = np.asarray(cow_dst)[live]
+                self._kc[:, dst] = self._kc[:, src]
+                self._vc[:, dst] = self._vc[:, src]
+                if self._kv_quant:
+                    self._ks[:, dst] = self._ks[:, src]
+                    self._vs[:, dst] = self._vs[:, src]
         # the oracle's argmax: for the query at absolute position p the
         # model predicts the true token at p + 1 — identical indexing
         # to the real executable's shifted argmax

@@ -33,7 +33,7 @@ __all__ = [
     "poisson_trace", "shared_prefix_trace", "repetitive_trace",
     "mixed_trace", "fleet_trace", "diurnal_trace", "agentic_trace",
     "thousand_tenant_trace", "rag_trace", "hot_tenant_trace",
-    "TRACES", "build_trace",
+    "structured_output_trace", "TRACES", "build_trace",
 ]
 
 
@@ -232,6 +232,31 @@ def rag_trace(n_requests, rate, max_new, seed=0, doc_len=48):
     return arrivals, prompts, new_tokens
 
 
+def structured_output_trace(n_requests, rate, max_new, seed=0,
+                            prefix_len=8, max_items=4):
+    """Structured-output traffic (ROADMAP item 6's explicit leftover):
+    every request is a short instruction prompt whose completion is a
+    grammar-constrained JSON array — ``[ item (, item)* ] eos`` with
+    1..``max_items`` items.  ``new_tokens`` is sized to the exact
+    constrained emission length (2 * items + 2: bracket, items with
+    separators, closing bracket, eos), so the bench's
+    ``--trace structured`` row replays the token economics of
+    constrained decoding — short bursts, tight budgets — and the
+    per-request ``items`` draw is recoverable from ``new_tokens``.
+    The grammar itself lives with the bench/engine
+    (:func:`paddle_tpu.inference.llm.structured.json_array_grammar`);
+    a trace stays a pure arrival/prompt/length schedule."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    prompts = [rng.randint(0, 128, (prefix_len
+                                    + int(rng.randint(2, 8)),))
+               .astype(np.int32) for _ in range(n_requests)]
+    new_tokens = [2 * int(rng.randint(1, max_items + 1)) + 2
+                  for _ in range(n_requests)]
+    return arrivals, prompts, new_tokens
+
+
 def hot_tenant_trace(n_requests, rate, max_new, seed=0, tenants=4,
                      prefix_len=16, hot_frac=0.9):
     """Pathological tenant skew for router policy experiments: one hot
@@ -275,7 +300,12 @@ TRACES = {
     "thousand_tenant": thousand_tenant_trace,
     "rag": rag_trace,
     "hot_tenant": hot_tenant_trace,
+    "structured_output": structured_output_trace,
 }
+
+# ``--trace structured`` reads better on the bench command line; both
+# names build the identical trace
+TRACES["structured"] = structured_output_trace
 
 
 def build_trace(name, n_requests, rate, max_new, seed=0, **kw):

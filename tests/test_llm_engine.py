@@ -648,16 +648,23 @@ class TestPrefixCaching:
         eng = LLMEngine(m, block_size=8, max_batch=4, max_model_len=64,
                         token_budget=16)
         eng.warmup()
+        from paddle_tpu.inference.llm.sampling import neutral_row_params
+
         ids = jnp.zeros((12,), jnp.int32)      # 12 is not a bucket
         tables = jnp.zeros((eng.max_batch, eng.max_pages), jnp.int32)
         positions = jnp.full((12,), -1, jnp.int32)
         rows = jnp.zeros((12,), jnp.int32)
         zr = jnp.zeros((eng.max_batch,), jnp.int32)
+        cow_dst = jnp.full((eng.max_batch,), eng.num_blocks, jnp.int32)
+        knobs = tuple(jnp.asarray(k)
+                      for k in neutral_row_params(eng.max_batch))
+        chan = jnp.zeros((12, eng.vocab_size), jnp.float32)
         with pytest.raises(RecompileError, match="ragged") as ei:
             with compile_watcher(eng._ragged, labels=("ragged",)):
                 _, _, eng._kc, eng._vc = eng._ragged(
                     eng.params, ids, eng._kc, eng._vc, tables,
-                    positions, rows, zr, zr, zr)
+                    positions, rows, zr, zr, zr, zr, cow_dst,
+                    *knobs, chan, chan)
         # the report names the offending cache KEY, not just a count —
         # the off-grid token axis is visible in the new signature
         msg = str(ei.value)

@@ -1,0 +1,446 @@
+"""Production request surface (inference/llm/sampling, structured).
+
+The load-bearing claims: (1) every sampling/constraint/n>1 knob rides
+batched DEVICE OPERANDS of the one ragged executable — a mixed batch of
+greedy, nucleus, penalized, biased, constrained, and forked requests
+compiles NOTHING after warmup; (2) constrained decoding is token-exact
+vs a host-reference masked-greedy decode, including under speculative
+verify and prefix-cache hits; (3) an n>1 fork family is bitwise the n
+independent seeded replays, pages freed refcount-exactly; (4) stop
+strings match across detokenization boundaries; (5) every parameter is
+validated up front.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _make_model(num_layers=2, seed=0):
+    from paddle_tpu.models.gpt import gpt_tiny
+
+    paddle.seed(seed)
+    m = gpt_tiny(num_layers=num_layers)
+    m.eval()
+    return m
+
+
+def _engine(m, **kw):
+    from paddle_tpu.inference.llm import LLMEngine
+
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_model_len", 64)
+    return LLMEngine(m, **kw)
+
+
+def _masked_greedy_reference(m, prompt, grammar, max_new, eos_id,
+                             max_length=64):
+    """Host reference: dense-cache FMT forward, mask the CURRENT
+    grammar state's disallowed tokens to FILTERED, argmax, advance."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.incubate.nn import FusedMultiTransformer
+    from paddle_tpu.inference.llm import FILTERED
+
+    fmt = FusedMultiTransformer(m, max_length=max_length)
+    ids = np.asarray(prompt, np.int32)[None]
+    ck, cv = fmt.init_cache(1)
+    logits, ck, cv = fmt._prefill(fmt.params, jnp.asarray(ids), ck, cv, 0)
+    state = grammar.start_state()
+    out, t = [], ids.shape[1]
+    for step in range(max_new):
+        row = np.asarray(logits[0], np.float64)
+        row[~grammar.allowed(state)] = FILTERED
+        tok = int(row.argmax())
+        out.append(tok)
+        state = grammar.advance(state, tok)
+        if tok == eos_id:
+            break
+        logits, ck, cv = fmt._decode(
+            fmt.params, jnp.asarray([[tok]], jnp.int32), ck, cv,
+            t + step)
+    return out
+
+
+def _demo_grammar(vocab_size=128):
+    from paddle_tpu.inference.llm import json_array_grammar
+
+    return json_array_grammar(vocab_size, open_id=10, close_id=11,
+                              comma_id=12, item_ids=(20, 21, 22),
+                              eos_id=1, max_items=4)
+
+
+# ---------------------------------------------------------------------------
+class TestValidation:
+    def test_each_bad_parameter_raises(self):
+        from paddle_tpu.inference.llm import validate_sampling
+
+        def v(**kw):
+            base = dict(top_k=0, top_p=1.0, min_p=0.0,
+                        repetition_penalty=1.0, presence_penalty=0.0,
+                        frequency_penalty=0.0, logit_bias=None,
+                        logprobs=0, stop=None, n=1, vocab_size=128)
+            base.update(kw)
+            return validate_sampling(**base)
+
+        v()                                           # defaults pass
+        for bad in (dict(top_k=-1), dict(top_k=1.5), dict(top_k=True),
+                    dict(top_p=0.0), dict(top_p=1.5),
+                    dict(min_p=-0.1), dict(min_p=2.0),
+                    dict(repetition_penalty=0.0),
+                    dict(repetition_penalty=float("nan")),
+                    dict(presence_penalty="x"),
+                    dict(frequency_penalty=float("inf")),
+                    dict(logit_bias=[1, 2]),
+                    dict(logit_bias={128: 1.0}),      # off-vocab id
+                    dict(logit_bias={5: float("nan")}),
+                    dict(logprobs=-1), dict(logprobs=True),
+                    dict(logprobs=129),               # > vocab
+                    dict(stop=""), dict(stop=("ok", "")),
+                    dict(n=0), dict(n=True)):
+            with pytest.raises(ValueError):
+                v(**bad)
+        # normalization: string stop -> tuple, bias keys -> int
+        bias, stop = v(logit_bias={"7": 2}, stop="END")
+        assert bias == {7: 2.0} and stop == ("END",)
+
+    def test_engine_gates_up_front_and_stays_empty(self):
+        m = _make_model()
+        eng = _engine(m)
+        p = np.arange(4, dtype=np.int32)
+        with pytest.raises(ValueError, match="top_p"):
+            eng.add_request(p, top_p=0.0)
+        with pytest.raises(ValueError, match="detokenizer"):
+            eng.add_request(p, stop="END")    # no detokenizer wired
+        with pytest.raises(ValueError, match="seed"):
+            eng.add_request(p, n=2)           # n>1 needs explicit seed
+        with pytest.raises(ValueError, match="max_batch"):
+            eng.add_request(p, n=99, seed=0)
+        with pytest.raises(ValueError, match="grammar"):
+            eng.add_request(p, grammar=object())
+        with pytest.raises(ValueError, match="logit_bias"):
+            eng.generate([p], logit_bias={999: 1.0})
+        assert not eng.has_unfinished()       # nothing half-submitted
+
+
+# ---------------------------------------------------------------------------
+class TestLogitsPipeline:
+    """apply_logits_pipeline vs numpy reference, knob by knob."""
+
+    def _run(self, x, ri=0, rmax=4, **kw):
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.llm import (apply_logits_pipeline,
+                                              neutral_row_params)
+
+        tk, tp, mp, rp, pp, fp = (a.copy() for a in
+                                  neutral_row_params(rmax))
+        for name, vec in (("top_k", tk), ("top_p", tp), ("min_p", mp),
+                          ("rep", rp), ("pres", pp), ("freq", fp)):
+            if name in kw:
+                vec[ri] = kw[name]
+        tb, v = x.shape
+        bias = kw.get("bias", np.zeros((tb, v), np.float32))
+        counts = kw.get("counts", np.zeros((tb, v), np.float32))
+        rows = np.full(tb, ri, np.int32)
+        out = apply_logits_pipeline(
+            jnp.asarray(x), jnp.asarray(rows), jnp.asarray(tk),
+            jnp.asarray(tp), jnp.asarray(mp), jnp.asarray(rp),
+            jnp.asarray(pp), jnp.asarray(fp), jnp.asarray(bias),
+            jnp.asarray(counts))
+        return np.asarray(out)
+
+    def test_neutral_knobs_are_bitwise_identity(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(3, 16).astype(np.float32)
+        np.testing.assert_array_equal(self._run(x), x)
+
+    def test_top_k_keeps_exactly_k(self):
+        from paddle_tpu.inference.llm import FILTERED
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 16).astype(np.float32)
+        out = self._run(x, top_k=3)
+        for r in range(2):
+            kept = np.where(out[r] > FILTERED / 2)[0]
+            assert set(kept) == set(np.argsort(-x[r])[:3])
+            np.testing.assert_array_equal(out[r][kept], x[r][kept])
+
+    def test_top_p_keeps_smallest_mass_prefix(self):
+        from paddle_tpu.inference.llm import FILTERED
+
+        rng = np.random.RandomState(2)
+        x = (3.0 * rng.randn(1, 16)).astype(np.float32)
+        out = self._run(x, top_p=0.7)
+        # reference: sorted softmax, keep while mass BEFORE < 0.7
+        z = np.sort(x[0].astype(np.float64))[::-1]
+        p = np.exp(z - z.max()) / np.exp(z - z.max()).sum()
+        keep_n = int(np.searchsorted(np.cumsum(p) - p, 0.7))
+        kept = np.where(out[0] > FILTERED / 2)[0]
+        assert set(kept) == set(np.argsort(-x[0])[:keep_n])
+        assert 1 <= keep_n < 16               # the filter actually cut
+
+    def test_min_p_drops_below_scaled_max(self):
+        from paddle_tpu.inference.llm import FILTERED
+
+        rng = np.random.RandomState(3)
+        x = (3.0 * rng.randn(1, 16)).astype(np.float32)
+        out = self._run(x, min_p=0.2)
+        z = x[0].astype(np.float64)
+        p = np.exp(z - z.max()) / np.exp(z - z.max()).sum()
+        expect = np.where(p >= 0.2 * p.max())[0]
+        kept = np.where(out[0] > FILTERED / 2)[0]
+        assert set(kept) == set(expect) and 0 < len(kept) < 16
+
+    def test_penalties_and_bias_match_documented_arithmetic(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(2, 8).astype(np.float32)
+        counts = np.zeros((2, 8), np.float32)
+        counts[0, [1, 3]] = [2.0, 1.0]        # row 0 saw tokens 1, 3
+        counts[1, 5] = 4.0
+        bias = np.zeros((2, 8), np.float32)
+        bias[:, 2] = 1.5
+        out = self._run(x, rep=1.3, pres=0.5, freq=0.25,
+                        counts=counts, bias=bias)
+        seen = counts > 0
+        ref = np.where(x > 0, x / np.float32(1.3), x * np.float32(1.3))
+        ref = np.where(seen, ref, x)
+        ref = ref - np.where(seen, np.float32(0.5), np.float32(0.0))
+        ref = ref - np.float32(0.25) * counts + bias
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_other_rows_untouched_by_a_hot_row(self):
+        # two tokens mapping to DIFFERENT rows: row 1 gets aggressive
+        # knobs, row 0 stays neutral and must pass through bitwise
+        import jax.numpy as jnp
+
+        from paddle_tpu.inference.llm import (apply_logits_pipeline,
+                                              neutral_row_params)
+
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 16).astype(np.float32)
+        tk, tp, mp, rp, pp, fp = (a.copy() for a in
+                                  neutral_row_params(4))
+        tk[1], tp[1], rp[1] = 2, 0.5, 1.5
+        z = np.zeros((2, 16), np.float32)
+        out = np.asarray(apply_logits_pipeline(
+            jnp.asarray(x), jnp.asarray(np.array([0, 1], np.int32)),
+            jnp.asarray(tk), jnp.asarray(tp), jnp.asarray(mp),
+            jnp.asarray(rp), jnp.asarray(pp), jnp.asarray(fp),
+            jnp.asarray(z), jnp.asarray(z)))
+        np.testing.assert_array_equal(out[0], x[0])
+        assert (out[1] != x[1]).any()
+
+
+# ---------------------------------------------------------------------------
+class TestHostHelpers:
+    def test_stop_watcher_matches_across_token_boundary(self):
+        from paddle_tpu.inference.llm import StopStringWatcher
+
+        pieces = {20: "ab", 21: "cd", 22: "ef"}
+        detok = lambda ids: "".join(pieces[i] for i in ids)
+        w = StopStringWatcher(("bc",), detok)
+        assert w.check([20]) is None          # "ab": no match yet
+        # "bc" only exists in the JOINT rendering of tokens 20+21
+        assert w.check([20, 21]) == "bc"
+        # long tail: the window doubles until it covers the straddle
+        assert w.check([22] * 12 + [20, 21]) == "bc"
+        assert w.check([22, 22, 22]) is None
+
+    def test_top_logprobs_deterministic_and_normalized(self):
+        from paddle_tpu.inference.llm import top_logprobs
+
+        row = np.array([2.0, 1.0, 2.0, 0.0], np.float64)
+        chosen_lp, alts = top_logprobs(row, 3, chosen=2)
+        ids = [t for t, _ in alts]
+        assert ids == [0, 2, 1]               # tie 0 vs 2 -> lower id
+        assert np.isclose(
+            sum(np.exp(lp) for _, lp in top_logprobs(row, 4, 0)[1]), 1.0)
+        assert np.isclose(chosen_lp, dict(alts)[2])
+
+    def test_grammar_spec_roundtrip_and_legality(self):
+        from paddle_tpu.inference.llm import (ConstraintState,
+                                              grammar_from_spec)
+
+        g = _demo_grammar()
+        g2 = grammar_from_spec(g.to_spec())
+        assert g2.transitions == g.transitions
+        g3 = grammar_from_spec(
+            {"kind": "json_array", "open": 10, "close": 11,
+             "comma": 12, "items": [20, 21, 22], "eos": 1,
+             "max_items": 4}, vocab_size=128)
+        assert g3.transitions == g.transitions
+        with pytest.raises(ValueError, match="kind"):
+            grammar_from_spec({"transitions": {}})
+
+        cs = ConstraintState(g)
+        assert [bool(x) for x in g.allowed(0)[[10, 11, 20]]] \
+            == [True, False, False]
+        cs.advance(10)                        # '['
+        with pytest.raises(RuntimeError, match="no transition"):
+            cs.advance(11)                    # ']' illegal right after '['
+        assert cs.peek([20, 12, 21]) == [2, 3, 4]
+        assert cs.peek([11, 20])[-1] is None  # dead end stays dead
+        row = np.zeros(128, np.float32)
+        cs.bias_row(row)
+        from paddle_tpu.inference.llm import FILTERED
+        assert row[20] == 0.0 and row[10] == FILTERED
+
+
+# ---------------------------------------------------------------------------
+class TestEngineRequestSurface:
+    def test_top_k1_is_greedy_and_bias_forces_tokens(self):
+        m = _make_model()
+        eng = _engine(m)
+        rng = np.random.RandomState(0)
+        p = rng.randint(0, 128, (6,)).astype(np.int32)
+        greedy = eng.generate([p], max_new_tokens=6)[0]
+        # temperature>0 + top_k=1: only one candidate survives, so the
+        # sampled stream IS the greedy stream
+        topk1 = eng.generate([p], max_new_tokens=6, temperature=1.0,
+                             top_k=1, seed=7)[0]
+        np.testing.assert_array_equal(greedy, topk1)
+        # a huge bias on one token forces every emission to it
+        forced = eng.generate([p], max_new_tokens=4,
+                              logit_bias={42: 1e9})[0]
+        np.testing.assert_array_equal(forced[len(p):], [42] * 4)
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_logprobs_shapes_in_a_mixed_batch(self):
+        m = _make_model()
+        eng = _engine(m)
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 6, 5)]
+        rids = [eng.add_request(prompts[0], max_new_tokens=5,
+                                logprobs=3),
+                eng.add_request(prompts[1], max_new_tokens=5,
+                                temperature=0.8, top_p=0.9, seed=3,
+                                logprobs=2),
+                eng.add_request(prompts[2], max_new_tokens=5)]
+        outs = {}
+        while eng.has_unfinished():
+            for fo in eng.step():
+                outs[fo.request_id] = fo
+        for rid, n in zip(rids[:2], (3, 2)):
+            fo = outs[rid]
+            assert len(fo.logprobs) == len(fo.output_ids)
+            for tok, (chosen_lp, alts) in zip(fo.output_ids,
+                                              fo.logprobs):
+                assert chosen_lp <= 0.0 and len(alts) == n
+                lps = [lp for _, lp in alts]
+                assert lps == sorted(lps, reverse=True)
+            # greedy rows: the chosen token IS the top alternative
+            if rid == rids[0]:
+                assert all(alts[0][0] == int(t) for t, (_, alts) in
+                           zip(fo.output_ids, fo.logprobs))
+        assert outs[rids[2]].logprobs is None
+
+    def test_stop_string_straddles_detokenization_boundary(self):
+        from paddle_tpu.inference.llm import DfaTokenGrammar
+
+        pieces = {20: "ab", 21: "cd", 22: "ef", 1: ""}
+        detok = lambda ids: "".join(pieces.get(int(i), "?")
+                                    for i in ids)
+        # grammar forces the exact emission 20, 21, 22, eos...
+        g = DfaTokenGrammar(128, {0: {20: 1}, 1: {21: 2}, 2: {22: 3},
+                                  3: {1: 4}, 4: {1: 4}})
+        m = _make_model()
+        eng = _engine(m, detokenizer=detok)
+        p = np.arange(5, dtype=np.int32)
+        rid = eng.add_request(p, max_new_tokens=8, grammar=g,
+                              eos_token_id=1, stop=("bc",))
+        fo = None
+        while eng.has_unfinished():
+            for f in eng.step():
+                fo = f
+        # "bc" spans the pieces of tokens 20 and 21: the match only
+        # exists in the joint rendering, and it ends the request BEFORE
+        # token 22 or eos
+        assert fo.request_id == rid and fo.finish_reason == "stop"
+        assert fo.matched_stop == "bc"
+        np.testing.assert_array_equal(fo.output_ids, [20, 21])
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    @pytest.mark.parametrize("speculative", [None, 2])
+    def test_constrained_exact_vs_host_masked_greedy(self, speculative):
+        m = _make_model()
+        g = _demo_grammar()
+        rng = np.random.RandomState(2)
+        # >= 2 full pages of prompt, so the rerun below really adopts
+        # cached prefix pages (only complete pages are cacheable)
+        p = rng.randint(0, 128, (18,)).astype(np.int32)
+        ref = _masked_greedy_reference(m, p, g, max_new=12, eos_id=1)
+        eng = _engine(m, speculative=speculative)
+        out = eng.generate([p], max_new_tokens=12, grammar=g,
+                           eos_token_id=1)[0]
+        np.testing.assert_array_equal(out[len(p):], ref)
+        # legality: the emission replays through the grammar
+        s = g.start_state()
+        for t in ref:
+            s = g.advance(s, int(t))
+            assert s is not None
+        # a second run hits the cached prompt pages and must not drift
+        hit = eng.generate([p], max_new_tokens=12, grammar=g,
+                           eos_token_id=1)[0]
+        np.testing.assert_array_equal(hit, out)
+        assert eng.prefix_cache_stats()["prefix_hit_tokens"] > 0
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+
+    def test_fork_family_bitwise_equals_seeded_replays(self):
+        m = _make_model()
+        rng = np.random.RandomState(3)
+        p = rng.randint(0, 128, (6,)).astype(np.int32)
+        # tight pool: 3 family members x 2 pages demanded > 4 pages ->
+        # the family itself preempts and recomputes mid-flight
+        eng = _engine(m, num_blocks=4, max_batch=3, max_model_len=24)
+        fam = eng.generate([p], max_new_tokens=10, temperature=0.9,
+                           seed=50, n=3)[0]
+        assert len(fam) == 3
+        assert eng.scheduler.num_preemptions > 0
+        assert [e[1] for e in eng.events].count("fork") == 2
+        assert eng.block_manager.num_free_blocks == eng.num_blocks
+        # high temperature: the three streams really diverge
+        assert not all(np.array_equal(fam[0], f) for f in fam[1:])
+        # replays: child k == an independent request seeded seed+k
+        replay = _engine(m, max_model_len=24)
+        rids = [replay.add_request(p, max_new_tokens=10,
+                                   temperature=0.9, seed=50 + k)
+                for k in range(3)]
+        outs = {}
+        while replay.has_unfinished():
+            for fo in replay.step():
+                outs[fo.request_id] = fo.all_ids
+        for member, rid in zip(fam, rids):
+            np.testing.assert_array_equal(member, outs[rid])
+
+    def test_mixed_surface_batch_compiles_nothing_after_warmup(
+            self, compile_watcher):
+        m = _make_model()
+        eng = _engine(m)
+        eng.warmup()
+        g = _demo_grammar()
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, 128, (n,)).astype(np.int32)
+                   for n in (4, 7, 5, 6)]
+        outs = {}
+        with compile_watcher(eng._ragged, labels=("ragged",)):
+            eng.add_request(prompts[0], max_new_tokens=6)
+            eng.add_request(prompts[1], max_new_tokens=6,
+                            temperature=0.8, top_k=20, top_p=0.9,
+                            min_p=0.05, repetition_penalty=1.2,
+                            presence_penalty=0.3,
+                            frequency_penalty=0.2,
+                            logit_bias={9: -2.0}, logprobs=2, seed=9)
+            eng.add_request(prompts[2], max_new_tokens=10, grammar=g,
+                            eos_token_id=1)
+            eng.add_request(prompts[3], max_new_tokens=6,
+                            temperature=0.7, seed=11, n=2)
+            while eng.has_unfinished():
+                for fo in eng.step():
+                    outs[fo.request_id] = fo
+        assert len(outs) == 5                 # 4 parents + 1 fork child
+        assert all(fo.ok for fo in outs.values())
+        assert eng.block_manager.num_free_blocks == eng.num_blocks

@@ -23,6 +23,7 @@ from paddle_tpu.sim.workloads import (
     rag_trace,
     repetitive_trace,
     shared_prefix_trace,
+    structured_output_trace,
     thousand_tenant_trace,
 )
 
@@ -174,6 +175,7 @@ GOLDEN = {
     "thousand_tenant": (16, 3, 1.16602, 25103, 96),
     "rag": (16, 4, 2.257079, 53294, 32),
     "hot_tenant": (16, 5, 1.289918, 25456, 100),
+    "structured_output": (16, 6, 1.226067, 12428, 88),
 }
 
 
@@ -224,3 +226,9 @@ def test_scenario_traces_have_their_advertised_shape():
     for p in prompts:
         heads[p[:16].tobytes()] = heads.get(p[:16].tobytes(), 0) + 1
     assert max(heads.values()) >= 150
+    # structured_output: constrained-emission lengths are exactly
+    # 2 * items + 2 for 1..4 items, and "structured" is the CLI alias
+    t1 = structured_output_trace(40, 100.0, 8, seed=0)
+    assert all(n in (4, 6, 8, 10) for n in t1[2])
+    assert _same_trace(t1, build_trace("structured", 40, 100.0, 8,
+                                       seed=0))
