@@ -105,7 +105,7 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
                   prefix_caching=True, token_budget=64, tp=1,
                   speculative=None, faults=None, retry=None,
                   max_queue=None, quantize=None, memory_budget=None,
-                  num_blocks=None):
+                  num_blocks=None, lora=None):
     import paddle_tpu as paddle
     from paddle_tpu.inference.llm import LLMEngine
     from paddle_tpu.models.gpt import gpt_tiny
@@ -121,7 +121,7 @@ def _build_engine(max_batch, seed=0, max_model_len=64,
                      speculative=speculative, faults=faults,
                      retry=retry, max_queue=max_queue,
                      quantize=quantize, memory_budget=memory_budget,
-                     num_blocks=num_blocks)
+                     num_blocks=num_blocks, lora=lora)
 
 
 # The trace constructors moved to paddle_tpu.sim.workloads (same
@@ -404,6 +404,17 @@ def main():
                          "leaks, zero post-warmup compiles, and finite "
                          "perplexity/top-k quality deltas vs the f32 "
                          "engine")
+    ap.add_argument("--lora", type=int, default=0, metavar="N",
+                    help="GATED acceptance row for multi-LoRA serving: "
+                         "replay a Zipf tenant mix over N registered "
+                         "adapters (plus base-model traffic) as ONE "
+                         "mixed continuous batch, and again through a "
+                         "serial adapter-swap baseline that drains "
+                         "between tenant groups; rc 1 unless the mixed "
+                         "batch is >= 2x tokens/s, token-exact vs the "
+                         "serial leg, leaks zero pages, and an armed "
+                         "CompileWatcher sees zero post-warmup "
+                         "compiles across every adapter load")
     ap.add_argument("--trace", default=None, metavar="NAME",
                     help="named workload from paddle_tpu.sim.workloads "
                          "(poisson, shared_prefix, repetitive, fleet, "
@@ -475,6 +486,8 @@ def main():
         return _main_sampling_mix(args, jax)
     if args.quant is not None:
         return _main_quant(args, jax)
+    if args.lora > 0:
+        return _main_lora(args, jax)
     if args.trace is not None:
         return _main_trace(args, jax)
 
@@ -1370,6 +1383,162 @@ def _main_quant(args, jax):
             f"leaked={leaked}/{base_leaked} "
             f"new_compiles={len(new_compiles)} "
             f"quality_finite={quality_finite}")
+
+
+def _main_lora(args, jax):
+    """--lora N: the multi-LoRA serving acceptance row.
+
+    Builds the thousand_tenant_lora_trace Zipf tenant mix over N
+    registered adapters plus base-model traffic, then replays it twice
+    on identically-registered engines:
+
+    - the MIXED leg submits everything up front and lets continuous
+      batching run tenants of different adapters side by side in the
+      one ragged executable (per-row slot gather, slot 0 = base);
+    - the SERIAL adapter-swap baseline models a one-adapter-at-a-time
+      server: requests are grouped into maximal consecutive runs of
+      the same adapter (trace order) and each group is fully drained
+      before the next is admitted — the swap barrier that multi-LoRA
+      batching removes.
+
+    GATED, not just measured — rc 1 unless: the mixed leg is >= 2x
+    the serial leg's tokens/s; the two legs are TOKEN-EXACT per
+    request (batching across tenants must never change tokens); every
+    adapter was actually loaded into a pool slot; armed CompileWatchers
+    see zero post-warmup compiles on BOTH legs (adapter slot loads are
+    host-staged device_put swaps, never recompiles); and both engines
+    leak zero pages."""
+    from paddle_tpu.sim.workloads import thousand_tenant_lora_trace
+
+    n_adapters = args.lora
+    max_model_len = max(64, 32 + args.max_new)
+    _, prompts, new_tokens, adapter_ids = thousand_tenant_lora_trace(
+        args.requests, args.rate, args.max_new, seed=args.seed,
+        adapters=n_adapters + 1)
+    n_req = len(prompts)
+
+    # one weight set per adapter, shared by both legs — token-exactness
+    # across legs only means anything if the adapters are the weights
+    lora_cfg = dict(rank=4, max_adapters=n_adapters + 1)
+
+    def _make_engine():
+        # fresh RandomState per build -> both legs draw byte-identical
+        # adapter weights
+        wrng = np.random.RandomState(args.seed + 7)
+        eng = _build_engine(args.max_batch, args.seed,
+                            max_model_len=max_model_len,
+                            token_budget=args.token_budget,
+                            lora=lora_cfg)
+        for a in range(1, n_adapters + 1):
+            weights = {}
+            for key in eng.lora.targets:
+                L, d_in, d_out = eng._lora_shapes[key]
+                r = eng.lora.rank
+                weights[key] = (
+                    wrng.standard_normal((L, d_in, r)).astype(
+                        np.float32) * 0.3,
+                    wrng.standard_normal((L, r, d_out)).astype(
+                        np.float32) * 0.3)
+            eng.add_adapter(f"adapter-{a}", weights)
+        return eng
+
+    adapters_a = _make_engine()
+    adapters_b = _make_engine()
+
+    def _replay(eng, groups):
+        watcher = eng.warmup()
+        eng._bench_warmup_ms = {k: round(v, 3) for k, v in
+                                watcher.compile_ms.items()}
+        outputs, reasons = {}, {}
+        tokens = 0
+        t0 = time.perf_counter()
+        for group in groups:
+            rid_to_idx = {}
+            for i in group:
+                rid = eng.add_request(prompts[i],
+                                      max_new_tokens=new_tokens[i],
+                                      adapter_id=adapter_ids[i])
+                rid_to_idx[rid] = i
+            while eng.has_unfinished():
+                for fo in eng.step():
+                    outputs[rid_to_idx[fo.request_id]] = \
+                        fo.all_ids.tolist()
+                    reasons[rid_to_idx[fo.request_id]] = \
+                        fo.finish_reason
+                    tokens += len(fo.output_ids)
+        wall = time.perf_counter() - t0
+        leaked = eng.num_blocks - eng.block_manager.num_free_blocks
+        return {"outputs": outputs, "reasons": reasons,
+                "tokens": tokens, "wall_s": wall,
+                "tokens_per_s": tokens / wall,
+                "new_compiles": watcher.new_compiles(),
+                "leaked": leaked,
+                "warmup_ms": eng._bench_warmup_ms}
+
+    # serial baseline: maximal consecutive same-adapter runs, each
+    # drained to empty before the next — the adapter-swap barrier
+    serial_groups = []
+    for i in range(n_req):
+        if serial_groups and \
+                adapter_ids[serial_groups[-1][-1]] == adapter_ids[i]:
+            serial_groups[-1].append(i)
+        else:
+            serial_groups.append([i])
+
+    _lint_census(args, adapters_a)
+    mixed = _replay(adapters_a, [list(range(n_req))])
+    serial = _replay(adapters_b, serial_groups)
+
+    token_exact = mixed["outputs"] == serial["outputs"]
+    all_length = all(r == "length" for r in mixed["reasons"].values())
+    stats = adapters_a.lora_stats()
+    speedup = mixed["tokens_per_s"] / serial["tokens_per_s"]
+
+    row = {
+        "metric": "llm_serving_lora",
+        "value": round(mixed["tokens_per_s"], 2),
+        "unit": "tokens/s",
+        "adapters": n_adapters,
+        "serial_tokens_per_s": round(serial["tokens_per_s"], 2),
+        "vs_serial_swap": round(speedup, 3),
+        "serial_groups": len(serial_groups),
+        "token_exact": token_exact,
+        "all_length": all_length,
+        "adapter_loads": stats["loads"],
+        "adapter_evictions": stats["evictions"],
+        "adapter_hits": stats["hits"],
+        "adapters_resident": stats["resident"],
+        "new_compiles": len(mixed["new_compiles"]),
+        "serial_new_compiles": len(serial["new_compiles"]),
+        "leaked_pages": mixed["leaked"],
+        "serial_leaked_pages": serial["leaked"],
+        "requests": n_req,
+        "max_new": args.max_new,
+        "warmup_ms": mixed["warmup_ms"],
+        "compile_count": len(mixed["warmup_ms"]),
+        "backend": jax.default_backend(),
+        "config": f"gpt_tiny 2L block_size=8 rank=4 "
+                  f"max_adapters={n_adapters + 1} "
+                  f"max_model_len={max_model_len}",
+    }
+    print(json.dumps(row))
+    ok = (speedup >= 2.0
+          and token_exact
+          and all_length
+          and stats["loads"] >= n_adapters
+          and not mixed["new_compiles"]
+          and not serial["new_compiles"]
+          and mixed["leaked"] == 0 and serial["leaked"] == 0)
+    _write_artifact(args, row, ok=ok)
+    if not ok:
+        raise SystemExit(
+            "multi-LoRA replay violated its contract: "
+            f"vs_serial_swap={speedup:.3f} (need >= 2.0) "
+            f"token_exact={token_exact} all_length={all_length} "
+            f"adapter_loads={stats['loads']} (need >= {n_adapters}) "
+            f"new_compiles={len(mixed['new_compiles'])}"
+            f"/{len(serial['new_compiles'])} "
+            f"leaked={mixed['leaked']}/{serial['leaked']}")
 
 
 def _main_fleet(args, jax):

@@ -962,7 +962,12 @@ def _cli_build_engine(ns):
                      token_budget=ns.token_budget,
                      tensor_parallel=ns.tp if ns.tp > 1 else None,
                      speculative=ns.spec if ns.spec > 0 else None,
-                     quantize=getattr(ns, "quantize", None))
+                     quantize=getattr(ns, "quantize", None),
+                     # --lora N: N tenant adapters -> N+1 pool slots
+                     # (slot 0 is the reserved base identity)
+                     lora=(dict(rank=4,
+                                max_adapters=getattr(ns, "lora", 0) + 1)
+                           if getattr(ns, "lora", 0) else None))
 
 
 def _cli_engine(ns):
@@ -995,6 +1000,9 @@ def _cli_cost(ns):
                 f"{mem['weights_bytes']} + kv pool "
                 f"{mem['kv_pool_bytes']} "
                 f"({mem['num_blocks']} x {mem['page_bytes']}B pages)")
+        if mem.get("lora_pool_bytes"):
+            line += (f"; lora adapter pools "
+                     f"{mem['lora_pool_bytes']} (counted in weights)")
         if mem.get("memory_budget") is not None:
             line += (f"; budget {mem['memory_budget']} admits "
                      f"max_batch <= {mem.get('derived_max_batch', 0)}")
@@ -1067,6 +1075,12 @@ def main(argv=None):
                              help="lint the quantized serving profile "
                                   "(weight-only int8 GEMM + int8 "
                                   "paged KV pool)")
+    engine_args.add_argument("--lora", type=int, default=0,
+                             metavar="N",
+                             help="lint the multi-LoRA serving profile "
+                                  "with N adapter slots (rank 4; the "
+                                  "ragged family must stay at its "
+                                  "golden size)")
 
     eng = sub.add_parser("engine", parents=[common, engine_args],
                          help="lint the LLM engine's warmup "

@@ -612,6 +612,20 @@ def engine_memory_model(engine, memory_budget=None):
         nbytes = int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
         weights += nbytes // tp if _sharded(spec) else nbytes
 
+    # adapter residency (multi-LoRA): the lora.* pool leaves live in
+    # params["blocks"] beside the base weights, so weights_bytes above
+    # already counts them — this breaks them out so M001 (and any HBM
+    # planner) can see what the adapter slots cost on their own
+    lora = 0
+    blocks = engine.params.get("blocks", {})
+    for key in blocks:
+        if not key.startswith("lora."):
+            continue
+        leaf = blocks[key]
+        spec = engine._param_specs["blocks"][key]
+        nbytes = int(np.prod(leaf.shape)) * jnp.dtype(leaf.dtype).itemsize
+        lora += nbytes // tp if _sharded(spec) else nbytes
+
     # an int8-quantized pool stores 1 byte per element plus one f32
     # scale per (head, slot) — head_dim + 4 bytes per slot instead of
     # head_dim * itemsize, matching the engine's own page_bytes
@@ -631,6 +645,7 @@ def engine_memory_model(engine, memory_budget=None):
         "tp": tp,
         "kv_quantized": kv_quant,
         "weights_bytes": int(weights),
+        "lora_pool_bytes": int(lora),
         "page_bytes": int(page),
         "kv_pool_bytes": int(pool),
         "seq_bytes": int(seq),
@@ -861,11 +876,16 @@ def run_census(engine, *, memory_budget=None, profile="tpu-v4",
                 seq = memory["seq_bytes"]
                 admissible = ((budget - weights) // seq
                               if budget - weights >= seq else 0)
+                lora_bytes = memory.get("lora_pool_bytes", 0)
+                lora_note = (
+                    f" (of which LoRA adapter pools "
+                    f"{_fmt_bytes(lora_bytes)})" if lora_bytes else "")
                 findings.append(Finding(
                     "M001", ERROR, e["label"],
                     f"estimated per-chip peak {_fmt_bytes(est_peak)} "
                     f"exceeds the declared budget {_fmt_bytes(budget)} "
-                    f"— weights {_fmt_bytes(weights)} + KV pages "
+                    f"— weights {_fmt_bytes(weights)}{lora_note} + "
+                    f"KV pages "
                     f"{_fmt_bytes(pool)} ({memory['num_blocks']} "
                     f"blocks x {_fmt_bytes(memory['page_bytes'])}) + "
                     f"transients {_fmt_bytes(transient)}; at "

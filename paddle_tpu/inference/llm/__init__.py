@@ -36,6 +36,12 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   socket PredictorServer) streaming token deltas with
                   the full sampling/constraint parameter set on the
                   wire, backed by an engine or a Fleet
+- lora:           multi-LoRA serving — packed per-tenant adapter pools
+                  (LoRAConfig / AdapterManager) batched through the one
+                  ragged executable as a per-row slot gather + rank-r
+                  einsum beside each block GEMM; host-LRU slot
+                  load/evict with zero recompiles, slot 0 the exact
+                  base-model identity
 - events:         the frozen, versioned event-log record schema
                   (named fields per kind, wall-clock-free by
                   construction) shared by engines, fleets and the
@@ -72,6 +78,11 @@ from .block_manager import (  # noqa: F401
 )
 from .engine import AsyncLLMEngine, LLMEngine, RequestOutput  # noqa: F401
 from .http_server import HttpLLMServer  # noqa: F401
+from .lora import (  # noqa: F401
+    LORA_TARGET_LEAVES,
+    AdapterManager,
+    LoRAConfig,
+)
 from .sampling import (  # noqa: F401
     FILTERED,
     StopStringWatcher,
@@ -138,6 +149,7 @@ __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "prefix_block_hashes", "Scheduler", "Request", "PrefillChunk",
            "RaggedRow", "ScheduledBatch", "LLMEngine", "AsyncLLMEngine",
            "RequestOutput", "HttpLLMServer",
+           "LORA_TARGET_LEAVES", "AdapterManager", "LoRAConfig",
            "FILTERED", "StopStringWatcher", "apply_logits_pipeline",
            "neutral_row_params", "token_counts", "top_logprobs",
            "validate_sampling",

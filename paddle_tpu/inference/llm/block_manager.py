@@ -43,13 +43,20 @@ def hash_block_tokens(prev_hash, tokens):
     return hash((prev_hash, tuple(int(t) for t in tokens)))
 
 
-def prefix_block_hashes(token_ids, block_size, limit=None):
+def prefix_block_hashes(token_ids, block_size, limit=None, salt=None):
     """Chain hashes for every FULL page of ``token_ids`` (ragged tail
-    excluded).  ``limit`` caps the number of pages hashed."""
+    excluded).  ``limit`` caps the number of pages hashed.
+
+    ``salt`` seeds the chain: pages are only shareable between
+    sequences hashed under the SAME salt.  Multi-LoRA serving salts
+    with the request's adapter_id — a qkv-target adapter makes the K/V
+    contents adapter-dependent, so two tenants sharing a token prefix
+    must NOT share cached pages.  ``salt=None`` (the base model) keeps
+    the historical hash values exactly."""
     n_full = len(token_ids) // block_size
     if limit is not None:
         n_full = min(n_full, limit)
-    hashes, h = [], None
+    hashes, h = [], None if salt is None else ("lora", salt)
     for i in range(n_full):
         h = hash_block_tokens(h, token_ids[i * block_size:
                                            (i + 1) * block_size])
@@ -156,16 +163,18 @@ class BlockManager:
             raise RuntimeError("hash maps differ in size")
 
     # ------------------------------------------------------- prefix cache --
-    def prefix_chain_hashes(self, token_ids, limit=None):
+    def prefix_chain_hashes(self, token_ids, limit=None, salt=None):
         """Chain hashes of ``token_ids``'s full pages at THIS pool's
         page size — the public spelling of the content-hash scheme the
         cache registers pages under.  The fleet router keys prefix
         affinity on these, so router keys and cache registrations hash
         identically by construction (one authority, one page size);
         ``limit`` caps the number of pages hashed, mirroring the
-        scheduler's admission cap of ``(n - 1) // block_size``."""
+        scheduler's admission cap of ``(n - 1) // block_size``.
+        ``salt`` namespaces the chain per adapter (see
+        :func:`prefix_block_hashes`)."""
         return prefix_block_hashes(token_ids, self.block_size,
-                                   limit=limit)
+                                   limit=limit, salt=salt)
 
     def match_prefix(self, hashes):
         """Length of the longest leading run of ``hashes`` whose pages

@@ -78,6 +78,7 @@ from .faults import (
     RetryPolicy,
     StepWatchdog,
 )
+from .lora import AdapterManager, LoRAConfig, init_adapter_pools, lora_key
 from .paged_attention import (
     paged_ragged_attention,
     paged_ragged_attention_quant,
@@ -117,6 +118,20 @@ _TP_BLOCK_SPECS = {
     "mlp.fc_out.weight": P(None, "mp", None),
     "attn.qkv.weight_scale": P(None, None, "mp"),
     "mlp.fc_in.weight_scale": P(None, None, "mp"),
+    # multi-LoRA adapter pools ([L, A, in, r] / [L, A, r, out]) shard
+    # with their base GEMM's Megatron layout: a column-parallel
+    # target's B pool splits its output columns (A replicated), a
+    # row-parallel target's A pool splits its input rows (B
+    # replicated) — the per-device partial deltas ride the layer's
+    # existing psum, so tp>1 stays bit-identical to tp=1
+    "lora.attn.qkv.weight.A": P(),
+    "lora.attn.qkv.weight.B": P(None, None, None, "mp"),
+    "lora.attn.proj.weight.A": P(None, None, "mp", None),
+    "lora.attn.proj.weight.B": P(),
+    "lora.mlp.fc_in.weight.A": P(),
+    "lora.mlp.fc_in.weight.B": P(None, None, None, "mp"),
+    "lora.mlp.fc_out.weight.A": P(None, None, "mp", None),
+    "lora.mlp.fc_out.weight.B": P(),
 }
 
 
@@ -213,6 +228,13 @@ class LLMEngine:
     residency terms shrink, so under a ``memory_budget=`` the derived
     admissible max_batch grows (see inference/llm/quant.py); int8 KV
     output is approximate — quality.py measures the delta.
+    ``lora=LoRAConfig(rank, max_adapters, targets)`` (or a dict / int)
+    turns on multi-LoRA serving: the engine holds packed adapter pools
+    (slot 0 the exact base-model identity), requests carry
+    ``adapter_id=`` (register with :meth:`add_adapter` first), and the
+    jitted step applies each row's adapter as a batched rank-r einsum
+    beside the four block GEMMs — one extra int32 operand, zero extra
+    executables (see inference/llm/lora.py).
     """
 
     def __init__(self, model, *, block_size=16, num_blocks=None,
@@ -220,7 +242,7 @@ class LLMEngine:
                  enable_prefix_caching=True, token_budget=64,
                  mesh=None, tensor_parallel=None, seed=None,
                  speculative=None, memory_budget=None, quantize=None,
-                 faults=None, retry=None, max_queue=None,
+                 lora=None, faults=None, retry=None, max_queue=None,
                  step_timeout_s=None, clock=None,
                  record_step_gauges=False, detokenizer=None):
         # ----------------------------------------- lifecycle hardening ----
@@ -298,6 +320,9 @@ class LLMEngine:
         self.quant = ServingQuantConfig.resolve(quantize)
         self._w_quant = bool(self.quant and self.quant.weights)
         self._kv_quant = bool(self.quant and self.quant.kv_cache)
+        # multi-LoRA serving (None | int | dict | LoRAConfig): packed
+        # per-tenant adapter pools applied inside the ragged step
+        self.lora = LoRAConfig.resolve(lora)
         # speculative decoding (None | K | dict | SpeculativeConfig):
         # an n-gram drafter plus the bucketed verify executable family
         self.spec = SpeculativeConfig.resolve(speculative)
@@ -337,6 +362,23 @@ class LLMEngine:
             params = dict(params)
             params["blocks"] = quantize_block_weights(
                 dict(params["blocks"]))
+        self._lora_mgr = None
+        self._qkv_perm = None
+        if self.lora is not None:
+            # adapter pools join the BLOCK leaves before the budget
+            # math below, so adapter residency is priced into the
+            # admissible-batch derivation and the memory model (M001);
+            # zero pools make every slot the base identity until an
+            # adapter is loaded, and they scan with params["blocks"]
+            params = dict(params)
+            params["blocks"] = dict(params["blocks"])
+            self._lora_shapes = {
+                k: tuple(params["blocks"][k].shape)
+                for k in self.lora.targets}
+            params["blocks"].update(init_adapter_pools(
+                params["blocks"], self.lora, self.dtype))
+            self._lora_mgr = AdapterManager(self.lora,
+                                            self._lora_shapes)
 
         # ---------------------------------------------- HBM budget --------
         # pages + weights bound max_batch (ROADMAP item 3): under a
@@ -388,7 +430,11 @@ class LLMEngine:
         self.scheduler = Scheduler(self.block_manager,
                                    max_batch=self.max_batch,
                                    token_budget=self.token_budget,
-                                   drafter=self.drafter)
+                                   drafter=self.drafter,
+                                   lora_slots=(
+                                       self.lora.max_adapters - 1
+                                       if self.lora is not None
+                                       else None))
         cache_shape = (self.num_layers, self.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
         self._kv_dtype = jnp.int8 if self._kv_quant else self.dtype
@@ -425,8 +471,12 @@ class LLMEngine:
                     f"intermediate_size {inter} not divisible by "
                     f"tensor_parallel {tp}")
             # regroup fused-qkv columns head-major so the contiguous 'mp'
-            # shard of the last dim is one device's (q, k, v) head group
+            # shard of the last dim is one device's (q, k, v) head group.
+            # Kept on self: adapter loads apply the SAME permutation to
+            # a qkv-target LoRA B half (its output columns are base qkv
+            # columns; the pools start zero, so nothing to permute now)
             perm = _qkv_head_permutation(nh, hd, tp)
+            self._qkv_perm = perm
             params = dict(params)
             params["blocks"] = dict(params["blocks"])
             params["blocks"]["attn.qkv.weight"] = \
@@ -496,27 +546,67 @@ class LLMEngine:
             def wmat(p_l, key):
                 return p_l[key]
 
-        def attn_proj(p_l, x):
+        lora_targets = self.lora.targets if self.lora is not None \
+            else ()
+
+        def lora_delta(p_l, key, x_t, slots_t):
+            """Batched per-token adapter delta for one target GEMM:
+            gather each token's [in, r] / [r, out] halves by its row's
+            adapter slot, then two rank-r einsums — ``(x @ A_g) @ B_g``
+            with the alpha/rank scale pre-folded into the stored B.
+            Slot 0 is all-zero, so base rows (and dead warmup rows)
+            contribute exact float zeros.  Under TP the halves carry
+            their base GEMM's sharding (_TP_BLOCK_SPECS): column
+            targets produce the local output shard directly, row
+            targets produce a partial summed by the caller's psum."""
+            a = p_l[lora_key(key, "A")][slots_t]      # [Tb, in, r]
+            b_ = p_l[lora_key(key, "B")][slots_t]     # [Tb, r, out]
+            h = jnp.einsum("ti,tir->tr", x_t, a)
+            return jnp.einsum("tr,tro->to", h, b_)
+
+        def attn_proj(p_l, x, slots_t=None):
             """LN -> fused QKV, the FusedMultiTransformer block head.
             Under TP the local qkv columns are this shard's head group
             (see _qkv_head_permutation), so nh_l heads come out."""
             hh = _layernorm(x, p_l["ln_1.weight"], p_l["ln_1.bias"], eps)
             qkv = hh @ wmat(p_l, "attn.qkv.weight") \
                 + p_l["attn.qkv.bias"]
+            if slots_t is not None and "attn.qkv.weight" in lora_targets:
+                # column-parallel target: the (permuted) B columns
+                # shard like the base qkv columns, so the delta IS the
+                # local shard — added before the head reshape
+                qkv = qkv + lora_delta(p_l, "attn.qkv.weight",
+                                       hh[0], slots_t)[None]
             b, t = x.shape[0], x.shape[1]
             qkv = qkv.reshape(b, t, 3, nh_l, hd)
             return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        def mlp_residual(p_l, x, att_out):
+        def mlp_residual(p_l, x, att_out, slots_t=None):
             # row-parallel proj/fc_out: partial matmul + psum, bias added
-            # once AFTER the reduction (replicated)
-            x = x + psum_mp(att_out @ wmat(p_l, "attn.proj.weight")) \
-                + p_l["attn.proj.bias"]
+            # once AFTER the reduction (replicated).  A row-parallel
+            # LoRA delta is a PARTIAL too (A shards the input rows), so
+            # it joins the base partial INSIDE the psum — linearity
+            # keeps tp>1 bit-identical to tp=1
+            part = att_out @ wmat(p_l, "attn.proj.weight")
+            if slots_t is not None and \
+                    "attn.proj.weight" in lora_targets:
+                part = part + lora_delta(p_l, "attn.proj.weight",
+                                         att_out[0], slots_t)[None]
+            x = x + psum_mp(part) + p_l["attn.proj.bias"]
             h2 = _layernorm(x, p_l["ln_2.weight"], p_l["ln_2.bias"], eps)
-            ff = jax.nn.gelu(h2 @ wmat(p_l, "mlp.fc_in.weight")
-                             + p_l["mlp.fc_in.bias"], approximate=True)
-            return x + psum_mp(ff @ wmat(p_l, "mlp.fc_out.weight")) \
-                + p_l["mlp.fc_out.bias"]
+            pre = h2 @ wmat(p_l, "mlp.fc_in.weight") \
+                + p_l["mlp.fc_in.bias"]
+            if slots_t is not None and \
+                    "mlp.fc_in.weight" in lora_targets:
+                pre = pre + lora_delta(p_l, "mlp.fc_in.weight",
+                                       h2[0], slots_t)[None]
+            ff = jax.nn.gelu(pre, approximate=True)
+            part = ff @ wmat(p_l, "mlp.fc_out.weight")
+            if slots_t is not None and \
+                    "mlp.fc_out.weight" in lora_targets:
+                part = part + lora_delta(p_l, "mlp.fc_out.weight",
+                                         ff[0], slots_t)[None]
+            return x + psum_mp(part) + p_l["mlp.fc_out.bias"]
 
         def scatter_pages(cache, slots, values):
             """Write [N, nh_l, hd] rows at absolute token slots; padded
@@ -566,7 +656,7 @@ class LLMEngine:
         def ragged_fn(params, ids, kc, vc, block_tables, positions,
                       rows, row_start, row_qlen, row_pos0, cow_src,
                       cow_dst, top_k, top_p, min_p, rep_pen, pres_pen,
-                      freq_pen, bias, counts):
+                      freq_pen, bias, counts, *lora_args):
             """THE executable: one ragged token batch covers every
             serving phase.  ids [Tb] — the step's query tokens packed
             back-to-back and padded to the token bucket; positions [Tb]
@@ -598,6 +688,11 @@ class LLMEngine:
             PROCESSED ones, so greedy-under-mask and speculative
             acceptance see exactly what the sampler samples from.
             Neutral operand values are bitwise identities.
+
+            A LoRA engine appends ONE operand: ``adapter_rows`` [R],
+            each row's resident adapter slot, gathered to per-token
+            slots through the same token→row map — the multi-tenant
+            batch costs one int32 vector, not an executable.
             Returns (argmax [Tb], logits [Tb, V], kc, vc)."""
             kc = copy_cow_pages(kc, cow_src, cow_dst)
             vc = copy_cow_pages(vc, cow_src, cow_dst)
@@ -610,11 +705,12 @@ class LLMEngine:
             slot = (block_tables[rows, p_safe // bs] * bs + p_safe % bs)
             slots = jnp.where(positions >= 0, slot, nb * bs)
             ctx = p_safe + jnp.where(positions >= 0, 1, 0)
+            slots_t = lora_args[0][rows] if lora_args else None
 
             def layer(carry, xs):
                 x = carry
                 p_l, kc_l, vc_l = xs
-                q, k, v = attn_proj(p_l, x)       # [1, Tb, nh_l, hd]
+                q, k, v = attn_proj(p_l, x, slots_t)  # [1, Tb, nh_l, hd]
                 kc_l = scatter_pages(kc_l, slots, k[0])
                 vc_l = scatter_pages(vc_l, slots, v[0])
                 out = paged_ragged_attention(q[0], kc_l, vc_l,
@@ -622,7 +718,7 @@ class LLMEngine:
                                              row_start, row_qlen,
                                              row_pos0)
                 out = out.astype(x.dtype).reshape(1, tb, nh_l * hd)
-                return mlp_residual(p_l, x, out), (kc_l, vc_l)
+                return mlp_residual(p_l, x, out, slots_t), (kc_l, vc_l)
 
             x, (kc, vc) = jax.lax.scan(layer, x,
                                        (params["blocks"], kc, vc))
@@ -636,7 +732,7 @@ class LLMEngine:
                             positions, rows, row_start, row_qlen,
                             row_pos0, cow_src, cow_dst, top_k, top_p,
                             min_p, rep_pen, pres_pen, freq_pen, bias,
-                            counts):
+                            counts, *lora_args):
             """ragged_fn with the int8 KV pool: identical packing and
             causal semantics, but the per-layer scatter quantizes each
             written token row (int8 values + per-head f32 scale) and
@@ -658,11 +754,12 @@ class LLMEngine:
             slot = (block_tables[rows, p_safe // bs] * bs + p_safe % bs)
             slots = jnp.where(positions >= 0, slot, nb * bs)
             ctx = p_safe + jnp.where(positions >= 0, 1, 0)
+            slots_t = lora_args[0][rows] if lora_args else None
 
             def layer(carry, xs):
                 x = carry
                 p_l, kc_l, vc_l, ks_l, vs_l = xs
-                q, k, v = attn_proj(p_l, x)       # [1, Tb, nh_l, hd]
+                q, k, v = attn_proj(p_l, x, slots_t)  # [1, Tb, nh_l, hd]
                 kc_l, ks_l = scatter_pages_quant(kc_l, ks_l, slots,
                                                  k[0])
                 vc_l, vs_l = scatter_pages_quant(vc_l, vs_l, slots,
@@ -671,8 +768,8 @@ class LLMEngine:
                     q[0], kc_l, vc_l, ks_l, vs_l, block_tables, ctx,
                     rows, row_start, row_qlen, row_pos0)
                 out = out.astype(x.dtype).reshape(1, tb, nh_l * hd)
-                return mlp_residual(p_l, x, out), (kc_l, vc_l, ks_l,
-                                                   vs_l)
+                return mlp_residual(p_l, x, out, slots_t), (kc_l, vc_l,
+                                                            ks_l, vs_l)
 
             x, (kc, vc, ks, vs) = jax.lax.scan(
                 layer, x, (params["blocks"], kc, vc, ks, vs))
@@ -724,8 +821,11 @@ class LLMEngine:
             # tables, positions, rows, row_start, row_qlen, row_pos0,
             # cow_src, cow_dst, then the eight sampling operands (six
             # per-row knob vectors + the two [Tb, V] channels) — all
-            # replicated, like every host-packed descriptor
-            self._ragged = tp_wrap(step_fn, 16)
+            # replicated, like every host-packed descriptor.  A LoRA
+            # engine appends one more replicated operand: the per-row
+            # adapter_rows slot vector.
+            self._ragged = tp_wrap(
+                step_fn, 17 if self.lora is not None else 16)
         else:
             self._ragged = jax.jit(
                 step_fn, donate_argnums=tuple(range(2, 2 + n_pools)))
@@ -736,10 +836,23 @@ class LLMEngine:
                     deadline_ms=None, top_k=0, top_p=1.0, min_p=0.0,
                     repetition_penalty=1.0, presence_penalty=0.0,
                     frequency_penalty=0.0, logit_bias=None, logprobs=0,
-                    stop=None, grammar=None, n=1):
+                    stop=None, grammar=None, n=1, adapter_id=None):
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]  # noqa: H001 (host request boundary)
         if not prompt:
             raise ValueError("empty prompt")
+        # adapter validation FIRST among tenant-facing knobs: an
+        # unknown adapter must leave the engine completely untouched
+        # (no request id burned, no queue entry) so the HTTP layer can
+        # turn it into a clean 400
+        if adapter_id is not None:
+            if self.lora is None:
+                raise ValueError(
+                    "adapter_id= needs a LoRA-enabled engine — "
+                    "construct with lora=LoRAConfig(...)")
+            if not self._lora_mgr.known(adapter_id):
+                raise ValueError(
+                    f"unknown adapter {adapter_id!r} — register it "
+                    f"with add_adapter() first")
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -801,16 +914,24 @@ class LLMEngine:
                       frequency_penalty=float(frequency_penalty),
                       logit_bias=logit_bias, logprobs=int(logprobs),
                       stop=stop, grammar=grammar, n=int(n),
-                      arrival_time=now)
+                      adapter_id=adapter_id, arrival_time=now)
         if grammar is not None:
             req._constraint = ConstraintState(grammar)
         # bounded admission: past the configured waiting-queue depth
         # (or while draining) the request is SHED — it finishes
         # immediately with FinishReason.shed instead of growing an
-        # unbounded queue whose tail can never meet a deadline
-        if self._draining or (self.max_queue is not None
-                              and self.scheduler.queue_depth()
-                              >= self.max_queue):
+        # unbounded queue whose tail can never meet a deadline.  The
+        # per-tenant quota sheds the same way: a tenant already at its
+        # live-request cap cannot crowd out the other adapters.
+        quota = self.lora.tenant_quota if self.lora is not None else None
+        over_quota = (
+            quota is not None and adapter_id is not None
+            and sum(1 for r in self._requests.values()
+                    if r.adapter_id == adapter_id) >= quota)
+        if over_quota or self._draining \
+                or (self.max_queue is not None
+                    and self.scheduler.queue_depth()
+                    >= self.max_queue):
             self.stats["shed"] += 1
             self.events.append((self._step_index, "shed", request_id))
             req.status = FINISHED
@@ -963,6 +1084,9 @@ class LLMEngine:
                     sds((rmax,), f32), sds((rmax,), f32),
                     # bias + counts channels bucket with the token axis
                     sds((tb, v), f32), sds((tb, v), f32))
+            if self.lora is not None:
+                # the single extra LoRA operand: per-row adapter slots
+                args = args + (sds((rmax,), i32),)
             yield kind, tb, self._ragged, args
 
     def _alloc_pools(self, cache_shape, scale_shape):
@@ -1039,10 +1163,13 @@ class LLMEngine:
                 knobs = tuple(jnp.asarray(k)
                               for k in neutral_row_params(rmax))
                 chan = jnp.zeros((tb, self.vocab_size), jnp.float32)
+                # slot 0 (the all-zero base identity) for every dead
+                # warmup row — the LoRA operand's bitwise-neutral value
+                lora_ops = (zr,) if self.lora is not None else ()
                 out = self._ragged(
                     self.params, ids, *self._pools(), tables,
                     positions, rows, zr, zr, zr, zr, cow_dst,
-                    *knobs, chan, chan)
+                    *knobs, chan, chan, *lora_ops)
                 self._set_pools(out[2:])
                 jax.block_until_ready(self._kc)
                 timings[f"{kind}[{tb}]"] = \
@@ -1200,7 +1327,8 @@ class LLMEngine:
         if not bm.enable_prefix_caching:
             return
         hashes = bm.prefix_chain_hashes(
-            req.all_ids, limit=req.num_cached // self.block_size)
+            req.all_ids, limit=req.num_cached // self.block_size,
+            salt=req.adapter_id)
         for i, h in enumerate(hashes):
             bm.register_full_block(req.request_id, i, h)
 
@@ -1215,6 +1343,62 @@ class LLMEngine:
                 "reused_blocks": bm.prefix_reused_blocks,
                 "evictions": bm.prefix_evictions,
                 "cached_blocks": bm.num_cached_blocks}
+
+    # ----------------------------------------------------------- multi-LoRA --
+    def add_adapter(self, adapter_id, weights):
+        """Register one tenant adapter: ``weights`` maps each
+        configured target leaf to ``(A [L, in, r], B [L, r, out])``.
+        Host-only — the device pool slot is written lazily the first
+        time a step actually batches the adapter, so registering ten
+        thousand tenants costs host RAM, not HBM or compiles."""
+        if self.lora is None:
+            raise ValueError(
+                "add_adapter() needs a LoRA-enabled engine — "
+                "construct with lora=LoRAConfig(...)")
+        self._lora_mgr.register(adapter_id, weights)
+        self.events.append((self._step_index, "adapter_register",
+                            adapter_id))
+
+    def lora_stats(self):
+        """Host-side adapter residency counters (benches and tests):
+        loads/evictions/hits plus registered/resident/slot gauges."""
+        if self.lora is None:
+            raise ValueError("lora_stats() needs a LoRA-enabled engine")
+        return self._lora_mgr.lora_stats()
+
+    def _lora_slot(self, req, pinned):
+        """Resident pool slot for one row's adapter, loading it into a
+        (possibly LRU-evicted) slot first when absent."""
+        slot, weights = self._lora_mgr.acquire(req.adapter_id,
+                                               pinned=pinned)
+        if weights is not None:
+            self._load_adapter_slot(slot, weights)
+            self.events.append((self._step_index, "adapter_load",
+                                req.adapter_id, slot))
+        return slot
+
+    def _load_adapter_slot(self, slot, weights):
+        """Write one adapter into pool slot ``slot`` — the host-staged
+        migration idiom (``device_get`` → numpy row write →
+        ``device_put``): no jit anywhere on the path, so an armed
+        CompileWatcher sees adapter churn as zero compiles.  Under TP
+        the rebuilt leaves go back with their pool shardings, and the
+        qkv B half is permuted to the head-blocked column layout the
+        base qkv weight was loaded in."""
+        blocks = dict(self.params["blocks"])
+        for key, (a_h, b_h) in weights.items():
+            if key == "attn.qkv.weight" and self._qkv_perm is not None:
+                b_h = b_h[:, :, self._qkv_perm]
+            for side, val in (("A", a_h), ("B", b_h)):
+                lk = lora_key(key, side)
+                host = np.array(jax.device_get(blocks[lk]))  # noqa: H001 (host-staged slot swap by design)
+                host[:, slot] = val.astype(host.dtype)
+                if self.tp > 1:
+                    blocks[lk] = jax.device_put(
+                        host, self._param_shardings["blocks"][lk])
+                else:
+                    blocks[lk] = jax.device_put(host)
+        self.params = {**self.params, "blocks": blocks}
 
     # ------------------------------------------------------------ migration --
     def _gather_pages(self, block_ids):
@@ -1317,6 +1501,16 @@ class LLMEngine:
             raise MigrationError(
                 f"destination running set is full "
                 f"({self.max_batch} sequences)", reason="capacity")
+        aid = getattr(req, "adapter_id", None)
+        if aid is not None and (
+                self.lora is None or not self._lora_mgr.known(aid)):
+            # up-front, before any allocation: a destination that
+            # cannot serve the tenant's adapter must refuse the
+            # migration token-exactly intact on the source
+            raise MigrationError(
+                f"destination cannot serve adapter {aid!r} — "
+                f"{'no lora= configured' if self.lora is None else 'adapter not registered'}",
+                reason="adapter")
         expect = (self.num_layers, len(seq["block_ids"]),
                   self.block_size, self.num_heads, self.head_dim)
         if tuple(k_pages.shape) != expect or \
@@ -1426,6 +1620,20 @@ class LLMEngine:
             row_pos0[ri] = row.start
             s += row.length
 
+        # LoRA residency: map each row's adapter_id to its device pool
+        # slot (loading/evicting host-side as needed — compile-free),
+        # then ship the per-row slot vector as the ONE extra operand.
+        # Adapters this batch is about to index are pinned so the LRU
+        # never evicts under a launch's feet; the scheduler's
+        # distinct-adapter admission gate guarantees they fit.
+        adapter_rows = None
+        if self.lora is not None:
+            adapter_rows = np.zeros(rmax, np.int32)
+            pinned = {row.request.adapter_id for row in rows
+                      if row.request.adapter_id is not None}
+            for ri, row in enumerate(rows):
+                adapter_rows[ri] = self._lora_slot(row.request, pinned)
+
         # COW page copies + sampling operands — neutral identities
         # unless this batch carries fork COWs or pipeline rows, so
         # legacy traffic runs the same executable on the same values it
@@ -1498,7 +1706,8 @@ class LLMEngine:
                            lambda: self._ragged_launch(
                                rows, ids, tables, positions, tok_rows,
                                row_start, row_qlen, row_pos0,
-                               cow_src, cow_dst, knobs, bias, counts))
+                               cow_src, cow_dst, knobs, bias, counts,
+                               adapter_rows))
         if out is None:
             return              # quarantined; reservations rolled back
         nxt, logits = out[0], out[1]
@@ -1552,7 +1761,7 @@ class LLMEngine:
 
     def _ragged_launch(self, rows, ids, tables, positions, tok_rows,
                        row_start, row_qlen, row_pos0, cow_src, cow_dst,
-                       knobs, bias, counts):
+                       knobs, bias, counts, adapter_rows=None):
         """Execute ONE packed ragged launch — the device-step seam.
         Numpy operands in, the executable's output tuple out.  ``rows``
         is the host-side schedule the operands were packed from: the
@@ -1561,8 +1770,13 @@ class LLMEngine:
         synthesize the argmax vector from its token oracle instead of
         running the device.  ``knobs`` is the six-tuple of per-row
         sampling vectors; ``bias``/``counts`` the [tb, V] channels
-        (possibly the cached neutral device array)."""
+        (possibly the cached neutral device array); ``adapter_rows``
+        the per-row LoRA slot vector (None on a LoRA-free engine — the
+        operand, and hence the executable signature, only exists when
+        lora= is configured)."""
         del rows  # the real launch needs only the packed operands
+        lora_ops = (() if adapter_rows is None
+                    else (jnp.asarray(adapter_rows),))
         with profiler.RecordEvent("llm_engine::ragged"):
             return self._ragged(
                 self.params, jnp.asarray(ids), *self._pools(),
@@ -1571,7 +1785,7 @@ class LLMEngine:
                 jnp.asarray(row_qlen), jnp.asarray(row_pos0),
                 jnp.asarray(cow_src), jnp.asarray(cow_dst),
                 *(jnp.asarray(k) for k in knobs),
-                jnp.asarray(bias), jnp.asarray(counts))
+                jnp.asarray(bias), jnp.asarray(counts), *lora_ops)
 
     def _fetch_sampling_rows(self, rows, starts, logits):
         """Fetch ONLY the logits of tokens that sample: greedy batches
@@ -1655,6 +1869,7 @@ class LLMEngine:
                 logit_bias=req.logit_bias, logprobs=req.logprobs,
                 stop=req.stop, grammar=req.grammar,
                 n=1, parent_id=req.request_id, fork_index=k,
+                adapter_id=req.adapter_id,
                 arrival_time=req.arrival_time,
                 num_cached=req.num_cached,
                 num_prefill_tokens=req.num_prefill_tokens,
@@ -1796,7 +2011,7 @@ class LLMEngine:
                  top_k=0, top_p=1.0, min_p=0.0, repetition_penalty=1.0,
                  presence_penalty=0.0, frequency_penalty=0.0,
                  logit_bias=None, logprobs=0, stop=None, grammar=None,
-                 n=1):
+                 n=1, adapter_id=None):
         """Batch convenience: returns one [T+new] int array per prompt
         (ragged list, request order preserved) — or, for ``n > 1``,
         one LIST of n arrays per prompt (parent first, then forks
@@ -1842,7 +2057,8 @@ class LLMEngine:
                                   frequency_penalty=frequency_penalty,
                                   logit_bias=logit_bias,
                                   logprobs=logprobs, stop=stop,
-                                  grammar=grammar, n=n)
+                                  grammar=grammar, n=n,
+                                  adapter_id=adapter_id)
                  for p in prompts]
         outs = {}
         while self.has_unfinished():

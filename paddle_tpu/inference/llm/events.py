@@ -29,8 +29,10 @@ __all__ = [
 ]
 
 # v2 added the "fork" kind (n>1 parallel sampling splits a request
-# into its COW fork family at final-chunk commit)
-SCHEMA_VERSION = 2
+# into its COW fork family at final-chunk commit); v3 added the
+# multi-LoRA kinds "adapter_register" (host registry) and
+# "adapter_load" (device pool slot swap)
+SCHEMA_VERSION = 3
 
 # detail-field names per engine event kind, in tuple order after
 # (step, kind).  Frozen: changing arity or adding kinds bumps
@@ -48,6 +50,12 @@ ENGINE_EVENT_FIELDS = {
     "import": ("request_id", "pages"),
     "release": ("request_id",),
     "fork": ("request_id", "child_id"),
+    # multi-LoRA: registration is host-only; a load names the device
+    # pool slot the adapter was swapped into (LRU evictions show up as
+    # a later load re-claiming the slot — no separate evict event, the
+    # slot column tells the story wall-clock-free)
+    "adapter_register": ("adapter_id",),
+    "adapter_load": ("adapter_id", "slot"),
 }
 
 # fleet event kinds ("shed"/"finish" are shared with the engine and

@@ -476,6 +476,7 @@ class Fleet:
                 r.role = "prefill" if r.index < n_prefill else "decode"
         self.router = Router(self.replicas, load_cap=router_load_cap)
         self._live = {}          # fleet rid -> _FleetRequest
+        self._adapters = {}      # adapter_id -> weights (LoRA re-reg)
         self._early = []         # outputs finished without a step
         self._next_id = 0
         self._step_index = -1
@@ -557,7 +558,7 @@ class Fleet:
                     min_p=0.0, repetition_penalty=1.0,
                     presence_penalty=0.0, frequency_penalty=0.0,
                     logit_bias=None, logprobs=0, stop=None,
-                    grammar=None, n=1):
+                    grammar=None, n=1, adapter_id=None):
         """Route one request to a replica (affinity first, least-loaded
         fallback).  Sheds at the fleet gate — FinishReason.shed, output
         delivered by the next step() — while draining, when no replica
@@ -598,7 +599,8 @@ class Fleet:
                       presence_penalty=presence_penalty,
                       frequency_penalty=frequency_penalty,
                       logit_bias=logit_bias, logprobs=logprobs,
-                      stop=stop, grammar=grammar)
+                      stop=stop, grammar=grammar,
+                      adapter_id=adapter_id)
         keys = self.router.affinity_keys(prompt)
         target, score = self.router.pick(keys, pool)
         # the replica-level id IS the fleet-level id: a validation error
@@ -610,6 +612,20 @@ class Fleet:
         self.events.append((self._step_index, "route", request_id,
                             target.index, score))
         return request_id
+
+    def add_adapter(self, adapter_id, weights):
+        """Register one tenant adapter on EVERY replica (LoRA fleets
+        only — the engines raise without ``lora=``).  The fleet keeps
+        the host weight copies so a replica rebuilt after a kill is
+        re-registered before it rejoins the pool: failover resubmission
+        of an ``adapter_id`` request always lands on an engine that
+        knows the tenant."""
+        if adapter_id in self._adapters:
+            raise ValueError(
+                f"adapter {adapter_id!r} is already registered")
+        for r in self.replicas:
+            r.engine.add_adapter(adapter_id, weights)
+        self._adapters[adapter_id] = weights
 
     def abort_request(self, request_id):
         """Cancel a live request wherever it currently runs; the
@@ -1059,6 +1075,11 @@ class Fleet:
         if r.state == DEAD:
             r.engine = self._build_engine(index)
             r.engine.warmup()    # replays the warm cache — no compiles
+            # a rebuilt replica must serve every tenant the fleet
+            # knows: re-register the host adapter copies (device slots
+            # refill lazily on first use — still zero compiles)
+            for aid, weights in self._adapters.items():
+                r.engine.add_adapter(aid, weights)
             self.router.forget(r)
         r.state = HEALTHY
         r.miss_streak = r.ok_streak = 0

@@ -21,7 +21,9 @@ Request fields (unknown fields are a 400, so client typos fail loudly):
 ``presence_penalty``, ``frequency_penalty``, ``logit_bias``
 ({token_id: bias}), ``logprobs`` (top-N per token), ``stop`` (string or
 list), ``grammar`` (a :func:`~.structured.grammar_from_spec` spec
-dict), ``n`` (engine backends only), ``stream`` (bool).
+dict), ``n`` (engine backends only), ``adapter`` (a registered LoRA
+adapter id — unknown adapters are a 400 BEFORE admission, so the
+engine is left empty), ``stream`` (bool).
 
 Non-streaming responses carry ``completions`` — a list of ``n``
 ``{"index", "request_id", "output_ids", "finish_reason",
@@ -51,7 +53,8 @@ _FIELDS = frozenset((
     "prompt_ids", "max_new_tokens", "eos_token_id", "temperature",
     "seed", "deadline_ms", "top_k", "top_p", "min_p",
     "repetition_penalty", "presence_penalty", "frequency_penalty",
-    "logit_bias", "logprobs", "stop", "grammar", "n", "stream",
+    "logit_bias", "logprobs", "stop", "grammar", "n", "adapter",
+    "stream",
 ))
 
 
@@ -118,6 +121,13 @@ class _Handler(BaseHTTPRequestHandler):
             if spec is not None:
                 body["grammar"] = grammar_from_spec(
                     spec, vocab_size=app.vocab_size)
+            # the wire name is "adapter"; the engine kwarg adapter_id.
+            # An unknown adapter raises inside add_request BEFORE any
+            # state lands, so the except below turns it into a 400
+            # with the engine left empty
+            adapter = body.pop("adapter", None)
+            if adapter is not None:
+                body["adapter_id"] = adapter
             prompt_ids = body.pop("prompt_ids")
             rid = app.submit(prompt_ids, **body)
         except (ValueError, TypeError, json.JSONDecodeError) as e:

@@ -70,6 +70,7 @@ class Request:
     n: int = 1                  # parallel samples (COW fork after prefill)
     parent_id: object = None    # fork family root (None for the parent)
     fork_index: int = 0         # 0 for the parent, 1..n-1 for children
+    adapter_id: object = None   # LoRA adapter (None: the base model)
     arrival_time: float = field(default_factory=time.monotonic)
     output_ids: list = field(default_factory=list)
     num_cached: int = 0         # tokens whose K/V sit in the paged cache
@@ -158,9 +159,15 @@ class Scheduler:
     """Admission queue + running set + preempt-on-OOM policy."""
 
     def __init__(self, block_manager, max_batch=8, watermark_blocks=1,
-                 token_budget=64, drafter=None):
+                 token_budget=64, drafter=None, lora_slots=None):
         self.block_manager = block_manager
         self.max_batch = int(max_batch)
+        # multi-LoRA: at most this many DISTINCT non-base adapters may
+        # be live in the running set at once (the engine passes
+        # max_adapters - 1 — pool slots minus the reserved base slot),
+        # so every launch's slot acquisition is guaranteed to succeed
+        # without evicting an adapter the same launch indexes
+        self.lora_slots = None if lora_slots is None else int(lora_slots)
         self.watermark_blocks = int(watermark_blocks)
         # the budget must cover one decode token per running sequence,
         # or a full batch would starve every waiting prefill forever
@@ -316,15 +323,27 @@ class Scheduler:
         # admission) can never push the running set past max_batch.
         reserved = sum(r.n - 1 for r in self.running
                        if r.n > 1 and not r._forked)
+        # multi-LoRA admission gate: the DISTINCT adapters of the
+        # running set must fit the device pool's non-base slots, so a
+        # head-of-line request bringing a NEW adapter waits (FIFO, like
+        # the capacity breaks below) until a tenant drains
+        live_adapters = {r.adapter_id for r in self.running
+                         if r.adapter_id is not None}
         while self.waiting and budget > 0:
             req = self.waiting[0]
             if len(self.running) + reserved + req.n > self.max_batch:
+                break
+            if (self.lora_slots is not None
+                    and req.adapter_id is not None
+                    and req.adapter_id not in live_adapters
+                    and len(live_adapters) >= self.lora_slots):
                 break
             n = len(req.all_ids)
             # at least the last token must be computed (its logits seed
             # the first generated token), so cap reuse at n-1 tokens
             hashes = bm.prefix_chain_hashes(
-                req.all_ids, limit=(n - 1) // bm.block_size)
+                req.all_ids, limit=(n - 1) // bm.block_size,
+                salt=req.adapter_id)
             k = bm.match_prefix(hashes)
             margin = self.watermark_blocks if self.running else 0
             if not bm.can_allocate(n, margin=margin,
@@ -343,6 +362,8 @@ class Scheduler:
             req.num_prefill_tokens = n
             req.status = RUNNING
             self.running.append(req)
+            if req.adapter_id is not None:
+                live_adapters.add(req.adapter_id)
             if req.n > 1 and not req._forked:
                 reserved += req.n - 1
             self.prompt_tokens += n

@@ -166,7 +166,8 @@ class SimEngine(LLMEngine):
 
     def _ragged_launch(self, rows, ids, tables, positions, tok_rows,
                        row_start, row_qlen, row_pos0, cow_src=None,
-                       cow_dst=None, knobs=None, bias=None, counts=None):
+                       cow_dst=None, knobs=None, bias=None, counts=None,
+                       adapter_rows=None):
         # fork COW data copies land in numpy (dst == num_blocks is the
         # dropped padding slot, same contract as the device executable)
         if cow_dst is not None:

@@ -32,8 +32,9 @@ import numpy as np
 __all__ = [
     "poisson_trace", "shared_prefix_trace", "repetitive_trace",
     "mixed_trace", "fleet_trace", "diurnal_trace", "agentic_trace",
-    "thousand_tenant_trace", "rag_trace", "hot_tenant_trace",
-    "structured_output_trace", "TRACES", "build_trace",
+    "thousand_tenant_trace", "thousand_tenant_lora_trace", "rag_trace",
+    "hot_tenant_trace", "structured_output_trace", "TRACES",
+    "build_trace",
 ]
 
 
@@ -212,6 +213,49 @@ def thousand_tenant_trace(n_requests, rate, max_new, seed=0,
     new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
                   for _ in range(n_requests)]
     return arrivals, prompts, new_tokens
+
+
+def thousand_tenant_lora_trace(n_requests, rate, max_new, seed=0,
+                               tenants=1000, prefix_len=16, alpha=1.1,
+                               adapters=4):
+    """:func:`thousand_tenant_trace` plus per-request LoRA
+    ``adapter_id``s — the multi-LoRA fleet replay schema
+    ``(arrivals, prompts, new_tokens, adapter_ids)``.
+
+    The first three elements are BYTE-IDENTICAL to
+    ``thousand_tenant_trace(...)`` with the same arguments: the rng
+    draw order is unchanged and the adapter assignment consumes no
+    extra draws (``adapter_ids[i] = "adapter-<tid % adapters>"``,
+    derived from the same Zipf tenant draw that picked the prefix), so
+    a LoRA replay serves exactly the tenant/arrival mix the plain
+    trace's goldens pin.  Adapter 0's tenants map to ``None`` — the
+    base model — so every replay mixes base and adapter rows in one
+    batch.  NOT in :data:`TRACES` (different schema; the bench's
+    ``--lora`` mode builds it directly)."""
+    rng = np.random.RandomState(seed)
+    gaps = rng.exponential(1.0 / rate, size=n_requests)
+    arrivals = np.cumsum(gaps)
+    prefixes = {}
+
+    def tenant_prefix(tid):
+        if tid not in prefixes:
+            trng = np.random.RandomState((seed * 7919 + tid) & 0x7FFFFFFF)
+            prefixes[tid] = trng.randint(0, 128, (prefix_len,)) \
+                .astype(np.int32)
+        return prefixes[tid]
+
+    prompts, adapter_ids = [], []
+    for _ in range(n_requests):
+        tid = int(rng.zipf(alpha)) % tenants
+        prompts.append(np.concatenate(
+            [tenant_prefix(tid),
+             rng.randint(0, 128, (int(rng.randint(4, 13)),))
+             .astype(np.int32)]))
+        aidx = tid % adapters
+        adapter_ids.append(None if aidx == 0 else f"adapter-{aidx}")
+    new_tokens = [int(rng.randint(max(2, max_new // 2), max_new + 1))
+                  for _ in range(n_requests)]
+    return arrivals, prompts, new_tokens, adapter_ids
 
 
 def rag_trace(n_requests, rate, max_new, seed=0, doc_len=48):

@@ -66,8 +66,10 @@ def _busy_scenario(eng):
 # schema shape
 # ----------------------------------------------------------------------
 def test_schema_is_versioned_and_named():
-    assert SCHEMA_VERSION == 2       # v2 added the "fork" kind
+    assert SCHEMA_VERSION == 3       # v3 added the adapter kinds
     assert "fork" in ENGINE_EVENT_FIELDS
+    assert "adapter_register" in ENGINE_EVENT_FIELDS
+    assert "adapter_load" in ENGINE_EVENT_FIELDS
     assert set(EVENT_FIELDS) == \
         set(ENGINE_EVENT_FIELDS) | set(FLEET_EVENT_FIELDS)
     # the two shared kinds carry identical fields at both levels
@@ -89,17 +91,21 @@ def test_records_carry_named_fields():
     recs = to_records([(3, "add", 7),
                        (4, "finish", 7, "stop"),
                        (5, "migrate", 7, 0, 1, 4),
-                       (6, "fork", 7, "7.1")])
-    assert recs[0] == {"schema_version": 2, "step": 3, "kind": "add",
+                       (6, "fork", 7, "7.1"),
+                       (7, "adapter_load", "tenant-a", 3)])
+    assert recs[0] == {"schema_version": 3, "step": 3, "kind": "add",
                        "request_id": 7}
     assert recs[1]["reason"] == "stop"
-    assert recs[2] == {"schema_version": 2, "step": 5,
+    assert recs[2] == {"schema_version": 3, "step": 5,
                        "kind": "migrate", "request_id": 7, "src": 0,
                        "dst": 1, "pages": 4}
     # fork child ids are strings ("<parent>.<k>") — legal per the
     # int/str/None wall-clock-free rule
-    assert recs[3] == {"schema_version": 2, "step": 6, "kind": "fork",
+    assert recs[3] == {"schema_version": 3, "step": 6, "kind": "fork",
                        "request_id": 7, "child_id": "7.1"}
+    assert recs[4] == {"schema_version": 3, "step": 7,
+                       "kind": "adapter_load", "adapter_id": "tenant-a",
+                       "slot": 3}
     assert_wall_clock_free(recs)
 
 
