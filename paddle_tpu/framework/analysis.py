@@ -533,6 +533,10 @@ _METADATA_ATTRS = {"shape", "ndim", "size", "dtype", "name", "aval",
                    "sharding"}
 _SYNC_METHODS = {"item": "item-call", "tolist": "item-call"}
 _CAST_FUNCS = {"float": "py-cast", "int": "py-cast", "bool": "py-cast"}
+# flagged UNCONDITIONALLY (no taint needed): these functions block the
+# host on device work by definition, and the async-lookahead engine's
+# pipelined step path must not hide one without an annotation
+_EXPLICIT_SYNCS = ("device_get", "block_until_ready")
 _NOQA = "noqa: H001"
 _NOQA_MODULE = "noqa-module: H001"
 
@@ -635,6 +639,20 @@ class _HostSyncLinter(ast.NodeVisitor):
                 and node.args and self._is_tainted(node.args[0]):
             self._record(node, _CAST_FUNCS[func.id],
                          f"{func.id}() on a tensor value")
+        elif isinstance(func, ast.Attribute) and \
+                func.attr in _EXPLICIT_SYNCS and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id == "jax":
+            # unconditional: jax.device_get / jax.block_until_ready
+            # are host syncs BY DEFINITION, no taint analysis needed —
+            # the name-taint pass cannot see them anyway (``self.…``
+            # attributes carry the engine's device state, and ``self``
+            # is excluded from the tensor-param taint).  One untagged
+            # call inside the pipelined step path stalls the lookahead
+            # window the engine works to keep full.
+            self._record(node, "explicit-sync",
+                         f"jax.{func.attr}() blocks the host on "
+                         f"device work")
 
     def _record(self, node, category, detail):
         line = self.lines[node.lineno - 1] \

@@ -715,6 +715,57 @@ def migration_estimate(engine, num_tokens, num_pages, profile="tpu-v4",
                        else "recompute")}
 
 
+def speculative_draft_estimate(engine, profile="tpu-v4"):
+    """Static per-step cost of the model-based draft phase.
+
+    The draft model rides the SAME ragged executable family as the
+    target (its padding layers are zeroed, not removed — a zero block
+    still multiplies at full price on device), so one draft launch
+    costs exactly one target launch of its bucket.  A K-deep greedy
+    chain costs one catch-up launch plus K-1 single-token decode
+    launches per step, all at the smallest decode bucket in the common
+    case.  The estimate prices that against the dense 2-flops-per-
+    param-per-token decode bound: worthwhile speculation needs the
+    acceptance rate to beat ``flops_overhead_ratio / (1 + K)`` — the
+    break-even line PERF.md rows quote.
+
+    Returns {draft_launches_per_step, draft_flops_per_step,
+    target_flops_per_token, flops_overhead_ratio, break_even_acceptance}
+    or None when the engine has no model-based drafter."""
+    spec = getattr(engine, "spec", None)
+    if spec is None or not getattr(spec, "uses_draft_model", False):
+        return None
+    k = int(spec.num_tokens)
+    n_params = sum(int(np.prod(leaf.shape)) if leaf.shape else 1
+                   for leaf in jtu.tree_leaves(engine.params))
+    per_tok = 2.0 * n_params
+    launches = k                      # 1 catch-up + (K-1) chain steps
+    draft_flops = per_tok * launches  # ~1 token per launch steady-state
+    ratio = draft_flops / per_tok / (1 + k)
+    return {"draft_launches_per_step": launches,
+            "draft_flops_per_step": int(draft_flops),
+            "target_flops_per_token": int(per_tok),
+            "flops_overhead_ratio": draft_flops / per_tok,
+            "break_even_acceptance": ratio}
+
+
+def measured_host_overhead_s(engine):
+    """Event-log-calibrated per-launch host overhead for
+    :class:`StepTimeModel`: the engine's accumulated critical-path
+    planning time (schedule + pack + staged-claim validation — the
+    ``host_plan_s`` lifecycle gauge) divided by its launch count.
+    Feed the result back as ``StepTimeModel(host_overhead_s=...)`` so
+    the simulator's clock carries the measured scheduling cost of THIS
+    workload — with ``lookahead=True``, staged-claimed steps
+    contribute only their validation slice, so the calibrated value
+    (and hence the sim) automatically credits the pipeline."""
+    stats = engine.lifecycle_stats()
+    n = getattr(engine, "_launch_count", 0)
+    if not n:
+        return 0.0
+    return float(stats.get("host_plan_s") or 0.0) / n
+
+
 # --------------------------------------------------------------------------
 # per-launch step-time model (the discrete-event simulator's clock)
 # --------------------------------------------------------------------------

@@ -140,6 +140,7 @@ from .scheduler import (  # noqa: F401
     Scheduler,
 )
 from .spec import (  # noqa: F401
+    DraftModelDrafter,
     NgramDrafter,
     SpeculativeConfig,
     rollback_draft_reservation,
@@ -155,7 +156,8 @@ __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "validate_sampling",
            "ConstraintState", "DfaTokenGrammar", "Grammar",
            "grammar_from_spec", "json_array_grammar",
-           "NgramDrafter", "SpeculativeConfig", "rollback_draft_reservation",
+           "DraftModelDrafter", "NgramDrafter", "SpeculativeConfig",
+           "rollback_draft_reservation",
            "Fleet", "HealthConfig", "MigrationPolicy", "Replica", "Router",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",
            "MigrationError", "PoolLostError", "RetryPolicy", "StepWatchdog",

@@ -253,6 +253,17 @@ class BlockManager:
         self._tokens[seq_id] = int(num_tokens)
         return list(table)
 
+    def would_cow(self, seq_id):
+        """Would ``append_slot`` copy-on-write the shared partial tail
+        page?  The lookahead stager refuses such sequences: a COW
+        append rewires the table and drops a reference, which
+        ``rollback_slots`` cannot invert — the sync scheduler must own
+        that append so the in-kernel page copy is actually issued."""
+        table = self._tables[seq_id]
+        tokens = self._tokens[seq_id]
+        return bool(table) and tokens % self.block_size != 0 \
+            and self._ref[table[-1]] > 1
+
     def can_append(self, seq_id):
         """Would ``append_slot`` succeed without raising?"""
         table = self._tables[seq_id]
@@ -373,6 +384,23 @@ class BlockManager:
             self._ref[blk] += 1
         self._tables[child_id] = list(table)
         self._tokens[child_id] = self._tokens[parent_id]
+
+    def promote_fork(self, parent_id, child_id):
+        """Replace the parent's page chain with its fork child's —
+        tree-speculation branch acceptance: the verified sibling row's
+        K/V lives on the child's (COW-diverged) chain, so the child
+        BECOMES the sequence.  The parent's old pages drop one
+        reference each (still-shared pages survive under the child's
+        table; exclusively-held ones go back to the pool / LRU), and
+        the child's table and token count are renamed to
+        ``parent_id``.  The child id ceases to exist."""
+        if child_id not in self._tables:
+            raise KeyError(f"fork child {child_id!r} owns no pages")
+        table = self._tables.pop(child_id)
+        tokens = self._tokens.pop(child_id)
+        self.free(parent_id)
+        self._tables[parent_id] = table
+        self._tokens[parent_id] = tokens
 
     # ----------------------------------------------------------- migration --
     def export_seq(self, seq_id):

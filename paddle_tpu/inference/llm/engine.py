@@ -69,7 +69,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ... import profiler
 from ...framework import jax_compat  # noqa: F401  (aliases jax.shard_map)
 from ...incubate.nn import _layernorm
-from .block_manager import BlockManager
+from .block_manager import BlockManager, NoFreeBlocksError
 from .faults import (
     FinishReason,
     InjectedFault,
@@ -97,9 +97,21 @@ from .sampling import (
     top_logprobs,
     validate_sampling,
 )
-from .scheduler import FINISHED, RUNNING, Request, Scheduler, bucket_size
+from .scheduler import (
+    FINISHED,
+    RUNNING,
+    RaggedRow,
+    Request,
+    Scheduler,
+    bucket_size,
+)
 from .structured import ConstraintState
-from .spec import NgramDrafter, SpeculativeConfig, rollback_draft_reservation
+from .spec import (
+    DraftModelDrafter,
+    NgramDrafter,
+    SpeculativeConfig,
+    rollback_draft_reservation,
+)
 
 # Megatron-style sharding of the stacked block params over the 'mp' axis
 # (leading dim is the layer stack): qkv/fc_in split their OUTPUT columns,
@@ -244,7 +256,8 @@ class LLMEngine:
                  speculative=None, memory_budget=None, quantize=None,
                  lora=None, faults=None, retry=None, max_queue=None,
                  step_timeout_s=None, clock=None,
-                 record_step_gauges=False, detokenizer=None):
+                 record_step_gauges=False, detokenizer=None,
+                 lookahead=False):
         # ----------------------------------------- lifecycle hardening ----
         # validate the robustness knobs FIRST (mirrors max_new_tokens):
         # a bad config must fail loudly at construction, not mid-traffic
@@ -323,10 +336,28 @@ class LLMEngine:
         # multi-LoRA serving (None | int | dict | LoRAConfig): packed
         # per-tenant adapter pools applied inside the ragged step
         self.lora = LoRAConfig.resolve(lora)
-        # speculative decoding (None | K | dict | SpeculativeConfig):
-        # an n-gram drafter plus the bucketed verify executable family
+        # speculative decoding (None | K | method str | dict |
+        # SpeculativeConfig): an n-gram drafter — or, for
+        # method="draft-model"/"tree", the hybrid model-based drafter
+        # whose params/pools come up in _init_draft_model below — plus
+        # the bucketed verify executable family
         self.spec = SpeculativeConfig.resolve(speculative)
-        self.drafter = NgramDrafter(self.spec) if self.spec else None
+        if self.spec is None:
+            self.drafter = None
+        elif self.spec.uses_draft_model:
+            self.drafter = DraftModelDrafter(self.spec)
+        else:
+            self.drafter = NgramDrafter(self.spec)
+        # async lookahead: while step N's launch runs on device, plan
+        # and pack step N+1's operands (see _stage_next/_claim_staged)
+        self.lookahead = bool(lookahead)
+        self._staged = None          # (plan_rows, packed operands)
+        self._staged_epoch = -1
+        self._plan_epoch = 0         # bumped by every plan-invalidating
+                                     # lifecycle mutation
+        self._host_plan_s = 0.0      # critical-path schedule+pack time
+        self._step_wall_s = 0.0      # total step() wall time
+        self._launch_count = 0
 
         # ------------------------------------------------ mesh resolution --
         if mesh is None and tensor_parallel and int(tensor_parallel) > 1:
@@ -455,6 +486,11 @@ class LLMEngine:
                       "chunk_launches": 0, "tokens_generated": 0,
                       "spec_steps": 0, "draft_tokens": 0,
                       "accepted_tokens": 0, "mixed_steps": 0,
+                      # async lookahead: plans staged under device
+                      # time / staged plans that survived to launch
+                      "staged_steps": 0, "staged_hits": 0,
+                      # tree speculation: sibling branches taken
+                      "tree_hits": 0,
                       # lifecycle/fault counters (lifecycle_stats())
                       "aborted": 0, "deadline_missed": 0, "shed": 0,
                       "retries": 0, "quarantined": 0, "step_faults": 0}
@@ -830,6 +866,83 @@ class LLMEngine:
             self._ragged = jax.jit(
                 step_fn, donate_argnums=tuple(range(2, 2 + n_pools)))
 
+        # model-based drafting: draft params (leading target layers +
+        # zero-padded identity blocks) and a second set of paged pools
+        # that ride the SAME executable family — zero extra compiles
+        self._draft_params = None
+        self._draft_bm = None
+        if self.spec is not None and self.spec.uses_draft_model:
+            self._init_draft_model(cache_shape, scale_shape)
+
+    def _init_draft_model(self, cache_shape, scale_shape):
+        """Build the draft model's params and paged pools.
+
+        The draft model is the target's first ``draft_layers``
+        transformer blocks followed by ZERO blocks: with every leaf of
+        a padded layer zeroed (weights AND biases), qkv is zero, so
+        attention reads all-zero values, projection and MLP emit zero,
+        and the residual stream passes through bit-exactly — the
+        padded layers are exact identities.  Leaf shapes match the
+        target's, so the draft rides the already-jitted ragged
+        executable (params are its first operand) with ZERO new
+        compiles; embed/head dicts are shared by reference.  The draft
+        gets its own K/V pools and BlockManager (prefix caching off —
+        draft state is disposable) sized like the target's."""
+        dl = min(int(self.spec.draft_layers), self.num_layers)
+        blocks = {}
+        for k, w in self.params["blocks"].items():
+            if dl >= self.num_layers or k.startswith("lora."):
+                # full-depth draft degenerates to the target; LoRA
+                # pools are reused as-is — draft rows always pass
+                # slot 0, the all-zero base identity, so stale pool
+                # contents can never leak into a draft
+                blocks[k] = w
+                continue
+            pad = jnp.concatenate([w[:dl], jnp.zeros_like(w[dl:])],
+                                  axis=0)
+            if self.tp > 1:
+                pad = jax.device_put(
+                    pad, self._param_shardings["blocks"][k])
+            blocks[k] = pad
+        self._draft_params = {"embed": self.params["embed"],
+                              "blocks": blocks,
+                              "head": self.params["head"]}
+        if self.tp > 1:
+            zeros = jax.jit(lambda: jnp.zeros(cache_shape,
+                                              self._kv_dtype),
+                            out_shardings=self._cache_sharding)
+            self._draft_kc = zeros()
+            self._draft_vc = zeros()
+            if self._kv_quant:
+                szeros = jax.jit(
+                    lambda: jnp.zeros(scale_shape, jnp.float32),
+                    out_shardings=self._scale_sharding)
+                self._draft_ks = szeros()
+                self._draft_vs = szeros()
+        else:
+            self._draft_kc = jnp.zeros(cache_shape, self._kv_dtype)
+            self._draft_vc = jnp.zeros(cache_shape, self._kv_dtype)
+            if self._kv_quant:
+                self._draft_ks = jnp.zeros(scale_shape, jnp.float32)
+                self._draft_vs = jnp.zeros(scale_shape, jnp.float32)
+        self._draft_bm = BlockManager(self.num_blocks, self.block_size,
+                                      enable_prefix_caching=False)
+        self.events.append((self._step_index, "draft_model_load", dl,
+                            self.num_blocks))
+
+    def _draft_pools(self):
+        if self._kv_quant:
+            return (self._draft_kc, self._draft_vc,
+                    self._draft_ks, self._draft_vs)
+        return (self._draft_kc, self._draft_vc)
+
+    def _set_draft_pools(self, pools):
+        if self._kv_quant:
+            (self._draft_kc, self._draft_vc,
+             self._draft_ks, self._draft_vs) = pools
+        else:
+            self._draft_kc, self._draft_vc = pools
+
     # ----------------------------------------------------------- requests --
     def add_request(self, prompt_ids, max_new_tokens=16, eos_token_id=None,
                     temperature=0.0, request_id=None, seed=None,
@@ -942,6 +1055,7 @@ class LLMEngine:
             return request_id
         self._requests[request_id] = req
         self.scheduler.add(req)
+        self._invalidate_plan()
         self.events.append((self._step_index, "add", request_id))
         return request_id
 
@@ -959,6 +1073,7 @@ class LLMEngine:
             return False
         rollback_draft_reservation(self.block_manager, req)
         self.scheduler.abort(req)
+        self._invalidate_plan()
         self.stats["aborted"] += 1
         self.events.append((self._step_index, "abort", request_id))
         self._finish_early(req, FinishReason.ABORTED)
@@ -969,6 +1084,8 @@ class LLMEngine:
         device step (abort / deadline / quarantine): pages are already
         reclaimed by the caller; the output joins the next step()'s
         finished list."""
+        self._invalidate_plan()
+        self._drafter_forget(req.request_id)
         req.status = FINISHED
         req.finish_reason = reason
         self._requests.pop(req.request_id, None)
@@ -994,6 +1111,14 @@ class LLMEngine:
     def _drain_early(self):
         early, self._early = self._early, []
         return early
+
+    def _invalidate_plan(self):
+        """Mark every staged lookahead plan stale: any lifecycle
+        mutation that could change what the scheduler would pick for
+        the next step (admission, abort, finish, fork, quarantine,
+        migration import/release) bumps the epoch, and _claim_staged
+        discards a plan staged under an older one."""
+        self._plan_epoch += 1
 
     def has_unfinished(self):
         return bool(self._early) or self.scheduler.has_unfinished()
@@ -1040,6 +1165,18 @@ class LLMEngine:
                 "inflight": len(self.scheduler.running),
                 "free_pages": self.block_manager.num_free_blocks,
                 "last_step_ms": self._last_step_ms,
+                # async lookahead gauges: staged/claimed plan counts
+                # and the measured fraction of step wall time the host
+                # spends planning+packing ON the critical path (plans
+                # claimed from a lookahead stage contribute ~0 — their
+                # packing ran under the previous step's device window).
+                # Wall-clock floats live HERE, never in events.
+                "staged_steps": s["staged_steps"],
+                "staged_hits": s["staged_hits"],
+                "host_plan_s": self._host_plan_s,
+                "host_overhead_fraction": (
+                    self._host_plan_s / self._step_wall_s
+                    if self._step_wall_s > 0 else None),
                 # per-step cumulative counter trajectory (empty unless
                 # record_step_gauges=True; see _record_step_gauges)
                 "step_gauges": self.step_gauges}
@@ -1171,7 +1308,7 @@ class LLMEngine:
                     positions, rows, zr, zr, zr, zr, cow_dst,
                     *knobs, chan, chan, *lora_ops)
                 self._set_pools(out[2:])
-                jax.block_until_ready(self._kc)
+                jax.block_until_ready(self._kc)  # noqa: H001 (warmup timing sync — never on the serving step path)
                 timings[f"{kind}[{tb}]"] = \
                     (time.perf_counter() - t0) * 1e3
         from ...framework.analysis import CompileWatcher
@@ -1193,7 +1330,9 @@ class LLMEngine:
             # the last_step_ms health gauge: time of the whole
             # iteration (schedule + launches + commit) on the injected
             # timer, kept OUT of the deterministic event log
-            self._last_step_ms = (self._timer() - t0) * 1e3
+            dt = self._timer() - t0
+            self._step_wall_s += dt
+            self._last_step_ms = dt * 1e3
 
     def _step_impl(self):
         self._step_index += 1
@@ -1202,18 +1341,34 @@ class LLMEngine:
             self.faults.begin_step(self._step_index)
         finished = self._drain_early()
         self._expire_deadlines(finished)
-        pre_preempt = self.scheduler.num_preemptions
-        with profiler.RecordEvent("llm_engine::schedule"):
-            batch = self.scheduler.schedule()
-        if self.scheduler.num_preemptions > pre_preempt:
-            self.events.append(
-                (self._step_index, "preempt",
-                 self.scheduler.num_preemptions - pre_preempt))
-        if batch.kind == "idle":
-            self._record_step_gauges()
-            return finished
-        self.stats["steps"] += 1
-        self._ragged_step(batch, finished)
+        staged = self._claim_staged()
+        if staged is not None:
+            # the whole plan+pack for this step already ran under the
+            # PREVIOUS step's device window — only the (cheap) claim
+            # validation sits on this step's critical path, which is
+            # what the host_overhead_fraction gauge measures dropping
+            plan_rows, pk = staged
+            self.stats["steps"] += 1
+            self.stats["staged_hits"] += 1
+            self.stats["decode_steps"] += 1
+            self._launch_packed(plan_rows, pk, finished)
+        else:
+            if isinstance(self.drafter, DraftModelDrafter):
+                self._draft_phase()
+            t0 = self._timer()
+            pre_preempt = self.scheduler.num_preemptions
+            with profiler.RecordEvent("llm_engine::schedule"):
+                batch = self.scheduler.schedule()
+            if self.scheduler.num_preemptions > pre_preempt:
+                self.events.append(
+                    (self._step_index, "preempt",
+                     self.scheduler.num_preemptions - pre_preempt))
+            if batch.kind == "idle":
+                self._host_plan_s += self._timer() - t0
+                self._record_step_gauges()
+                return finished
+            self.stats["steps"] += 1
+            self._ragged_step(batch, finished, t_sched=t0)
         if self.tp > 1:
             # ONE host-side allocator drives every shard (tables ride
             # replicated), so page accounting must be shard-invariant:
@@ -1299,6 +1454,7 @@ class LLMEngine:
         slot reservation and STAY RUNNING — the failed launch never
         executed, so their K/V state is untouched and the next step
         re-reserves and re-launches them token-exactly."""
+        self._invalidate_plan()
         victim = getattr(exc, "victim", None)
         victims = (list(reqs) if victim is None or not reqs
                    else [reqs[victim % len(reqs)]])
@@ -1401,58 +1557,64 @@ class LLMEngine:
         self.params = {**self.params, "blocks": blocks}
 
     # ------------------------------------------------------------ migration --
+    @staticmethod
+    def _gather_pool(pool, idx):
+        """Select page rows [:, idx] of one KV pool as a host numpy
+        array, slicing ON DEVICE first so the host transfer carries
+        only the selected pages — O(len(idx)) bytes, not the whole
+        pool.  Eager ``jnp.take`` compiles outside the ragged family
+        (nothing for an armed CompileWatcher to see) and leaves the
+        committed pool buffer untouched, so donation is unaffected.
+        Plain-numpy pools (the simulator's) skip the device round
+        trip."""
+        if isinstance(pool, np.ndarray):
+            return pool[:, idx]
+        sel = jnp.take(pool, jnp.asarray(idx, jnp.int32), axis=1)
+        return np.asarray(jax.device_get(sel))  # noqa: H001 (migration pulls only the selected pages by design)
+
     def _gather_pages(self, block_ids):
-        """Host-staged page gather: ``jax.device_get`` of the pools
-        (whole-array transfer — no jit, no gather executable, nothing
-        for an armed CompileWatcher to see), then a numpy row select.
-        Returns (k_pages, v_pages) as [L, P, bs, Nkv, D] numpy arrays
-        in ``block_ids`` order — the GLOBAL view even when the pools
-        are head-sharded (jax assembles addressable shards)."""
+        """Host-staged page gather: device-side row select of the
+        pools, then a transfer of JUST those rows.  Returns (k_pages,
+        v_pages) as [L, n, bs, Nkv, D] numpy arrays in ``block_ids``
+        order — the GLOBAL view even when the pools are head-sharded
+        (jax assembles addressable shards)."""
         idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
-        k = np.asarray(jax.device_get(self._kc))[:, idx]  # noqa: H001 (migration is a host-staged transfer by design)
-        v = np.asarray(jax.device_get(self._vc))[:, idx]  # noqa: H001
-        return k, v
+        return (self._gather_pool(self._kc, idx),
+                self._gather_pool(self._vc, idx))
 
     def _gather_scale_pages(self, block_ids):
         """Scale-pool counterpart of :meth:`_gather_pages` for the int8
-        KV pool: [L, P, Nkv, bs] numpy arrays in ``block_ids`` order."""
+        KV pool: [L, n, Nkv, bs] numpy arrays in ``block_ids`` order."""
         idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
-        ks = np.asarray(jax.device_get(self._ks))[:, idx]  # noqa: H001 (migration is a host-staged transfer by design)
-        vs = np.asarray(jax.device_get(self._vs))[:, idx]  # noqa: H001
-        return ks, vs
+        return (self._gather_pool(self._ks, idx),
+                self._gather_pool(self._vs, idx))
 
     def _scatter_pages(self, block_ids, k_pages, v_pages):
-        """Host-staged page scatter: pull the pools to host, write the
-        migrated pages into their destination rows, and ``device_put``
-        fresh pool arrays back (re-sharded under TP).  The rebuilt
-        arrays are ordinary committed buffers — the next step's jitted
-        call donates them exactly like the ones they replace, so
-        migration composes with donation and compiles nothing."""
-        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
-        kh = np.array(jax.device_get(self._kc))  # noqa: H001 (migration is a host-staged transfer by design)
-        vh = np.array(jax.device_get(self._vc))  # noqa: H001
-        kh[:, idx] = k_pages
-        vh[:, idx] = v_pages
+        """Host-staged page scatter: upload the migrated pages and
+        write them into their destination pool rows ON DEVICE
+        (``.at[idx].set`` — an eager functional update outside the
+        ragged family), re-sharded under TP.  Transfer cost is the
+        migrated pages, not the pool.  The rebuilt arrays are ordinary
+        committed buffers — the next step's jitted call donates them
+        exactly like the ones they replace, so migration composes with
+        donation and compiles nothing in the watched family."""
+        idx = jnp.asarray(np.asarray(block_ids, np.int64))  # noqa: H001 (host block-id list, not a tensor)
+        kc = self._kc.at[:, idx].set(jnp.asarray(k_pages, self._kc.dtype))
+        vc = self._vc.at[:, idx].set(jnp.asarray(v_pages, self._vc.dtype))
         if self.tp > 1:
-            self._kc = jax.device_put(kh, self._cache_sharding)
-            self._vc = jax.device_put(vh, self._cache_sharding)
-        else:
-            self._kc = jax.device_put(kh)
-            self._vc = jax.device_put(vh)
+            kc = jax.device_put(kc, self._cache_sharding)
+            vc = jax.device_put(vc, self._cache_sharding)
+        self._kc, self._vc = kc, vc
 
     def _scatter_scale_pages(self, block_ids, k_scales, v_scales):
         """Scale-pool counterpart of :meth:`_scatter_pages`."""
-        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
-        ksh = np.array(jax.device_get(self._ks))  # noqa: H001 (migration is a host-staged transfer by design)
-        vsh = np.array(jax.device_get(self._vs))  # noqa: H001
-        ksh[:, idx] = k_scales
-        vsh[:, idx] = v_scales
+        idx = jnp.asarray(np.asarray(block_ids, np.int64))  # noqa: H001 (host block-id list, not a tensor)
+        ks = self._ks.at[:, idx].set(jnp.asarray(k_scales, self._ks.dtype))
+        vs = self._vs.at[:, idx].set(jnp.asarray(v_scales, self._vs.dtype))
         if self.tp > 1:
-            self._ks = jax.device_put(ksh, self._scale_sharding)
-            self._vs = jax.device_put(vsh, self._scale_sharding)
-        else:
-            self._ks = jax.device_put(ksh)
-            self._vs = jax.device_put(vsh)
+            ks = jax.device_put(ks, self._scale_sharding)
+            vs = jax.device_put(vs, self._scale_sharding)
+        self._ks, self._vs = ks, vs
 
     def export_request(self, request_id):
         """Serialize one RUNNING request for migration to a peer
@@ -1553,6 +1715,7 @@ class LLMEngine:
         req.draft_tokens = []
         self._requests[rid] = req
         self.scheduler.running.append(req)
+        self._invalidate_plan()
         self.events.append((self._step_index, "import", rid,
                             len(table)))
 
@@ -1564,19 +1727,25 @@ class LLMEngine:
         call it only after the import succeeded."""
         req = self._requests.pop(request_id)
         self.scheduler.abort(req)
+        self._invalidate_plan()
+        self._drafter_forget(request_id)
         self.events.append((self._step_index, "release", request_id))
 
-    def _ragged_step(self, batch, finished):
+    def _ragged_step(self, batch, finished, t_sched=None):
         """ONE unified launch for the whole scheduled step: every row —
         plain decode, speculative verify, prefill chunk — packs into a
         single flat token batch padded to the total-token bucket, and
         commits replay the retired engine's order exactly (decode/verify
         rows in scheduler order first, then chunks in schedule order),
         so seeded RNG streams and page bookkeeping are bitwise
-        unchanged."""
+        unchanged.  ``t_sched`` is the timer mark the scheduling pass
+        started at — packing belongs to the same critical-path host
+        window the host_overhead_fraction gauge measures."""
         rows = [row for row in batch.rows
                 if row.request.status != FINISHED]
         if not rows:
+            if t_sched is not None:
+                self._host_plan_s += self._timer() - t_sched
             return
         has_decode = any(row.kind != "chunk" for row in rows)
         has_chunk = any(row.kind == "chunk" for row in rows)
@@ -1588,10 +1757,20 @@ class LLMEngine:
                 sum(1 for row in rows if row.kind == "chunk")
         if has_decode and has_chunk:
             self.stats["mixed_steps"] += 1
+        pk = self._pack_ragged(rows, batch.cows)
+        if t_sched is not None:
+            self._host_plan_s += self._timer() - t_sched
+        self._launch_packed(rows, pk, finished)
 
+    def _pack_ragged(self, rows, cows):
+        """Pack one step's RaggedRows into the executable's numpy
+        operands.  Pure host work over scheduler/book state — shared
+        verbatim by the synchronous step path and the lookahead stager
+        (which runs it under the PREVIOUS step's device window), so a
+        staged launch is operand-identical to the sync one.  Returns
+        the packed-operand dict ``_launch_packed`` consumes."""
         total = sum(row.length for row in rows)
         tb = bucket_size(total, self.token_budget, floor=8)
-        self.last_launches.append(("ragged", tb))
         rmax = self.max_batch
         ids = np.zeros(tb, np.int32)
         positions = np.full(tb, -1, np.int32)
@@ -1607,13 +1786,19 @@ class LLMEngine:
             starts.append(s)
             if row.kind == "chunk":
                 toks = req.all_ids[row.start:row.start + row.length]
+            elif row.kind == "tree":
+                # sibling branch: re-write position T-1's K/V on the
+                # fork's own COW chain, then score the second-best
+                # first token at position T
+                toks = [req.all_ids[-1], row.sibling]
             else:
                 toks = [req.all_ids[-1]] + list(req.draft_tokens)
             ids[s:s + row.length] = toks
             positions[s:s + row.length] = np.arange(
                 row.start, row.start + row.length)
             tok_rows[s:s + row.length] = ri
-            bt = self.block_manager.block_table(req.request_id)
+            bt = self.block_manager.block_table(
+                req.request_id if row.table_id is None else row.table_id)
             tables[ri, :len(bt)] = bt
             row_start[ri] = s
             row_qlen[ri] = row.length
@@ -1642,7 +1827,7 @@ class LLMEngine:
         # common (no-pipeline) step never re-uploads it.
         cow_src = np.zeros(rmax, np.int32)
         cow_dst = np.full(rmax, self.num_blocks, np.int32)
-        for i, (csrc, cdst) in enumerate(batch.cows):
+        for i, (csrc, cdst) in enumerate(cows):
             cow_src[i] = csrc
             cow_dst[i] = cdst
         knobs = neutral_row_params(rmax)
@@ -1701,33 +1886,77 @@ class LLMEngine:
                 chan = jnp.zeros((tb, self.vocab_size), jnp.float32)
                 self._neutral_chan[tb] = chan
             bias = counts = chan
+        return {"tb": tb, "starts": starts, "ids": ids,
+                "tables": tables, "positions": positions,
+                "tok_rows": tok_rows, "row_start": row_start,
+                "row_qlen": row_qlen, "row_pos0": row_pos0,
+                "cow_src": cow_src, "cow_dst": cow_dst, "knobs": knobs,
+                "bias": bias, "counts": counts,
+                "adapter_rows": adapter_rows}
 
+    def _launch_packed(self, rows, pk, finished):
+        """Launch one packed ragged step and commit its results — the
+        shared back half of the sync path and a claimed lookahead
+        plan."""
+        starts = pk["starts"]
+        self.last_launches.append(("ragged", pk["tb"]))
+        self._launch_count += 1
         out = self._launch("ragged", [row.request for row in rows],
                            lambda: self._ragged_launch(
-                               rows, ids, tables, positions, tok_rows,
-                               row_start, row_qlen, row_pos0,
-                               cow_src, cow_dst, knobs, bias, counts,
-                               adapter_rows))
+                               rows, pk["ids"], pk["tables"],
+                               pk["positions"], pk["tok_rows"],
+                               pk["row_start"], pk["row_qlen"],
+                               pk["row_pos0"], pk["cow_src"],
+                               pk["cow_dst"], pk["knobs"], pk["bias"],
+                               pk["counts"], pk["adapter_rows"]))
         if out is None:
-            return              # quarantined; reservations rolled back
+            # quarantined; reservations rolled back.  Tree fork chains
+            # this step scheduled never launched — free them.
+            for row in rows:
+                if row.kind == "tree" and \
+                        self.block_manager.has_seq(row.table_id):
+                    self.block_manager.free(row.table_id)
+            return
         nxt, logits = out[0], out[1]
         self._set_pools(out[2:])
+        # async lookahead: the launch above is dispatched but NOT yet
+        # synced — np.asarray(nxt) below is the blocking pull.  Plan
+        # and pack step N+1 here so that host work runs entirely under
+        # step N's device window.
+        self._stage_next(rows)
         nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per step)
         row_logits = self._fetch_sampling_rows(rows, starts, logits)
 
         # commit phase A: decode/verify rows, in scheduler order — the
         # same _commit_verified-if-any-drafts-else-vectorized split the
         # retired per-phase steps made, so gumbel draw order (and thus
-        # seeded output) is bitwise preserved
+        # seeded output) is bitwise preserved.  Tree sibling rows are
+        # looked up by their main row's request and walked inside
+        # _commit_verified.
         nonchunk = [(ri, row) for ri, row in enumerate(rows)
-                    if row.kind != "chunk"]
+                    if row.kind not in ("chunk", "tree")]
+        tree_rows = {row.request.request_id: (ri, row)
+                     for ri, row in enumerate(rows)
+                     if row.kind == "tree"}
         if any(row.request.draft_tokens for _, row in nonchunk):
             self.stats["spec_steps"] += 1
             for ri, row in nonchunk:
                 s0 = starts[ri]
+                tree = None
+                tr = tree_rows.pop(row.request.request_id, None)
+                if tr is not None:
+                    tri, trow = tr
+                    ts = starts[tri]
+                    tree = (trow.table_id, trow.sibling,
+                            nxt[ts:ts + 2], row_logits.get(tri))
                 self._commit_verified(row.request,
                                       nxt[s0:s0 + row.length],
-                                      row_logits.get(ri), finished)
+                                      row_logits.get(ri), finished,
+                                      tree=tree)
+            for _tri, trow in tree_rows.values():
+                # defensive: a sibling row whose main row vanished
+                if self.block_manager.has_seq(trow.table_id):
+                    self.block_manager.free(trow.table_id)
         elif nonchunk:
             entries = []
             for ri, row in nonchunk:
@@ -1758,6 +1987,331 @@ class LLMEngine:
                 self._commit_tokens(
                     [(r, tok, None if lg is None else lg[0])
                      for r in fam], finished)
+
+    # --------------------------------------------------- async lookahead --
+    def _stage_next(self, rows):
+        """Plan + pack step N+1 while step N's launch is in flight.
+
+        Runs between dispatch and the blocking token pull, so the work
+        hides under device time.  Staging only fires when the next
+        step is PROVABLY a plain all-decode step whose schedule cannot
+        depend on step N's outcome:
+
+        - ``lookahead=True``, no fault injector (alloc-fault schedules
+          are per step — claiming step N+1's slots at step N would
+          misalign them), no model drafter (its draft phase launches
+          device work per step);
+        - no waiting requests (admission could change everything),
+          every running request fully prefilled with no pending
+          drafts, no sampling-pipeline rows (their bias/counts operands
+          depend on the not-yet-committed token), and the current step
+          itself all-decode (verify/chunk commits move row geometry);
+        - no append would COW (a COW rewires the fork sibling's table,
+          which a discard could not invert — and the page-copy pair
+          must be issued by the launch that owns the append).
+
+        One slot per running request is claimed NOW; the claim is
+        validated (and the unknown query token patched in) by
+        _claim_staged, or rolled back exactly by _discard_staged.
+        With an n-gram drafter attached, claiming additionally
+        requires every re-proposal to come back empty — a non-empty
+        draft means the sync scheduler would have built a verify row
+        instead."""
+        if not self.lookahead or self.faults is not None \
+                or self._draft_params is not None:
+            return
+        sch = self.scheduler
+        running = sch.running
+        if sch.waiting or not running:
+            return
+        for row in rows:
+            if row.kind != "decode":
+                return
+        bm = self.block_manager
+        for r in running:
+            if not r.prefill_done or r.uses_pipeline \
+                    or r.draft_tokens or bm.would_cow(r.request_id):
+                return
+        plan_rows, claimed = [], []
+        try:
+            for r in running:
+                bm.append_slot(r.request_id)
+                claimed.append(r)
+                plan_rows.append(RaggedRow(
+                    r, "decode", bm.num_tokens(r.request_id) - 1, 1))
+        except NoFreeBlocksError:
+            # exact inverse, newest claim first: the LIFO free list
+            # ends up byte-identical to the never-staged state
+            for r in reversed(claimed):
+                bm.rollback_slots(r.request_id, 1)
+            return
+        pk = self._pack_ragged(plan_rows, [])
+        self._staged = (plan_rows, pk)
+        self._staged_epoch = self._plan_epoch
+        self.stats["staged_steps"] += 1
+        self.events.append(
+            (self._step_index, "step_staged", len(plan_rows)))
+
+    def _claim_staged(self):
+        """Validate and take the staged step-N+1 plan, or discard it.
+
+        The plan epoch catches every lifecycle mutation since staging
+        (add/abort/finish/fork/quarantine/migration); the per-row
+        checks pin the running set and its book state to exactly what
+        the stager assumed; the drafter re-proposal check keeps
+        speculation intact (any non-empty draft → the sync scheduler
+        must build this step).  On success the one operand staging
+        couldn't know — each row's query token, committed by step N —
+        is patched into the packed ids and the plan launches as-is."""
+        staged, self._staged = self._staged, None
+        if staged is None:
+            return None
+        t0 = self._timer()
+        try:
+            plan_rows, pk = staged
+            running = self.scheduler.running
+            valid = (self._staged_epoch == self._plan_epoch
+                     and not self.scheduler.waiting
+                     and len(running) == len(plan_rows))
+            if valid:
+                for row, r in zip(plan_rows, running):
+                    if row.request is not r or r.status != RUNNING \
+                            or not r.prefill_done or r.draft_tokens \
+                            or r.uses_pipeline \
+                            or row.start != r.num_cached:
+                        valid = False
+                        break
+            if valid and self.drafter is not None:
+                spare = self.token_budget - len(running)
+                if spare > 0:
+                    for r in running:
+                        cap = min(spare, r.max_new_tokens
+                                  - len(r.output_ids) - 1)
+                        if cap > 0 and self.drafter.propose(
+                                r.all_ids, cap,
+                                request_id=r.request_id):
+                            valid = False
+                            break
+            if not valid:
+                self._discard_staged(plan_rows)
+                return None
+            for ri, row in enumerate(plan_rows):
+                pk["ids"][pk["row_start"][ri]] = \
+                    row.request.all_ids[-1]
+            return plan_rows, pk
+        finally:
+            self._host_plan_s += self._timer() - t0
+
+    def _discard_staged(self, plan_rows):
+        """Roll back the staged slot claims exactly — one slot per
+        still-live staged row, newest first (LIFO free-list inverse) —
+        so the subsequent sync schedule allocates the very pages the
+        never-staged engine would have."""
+        bm = self.block_manager
+        for row in reversed(plan_rows):
+            req = row.request
+            if req.status == RUNNING and req.prefill_done \
+                    and bm.has_seq(req.request_id):
+                extra = bm.num_tokens(req.request_id) - req.num_cached
+                if extra > 0:
+                    bm.rollback_slots(req.request_id, extra)
+
+    # ------------------------------------------------- model drafting --
+    def _draft_phase(self):
+        """Fill the model drafter's proposals for this step.
+
+        Runs BEFORE scheduling: for every fully-prefilled running
+        request whose prompt-lookup draft comes up empty (the hybrid
+        contract — n-gram hits are free and win), the draft model runs
+        through the SAME ragged executable against its own pools:
+
+        1. catch-up — the valid draft-KV prefix is the longest common
+           prefix of the drafter's fed-token history and the real
+           ``all_ids`` (K/V at p depends on tokens [0, p] only);
+           everything past it is re-fed in token_budget-bounded
+           chunks, and the final fed position's argmax is the first
+           greedy draft token (for ``method="tree"``, the runner-up of
+           that same logits row becomes the sibling branch);
+        2. chain — up to ``min(K, cap) - 1`` batched one-token greedy
+           decode launches extend every candidate's chain in lockstep.
+
+        Draft-pool OOM for a request just skips drafting it this step
+        (its draft state is dropped and rebuilt later); plain decode
+        correctness never depends on this phase."""
+        dr = self.drafter
+        dbm = self._draft_bm
+        K = self.spec.num_tokens
+        dr.proposals = {}
+        dr.siblings = {}
+        live = {r.request_id for r in self.scheduler.running}
+        live.update(r.request_id for r in self.scheduler.waiting)
+        for rid in [r for r in dr.history if r not in live]:
+            dr.forget(rid)
+            if dbm.has_seq(rid):
+                dbm.free(rid)
+        cands = []
+        for r in self.scheduler.running:
+            if not r.prefill_done:
+                continue
+            cap = min(K, r.max_new_tokens - len(r.output_ids) - 1)
+            if cap <= 0:
+                continue
+            if dr._ngram.propose(r.all_ids, cap):
+                continue            # free n-gram draft wins this row
+            cands.append((r, cap))
+        if not cands:
+            return
+        # -- draft-pool bookkeeping + catch-up work list
+        feeds = []
+        for r, cap in cands:
+            rid = r.request_id
+            H = r.all_ids
+            hist = dr.history.get(rid, [])
+            lcp = 0
+            hmax = min(len(hist), len(H) - 1)
+            while lcp < hmax and hist[lcp] == H[lcp]:
+                lcp += 1
+            try:
+                if not dbm.has_seq(rid):
+                    lcp = 0
+                    dbm.allocate(rid, len(H))
+                else:
+                    extra = dbm.num_tokens(rid) - lcp
+                    if extra > 0:
+                        dbm.rollback_slots(rid, extra)
+                    dbm.append_slots(rid, len(H) - lcp)
+            except NoFreeBlocksError:
+                if dbm.has_seq(rid):
+                    dbm.free(rid)
+                dr.history.pop(rid, None)
+                continue
+            feeds.append((r, cap, lcp, H))
+            dr.history[rid] = list(H)
+        if not feeds:
+            return
+        # -- catch-up launches: chunk every pending feed through the
+        # token budget; a row's FINAL fed position yields g0 (and,
+        # for trees, the runner-up sibling)
+        chains = {}
+        want_sib = self.spec.method == "tree"
+        work = [[r, cap, lcp, H] for r, cap, lcp, H in feeds]
+        while work:
+            entries, meta, used = [], [], 0
+            for w in work:
+                if len(entries) >= self.max_batch \
+                        or used >= self.token_budget:
+                    break
+                r, cap, start, H = w
+                c = min(len(H) - start, self.token_budget - used)
+                entries.append((r.request_id, H[start:start + c],
+                                start))
+                w[2] = start + c
+                used += c
+                meta.append((r, w[2] == len(H)))
+            work = [w for w in work if w[2] < len(w[3])]
+            nxt, logits, starts = self._draft_launch(entries)
+            done = [(i, starts[i] + len(entries[i][1]) - 1)
+                    for i, (_r, fin) in enumerate(meta) if fin]
+            lg = None
+            if want_sib and done:
+                lg = np.asarray(logits[np.asarray(  # noqa: H001 (draft logits rows for the tree sibling, by design)
+                    [p for _i, p in done], np.int32)])
+            for k, (i, p) in enumerate(done):
+                r = meta[i][0]
+                g0 = int(nxt[p])  # noqa: H001 (host argmax, already fetched)
+                chains[r.request_id] = [g0]
+                if lg is not None:
+                    row = np.array(lg[k], np.float64)
+                    row[g0] = -np.inf
+                    dr.siblings[r.request_id] = int(np.argmax(row))  # noqa: H001 (host math on fetched row)
+        # -- greedy chain: K-1 batched one-token decode launches
+        act = [(r, cap) for r, cap, _lcp, _H in feeds
+               if chains.get(r.request_id)]
+        for _depth in range(1, K):
+            act = [(r, cap) for r, cap in act
+                   if len(chains[r.request_id]) < cap]
+            if not act:
+                break
+            entries, kept = [], []
+            for r, cap in act:
+                rid = r.request_id
+                try:
+                    dbm.append_slot(rid)
+                except NoFreeBlocksError:
+                    continue        # freeze this chain at its depth
+                entries.append((rid, [chains[rid][-1]],
+                                dbm.num_tokens(rid) - 1))
+                kept.append((r, cap))
+            if not entries:
+                break
+            nxt, _logits, starts = self._draft_launch(entries)
+            for i, (r, _cap) in enumerate(kept):
+                chains[r.request_id].append(int(nxt[starts[i]]))  # noqa: H001 (host argmax, already fetched)
+            act = kept
+        # the last chain token was predicted but never FED, so the
+        # history (what the draft pool encodes) excludes it
+        for r, cap, _lcp, H in feeds:
+            rid = r.request_id
+            chain = chains.get(rid)
+            if not chain:
+                continue
+            dr.proposals[rid] = list(chain[:cap])
+            dr.history[rid] = list(H) + chain[:-1]
+
+    def _draft_launch(self, entries):
+        """One ragged launch of the DRAFT model: the same jitted
+        executable (params are its first operand — zero new compiles),
+        the draft pools, neutral sampling operands, LoRA slot 0 (the
+        zero base identity).  ``entries`` are ``(seq_id, tokens,
+        pos0)`` rows over the draft BlockManager's tables.  Returns
+        (argmax np [Tb], logits device [Tb, V], starts)."""
+        total = sum(len(toks) for _sid, toks, _p in entries)
+        tb = bucket_size(total, self.token_budget, floor=8)
+        rmax = self.max_batch
+        ids = np.zeros(tb, np.int32)
+        positions = np.full(tb, -1, np.int32)
+        tok_rows = np.zeros(tb, np.int32)
+        tables = np.zeros((rmax, self.max_pages), np.int32)
+        row_start = np.zeros(rmax, np.int32)
+        row_qlen = np.zeros(rmax, np.int32)
+        row_pos0 = np.zeros(rmax, np.int32)
+        starts = []
+        s = 0
+        for ri, (sid, toks, p0) in enumerate(entries):
+            n = len(toks)
+            starts.append(s)
+            ids[s:s + n] = toks
+            positions[s:s + n] = np.arange(p0, p0 + n)
+            tok_rows[s:s + n] = ri
+            bt = self._draft_bm.block_table(sid)
+            tables[ri, :len(bt)] = bt
+            row_start[ri] = s
+            row_qlen[ri] = n
+            row_pos0[ri] = p0
+            s += n
+        zr = np.zeros(rmax, np.int32)
+        cow_dst = np.full(rmax, self.num_blocks, np.int32)
+        knobs = neutral_row_params(rmax)
+        chan = self._neutral_chan.get(tb)
+        if chan is None:
+            chan = jnp.zeros((tb, self.vocab_size), jnp.float32)
+            self._neutral_chan[tb] = chan
+        lora_ops = ((jnp.asarray(zr),)
+                    if self.lora is not None else ())
+        self.last_launches.append(("ragged", tb))
+        self._launch_count += 1
+        with profiler.RecordEvent("llm_engine::draft"):
+            out = self._ragged(
+                self._draft_params, jnp.asarray(ids),
+                *self._draft_pools(), jnp.asarray(tables),
+                jnp.asarray(positions), jnp.asarray(tok_rows),
+                jnp.asarray(row_start), jnp.asarray(row_qlen),
+                jnp.asarray(row_pos0), jnp.asarray(zr),
+                jnp.asarray(cow_dst),
+                *(jnp.asarray(k) for k in knobs), chan, chan,
+                *lora_ops)
+        self._set_draft_pools(out[2:])
+        return np.asarray(out[0]), out[1], starts  # noqa: H001 (draft argmax pull, one per draft launch by design)
 
     def _ragged_launch(self, rows, ids, tables, positions, tok_rows,
                        row_start, row_qlen, row_pos0, cow_src, cow_dst,
@@ -1852,6 +2406,7 @@ class LLMEngine:
         if req.n <= 1 or req._forked:
             return [req]
         req._forked = True
+        self._invalidate_plan()
         fam = [req]
         for k in range(1, req.n):
             cid = f"{req.request_id}.{k}"
@@ -1921,7 +2476,8 @@ class LLMEngine:
             elif len(req.output_ids) >= req.max_new_tokens:
                 self._finish(req, "length", finished)
 
-    def _commit_verified(self, req, argmax_row, logits_row, finished):
+    def _commit_verified(self, req, argmax_row, logits_row, finished,
+                         tree=None):
         """Acceptance + bulk commit for one verified row.
 
         Tokens emit in position order; a sampled request consumes
@@ -1930,11 +2486,27 @@ class LLMEngine:
         sampling), keeping its stream bitwise aligned with the
         non-speculative engine.  Unaccepted slots roll back BEFORE
         prefix-cache registration, so the cache only ever sees pages
-        full of accepted tokens."""
+        full of accepted tokens.
+
+        ``tree`` — ``(tmp_id, sibling_token, sib_argmax, sib_logits)``
+        — is the request's 2-token sibling row (tree speculation): if
+        the FIRST emitted token misses the chain draft but equals the
+        sibling token, the sibling row already holds that branch's K/V
+        and its position-1 logits, so a SECOND token commits from them
+        (one extra gumbel draw, same per-emitted-token stream
+        discipline) and the fork chain is promoted to be the request's
+        table.  Any other outcome frees the fork chain; either way the
+        books end the step exactly like a non-tree commit of the same
+        emitted count."""
         drafts = req.draft_tokens
         req.draft_tokens = []
         d = len(drafts)
         self.stats["draft_tokens"] += d
+        tmp_id = sib_tok = sib_argmax = sib_logits = None
+        if tree is not None:
+            tmp_id, sib_tok, sib_argmax, sib_logits = tree
+            self.stats["draft_tokens"] += 1  # the sibling proposal
+        promoted = False
         reason = None
         emitted = 0
         for j in range(d + 1):
@@ -1967,16 +2539,51 @@ class LLMEngine:
                 reason = "length"
                 break
             if not matched:
+                if j == 0 and tmp_id is not None and tok == sib_tok:
+                    # tree hit: the target's real first token is the
+                    # sibling branch — its K/V and next-token scores
+                    # are already on the fork chain
+                    self.stats["accepted_tokens"] += 1
+                    self.stats["tree_hits"] += 1
+                    promoted = True
+                    if req.temperature > 0.0:
+                        tok2 = self._sample_token(req, sib_logits[1])
+                    else:
+                        tok2 = int(sib_argmax[1])  # noqa: H001 (host row, already fetched)
+                    req.output_ids.append(tok2)
+                    emitted += 1
+                    self.stats["tokens_generated"] += 1
+                    if req.logprobs and sib_logits is not None:
+                        req.logprobs_content.append(top_logprobs(
+                            sib_logits[1], req.logprobs, tok2))
+                    if self._check_stop(req) is not None:
+                        reason = "stop"
+                    elif req.eos_token_id is not None \
+                            and tok2 == req.eos_token_id:
+                        reason = "stop"
+                    elif len(req.output_ids) >= req.max_new_tokens:
+                        reason = "length"
                 break
-        # the scheduler reserved 1 + d slots; keep the emitted ones.
-        # K/V through position num_cached + emitted - 1 stays valid:
-        # every kept position's token matched its draft (the last
-        # emitted token's slot is the first one rolled back, preserving
-        # the num_cached == len(all_ids) - 1 decode invariant).
         pages_before = req.num_cached // self.block_size
         req.num_cached += emitted
-        self.block_manager.rollback_slots(req.request_id,
-                                          1 + d - emitted)
+        if promoted:
+            # the fork chain holds the branch's K/V for positions
+            # 0..num_cached-1 and carries exactly num_cached slots (2
+            # appends on a fork of the T-1-token chain) — adopt it and
+            # drop the main chain with its now-stale reservation
+            self.block_manager.promote_fork(req.request_id, tmp_id)
+        else:
+            # the scheduler reserved 1 + d slots; keep the emitted
+            # ones.  K/V through position num_cached + emitted - 1
+            # stays valid: every kept position's token matched its
+            # draft (the last emitted token's slot is the first one
+            # rolled back, preserving the num_cached == len(all_ids)
+            # - 1 decode invariant).
+            self.block_manager.rollback_slots(req.request_id,
+                                              1 + d - emitted)
+            if tmp_id is not None and \
+                    self.block_manager.has_seq(tmp_id):
+                self.block_manager.free(tmp_id)
         if req.num_cached // self.block_size > pages_before:
             self._register_full_blocks(req)
         if reason is not None:
@@ -1986,13 +2593,31 @@ class LLMEngine:
         """Speculative-decoding counters (acceptance rate for benches)."""
         s = self.stats
         prop = s["draft_tokens"]
-        return {"spec_steps": s["spec_steps"],
-                "draft_tokens": prop,
-                "accepted_tokens": s["accepted_tokens"],
-                "acceptance_rate":
-                    s["accepted_tokens"] / prop if prop else 0.0}
+        out = {"spec_steps": s["spec_steps"],
+               "draft_tokens": prop,
+               "accepted_tokens": s["accepted_tokens"],
+               "acceptance_rate":
+                   s["accepted_tokens"] / prop if prop else 0.0}
+        if self.spec is not None:
+            out["method"] = self.spec.method
+        if isinstance(self.drafter, DraftModelDrafter):
+            out["model_drafts"] = self.drafter.model_drafts
+            out["ngram_drafts"] = self.drafter.ngram_drafts
+            out["tree_hits"] = s["tree_hits"]
+        return out
+
+    def _drafter_forget(self, request_id):
+        """Drop model-drafter state (and the draft pool's pages) for a
+        request leaving the engine by any path."""
+        if isinstance(self.drafter, DraftModelDrafter):
+            self.drafter.forget(request_id)
+            if self._draft_bm is not None \
+                    and self._draft_bm.has_seq(request_id):
+                self._draft_bm.free(request_id)
 
     def _finish(self, req, reason, finished):
+        self._invalidate_plan()
+        self._drafter_forget(req.request_id)
         self.scheduler.remove_running(req)
         req.status = FINISHED
         req.finish_reason = reason

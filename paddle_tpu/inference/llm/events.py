@@ -31,8 +31,11 @@ __all__ = [
 # v2 added the "fork" kind (n>1 parallel sampling splits a request
 # into its COW fork family at final-chunk commit); v3 added the
 # multi-LoRA kinds "adapter_register" (host registry) and
-# "adapter_load" (device pool slot swap)
-SCHEMA_VERSION = 3
+# "adapter_load" (device pool slot swap); v4 added the lookahead
+# kinds "step_staged" (the engine planned+packed step N+1 under step
+# N's device time) and "draft_model_load" (a model-based drafter's
+# zero-padded block leaves + paged pools came up at engine init)
+SCHEMA_VERSION = 4
 
 # detail-field names per engine event kind, in tuple order after
 # (step, kind).  Frozen: changing arity or adding kinds bumps
@@ -56,6 +59,17 @@ ENGINE_EVENT_FIELDS = {
     # slot column tells the story wall-clock-free)
     "adapter_register": ("adapter_id",),
     "adapter_load": ("adapter_id", "slot"),
+    # async lookahead: step N staged (planned + packed) this many
+    # decode rows for step N+1 under step N's device window.  The
+    # count is the STAGED row count, not the claimed one — a discard
+    # (plan invalidated) shows up as a staged event with no
+    # corresponding skipped schedule, which is exactly how a replay
+    # diff localizes a lost pipeline window.  Wall-clock-free.
+    "step_staged": ("rows",),
+    # model-based speculative decoding: the draft model's block
+    # leaves (live layers + zero-padded identities) and paged pools
+    # came up.  Emitted once at construction (step -1).
+    "draft_model_load": ("layers", "pages"),
 }
 
 # fleet event kinds ("shed"/"finish" are shared with the engine and

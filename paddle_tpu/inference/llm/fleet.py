@@ -1155,13 +1155,19 @@ class Fleet:
                 if ms is not None:
                     slowest = ms if slowest is None else max(slowest, ms)
             for k, v in ls.items():
-                if k in ("last_step_ms", "step_gauges"):
-                    continue     # not summable; fleet carries its own
+                if k in ("last_step_ms", "step_gauges",
+                         "host_overhead_fraction"):
+                    continue     # not summable; recomputed below
                 if k in ("queue_depth", "inflight", "free_pages") \
                         and not r.live:
                     continue     # gauges of a dead replica are gone
                 agg[k] = agg.get(k, 0) + v
         agg["last_step_ms"] = slowest
+        # a ratio can't be summed: rebuild it from the fleet-wide
+        # numerator (host_plan_s, summed above) over summed step wall
+        wall = sum(r.engine._step_wall_s for r in self.replicas)
+        agg["host_overhead_fraction"] = (
+            agg.get("host_plan_s", 0.0) / wall if wall > 0 else None)
         agg["step_gauges"] = self.step_gauges
         agg["shed"] = agg.get("shed", 0) + self.stats["shed"]
         agg.update(self.router.stats())

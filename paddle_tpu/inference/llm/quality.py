@@ -46,7 +46,7 @@ def engine_logits(engine, token_ids):
     score reflects what the paged kernel actually attends over."""
     if getattr(engine, "tp", 1) != 1:
         raise ValueError("engine_logits runs on tp=1 engines")
-    params = jax.device_get(engine.params)
+    params = jax.device_get(engine.params)  # noqa: H001 (offline eval harness pulls weights once, off the serving path)
     blocks = params["blocks"]
     emb = params["embed"]
     dtype, eps = engine.dtype, engine.eps

@@ -131,13 +131,18 @@ class PrefillChunk:
 class RaggedRow:
     """One row of the step's ragged token batch: ``length`` query
     tokens for ``request`` at absolute positions [start, start +
-    length).  kind is "decode" (length 1), "verify" (1 + K drafts), or
-    "chunk" (a PrefillChunk slice, carried in ``chunk``)."""
+    length).  kind is "decode" (length 1), "verify" (1 + K drafts),
+    "chunk" (a PrefillChunk slice, carried in ``chunk``), or "tree"
+    (a 2-token sibling row verifying the draft model's second-best
+    first token on a COW fork chain — ``table_id`` names the fork's
+    temporary sequence, ``sibling`` the alternative token)."""
     request: object
-    kind: str                   # "decode" | "verify" | "chunk"
+    kind: str                   # "decode" | "verify" | "chunk" | "tree"
     start: int
     length: int
     chunk: object = None        # the PrefillChunk for kind == "chunk"
+    table_id: object = None     # block-table key (tree fork rows only)
+    sibling: int = None         # the tree branch's first-position token
 
 
 @dataclass
@@ -257,6 +262,7 @@ class Scheduler:
         # greedy drafter can spend only the spare budget and never
         # starves another sequence's decode slot.
         spare = budget - sum(1 for r in self.running if r.prefill_done)
+        trees = {}              # request_id -> (tmp_id, sibling_token)
         i = 0
         while i < len(self.running):
             req = self.running[i]
@@ -271,8 +277,38 @@ class Scheduler:
                 cap = min(spare,
                           req.max_new_tokens - len(req.output_ids) - 1)
                 if cap > 0:
-                    drafts = self.drafter.propose(req.all_ids, cap)
+                    drafts = self.drafter.propose(
+                        req.all_ids, cap, request_id=req.request_id)
+            tmp_id = sib = None
             try:
+                if drafts:
+                    sib = (self.drafter.sibling_token(req.request_id)
+                           if hasattr(self.drafter, "sibling_token")
+                           else None)
+                if sib is not None and spare - len(drafts) >= 2 \
+                        and not self.waiting \
+                        and not req.uses_pipeline \
+                        and len(self.running) + len(trees) \
+                        < self.max_batch:
+                    # tree branch: fork BEFORE the parent's own
+                    # reservation, so the 2-token sibling row COWs off
+                    # the shared partial tail and the parent appends on
+                    # a now-private chain — the two writes of position
+                    # T-1 land on different pages.  Row-count gate: the
+                    # descriptor batch is FIXED at max_batch rows, and
+                    # with no admissions pending, decode + chunk rows
+                    # are bounded by len(running).
+                    tmp_id = (req.request_id, "tree")
+                    try:
+                        bm.fork(req.request_id, tmp_id)
+                        _s, tcws = bm.append_slots(tmp_id, 2)
+                        if tcws:
+                            cowmap[tmp_id] = tcws[0]
+                    except NoFreeBlocksError:
+                        if bm.has_seq(tmp_id):
+                            bm.free(tmp_id)
+                        cowmap.pop(tmp_id, None)
+                        tmp_id = None
                 if drafts:
                     try:
                         _slots, cws = bm.append_slots(
@@ -281,11 +317,18 @@ class Scheduler:
                             cowmap[req.request_id] = cws[0]
                     except NoFreeBlocksError:
                         drafts = []   # degrade to plain decode first
+                        if tmp_id is not None:
+                            bm.free(tmp_id)
+                            cowmap.pop(tmp_id, None)
+                            tmp_id = None
                 if not drafts:
                     _slot, cw = bm.append_slot(req.request_id)
                     if cw is not None:
                         cowmap[req.request_id] = cw
             except NoFreeBlocksError as e:
+                if tmp_id is not None:
+                    bm.free(tmp_id)
+                    cowmap.pop(tmp_id, None)
                 victim = self.running[-1]
                 if victim is req and len(self.running) == 1 and \
                         not getattr(e, "injected", False):
@@ -302,6 +345,9 @@ class Scheduler:
                 continue        # retry req (or fall off the end)
             req.draft_tokens = drafts
             spare -= len(drafts)
+            if tmp_id is not None:
+                trees[req.request_id] = (tmp_id, sib)
+                spare -= 2      # the sibling row's two query tokens
             decodes.append(req)
             i += 1
         budget = spare
@@ -372,13 +418,20 @@ class Scheduler:
             chunks.append(PrefillChunk(req, req.num_cached, c))
             budget -= c
 
-        rows = [RaggedRow(r, "verify" if r.draft_tokens else "decode",
-                          r.num_cached, 1 + len(r.draft_tokens))
-                for r in decodes]
+        rows = []
+        for r in decodes:
+            rows.append(RaggedRow(
+                r, "verify" if r.draft_tokens else "decode",
+                r.num_cached, 1 + len(r.draft_tokens)))
+            if r.request_id in trees:
+                tmp_id, sib = trees[r.request_id]
+                rows.append(RaggedRow(r, "tree", r.num_cached, 2,
+                                      table_id=tmp_id, sibling=sib))
         rows += [RaggedRow(ch.request, "chunk", ch.start, ch.length,
                            chunk=ch) for ch in chunks]
         cows = [cowmap[r.request_id] for r in decodes
                 if r.request_id in cowmap]
+        cows += [cowmap[t] for t, _sib in trees.values() if t in cowmap]
         if chunks:
             return ScheduledBatch("mixed", decodes, chunks, rows,
                                   cows=cows)

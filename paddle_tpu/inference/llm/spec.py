@@ -65,17 +65,35 @@ def rollback_draft_reservation(block_manager, request):
 
 @dataclass
 class SpeculativeConfig:
-    """Knobs for n-gram speculative decoding.
+    """Knobs for speculative decoding.
 
     num_tokens: max draft length K per sequence per step (the verify
         executable family is bucketed over powers of two up to K).
     max_ngram / min_ngram: the drafter matches the longest suffix of the
         history between these lengths (longer matches first — a 3-gram
         hit is a stronger signal than a 1-gram hit).
+    method: "ngram" (model-free prompt lookup, the default), or
+        "draft-model" / "tree" — a tiny draft MODEL served through the
+        same engine: the target's first ``draft_layers`` transformer
+        blocks plus zero-padded identity blocks ride the SAME ragged
+        executable family against a second set of paged pools, drafted
+        greedily K deep.  "tree" additionally verifies the draft
+        model's second-best first token on a 2-token COW fork row, so
+        a first-position miss can still commit two tokens.  Both are
+        HYBRID: prompt-lookup hits are proposed first (they are free),
+        the model drafts only the misses — acceptance is therefore
+        never below the plain n-gram drafter's.
+    draft_layers: how many leading target layers the draft model keeps
+        (the rest are exact-identity zero blocks, so the draft shares
+        the target's executable, leaf shapes and compile census).
     """
     num_tokens: int = 4
     max_ngram: int = 3
     min_ngram: int = 1
+    method: str = "ngram"
+    draft_layers: int = 1
+
+    METHODS = ("ngram", "draft-model", "tree")
 
     def __post_init__(self):
         if self.num_tokens < 1:
@@ -84,21 +102,34 @@ class SpeculativeConfig:
             raise ValueError(
                 f"need 1 <= min_ngram <= max_ngram, got "
                 f"{self.min_ngram}..{self.max_ngram}")
+        if self.method not in self.METHODS:
+            raise ValueError(
+                f"speculative method must be one of {self.METHODS}, "
+                f"got {self.method!r}")
+        if self.draft_layers < 1:
+            raise ValueError("draft_layers must be >= 1")
+
+    @property
+    def uses_draft_model(self):
+        return self.method in ("draft-model", "tree")
 
     @classmethod
     def resolve(cls, spec):
-        """Engine-kwarg sugar: None | K | dict | SpeculativeConfig."""
+        """Engine-kwarg sugar: None | K | method str | dict |
+        SpeculativeConfig."""
         if spec is None or isinstance(spec, cls):
             return spec
         if isinstance(spec, bool):      # speculative=True: defaults
             return cls() if spec else None
         if isinstance(spec, int):
             return cls(num_tokens=spec)
+        if isinstance(spec, str):
+            return cls(method=spec)
         if isinstance(spec, dict):
             return cls(**spec)
         raise TypeError(
-            f"speculative= takes None/bool/int/dict/SpeculativeConfig, "
-            f"got {type(spec).__name__}")
+            f"speculative= takes None/bool/int/str/dict/"
+            f"SpeculativeConfig, got {type(spec).__name__}")
 
 
 class NgramDrafter:
@@ -113,10 +144,12 @@ class NgramDrafter:
     def __init__(self, config):
         self.config = config
 
-    def propose(self, token_ids, max_tokens):
+    def propose(self, token_ids, max_tokens, request_id=None):
         """Draft up to ``max_tokens`` next tokens for ``token_ids``
         (prompt + output so far).  Returns [] when no n-gram of length
-        min_ngram..max_ngram recurs, or when the budget is 0."""
+        min_ngram..max_ngram recurs, or when the budget is 0.
+        ``request_id`` is accepted for drafter-protocol uniformity
+        (the model-based drafter keys its per-request cache by it)."""
         cfg = self.config
         n_hist = len(token_ids)
         max_tokens = min(int(max_tokens), cfg.num_tokens)
@@ -133,3 +166,67 @@ class NgramDrafter:
                     if cont:
                         return list(cont)
         return []
+
+
+class DraftModelDrafter:
+    """Model-based drafting through the serving engine itself.
+
+    The drafter half is pure host state: per-request model proposals
+    (and, for ``method="tree"``, the second-best first-round token)
+    filled by the engine's batched draft phase each step — the engine
+    owns the draft params/pools and issues the launches, this object
+    owns the books.  ``propose`` is HYBRID: a prompt-lookup hit is
+    returned first (a free draft the model could only tie), so
+    acceptance is bounded below by the plain :class:`NgramDrafter`.
+
+    ``history`` maps request id -> the token list the DRAFT paged pool
+    currently encodes (real tokens plus greedily-fed drafts).  The
+    valid draft-KV prefix of a sequence is the longest common prefix
+    of its history entry and its real ``all_ids`` — K/V at position p
+    depends on tokens [0, p] only, so everything past the first
+    divergence is stale and the engine's catch-up chunk re-feeds it.
+    """
+
+    def __init__(self, config):
+        self.config = config
+        self._ngram = NgramDrafter(config)
+        self.proposals = {}     # rid -> model-drafted greedy chain
+        self.siblings = {}      # rid -> 2nd-best first token ("tree")
+        self.history = {}       # rid -> tokens encoded in the draft pool
+        # counters for spec_stats/bench: how many scheduled drafts came
+        # from the model vs the free n-gram path
+        self.model_drafts = 0
+        self.ngram_drafts = 0
+
+    def propose(self, token_ids, max_tokens, request_id=None):
+        """Scheduler hook: n-gram hit first, else this step's cached
+        model proposal (filled by the engine's draft phase).  A
+        returned n-gram draft drops the request's tree sibling — the
+        sibling is an alternative to the MODEL chain's first token and
+        must never pair with a lookup chain."""
+        ng = self._ngram.propose(token_ids, max_tokens)
+        if ng:
+            self.siblings.pop(request_id, None)
+            self.ngram_drafts += len(ng)
+            return ng
+        cap = min(int(max_tokens), self.config.num_tokens)
+        prop = self.proposals.get(request_id, [])[:max(cap, 0)]
+        if not prop:
+            self.siblings.pop(request_id, None)
+            return []
+        self.model_drafts += len(prop)
+        return list(prop)
+
+    def sibling_token(self, request_id):
+        """The tree-branch alternative for this request's first draft
+        position, or None (ngram chain, no model proposal, or
+        method="draft-model")."""
+        if self.config.method != "tree":
+            return None
+        return self.siblings.get(request_id)
+
+    def forget(self, request_id):
+        """Drop all per-request state (finished/aborted/released)."""
+        self.proposals.pop(request_id, None)
+        self.siblings.pop(request_id, None)
+        self.history.pop(request_id, None)

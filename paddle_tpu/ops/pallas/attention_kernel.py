@@ -326,7 +326,7 @@ def _tuned_blocks(q, k, causal, scale, interpret):
         # pays both
         grads = _flash_bwd(qq, kk, vv, out, lse, out, causal, scale,
                            bq, bk, interpret)
-        jax.block_until_ready((out, grads))
+        jax.block_until_ready((out, grads))  # noqa: H001 (autotune timing sync — measurement, not a serving path)
 
     return autotune.pick(
         "flash_attention",

@@ -66,10 +66,13 @@ def _busy_scenario(eng):
 # schema shape
 # ----------------------------------------------------------------------
 def test_schema_is_versioned_and_named():
-    assert SCHEMA_VERSION == 3       # v3 added the adapter kinds
+    assert SCHEMA_VERSION == 4       # v4 added the lookahead kinds
     assert "fork" in ENGINE_EVENT_FIELDS
     assert "adapter_register" in ENGINE_EVENT_FIELDS
     assert "adapter_load" in ENGINE_EVENT_FIELDS
+    assert ENGINE_EVENT_FIELDS["step_staged"] == ("rows",)
+    assert ENGINE_EVENT_FIELDS["draft_model_load"] == \
+        ("layers", "pages")
     assert set(EVENT_FIELDS) == \
         set(ENGINE_EVENT_FIELDS) | set(FLEET_EVENT_FIELDS)
     # the two shared kinds carry identical fields at both levels
@@ -92,20 +95,29 @@ def test_records_carry_named_fields():
                        (4, "finish", 7, "stop"),
                        (5, "migrate", 7, 0, 1, 4),
                        (6, "fork", 7, "7.1"),
-                       (7, "adapter_load", "tenant-a", 3)])
-    assert recs[0] == {"schema_version": 3, "step": 3, "kind": "add",
+                       (7, "adapter_load", "tenant-a", 3),
+                       (8, "step_staged", 3),
+                       (-1, "draft_model_load", 1, 24)])
+    assert recs[0] == {"schema_version": 4, "step": 3, "kind": "add",
                        "request_id": 7}
     assert recs[1]["reason"] == "stop"
-    assert recs[2] == {"schema_version": 3, "step": 5,
+    assert recs[2] == {"schema_version": 4, "step": 5,
                        "kind": "migrate", "request_id": 7, "src": 0,
                        "dst": 1, "pages": 4}
     # fork child ids are strings ("<parent>.<k>") — legal per the
     # int/str/None wall-clock-free rule
-    assert recs[3] == {"schema_version": 3, "step": 6, "kind": "fork",
+    assert recs[3] == {"schema_version": 4, "step": 6, "kind": "fork",
                        "request_id": 7, "child_id": "7.1"}
-    assert recs[4] == {"schema_version": 3, "step": 7,
+    assert recs[4] == {"schema_version": 4, "step": 7,
                        "kind": "adapter_load", "adapter_id": "tenant-a",
                        "slot": 3}
+    # v4 lookahead kinds: a staged step-N+1 plan (row count only —
+    # wall-clock-free) and the one-shot draft-model bring-up
+    assert recs[5] == {"schema_version": 4, "step": 8,
+                       "kind": "step_staged", "rows": 3}
+    assert recs[6] == {"schema_version": 4, "step": -1,
+                       "kind": "draft_model_load", "layers": 1,
+                       "pages": 24}
     assert_wall_clock_free(recs)
 
 
@@ -134,6 +146,40 @@ def test_engine_log_fits_schema_and_replays_identically():
         assert "shed" in kinds or "preempt" in kinds
         logs.append(recs)
     assert logs[0] == logs[1]
+
+
+def test_lookahead_and_draft_model_events_fit_schema():
+    """The v4 kinds fire from live engines and fit the frozen schema:
+    a lookahead engine logs step_staged rows (int counts, no wall
+    clock), and a draft-model engine logs its one-shot bring-up."""
+    from paddle_tpu.inference.llm import LLMEngine
+
+    m = _make_model()
+    eng = LLMEngine(m, block_size=8, max_batch=2, max_model_len=64,
+                    token_budget=16, lookahead=True)
+    rng = np.random.RandomState(2)
+    for _ in range(2):
+        eng.add_request(rng.randint(0, 128, (6,)).astype(np.int32),
+                        max_new_tokens=8)
+    for _ in range(64):
+        eng.step()
+        if not eng.has_unfinished():
+            break
+    recs = to_records(eng.events)
+    assert_wall_clock_free(recs)
+    staged = [r for r in recs if r["kind"] == "step_staged"]
+    assert staged and all(isinstance(r["rows"], int) and r["rows"] >= 1
+                          for r in staged)
+
+    dm = LLMEngine(m, block_size=8, max_batch=2, max_model_len=64,
+                   token_budget=16,
+                   speculative={"method": "draft-model",
+                                "draft_layers": 1})
+    recs = to_records(dm.events)
+    assert_wall_clock_free(recs)
+    loads = [r for r in recs if r["kind"] == "draft_model_load"]
+    assert len(loads) == 1 and loads[0]["layers"] == 1
+    assert loads[0]["pages"] == dm.num_blocks
 
 
 def test_fleet_log_fits_schema_and_replays_identically():

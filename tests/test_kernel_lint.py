@@ -345,6 +345,56 @@ class TestCleanSweeps:
 
 
 # ---------------------------------------------------------------------------
+class TestHostSyncPipeline:
+    """H001's explicit-sync extension: ``jax.device_get`` /
+    ``jax.block_until_ready`` are host syncs BY DEFINITION, flagged
+    without taint analysis — the name-taint pass cannot see device
+    state carried on ``self``, which is exactly how an accidental sync
+    would hide inside the async lookahead engine's pipelined step path
+    and stall the window the stager works to fill."""
+
+    def test_seeded_untagged_sync_in_step_path_fires(self, tmp_path):
+        bad = tmp_path / "engine_like.py"
+        bad.write_text(
+            "import jax\n"
+            "class Eng:\n"
+            "    def _launch_packed(self, rows):\n"
+            "        out = self._ragged(rows)\n"
+            "        jax.block_until_ready(out)\n"     # the bug
+            "        host = jax.device_get(self._kc)\n"  # and again
+            "        return host\n")
+        fs = A.check_host_sync([str(bad)])
+        cats = [f.category for f in fs]
+        assert cats.count("explicit-sync") == 2, \
+            [f.format() for f in fs]
+
+    def test_tagged_sync_is_allowlisted_per_line(self, tmp_path):
+        ok = tmp_path / "engine_like.py"
+        ok.write_text(
+            "import jax\n"
+            "class Eng:\n"
+            "    def warmup(self):\n"
+            "        jax.block_until_ready(self._kc)"
+            "  # noqa: H001 (warmup timing)\n"
+            "        jax.device_get(self._kc)\n")       # still a bug
+        fs = A.check_host_sync([str(ok)])
+        assert [f.category for f in fs] == ["explicit-sync"]
+        assert fs[0].where.endswith(":5")
+
+    def test_serving_tree_is_clean_and_rule_is_live(self):
+        """The shipped ops + inference/llm trees carry no untagged
+        explicit sync — and the rule is NOT vacuous: the engine's
+        known-legitimate sync sites (warmup timing, page migration)
+        are seen and annotated, with the one blocking pull inside the
+        pipelined step path tagged as the single intended sync."""
+        assert A.check_host_sync() == []
+        sites = [s for s in A.collect_host_sync_sites()
+                 if s.category == "explicit-sync"]
+        assert sites and all(s.allowed for s in sites)
+        assert any(s.path.endswith("engine.py") for s in sites)
+
+
+# ---------------------------------------------------------------------------
 class TestSupportsConsistency:
     """``supports()`` is the caller-facing gate; the verifier is the
     proof.  The gate must never admit a shape the proof rejects with an
