@@ -504,14 +504,15 @@ def main():
                          "hot-tenant fix; default off = historical "
                          "routing)")
     ap.add_argument("--lint", action="store_true",
-                    help="run the static cost census (graph-lint cost) "
-                         "AND the Pallas kernel verifier (graph-lint "
-                         "kernels, K001-K005) over the engine's warmup "
-                         "grid BEFORE the replay and embed both in the "
-                         "artifact — compile count, per-bucket "
-                         "FLOPs/HBM, memory model, M001/C001/B001 "
-                         "findings, per-kernel tiling/VMEM/bounds/race "
-                         "verdicts")
+                    help="run the static cost census (graph-lint cost), "
+                         "the Pallas kernel verifier (graph-lint "
+                         "kernels, K001-K005) AND the concurrency lint "
+                         "(graph-lint threads, R001-R005) BEFORE the "
+                         "replay and embed all three in the artifact — "
+                         "compile count, per-bucket FLOPs/HBM, memory "
+                         "model, M001/C001/B001 findings, per-kernel "
+                         "tiling/VMEM/bounds/race verdicts, and the "
+                         "host loop's lock/epoch-discipline verdict")
     args = ap.parse_args()
     args._census = None
 
@@ -620,11 +621,24 @@ def _lint_census(args, eng):
                      for f in kfs],
         "clean": not any(f.severity == "error" for f in kfs),
     }
+    # the concurrency lint's verdict rides along too: an artifact that
+    # says "fast" must also say "the host loop it measured holds its
+    # lock/epoch discipline" (R001-R005 over the serving tree)
+    from paddle_tpu.framework.concurrency_lint import check_concurrency
+
+    tfs = check_concurrency()
+    doc["threads"] = {
+        "findings": [{"rule": f.rule, "severity": f.severity,
+                      "category": f.category, "where": f.where,
+                      "message": f.message} for f in tfs],
+        "clean": not any(f.severity == "error" for f in tfs),
+    }
     doc["clean"] = not any(
         f["severity"] == "error" for f in doc["findings"])
     print(f"lint: census {census.compile_count} executable(s), "
           f"{len(census.findings)} finding(s); kernels "
-          f"{len(kfs)} finding(s)", file=sys.stderr)
+          f"{len(kfs)} finding(s); threads {len(tfs)} finding(s)",
+          file=sys.stderr)
     args._census = doc
     return doc
 

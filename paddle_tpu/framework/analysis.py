@@ -1045,6 +1045,11 @@ def _cli_ops(ns):
     return check_host_sync(ns.paths or None)
 
 
+def _cli_threads(ns):
+    from .concurrency_lint import check_concurrency
+    return check_concurrency(ns.paths or None, rules=ns.rules)
+
+
 def _cli_fn(ns):
     import importlib
     mod_name, _, attr = ns.target.partition(":")
@@ -1063,7 +1068,8 @@ def main(argv=None):
                     "serving engine's executable grid, imported static "
                     "programs, the op-kernel sources, and the Pallas "
                     "kernel registry "
-                    "(rules D001/S001/T001/G001/H001 + K001-K005 — "
+                    "(rules D001/S001/T001/G001/H001 + K001-K005 + "
+                    "the R001-R005 concurrency rules — "
                     "see docs/ANALYSIS.md)")
     ap.add_argument("--rules", default=None,
                     help="comma-separated rule ids (default: all)")
@@ -1142,6 +1148,17 @@ def main(argv=None):
                               "kernel sources")
     ops.add_argument("paths", nargs="*")
     ops.set_defaults(run=_cli_ops)
+
+    thr = sub.add_parser(
+        "threads", parents=[common],
+        help="concurrency lint over the serving tree: lock "
+             "discipline, lock order, blocking-while-locked, "
+             "lookahead epoch discipline, stale suppressions "
+             "(rules R001-R005, framework/concurrency_lint.py)")
+    thr.add_argument("paths", nargs="*",
+                     help="files/dirs to sweep (default: "
+                          "inference/llm, framework, sim)")
+    thr.set_defaults(run=_cli_threads)
 
     fn = sub.add_parser("fn", parents=[common],
                         help="lint an importable (jitted) "

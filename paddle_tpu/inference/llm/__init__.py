@@ -42,6 +42,11 @@ The serving-shaped subsystem over the round-4 ragged decode kernel:
                   einsum beside each block GEMM; host-LRU slot
                   load/evict with zero recompiles, slot 0 the exact
                   base-model identity
+- interleave:     InterleavingScheduler — seeded deterministic
+                  cooperative-checkpoint scheduler that drives the
+                  AsyncLLMEngine / Fleet threads through adversarial
+                  interleavings, replayable from its seed (the runtime
+                  half of framework/concurrency_lint.py's R-rules)
 - events:         the frozen, versioned event-log record schema
                   (named fields per kind, wall-clock-free by
                   construction) shared by engines, fleets and the
@@ -112,6 +117,11 @@ from .fleet import (  # noqa: F401
     Replica,
     Router,
 )
+from .interleave import (  # noqa: F401
+    InterleavingScheduler,
+    interleave_point,
+    interleave_wait,
+)
 from .faults import (  # noqa: F401
     Fault,
     FaultInjector,
@@ -159,6 +169,7 @@ __all__ = ["BlockManager", "NoFreeBlocksError", "hash_block_tokens",
            "DraftModelDrafter", "NgramDrafter", "SpeculativeConfig",
            "rollback_draft_reservation",
            "Fleet", "HealthConfig", "MigrationPolicy", "Replica", "Router",
+           "InterleavingScheduler", "interleave_point", "interleave_wait",
            "Fault", "FaultInjector", "FinishReason", "InjectedFault",
            "MigrationError", "PoolLostError", "RetryPolicy", "StepWatchdog",
            "EVENT_FIELDS", "SCHEMA_VERSION", "assert_wall_clock_free",

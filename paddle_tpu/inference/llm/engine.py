@@ -78,6 +78,7 @@ from .faults import (
     RetryPolicy,
     StepWatchdog,
 )
+from .interleave import interleave_point, interleave_wait, masked
 from .lora import AdapterManager, LoRAConfig, init_adapter_pools, lora_key
 from .paged_attention import (
     paged_ragged_attention,
@@ -286,6 +287,11 @@ class LLMEngine:
         # wall serving keeps perf_counter / time.sleep
         self._timer = clock if clock is not None else time.perf_counter
         self._sleep = getattr(clock, "sleep", time.sleep)
+        if self.faults is not None:
+            # injected "delay" faults stall on the same clock the
+            # watchdog measures with — virtual delays trip a virtual
+            # watchdog without any wall waiting
+            self.faults.sleep = self._sleep
         self.watchdog = (StepWatchdog(step_timeout_s, clock=self._timer)
                          if step_timeout_s is not None else None)
         self._early = []         # outputs finished without a device step
@@ -355,6 +361,12 @@ class LLMEngine:
         self._staged_epoch = -1
         self._plan_epoch = 0         # bumped by every plan-invalidating
                                      # lifecycle mutation
+        # timing gauges are read cross-thread (Fleet._beat health checks,
+        # fleet lifecycle_stats) while the stepping thread writes them, so
+        # they get their own leaf lock; everything else in the engine stays
+        # single-threaded by the AsyncLLMEngine contract.  Never block or
+        # take another lock while holding it (R002/R003).
+        self._gauge_lock = threading.Lock()
         self._host_plan_s = 0.0      # critical-path schedule+pack time
         self._step_wall_s = 0.0      # total step() wall time
         self._launch_count = 0
@@ -950,6 +962,7 @@ class LLMEngine:
                     repetition_penalty=1.0, presence_penalty=0.0,
                     frequency_penalty=0.0, logit_bias=None, logprobs=0,
                     stop=None, grammar=None, n=1, adapter_id=None):
+        interleave_point("add")
         prompt = [int(t) for t in np.asarray(prompt_ids).reshape(-1)]  # noqa: H001 (host request boundary)
         if not prompt:
             raise ValueError("empty prompt")
@@ -1068,6 +1081,7 @@ class LLMEngine:
         tokens were already emitted) is delivered by the next step().
         Returns True if the request existed and was aborted, False if
         it was unknown or already finished."""
+        interleave_point("abort")
         req = self._requests.get(request_id)
         if req is None or req.status == FINISHED:
             return False
@@ -1153,6 +1167,10 @@ class LLMEngine:
         the one wall-clock value here, and it never enters ``events``,
         so seed replays still produce identical logs)."""
         s = self.stats
+        with self._gauge_lock:
+            last_step_ms = self._last_step_ms
+            host_plan_s = self._host_plan_s
+            step_wall_s = self._step_wall_s
         return {"aborted": s["aborted"],
                 "deadline_missed": s["deadline_missed"],
                 "shed": s["shed"], "retries": s["retries"],
@@ -1164,7 +1182,7 @@ class LLMEngine:
                 "queue_depth": self.scheduler.queue_depth(),
                 "inflight": len(self.scheduler.running),
                 "free_pages": self.block_manager.num_free_blocks,
-                "last_step_ms": self._last_step_ms,
+                "last_step_ms": last_step_ms,
                 # async lookahead gauges: staged/claimed plan counts
                 # and the measured fraction of step wall time the host
                 # spends planning+packing ON the critical path (plans
@@ -1173,10 +1191,10 @@ class LLMEngine:
                 # Wall-clock floats live HERE, never in events.
                 "staged_steps": s["staged_steps"],
                 "staged_hits": s["staged_hits"],
-                "host_plan_s": self._host_plan_s,
+                "host_plan_s": host_plan_s,
                 "host_overhead_fraction": (
-                    self._host_plan_s / self._step_wall_s
-                    if self._step_wall_s > 0 else None),
+                    host_plan_s / step_wall_s
+                    if step_wall_s > 0 else None),
                 # per-step cumulative counter trajectory (empty unless
                 # record_step_gauges=True; see _record_step_gauges)
                 "step_gauges": self.step_gauges}
@@ -1331,10 +1349,12 @@ class LLMEngine:
             # iteration (schedule + launches + commit) on the injected
             # timer, kept OUT of the deterministic event log
             dt = self._timer() - t0
-            self._step_wall_s += dt
-            self._last_step_ms = dt * 1e3
+            with self._gauge_lock:
+                self._step_wall_s += dt
+                self._last_step_ms = dt * 1e3
 
     def _step_impl(self):
+        interleave_point("step")
         self._step_index += 1
         self.last_launches = []
         if self.faults is not None:
@@ -1364,7 +1384,8 @@ class LLMEngine:
                     (self._step_index, "preempt",
                      self.scheduler.num_preemptions - pre_preempt))
             if batch.kind == "idle":
-                self._host_plan_s += self._timer() - t0
+                with self._gauge_lock:
+                    self._host_plan_s += self._timer() - t0
                 self._record_step_gauges()
                 return finished
             self.stats["steps"] += 1
@@ -1541,6 +1562,7 @@ class LLMEngine:
         the rebuilt leaves go back with their pool shardings, and the
         qkv B half is permuted to the head-blocked column layout the
         base qkv weight was loaded in."""
+        interleave_point("adapter-load")
         blocks = dict(self.params["blocks"])
         for key, (a_h, b_h) in weights.items():
             if key == "attn.qkv.weight" and self._qkv_perm is not None:
@@ -1745,7 +1767,8 @@ class LLMEngine:
                 if row.request.status != FINISHED]
         if not rows:
             if t_sched is not None:
-                self._host_plan_s += self._timer() - t_sched
+                with self._gauge_lock:
+                    self._host_plan_s += self._timer() - t_sched
             return
         has_decode = any(row.kind != "chunk" for row in rows)
         has_chunk = any(row.kind == "chunk" for row in rows)
@@ -1759,7 +1782,8 @@ class LLMEngine:
             self.stats["mixed_steps"] += 1
         pk = self._pack_ragged(rows, batch.cows)
         if t_sched is not None:
-            self._host_plan_s += self._timer() - t_sched
+            with self._gauge_lock:
+                self._host_plan_s += self._timer() - t_sched
         self._launch_packed(rows, pk, finished)
 
     def _pack_ragged(self, rows, cows):
@@ -1924,6 +1948,9 @@ class LLMEngine:
         # and pack step N+1 here so that host work runs entirely under
         # step N's device window.
         self._stage_next(rows)
+        # adversarial window: a staged plan exists but is not yet
+        # claimed — exactly where stage-vs-abort races live
+        interleave_point("staged")
         nxt = np.asarray(nxt)  # noqa: H001 (the one host pull per step)
         row_logits = self._fetch_sampling_rows(rows, starts, logits)
 
@@ -2100,7 +2127,8 @@ class LLMEngine:
                     row.request.all_ids[-1]
             return plan_rows, pk
         finally:
-            self._host_plan_s += self._timer() - t0
+            with self._gauge_lock:
+                self._host_plan_s += self._timer() - t0
 
     def _discard_staged(self, plan_rows):
         """Roll back the staged slot claims exactly — one slot per
@@ -2214,16 +2242,16 @@ class LLMEngine:
                     for i, (_r, fin) in enumerate(meta) if fin]
             lg = None
             if want_sib and done:
-                lg = np.asarray(logits[np.asarray(  # noqa: H001 (draft logits rows for the tree sibling, by design)
+                lg = np.asarray(logits[np.asarray(  # draft logits rows for the tree sibling, by design
                     [p for _i, p in done], np.int32)])
             for k, (i, p) in enumerate(done):
                 r = meta[i][0]
-                g0 = int(nxt[p])  # noqa: H001 (host argmax, already fetched)
+                g0 = int(nxt[p])  # host argmax, already fetched
                 chains[r.request_id] = [g0]
                 if lg is not None:
                     row = np.array(lg[k], np.float64)
                     row[g0] = -np.inf
-                    dr.siblings[r.request_id] = int(np.argmax(row))  # noqa: H001 (host math on fetched row)
+                    dr.siblings[r.request_id] = int(np.argmax(row))  # host math on fetched row
         # -- greedy chain: K-1 batched one-token decode launches
         act = [(r, cap) for r, cap, _lcp, _H in feeds
                if chains.get(r.request_id)]
@@ -2246,7 +2274,7 @@ class LLMEngine:
                 break
             nxt, _logits, starts = self._draft_launch(entries)
             for i, (r, _cap) in enumerate(kept):
-                chains[r.request_id].append(int(nxt[starts[i]]))  # noqa: H001 (host argmax, already fetched)
+                chains[r.request_id].append(int(nxt[starts[i]]))  # host argmax, already fetched
             act = kept
         # the last chain token was predicted but never FED, so the
         # history (what the draft pool encodes) excludes it
@@ -2467,7 +2495,7 @@ class LLMEngine:
                 req.logprobs_content.append(
                     top_logprobs(logits, req.logprobs, tok))
             if req._constraint is not None:
-                req._constraint.advance(tok)  # noqa: H001 (intentional host grammar-state advance)
+                req._constraint.advance(tok)  # intentional host grammar-state advance
             if self._check_stop(req) is not None:
                 self._finish(req, "stop", finished)
             elif (req.eos_token_id is not None
@@ -2525,7 +2553,7 @@ class LLMEngine:
                 # j's mask was packed from the state after drafts[:j],
                 # which is exactly the path walked so far), so the
                 # transition always exists
-                req._constraint.advance(tok)  # noqa: H001 (intentional host grammar-state advance)
+                req._constraint.advance(tok)  # intentional host grammar-state advance
             matched = j < d and tok == drafts[j]
             if matched:
                 self.stats["accepted_tokens"] += 1
@@ -2549,7 +2577,7 @@ class LLMEngine:
                     if req.temperature > 0.0:
                         tok2 = self._sample_token(req, sib_logits[1])
                     else:
-                        tok2 = int(sib_argmax[1])  # noqa: H001 (host row, already fetched)
+                        tok2 = int(sib_argmax[1])  # host row, already fetched
                     req.output_ids.append(tok2)
                     emitted += 1
                     self.stats["tokens_generated"] += 1
@@ -2729,15 +2757,24 @@ class AsyncLLMEngine:
     thread is how a "drained" replica keeps touching the device.
     """
 
+    _worker_seq = 0     # deterministic worker thread names (interleave)
+
     def __init__(self, engine):
         self.engine = engine
+        # drain deadlines ride the ENGINE's injected clock, so a
+        # VirtualClock simulation drains in virtual seconds (satellite
+        # of the clock-injection audit: no raw time.monotonic here)
+        self._clock = getattr(engine, "_clock", time.monotonic)
         self._cond = threading.Condition()
         self._results = {}          # request_id -> RequestOutput
         self._aborts = set()        # rids to cancel, applied by the loop
         self._abandoned = set()     # rids whose caller gave up (timeout)
         self._draining = False
         self._stopped = False
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        AsyncLLMEngine._worker_seq += 1
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"llm-async-worker-{AsyncLLMEngine._worker_seq}")
         self._thread.start()
 
     def _loop(self):
@@ -2745,12 +2782,13 @@ class AsyncLLMEngine:
             with self._cond:
                 while not self._stopped and not self._aborts and \
                         not self.engine.has_unfinished():
-                    self._cond.wait(timeout=0.5)
+                    interleave_wait(self._cond, 0.5)
                 if self._stopped:
                     break
                 aborts, self._aborts = self._aborts, set()
             # engine state is touched ONLY on this thread: queued
             # aborts apply here, between device calls
+            interleave_point("loop")
             for rid in aborts:
                 self.engine.abort_request(rid)
             finished = self.engine.step()    # device call: lock NOT held
@@ -2780,10 +2818,14 @@ class AsyncLLMEngine:
             self._cond.notify_all()
 
     def submit(self, prompt_ids, **kwargs):
+        interleave_point("submit")
         with self._cond:
             if self._stopped:
                 raise RuntimeError("engine stopped")
-            rid = self.engine.add_request(prompt_ids, **kwargs)
+            # masked: points inside add_request must not deschedule a
+            # thread that HOLDS _cond (token-vs-lock deadlock)
+            with masked():
+                rid = self.engine.add_request(prompt_ids, **kwargs)
             self._cond.notify_all()
             return rid
 
@@ -2791,6 +2833,7 @@ class AsyncLLMEngine:
         """Queue a cancel for ``request_id``; the stepping thread
         applies it before its next device call and the aborted output
         (FinishReason.aborted) arrives like any other result."""
+        interleave_point("abort-queue")
         with self._cond:
             self._aborts.add(request_id)
             self._cond.notify_all()
@@ -2801,9 +2844,19 @@ class AsyncLLMEngine:
         discarded) before TimeoutError is raised — an abandoned request
         never keeps generating."""
         with self._cond:
-            ok = self._cond.wait_for(
-                lambda: request_id in self._results or self._stopped,
-                timeout=timeout)
+            # explicit predicate loop (not wait_for): the wait chunks
+            # ride interleave_wait, so a blocked caller participates in
+            # a deterministic schedule, and the deadline rides the
+            # engine's injected clock
+            deadline = (None if timeout is None
+                        else self._clock() + float(timeout))
+            while not (request_id in self._results or self._stopped):
+                if deadline is not None and self._clock() >= deadline:
+                    break
+                chunk = 0.1 if deadline is None else \
+                    max(0.0, min(0.1, deadline - self._clock()))
+                interleave_wait(self._cond, chunk)
+            ok = request_id in self._results or self._stopped
             if not ok:
                 self._abandoned.add(request_id)
                 self._aborts.add(request_id)
@@ -2840,7 +2893,7 @@ class AsyncLLMEngine:
             self.engine._draining = True
             self._cond.notify_all()
         deadline = (None if timeout_s is None
-                    else time.monotonic() + float(timeout_s))
+                    else self._clock() + float(timeout_s))
         try:
             with self._cond:
                 while not self._stopped:
@@ -2848,14 +2901,14 @@ class AsyncLLMEngine:
                             not self.engine.has_unfinished():
                         break
                     if deadline is not None and \
-                            time.monotonic() >= deadline:
+                            self._clock() >= deadline:
                         deadline = None     # abort once, then wait
                         for rid in list(getattr(self.engine,
                                                 "_requests", ())):
                             self._aborts.add(rid)
                         self._cond.notify_all()
                         continue
-                    self._cond.wait(timeout=0.02)
+                    interleave_wait(self._cond, 0.02)
         finally:
             with self._cond:
                 self.engine._draining = False

@@ -172,6 +172,10 @@ class FaultInjector:
 
     def __init__(self, schedule=(), seed=0):
         self.seed = int(seed)
+        # "delay" step faults stall via this; the owning engine rebinds
+        # it to ITS injected clock's sleep (see LLMEngine.__init__), so
+        # a VirtualClock run pays virtual — not wall — seconds
+        self.sleep = time.sleep
         self.schedule = list(schedule)
         for f in self.schedule:
             if f.site not in ("step", "alloc", "socket", "client",
@@ -289,7 +293,7 @@ class FaultInjector:
                 if attempt == 0:
                     self._attempts[key] = 1
                     self.events.append((self._step, "step", "delay", 0))
-                    time.sleep(f.delay_s)
+                    self.sleep(f.delay_s)
                 continue
             if f.kind == "transient" and attempt >= f.count:
                 continue        # absorbed: this attempt succeeds

@@ -97,6 +97,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from .engine import LLMEngine, RequestOutput
+from .interleave import interleave_point
 from .faults import FinishReason, MigrationError
 from .scheduler import RUNNING
 
@@ -657,6 +658,7 @@ class Fleet:
         drained.
         Returns the finished RequestOutputs (fleet-shed and failover
         casualties included)."""
+        interleave_point("fleet-step")
         self._step_index += 1
         if self.faults is not None:
             self.faults.begin_step(self._step_index)
@@ -753,10 +755,13 @@ class Fleet:
             wd = r.engine.watchdog
             if wd is not None and wd.num_wedged > r._last_wedged:
                 miss = "wedged"
-            elif self.health.slow_step_ms is not None and \
-                    (r.engine._last_step_ms or 0.0) \
-                    > self.health.slow_step_ms:
-                miss = "slow"
+            elif self.health.slow_step_ms is not None:
+                # the gauge is written by the replica's stepping thread
+                # (parallel_step) — read it under the engine's gauge lock
+                with r.engine._gauge_lock:
+                    last_ms = r.engine._last_step_ms
+                if (last_ms or 0.0) > self.health.slow_step_ms:
+                    miss = "slow"
         if r.engine.watchdog is not None:
             r._last_wedged = r.engine.watchdog.num_wedged
         if miss is not None:
@@ -1165,7 +1170,10 @@ class Fleet:
         agg["last_step_ms"] = slowest
         # a ratio can't be summed: rebuild it from the fleet-wide
         # numerator (host_plan_s, summed above) over summed step wall
-        wall = sum(r.engine._step_wall_s for r in self.replicas)
+        wall = 0.0
+        for r in self.replicas:
+            with r.engine._gauge_lock:
+                wall += r.engine._step_wall_s
         agg["host_overhead_fraction"] = (
             agg.get("host_plan_s", 0.0) / wall if wall > 0 else None)
         agg["step_gauges"] = self.step_gauges

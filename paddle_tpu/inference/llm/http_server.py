@@ -169,7 +169,9 @@ class _Handler(BaseHTTPRequestHandler):
                 last = len(ids)
             if done is not None:
                 break
-            time.sleep(app.poll_interval)
+            # the backend's injected sleep (VirtualClock-aware), never
+            # a raw wall-clock stall inside the delta poll loop
+            getattr(app.backend, "_sleep", time.sleep)(app.poll_interval)
         outs = app.collect(rid, n)
         self._sse_event({
             "request_id": str(rid),

@@ -71,7 +71,11 @@ class Request:
     parent_id: object = None    # fork family root (None for the parent)
     fork_index: int = 0         # 0 for the parent, 1..n-1 for children
     adapter_id: object = None   # LoRA adapter (None: the base model)
-    arrival_time: float = field(default_factory=time.monotonic)
+    # stamped by the engine on ITS injected clock (add_request passes
+    # arrival_time=self._clock()); -1.0 = never stamped.  No wall-clock
+    # default factory — a Request built under VirtualClock must not mix
+    # time.monotonic into virtual seconds.
+    arrival_time: float = -1.0
     output_ids: list = field(default_factory=list)
     num_cached: int = 0         # tokens whose K/V sit in the paged cache
     num_prefill_tokens: int = 0  # prefill target (len(all_ids) at admission)

@@ -1,0 +1,553 @@
+"""Concurrency lint (R001-R005): seeded-bug battery + clean-tree gate.
+
+Each rule is proven on a purpose-built buggy module (exact rule id,
+category AND message), then on the annotated benign variant (guarded-by /
+noqa / noqa-module), so the grammar that keeps the shipped tree clean is
+itself under test.  The clean-tree sweep at the bottom is the tier-1 CI
+gate: ``graph-lint threads --strict`` over the real serving tree must
+exit 0.
+"""
+
+import json
+
+import pytest
+
+from paddle_tpu.framework.concurrency_lint import (
+    ALL_RULES, check_concurrency, default_paths)
+
+
+def _lint(tmp_path, source, rules=None, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(source)
+    return check_concurrency([str(p)], rules=rules)
+
+
+def _only(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ---------------------------------------------------------------------------
+class TestR001LockDiscipline:
+    BUGGY = """\
+import threading
+
+class Widget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count
+
+    def stomp(self):
+        self._count = 0
+"""
+
+    def test_unguarded_read_and_write(self, tmp_path):
+        fs = _only(_lint(tmp_path, self.BUGGY), "R001")
+        cats = sorted(f.category for f in fs)
+        assert cats == ["unguarded-read", "unguarded-write"]
+        for f in fs:
+            assert f.severity == "error"
+            assert "'_count' is guarded by ['_lock']" in f.message
+        read = next(f for f in fs if f.category == "unguarded-read")
+        assert "Widget.peek" in read.where
+
+    def test_guarded_by_line_contract(self, tmp_path):
+        src = self.BUGGY.replace(
+            "        return self._count",
+            "        return self._count  # guarded-by: _lock")
+        fs = _only(_lint(tmp_path, src), "R001")
+        assert [f.category for f in fs] == ["unguarded-write"]
+
+    def test_guarded_by_def_contract_covers_body(self, tmp_path):
+        src = self.BUGGY.replace(
+            "    def peek(self):",
+            "    def peek(self):  # guarded-by: _lock")
+        fs = _only(_lint(tmp_path, src), "R001")
+        assert [f.category for f in fs] == ["unguarded-write"]
+
+    def test_noqa_suppresses_with_reason(self, tmp_path):
+        src = self.BUGGY.replace(
+            "        self._count = 0",
+            "        self._count = 0  # noqa: R001 (quiescent reset)")
+        fs = _only(_lint(tmp_path, src), "R001")
+        assert [f.category for f in fs] == ["unguarded-read"]
+
+    def test_noqa_in_docstring_does_not_count(self, tmp_path):
+        src = self.BUGGY.replace(
+            "        return self._count",
+            '        "noqa: R001"\n        return self._count')
+        fs = _only(_lint(tmp_path, src), "R001")
+        assert sorted(f.category for f in fs) == \
+            ["unguarded-read", "unguarded-write"]
+
+    def test_cross_object_gauge_read(self, tmp_path):
+        # regression shape of the REAL finding this PR fixed: the fleet
+        # health loop reading an engine gauge without the engine's lock
+        src = """\
+import threading
+
+class Engine:
+    def __init__(self):
+        self._gauge_lock = threading.Lock()
+        self._last_step_ms = None
+
+    def step(self):
+        with self._gauge_lock:
+            self._last_step_ms = 1.0
+
+class Fleet:
+    def _beat(self, replica):
+        return replica.engine._last_step_ms
+"""
+        fs = _only(_lint(tmp_path, src), "R001")
+        assert len(fs) == 1
+        assert fs[0].category == "unguarded-read"
+        assert "Fleet._beat" in fs[0].where
+        assert "_last_step_ms" in fs[0].message
+
+    def test_lock_held_access_is_clean(self, tmp_path):
+        src = self.BUGGY.replace(
+            "    def peek(self):\n        return self._count",
+            "    def peek(self):\n        with self._lock:\n"
+            "            return self._count").replace(
+            "    def stomp(self):\n        self._count = 0",
+            "    def stomp(self):\n        with self._lock:\n"
+            "            self._count = 0")
+        assert _only(_lint(tmp_path, src), "R001") == []
+
+
+# ---------------------------------------------------------------------------
+class TestR002LockOrder:
+    def test_cycle_with_witness_path(self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def fwd(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def rev(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+        fs = _only(_lint(tmp_path, src), "R002")
+        assert len(fs) == 1
+        assert fs[0].category == "lock-cycle"
+        assert "_a -> _b -> _a" in fs[0].message \
+            or "_b -> _a -> _b" in fs[0].message
+
+    def test_self_reentrancy_on_plain_lock(self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def reenter(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+        fs = _only(_lint(tmp_path, src), "R002")
+        assert len(fs) == 1
+        assert fs[0].category == "self-reentrancy"
+        assert "self-deadlock" in fs[0].message
+
+    def test_rlock_reentrancy_allowed(self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def reenter(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+        assert _only(_lint(tmp_path, src), "R002") == []
+
+    def test_reentrancy_through_call_graph(self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            self.inner()
+
+    def inner(self):
+        with self._lock:
+            pass
+"""
+        fs = _only(_lint(tmp_path, src), "R002")
+        assert len(fs) == 1
+        assert fs[0].category == "self-reentrancy"
+        assert "'outer' holds non-reentrant lock '_lock' while " \
+               "calling 'inner'" in fs[0].message
+
+    def test_condition_default_is_reentrant(self, tmp_path):
+        # Condition() wraps an RLock; Condition(Lock()) does not
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._cv = threading.Condition()
+
+    def reenter(self):
+        with self._cv:
+            with self._cv:
+                pass
+
+class X:
+    def __init__(self):
+        self._cv2 = threading.Condition(threading.Lock())
+
+    def reenter2(self):
+        with self._cv2:
+            with self._cv2:
+                pass
+"""
+        fs = _only(_lint(tmp_path, src), "R002")
+        assert len(fs) == 1
+        assert "_cv2" in fs[0].message
+
+    def test_consistent_order_is_clean(self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+
+    def one(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def two(self):
+        with self._a:
+            with self._b:
+                pass
+"""
+        assert _only(_lint(tmp_path, src), "R002") == []
+
+
+# ---------------------------------------------------------------------------
+class TestR003BlockingWhileLocked:
+    def test_device_sync_and_sleep_under_lock(self, tmp_path):
+        src = """\
+import threading
+import time
+import jax
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def stall(self):
+        with self._lock:
+            x = jax.device_get(1)
+            time.sleep(0.1)
+            return x
+"""
+        fs = _only(_lint(tmp_path, src), "R003")
+        cats = sorted(f.category for f in fs)
+        assert cats == ["device-sync", "sleep"]
+        for f in fs:
+            assert "while holding ['_lock']" in f.message
+
+    def test_blocking_outside_lock_is_clean(self, tmp_path):
+        src = """\
+import threading
+import time
+import jax
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def ok(self):
+        with self._lock:
+            n = 1
+        time.sleep(0.1)
+        return jax.device_get(n)
+"""
+        assert _only(_lint(tmp_path, src), "R003") == []
+
+    def test_socket_and_queue_get(self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.sock = None
+        self.inbox = None
+
+    def recv_locked(self):
+        with self._lock:
+            return self.sock.recv(1024)
+
+    def pull_locked(self):
+        with self._lock:
+            return self.inbox.get()
+
+    def pull_bounded_ok(self):
+        with self._lock:
+            return self.inbox.get(timeout=0.1)
+"""
+        fs = _only(_lint(tmp_path, src), "R003")
+        cats = sorted(f.category for f in fs)
+        assert cats == ["queue-get", "socket"]
+
+    def test_wait_on_sole_held_condition_is_correct_cv_usage(
+            self, tmp_path):
+        src = """\
+import threading
+
+class W:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._other = threading.Lock()
+
+    def ok(self):
+        with self._cv:
+            self._cv.wait(timeout=0.1)
+
+    def bad(self):
+        with self._other:
+            with self._cv:
+                self._cv.wait(timeout=0.1)
+"""
+        fs = _only(_lint(tmp_path, src), "R003")
+        assert len(fs) == 1
+        assert fs[0].category == "cond-wait"
+        assert "W.bad" in fs[0].where
+
+
+# ---------------------------------------------------------------------------
+class TestR004EpochDiscipline:
+    BUGGY = """\
+class MiniEngine:
+    def __init__(self):
+        self.scheduler = None
+        self._plan_epoch = 0
+
+    def _invalidate_plan(self):
+        self._plan_epoch += 1
+
+    def add_request(self, r):
+        self.scheduler.add(r)
+        self._invalidate_plan()
+
+    def sneaky_abort(self, rid):
+        self.scheduler.abort(rid)
+"""
+
+    def test_missing_epoch_bump(self, tmp_path):
+        fs = _only(_lint(tmp_path, self.BUGGY), "R004")
+        assert len(fs) == 1
+        assert fs[0].category == "missing-epoch-bump"
+        assert "MiniEngine.sneaky_abort" in fs[0].where
+        assert "scheduler.abort" in fs[0].message
+        assert "_invalidate_plan" in fs[0].message
+
+    def test_bump_through_helper_is_clean(self, tmp_path):
+        src = self.BUGGY.replace(
+            "    def sneaky_abort(self, rid):\n"
+            "        self.scheduler.abort(rid)",
+            "    def sneaky_abort(self, rid):\n"
+            "        self.scheduler.abort(rid)\n"
+            "        self._finish(rid)\n\n"
+            "    def _finish(self, rid):\n"
+            "        self._invalidate_plan()")
+        assert _only(_lint(tmp_path, src), "R004") == []
+
+    def test_private_and_step_entries_exempt(self, tmp_path):
+        src = """\
+class MiniEngine:
+    def _invalidate_plan(self):
+        pass
+
+    def _internal(self, rid):
+        self.scheduler.abort(rid)
+
+    def step(self):
+        self.block_manager.free("x")
+"""
+        assert _only(_lint(tmp_path, src), "R004") == []
+
+    def test_classes_without_epoch_not_checked(self, tmp_path):
+        src = """\
+class PlainScheduler:
+    def abort(self, rid):
+        self.scheduler.abort(rid)
+"""
+        assert _only(_lint(tmp_path, src), "R004") == []
+
+    def test_block_manager_mutators_detected(self, tmp_path):
+        src = """\
+class MiniEngine:
+    def _invalidate_plan(self):
+        pass
+
+    def release(self, rid):
+        self.block_manager.free(rid)
+"""
+        fs = _only(_lint(tmp_path, src), "R004")
+        assert len(fs) == 1
+        assert "block_manager.free" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+class TestR005StaleSuppressions:
+    def test_stale_noqa_line_tag(self, tmp_path):
+        src = """\
+class W:
+    def quiet(self):
+        return 1  # noqa: R001 (nothing fires here any more)
+"""
+        fs = _only(_lint(tmp_path, src), "R005")
+        assert len(fs) == 1
+        assert fs[0].severity == "warning"
+        assert fs[0].category == "stale-noqa"
+        assert "R001 no longer fires at this line" in fs[0].message
+
+    def test_stale_noqa_module_tag(self, tmp_path):
+        src = """\
+# noqa-module: R003
+class W:
+    def quiet(self):
+        return 1
+"""
+        fs = _only(_lint(tmp_path, src), "R005")
+        assert len(fs) == 1
+        assert fs[0].category == "stale-noqa-module"
+        assert "fires nowhere in this module" in fs[0].message
+
+    def test_live_noqa_not_flagged(self, tmp_path):
+        src = """\
+import threading
+
+class Widget:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def peek(self):
+        return self._count  # noqa: R001 (snapshot read)
+"""
+        findings = _lint(tmp_path, src)
+        assert _only(findings, "R005") == []
+        assert _only(findings, "R001") == []
+
+    def test_stale_h001_tag(self, tmp_path):
+        # an H001 suppression where no host sync happens is stale too
+        src = """\
+def pure(x):
+    return x + 1  # noqa: H001 (this never synced anything)
+"""
+        fs = _only(_lint(tmp_path, src), "R005")
+        assert len(fs) == 1
+        assert "H001" in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+class TestEntryPointsAndCLI:
+    def test_default_paths_cover_serving_tree(self):
+        paths = default_paths()
+        tails = sorted(p.replace("\\", "/").rsplit("paddle_tpu/", 1)[-1]
+                       for p in paths)
+        assert tails == ["framework", "inference/llm", "sim"]
+
+    def test_rule_filter(self, tmp_path):
+        fs = _lint(tmp_path, TestR001LockDiscipline.BUGGY,
+                   rules=["R002"])
+        assert fs == []
+
+    def test_cli_threads_reports_and_exits_1(self, tmp_path, capsys):
+        from paddle_tpu.framework import analysis as A
+
+        p = tmp_path / "buggy.py"
+        p.write_text(TestR001LockDiscipline.BUGGY)
+        rc = A.main(["threads", str(p)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "R001" in out
+        assert "unguarded" in out
+
+    def test_cli_threads_json(self, tmp_path, capsys):
+        from paddle_tpu.framework import analysis as A
+
+        p = tmp_path / "buggy.py"
+        p.write_text(TestR001LockDiscipline.BUGGY)
+        rc = A.main(["threads", "--json", str(p)])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert doc["errors"] == 2
+        rules = {f["rule"] for f in doc["findings"]}
+        assert rules == {"R001"}
+
+    def test_cli_strict_fails_on_warnings(self, tmp_path, capsys):
+        from paddle_tpu.framework import analysis as A
+
+        p = tmp_path / "stale.py"
+        p.write_text("class W:\n"
+                     "    def quiet(self):\n"
+                     "        return 1  # noqa: R001 (stale)\n")
+        assert A.main(["threads", str(p)]) == 0      # warning only
+        capsys.readouterr()
+        assert A.main(["threads", "--strict", str(p)]) == 1
+
+    def test_parse_error_is_warning_not_crash(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def oops(:\n")
+        fs = check_concurrency([str(p)])
+        assert len(fs) == 1
+        assert fs[0].rule == "R000"
+        assert fs[0].category == "parse-error"
+
+
+# ---------------------------------------------------------------------------
+class TestCleanTreeGate:
+    """The tier-1 CI gate: the shipped serving tree sweeps clean."""
+
+    def test_shipped_tree_strict_clean(self, capsys):
+        from paddle_tpu.framework import analysis as A
+
+        rc = A.main(["threads", "--strict"])
+        out = capsys.readouterr().out
+        assert rc == 0, f"concurrency lint regressed:\n{out}"
+
+    def test_all_rules_ran(self):
+        # the clean sweep must actually be running every rule, not an
+        # accidentally-narrowed subset
+        assert ALL_RULES == ("R001", "R002", "R003", "R004", "R005")
+        findings = check_concurrency()
+        assert findings == [], "\n".join(
+            f.format() for f in findings)

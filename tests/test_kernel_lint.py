@@ -566,3 +566,10 @@ def test_bench_lint_artifact_embeds_kernel_sweep(tmp_path):
     assert kl["clean"] is True
     assert kl["findings"] == []
     assert "kernels" in rc.stderr  # stderr summary mentions the sweep
+    # the concurrency lint's verdict rides in the same artifact: the
+    # host loop the bench just measured holds its lock/epoch discipline
+    th = art["census"]["threads"]
+    assert th["clean"] is True
+    assert [f for f in th["findings"]
+            if f["severity"] == "error"] == []
+    assert "threads" in rc.stderr

@@ -16,6 +16,8 @@ Examples::
     python tools/graph_lint.py kernels --tp 2 --strict --profile tpu-v5e
     python tools/graph_lint.py program /path/to/export/inference
     python tools/graph_lint.py ops paddle_tpu/ops --strict
+    python tools/graph_lint.py threads --strict
+    python tools/graph_lint.py threads paddle_tpu/inference/llm --json
     python tools/graph_lint.py fn mypkg.mod:f --arg f32[4,8]
 
 Exit codes: 0 clean (warnings allowed), 1 any error-severity finding
