@@ -981,6 +981,7 @@ def _cli_build_engine(ns):
                      tensor_parallel=ns.tp if ns.tp > 1 else None,
                      speculative=ns.spec if ns.spec > 0 else None,
                      quantize=getattr(ns, "quantize", None),
+                     kv_tier=getattr(ns, "kv_tier", None),
                      # --lora N: N tenant adapters -> N+1 pool slots
                      # (slot 0 is the reserved base identity)
                      lora=(dict(rank=4,
@@ -1000,6 +1001,7 @@ def _cli_cost(ns):
     from .cost import run_census
     eng = _cli_build_engine(ns)
     census = run_census(eng, memory_budget=ns.memory_budget,
+                        host_budget=getattr(ns, "host_budget", None),
                         profile=ns.profile,
                         max_executables=ns.max_executables)
     doc = census.to_dict()
@@ -1024,6 +1026,13 @@ def _cli_cost(ns):
         if mem.get("memory_budget") is not None:
             line += (f"; budget {mem['memory_budget']} admits "
                      f"max_batch <= {mem.get('derived_max_batch', 0)}")
+        if mem.get("host_pool_bytes") or mem.get("prefix_store_bytes"):
+            line += (f"; host tier {mem['host_pool_bytes']} pool + "
+                     f"{mem['prefix_store_bytes']} store "
+                     f"({mem['host_page_bytes']}B/page)")
+            if mem.get("host_budget") is not None:
+                line += (f" under host budget {mem['host_budget']} "
+                         f"({mem.get('host_budget_pages', 0)} pages)")
         print(line)
     return census.findings
 
@@ -1119,6 +1128,14 @@ def main(argv=None):
     cost.add_argument("--memory-budget", default=None,
                       help="per-chip HBM budget for M001, bytes or "
                            "'16GiB'")
+    cost.add_argument("--host-budget", default=None,
+                      help="host-RAM ceiling for the hierarchical-KV "
+                           "tier (M001 names both budgets), bytes or "
+                           "'64GiB'")
+    cost.add_argument("--kv-tier", default=None,
+                      help="configure the engine's hierarchical KV "
+                           "tier: total byte budget ('128MiB'), split "
+                           "evenly between host pool and prefix store")
     cost.add_argument("--profile", default="tpu-v4",
                       help="roofline device profile: "
                            "tpu-v4 | tpu-v5e | cpu")

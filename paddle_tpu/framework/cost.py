@@ -586,12 +586,18 @@ def check_collectives(closed, label=""):
 # --------------------------------------------------------------------------
 # engine memory model (pages + weights -> admissible batch)
 # --------------------------------------------------------------------------
-def engine_memory_model(engine, memory_budget=None):
+def engine_memory_model(engine, memory_budget=None, host_budget=None):
     """Per-chip HBM model of a live LLMEngine: weight bytes (sharding-
     aware — leaves whose PartitionSpec names 'mp' divide by tp), paged
     K/V pool bytes, per-page and per-sequence bytes, and — when a
     budget is declared — the admissible ``max_batch`` the budget
-    supports (ROADMAP item 3's "pages + weights bound max_batch")."""
+    supports (ROADMAP item 3's "pages + weights bound max_batch").
+
+    The hierarchical-KV host tier (``kv_tier=``) is priced beside HBM:
+    the host pool and prefix store budgets, the GLOBAL per-page
+    payload they hold (``page_bytes * tp`` — a demoted chain carries
+    every shard's pages), and — when ``host_budget`` is declared — how
+    many tier pages that host-RAM budget admits."""
     tp = getattr(engine, "tp", 1)
 
     # params and _param_specs are dicts with the same key structure, so
@@ -653,6 +659,23 @@ def engine_memory_model(engine, memory_budget=None):
         "num_blocks": int(engine.num_blocks),
         "memory_budget": budget,
     }
+    # hierarchical KV (inference/llm/kv_tier.py): the host-RAM tier is
+    # a SECOND memory budget beside HBM — report its configured pool/
+    # store sizes in the same model so M001 (and any planner) sees
+    # both, plus what one tier page costs (global payload: every
+    # shard's slice of the page rides the demote)
+    tier = getattr(engine, "kv_tier", None)
+    host_page = int(page) * tp
+    model["host_pool_bytes"] = int(tier.host_bytes) if tier else 0
+    model["prefix_store_bytes"] = int(tier.store_bytes) if tier else 0
+    model["host_page_bytes"] = host_page
+    model["host_tier_pages"] = (
+        (model["host_pool_bytes"] + model["prefix_store_bytes"])
+        // host_page)
+    hb = parse_bytes(host_budget)
+    model["host_budget"] = hb
+    if hb is not None:
+        model["host_budget_pages"] = int(hb // host_page)
     if budget is not None:
         try:
             model["derived_max_batch"] = derive_max_batch(
@@ -817,6 +840,21 @@ class StepTimeModel:
         engine's ``last_launches``: [(kind, bucket), ...])."""
         return sum(self.step_seconds(k, b) for k, b in launches)
 
+    def tier_seconds(self, nbytes, link_bytes_per_s=None):
+        """Seconds to move ``nbytes`` of page payload over the
+        host-HBM link — the hierarchical-KV traffic a step reports as
+        ``last_tier_bytes`` (demotes, swap-ins, store promotes and
+        adopts).  Priced at the profile's ICI rate by default — the
+        same rate TierPolicy's swap-vs-recompute estimate uses, so the
+        simulator's clock and the policy's break-even agree."""
+        if not nbytes:
+            return 0.0
+        prof = (DEVICE_PROFILES[self.profile]
+                if isinstance(self.profile, str) else self.profile)
+        link = (float(link_bytes_per_s) if link_bytes_per_s
+                else prof["ici_bytes_per_s"])
+        return int(nbytes) / link
+
     def to_dict(self):
         return {
             "profile": (self.profile if isinstance(self.profile, str)
@@ -882,8 +920,8 @@ class Census:
         return json.dumps(self.to_dict(), **kw)
 
 
-def run_census(engine, *, memory_budget=None, profile="tpu-v4",
-               max_executables=64, loop_aware=True):
+def run_census(engine, *, memory_budget=None, host_budget=None,
+               profile="tpu-v4", max_executables=64, loop_aware=True):
     """Enumerate the engine's full warmup grid (chunk x decode x verify,
     tp-aware), cost every executable, and run M001/C001/B001.
 
@@ -892,6 +930,10 @@ def run_census(engine, *, memory_budget=None, profile="tpu-v4",
     path).  ``memory_budget`` (bytes or '16GiB') overrides the
     engine's own declared budget for the M001 check; with neither, the
     M001 rule is skipped and the memory model is still reported.
+    ``host_budget`` declares the host-RAM ceiling the hierarchical-KV
+    tier (``kv_tier=``) must fit under — tier budgets past it are an
+    M001 too, and every M001 message names BOTH budgets when a host
+    tier is configured (one census, two memories).
     """
     entries = []
     families = {}
@@ -911,8 +953,21 @@ def run_census(engine, *, memory_budget=None, profile="tpu-v4",
             "roofline": est.roofline(profile)["bound"],
         })
 
-    memory = engine_memory_model(engine, memory_budget=memory_budget)
+    memory = engine_memory_model(engine, memory_budget=memory_budget,
+                                 host_budget=host_budget)
     budget = memory.get("memory_budget")
+    host_bytes = (memory["host_pool_bytes"]
+                  + memory["prefix_store_bytes"])
+    tier_note = ""
+    if host_bytes:
+        tier_note = (
+            f"; host tier holds {_fmt_bytes(host_bytes)} beside it "
+            f"(pool {_fmt_bytes(memory['host_pool_bytes'])} + store "
+            f"{_fmt_bytes(memory['prefix_store_bytes'])}"
+            + (f" under host budget "
+               f"{_fmt_bytes(memory['host_budget'])}"
+               if memory.get("host_budget") is not None else "")
+            + ")")
     if budget is not None:
         weights = memory["weights_bytes"]
         pool = memory["kv_pool_bytes"]
@@ -941,7 +996,24 @@ def run_census(engine, *, memory_budget=None, profile="tpu-v4",
                     f"blocks x {_fmt_bytes(memory['page_bytes'])}) + "
                     f"transients {_fmt_bytes(transient)}; at "
                     f"{_fmt_bytes(seq)}/sequence the budget supports "
-                    f"max_batch <= {admissible}"))
+                    f"max_batch <= {admissible}{tier_note}"))
+
+    # host-tier residency check: the configured tier budgets must fit
+    # the declared host-RAM ceiling — the host side of M001
+    hb = memory.get("host_budget")
+    if hb is not None and host_bytes > hb:
+        host_page = memory["host_page_bytes"]
+        findings.append(Finding(
+            "M001", ERROR, "kv_tier",
+            f"hierarchical-KV tier budgets total "
+            f"{_fmt_bytes(host_bytes)} (host pool "
+            f"{_fmt_bytes(memory['host_pool_bytes'])} + prefix store "
+            f"{_fmt_bytes(memory['prefix_store_bytes'])}) — over the "
+            f"declared host budget {_fmt_bytes(hb)}; at "
+            f"{_fmt_bytes(host_page)}/page (global payload) the host "
+            f"budget admits {memory['host_budget_pages']} tier pages"
+            + (f"; HBM budget {_fmt_bytes(budget)} beside it"
+               if budget is not None else "")))
 
     if max_executables is not None and len(entries) > max_executables:
         fam = ", ".join(f"{k}: {v}" for k, v in sorted(families.items()))

@@ -77,6 +77,12 @@ class BlockManager:
         # OOM at step N exercises the same preempt/recompute path a
         # real exhausted pool does, with zero special-casing downstream
         self.fault_hook = None
+        # hierarchical KV (kv_tier.py): when attached, _take's LRU
+        # eviction calls evict_hook(block_id, chain_hash) BEFORE the
+        # hash is discarded, so the engine can promote the still-valid
+        # full page into the fleet-wide prefix store instead of
+        # dropping the prefill work it holds
+        self.evict_hook = None
         # pop() takes from the tail: keep it sorted descending so pages
         # are handed out in ascending id order (stable tests/traces)
         self._free = list(range(self.num_blocks - 1, -1, -1))
@@ -218,8 +224,13 @@ class BlockManager:
         elif self._lru:
             # evict the least-recently-freed cached page
             blk, _ = self._lru.popitem(last=False)
-            del self._hash_to_block[self._block_hash.pop(blk)]
+            h = self._block_hash.pop(blk)
+            del self._hash_to_block[h]
             self.prefix_evictions += 1
+            if self.evict_hook is not None:
+                # the page's contents are still valid HERE (nothing
+                # reused the block yet) — last chance to promote them
+                self.evict_hook(blk, h)
         else:
             raise NoFreeBlocksError("KV cache pool exhausted")
         self._ref[blk] = 1

@@ -79,6 +79,7 @@ from .faults import (
     StepWatchdog,
 )
 from .interleave import interleave_point, interleave_wait, masked
+from .kv_tier import KVTierConfig
 from .lora import AdapterManager, LoRAConfig, init_adapter_pools, lora_key
 from .paged_attention import (
     paged_ragged_attention,
@@ -101,6 +102,7 @@ from .sampling import (
 from .scheduler import (
     FINISHED,
     RUNNING,
+    WAITING,
     RaggedRow,
     Request,
     Scheduler,
@@ -258,7 +260,7 @@ class LLMEngine:
                  lora=None, faults=None, retry=None, max_queue=None,
                  step_timeout_s=None, clock=None,
                  record_step_gauges=False, detokenizer=None,
-                 lookahead=False):
+                 lookahead=False, kv_tier=None):
         # ----------------------------------------- lifecycle hardening ----
         # validate the robustness knobs FIRST (mirrors max_new_tokens):
         # a bad config must fail loudly at construction, not mid-traffic
@@ -478,6 +480,31 @@ class LLMEngine:
                                        self.lora.max_adapters - 1
                                        if self.lora is not None
                                        else None))
+        # ------------------------------------------- hierarchical KV ------
+        # kv_tier= (None | bytes | dict | KVTierConfig) attaches the
+        # host-RAM page tier (kv_tier.py): preemption demotes chains to
+        # a bounded host pool instead of discarding them, re-admission
+        # swaps them back in instead of re-prefilling, and full pages
+        # evicted from the HBM prefix cache promote into a content-
+        # addressed host store any engine sharing it (a Fleet) can
+        # adopt from.  A TierPolicy prices swap bytes vs replay FLOPs.
+        self.kv_tier = KVTierConfig.resolve(kv_tier)
+        self.host_pool = None
+        self.prefix_store = None
+        self.tier_policy = None
+        # host bytes moved by THIS step's tier traffic (demote + swap +
+        # promote + store adoption) — the simulator's virtual clock
+        # charges the step-time model's link term for exactly these
+        self.last_tier_bytes = 0
+        if self.kv_tier is not None:
+            self.host_pool, self.prefix_store = self.kv_tier.build()
+            self.tier_policy = self.kv_tier.policy
+            if self.host_pool is not None:
+                self.scheduler.demote_hook = self._tier_demote
+                self.scheduler.swap_in_hook = self._tier_swap_in
+            if self.prefix_store is not None:
+                self.scheduler.prefix_fetch_hook = self._tier_prefix_fetch
+                self.block_manager.evict_hook = self._promote_evicted
         cache_shape = (self.num_layers, self.num_blocks, self.block_size,
                        self.num_heads, self.head_dim)
         self._kv_dtype = jnp.int8 if self._kv_quant else self.dtype
@@ -1100,6 +1127,7 @@ class LLMEngine:
         finished list."""
         self._invalidate_plan()
         self._drafter_forget(req.request_id)
+        self._tier_forget(req.request_id)
         req.status = FINISHED
         req.finish_reason = reason
         self._requests.pop(req.request_id, None)
@@ -1357,6 +1385,7 @@ class LLMEngine:
         interleave_point("step")
         self._step_index += 1
         self.last_launches = []
+        self.last_tier_bytes = 0
         if self.faults is not None:
             self.faults.begin_step(self._step_index)
         finished = self._drain_early()
@@ -1390,11 +1419,14 @@ class LLMEngine:
                 return finished
             self.stats["steps"] += 1
             self._ragged_step(batch, finished, t_sched=t0)
-        if self.tp > 1:
+        if self.tp > 1 or self.kv_tier is not None:
             # ONE host-side allocator drives every shard (tables ride
             # replicated), so page accounting must be shard-invariant:
-            # assert the books balance after each TP step
-            self.scheduler.check_invariants()
+            # assert the books balance after each TP step.  With a
+            # host tier configured the engine-level check (HBM + host
+            # pool + prefix store conservation) runs EVERY step — zero
+            # page leaks across tiers is the hierarchical-KV contract.
+            self.check_invariants()
         finished.extend(self._drain_early())
         self._record_step_gauges()
         return finished
@@ -1579,6 +1611,39 @@ class LLMEngine:
         self.params = {**self.params, "blocks": blocks}
 
     # ------------------------------------------------------------ migration --
+    _scatter_jit = None
+    _gather_jit = None
+
+    @classmethod
+    def _pool_kernels(cls):
+        """Jitted page-row scatter/gather for the migration and KV-tier
+        paths (cached per input shape — the page-bucket padding below
+        bounds the shape count).  The scatter DONATES its pool
+        argument, so XLA aliases the output buffer onto the input: an
+        in-place row write instead of the eager functional whole-pool
+        copy, and one dispatch instead of the eager op machinery that
+        dominated tier traffic.  Callers immediately reassign the
+        returned array over the donated one, so nothing observes the
+        consumed buffer."""
+        if cls._scatter_jit is None:
+            cls._scatter_jit = jax.jit(
+                lambda pool, idx, vals: pool.at[:, idx].set(vals),
+                donate_argnums=(0,))
+            cls._gather_jit = jax.jit(
+                lambda pool, idx: jnp.take(pool, idx, axis=1))
+        return cls._scatter_jit, cls._gather_jit
+
+    @staticmethod
+    def _page_bucket(n):
+        """Power-of-two bucket for a page-index batch.  The eager
+        gather/scatter updates below compile one executable per input
+        SHAPE; the KV tier turns page movement into a hot path with a
+        different chain length every call, so unpadded indices would
+        recompile per length (a silent compile storm outside the
+        watched ragged family).  Padding to buckets bounds that at
+        log2(max_pages) executables per op."""
+        return 1 << max(0, int(n - 1).bit_length())  # noqa: H001 (host page count, not a tensor)
+
     @staticmethod
     def _gather_pool(pool, idx):
         """Select page rows [:, idx] of one KV pool as a host numpy
@@ -1587,12 +1652,20 @@ class LLMEngine:
         pool.  Eager ``jnp.take`` compiles outside the ragged family
         (nothing for an armed CompileWatcher to see) and leaves the
         committed pool buffer untouched, so donation is unaffected.
-        Plain-numpy pools (the simulator's) skip the device round
-        trip."""
+        The index is padded to a power-of-two bucket (repeating the
+        last page — sliced back off before returning) so repeated
+        tier traffic reuses a handful of executables.  Plain-numpy
+        pools (the simulator's) skip the device round trip."""
         if isinstance(pool, np.ndarray):
             return pool[:, idx]
-        sel = jnp.take(pool, jnp.asarray(idx, jnp.int32), axis=1)
-        return np.asarray(jax.device_get(sel))  # noqa: H001 (migration pulls only the selected pages by design)
+        n = len(idx)
+        b = LLMEngine._page_bucket(n)
+        if b > n:
+            idx = np.concatenate(
+                [idx, np.full(b - n, idx[-1], dtype=np.int64)])
+        _, gather = LLMEngine._pool_kernels()
+        sel = gather(pool, np.asarray(idx, np.int32))  # noqa: H001 (host block-id list, not a tensor)
+        return np.asarray(jax.device_get(sel))[:, :n]  # noqa: H001 (migration pulls only the selected pages by design)
 
     def _gather_pages(self, block_ids):
         """Host-staged page gather: device-side row select of the
@@ -1619,20 +1692,54 @@ class LLMEngine:
         migrated pages, not the pool.  The rebuilt arrays are ordinary
         committed buffers — the next step's jitted call donates them
         exactly like the ones they replace, so migration composes with
-        donation and compiles nothing in the watched family."""
-        idx = jnp.asarray(np.asarray(block_ids, np.int64))  # noqa: H001 (host block-id list, not a tensor)
-        kc = self._kc.at[:, idx].set(jnp.asarray(k_pages, self._kc.dtype))
-        vc = self._vc.at[:, idx].set(jnp.asarray(v_pages, self._vc.dtype))
+        donation and compiles nothing in the watched family.  Indices
+        and payload are padded to a power-of-two bucket by repeating
+        the LAST page — duplicate indices carrying identical values
+        make the extra writes idempotent — so tier traffic reuses a
+        handful of executables instead of recompiling per chain
+        length."""
+        idxa, k_pages, v_pages = self._pad_scatter(
+            block_ids, k_pages, v_pages)
+        idx = np.asarray(idxa, np.int32)  # noqa: H001 (host block-id list, not a tensor)
+        scatter, _ = self._pool_kernels()
+        kc = scatter(self._kc, idx,
+                     np.asarray(k_pages, self._kc.dtype))  # noqa: H001 (host page payload upload by design)
+        vc = scatter(self._vc, idx,
+                     np.asarray(v_pages, self._vc.dtype))  # noqa: H001 (host page payload upload by design)
         if self.tp > 1:
             kc = jax.device_put(kc, self._cache_sharding)
             vc = jax.device_put(vc, self._cache_sharding)
         self._kc, self._vc = kc, vc
 
+    @staticmethod
+    def _pad_scatter(block_ids, k_pages, v_pages):
+        """Pad a scatter's index list and page payloads to the
+        power-of-two bucket (see :meth:`_page_bucket`) by repeating
+        the last page."""
+        idx = np.asarray(block_ids, np.int64)  # noqa: H001 (host block-id list, not a tensor)
+        n = len(idx)
+        b = LLMEngine._page_bucket(n)
+        if b > n:
+            idx = np.concatenate([idx, np.full(b - n, idx[-1],
+                                               dtype=np.int64)])
+            k_pages = np.concatenate(
+                [k_pages, np.repeat(k_pages[:, -1:], b - n, axis=1)],
+                axis=1)
+            v_pages = np.concatenate(
+                [v_pages, np.repeat(v_pages[:, -1:], b - n, axis=1)],
+                axis=1)
+        return idx, k_pages, v_pages
+
     def _scatter_scale_pages(self, block_ids, k_scales, v_scales):
         """Scale-pool counterpart of :meth:`_scatter_pages`."""
-        idx = jnp.asarray(np.asarray(block_ids, np.int64))  # noqa: H001 (host block-id list, not a tensor)
-        ks = self._ks.at[:, idx].set(jnp.asarray(k_scales, self._ks.dtype))
-        vs = self._vs.at[:, idx].set(jnp.asarray(v_scales, self._vs.dtype))
+        idxa, k_scales, v_scales = self._pad_scatter(
+            block_ids, k_scales, v_scales)
+        idx = np.asarray(idxa, np.int32)  # noqa: H001 (host block-id list, not a tensor)
+        scatter, _ = self._pool_kernels()
+        ks = scatter(self._ks, idx,
+                     np.asarray(k_scales, self._ks.dtype))  # noqa: H001 (host page payload upload by design)
+        vs = scatter(self._vs, idx,
+                     np.asarray(v_scales, self._vs.dtype))  # noqa: H001 (host page payload upload by design)
         if self.tp > 1:
             ks = jax.device_put(ks, self._scale_sharding)
             vs = jax.device_put(vs, self._scale_sharding)
@@ -1751,7 +1858,265 @@ class LLMEngine:
         self.scheduler.abort(req)
         self._invalidate_plan()
         self._drafter_forget(request_id)
+        self._tier_forget(request_id)
         self.events.append((self._step_index, "release", request_id))
+
+    # -------------------------------------------------- hierarchical KV --
+    def _tier_demote(self, victim):
+        """Scheduler preempt hook (kv_tier.py): stage the victim's page
+        chain into the host pool BEFORE its pages are freed, so
+        re-admission swaps it back in instead of re-prefilling.  Gated
+        three ways — the chain must be fully committed (a mid-prefill
+        chain holds garbage beyond ``num_cached``), the TierPolicy must
+        price swap-in bytes under replay FLOPs, and the chain must fit
+        the pool budget.  A demote-site fault aborts the stage with
+        NOTHING stored (both tiers exactly as before) — the preemption
+        falls back to plain recompute.  Never raises."""
+        rid = victim.request_id
+        pool, bm = self.host_pool, self.block_manager
+        if not victim.prefill_done or victim.num_cached <= 0 or \
+                bm.num_tokens(rid) != victim.num_cached:
+            return
+        seq = bm.export_seq(rid)
+        npages = len(seq["block_ids"])
+        nbytes = npages * self.page_bytes * self.tp
+        if rid in pool or not pool.fits(nbytes):
+            return
+        if self.tier_policy.decide(self, victim.num_cached,
+                                   npages) != "swap":
+            return
+        try:
+            if self.faults is not None:
+                self.faults.tier_fault("demote")
+            k, v = self._gather_pages(seq["block_ids"])
+        except InjectedFault:
+            return
+        entry = {"seq": seq, "k_pages": k, "v_pages": v,
+                 "k_scales": None, "v_scales": None}
+        if self._kv_quant:
+            ks, vs = self._gather_scale_pages(seq["block_ids"])
+            entry["k_scales"], entry["v_scales"] = ks, vs
+        for old in pool.put(rid, entry):
+            # chains LRU-evicted to make room lose their swap-in, but
+            # their FULL pages still promote into the prefix store
+            self._promote_chain(old)
+        self.last_tier_bytes += nbytes
+        self.events.append((self._step_index, "demote", rid, npages))
+
+    def _tier_swap_in(self, req, margin):
+        """Scheduler admission hook: swap a demoted chain back into
+        HBM.  Returns None when the request has no demoted chain (the
+        caller runs normal admission), "retry" when it does but cannot
+        land this step (capacity, or an injected promote fault — the
+        chain STAYS demoted for the next attempt), or the swapped-in
+        token count on success.
+
+        Pages still resident in the HBM prefix cache are adopted
+        instead of re-scattered (the common case right after a
+        preemption: the freed pages are parked on the LRU list), so
+        only the genuinely evicted suffix moves bytes.  Registration
+        happens strictly AFTER the payload lands (register-after-
+        scatter, like import_request), so a mid-swap fault can never
+        expose a garbage page through the prefix cache."""
+        pool, bm = self.host_pool, self.block_manager
+        rid = req.request_id
+        entry = pool.get(rid)
+        if entry is None:
+            return None
+        seq = entry["seq"]
+        n = len(req.all_ids)
+        cached = int(seq["num_tokens"])  # noqa: H001 (host export record field, not a tensor)
+        if not 0 < cached < n:
+            # stale chain (defensive — forget paths should have
+            # dropped it); recompute from scratch
+            self._promote_chain(pool.pop(rid))
+            return None
+        hashes = bm.prefix_chain_hashes(
+            req.all_ids, limit=(n - 1) // bm.block_size,
+            salt=req.adapter_id)
+        k = bm.match_prefix(hashes)
+        if not bm.can_allocate(n, margin=margin,
+                               cached_hashes=hashes[:k]):
+            return "retry"
+        try:
+            table = bm.allocate(rid, n, cached_hashes=hashes[:k])
+        except NoFreeBlocksError:
+            return "retry"
+        npay = len(seq["block_ids"])
+        moved = max(0, npay - k)
+        try:
+            if self.faults is not None:
+                self.faults.tier_fault("promote")
+            if moved:
+                self._scatter_pages(table[k:npay],
+                                    entry["k_pages"][:, k:npay],
+                                    entry["v_pages"][:, k:npay])
+                if self._kv_quant:
+                    self._scatter_scale_pages(
+                        table[k:npay],
+                        entry["k_scales"][:, k:npay],
+                        entry["v_scales"][:, k:npay])
+            bm.register_imported(rid, seq["hashes"])
+        except BaseException:
+            # exact reclamation: every page allocated above goes back
+            # (adopted pages re-park on the LRU with their contents
+            # untouched — the scatter targeted fresh pages only), and
+            # the chain stays demoted for the next attempt
+            bm.free(rid)
+            return "retry"
+        pool.pop(rid, swapped=True)
+        req.num_cached = cached
+        self.last_tier_bytes += moved * self.page_bytes * self.tp
+        self.events.append((self._step_index, "swap_in", rid, moved))
+        return cached
+
+    def _tier_prefix_fetch(self, req, hashes, k):
+        """Scheduler admission hook (fleet-wide prefix store): after a
+        normal admission adopted ``k`` HBM-resident pages, fetch the
+        longest store-resident run of the REMAINING hashes into the
+        already-allocated table and return the page count (the caller
+        extends ``num_cached``).  Policy-gated like demote; a fault (or
+        any failure) mid-fetch returns 0 with the fetched pages left
+        unregistered — they hold garbage, and the unchanged
+        ``num_cached`` means the prefill chunks recompute them."""
+        store, bm = self.prefix_store, self.block_manager
+        run = store.match(hashes[k:])
+        if not run:
+            return 0
+        if self.tier_policy.decide(self, run * bm.block_size,
+                                   run) != "swap":
+            return 0
+        rid = req.request_id
+        table = bm.block_table(rid)
+        entries = [store.get(h) for h in hashes[k:k + run]]
+        try:
+            if self.faults is not None:
+                self.faults.tier_fault("promote")
+            kp = np.concatenate([e["k_pages"] for e in entries], axis=1)
+            vp = np.concatenate([e["v_pages"] for e in entries], axis=1)
+            self._scatter_pages(table[k:k + run], kp, vp)
+            if self._kv_quant:
+                ks = np.concatenate([e["k_scales"] for e in entries],
+                                    axis=1)
+                vs = np.concatenate([e["v_scales"] for e in entries],
+                                    axis=1)
+                self._scatter_scale_pages(table[k:k + run], ks, vs)
+            for i, h in enumerate(hashes[k:k + run]):
+                bm.register_full_block(rid, k + i, h)
+        except BaseException:
+            return 0
+        self.last_tier_bytes += sum(
+            e["k_pages"].nbytes + e["v_pages"].nbytes for e in entries)
+        self.events.append((self._step_index, "store_adopt", rid, run))
+        return run
+
+    def _promote_evicted(self, blk, block_hash):
+        """BlockManager evict hook: a FULL page is leaving the HBM
+        prefix cache — promote its still-valid contents into the
+        content-addressed host store before the block is reused.
+        No-op when the page's hash is already stored, or while the
+        pool buffers are donated to an in-flight launch."""
+        store = self.prefix_store
+        if block_hash in store or self._pool_lost():
+            return
+        k, v = self._gather_pages([blk])
+        entry = {"seq": {"block_ids": [blk]}, "k_pages": k, "v_pages": v,
+                 "k_scales": None, "v_scales": None}
+        if self._kv_quant:
+            ks, vs = self._gather_scale_pages([blk])
+            entry["k_scales"], entry["v_scales"] = ks, vs
+        store.put(block_hash, entry)
+        self.last_tier_bytes += self.page_bytes * self.tp
+        self.events.append((self._step_index, "promote", 1))
+
+    def _promote_chain(self, entry):
+        """Promote every registered FULL page of one demoted chain into
+        the prefix store (chain eviction / request exit: the swap-in is
+        lost, the prefill work its full pages hold need not be)."""
+        store = self.prefix_store
+        if store is None or entry is None:
+            return
+        seq = entry["seq"]
+        promoted = 0
+        for i, h in enumerate(seq.get("hashes", ())):
+            if h is None or h in store:
+                continue
+            page = {"seq": {"block_ids": [seq["block_ids"][i]]},
+                    "k_pages": entry["k_pages"][:, i:i + 1],
+                    "v_pages": entry["v_pages"][:, i:i + 1],
+                    "k_scales": None, "v_scales": None}
+            if entry.get("k_scales") is not None:
+                page["k_scales"] = entry["k_scales"][:, i:i + 1]
+                page["v_scales"] = entry["v_scales"][:, i:i + 1]
+            store.put(h, page)
+            promoted += 1
+        if promoted:
+            self.last_tier_bytes += promoted * self.page_bytes * self.tp
+            self.events.append((self._step_index, "promote", promoted))
+
+    def _tier_forget(self, request_id):
+        """Drop a request's demoted chain (abort / deadline /
+        quarantine / release): the swap-in can never happen, but the
+        chain's full pages still promote into the prefix store."""
+        if self.host_pool is not None:
+            self._promote_chain(self.host_pool.pop(request_id))
+
+    def adopt_waiting(self, req):
+        """Adopt a foreign Request into this engine's WAITING queue —
+        the fleet's tier-reroute drain path: the source demoted the
+        chain into the SHARED host pool, and this engine's next
+        admission swaps it in (or re-prefills ``all_ids`` from scratch
+        if the pool evicted it first — token-exact either way).
+        Unlike :meth:`import_request` this needs no free pages NOW, so
+        a drain is never blocked on destination HBM headroom."""
+        rid = req.request_id
+        if rid in self._requests:
+            raise ValueError(f"request {rid!r} already live here")
+        aid = getattr(req, "adapter_id", None)
+        if aid is not None and (
+                self.lora is None or not self._lora_mgr.known(aid)):
+            raise MigrationError(
+                f"destination cannot serve adapter {aid!r} — "
+                f"{'no lora= configured' if self.lora is None else 'adapter not registered'}",
+                reason="adapter")
+        req.status = WAITING
+        req.num_cached = 0
+        req.draft_tokens = []
+        self._requests[rid] = req
+        self.scheduler.add(req)
+        self._invalidate_plan()
+        self.events.append((self._step_index, "add", rid))
+
+    def check_invariants(self):
+        """Global page conservation across every tier: the HBM books
+        (scheduler + BlockManager), the host pool's, and the prefix
+        store's — plus the cross-tier exclusion that a demoted chain's
+        request owns no HBM pages (the same K/V must never be resident
+        twice).  Asserted every step when a tier is configured, and
+        after every TP step regardless."""
+        self.scheduler.check_invariants()
+        if self.host_pool is not None:
+            self.host_pool.check_invariants()
+            for rid in self.host_pool._chains:
+                if self.block_manager.has_seq(rid):
+                    raise RuntimeError(
+                        f"request {rid} owns HBM pages AND a demoted "
+                        f"host-tier chain")
+        if self.prefix_store is not None:
+            self.prefix_store.check_invariants()
+
+    def tier_stats(self):
+        """Host-tier counters (benches and tests): per-tier residency
+        and traffic plus the scheduler's swapped-in token total."""
+        if self.kv_tier is None:
+            raise ValueError("tier_stats() needs a kv_tier= engine")
+        return {
+            "swapped_in_tokens": self.scheduler.swapped_in_tokens,
+            "host_pool": (self.host_pool.stats()
+                          if self.host_pool is not None else None),
+            "prefix_store": (self.prefix_store.stats()
+                             if self.prefix_store is not None else None),
+        }
 
     def _ragged_step(self, batch, finished, t_sched=None):
         """ONE unified launch for the whole scheduled step: every row —

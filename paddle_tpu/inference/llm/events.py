@@ -34,8 +34,12 @@ __all__ = [
 # "adapter_load" (device pool slot swap); v4 added the lookahead
 # kinds "step_staged" (the engine planned+packed step N+1 under step
 # N's device time) and "draft_model_load" (a model-based drafter's
-# zero-padded block leaves + paged pools came up at engine init)
-SCHEMA_VERSION = 4
+# zero-padded block leaves + paged pools came up at engine init);
+# v5 added the hierarchical-KV kinds "demote" / "swap_in" (host-RAM
+# page tier), "promote" / "store_adopt" (fleet-wide prefix store) and
+# the fleet-level "tier_reroute" (drain handed a running sequence to
+# a peer THROUGH the host tier)
+SCHEMA_VERSION = 5
 
 # detail-field names per engine event kind, in tuple order after
 # (step, kind).  Frozen: changing arity or adding kinds bumps
@@ -70,6 +74,17 @@ ENGINE_EVENT_FIELDS = {
     # leaves (live layers + zero-padded identities) and paged pools
     # came up.  Emitted once at construction (step -1).
     "draft_model_load": ("layers", "pages"),
+    # hierarchical KV (inference/llm/kv_tier.py): a preempted/drained
+    # sequence's page chain moved HBM -> host pool ("demote"), came
+    # back at re-admission ("swap_in"), a prefix-cache-evicted full
+    # page moved into the content-addressed host store ("promote"),
+    # or admission adopted store pages beyond the HBM prefix hit
+    # ("store_adopt").  Page counts only — deterministic ints, and the
+    # simulator replays the same decisions to the same counts.
+    "demote": ("request_id", "pages"),
+    "swap_in": ("request_id", "pages"),
+    "promote": ("pages",),
+    "store_adopt": ("request_id", "pages"),
 }
 
 # fleet event kinds ("shed"/"finish" are shared with the engine and
@@ -90,6 +105,11 @@ FLEET_EVENT_FIELDS = {
     "drained": ("replica",),
     "reroute": ("request_id", "src", "dst"),
     "restart": ("replica",),
+    # hierarchical KV: a drain handed a RUNNING sequence to a peer
+    # THROUGH the shared host tier (demote on src, swap-in on dst at
+    # its own admission) — the fallback when direct migration can't
+    # land (e.g. the destination has no free pages right now)
+    "tier_reroute": ("request_id", "src", "dst", "pages"),
 }
 
 EVENT_FIELDS = {**ENGINE_EVENT_FIELDS, **FLEET_EVENT_FIELDS}

@@ -124,9 +124,20 @@ class Fault:
                     accounting; 0 by default so replays stay
                     wall-clock-free).  Consumed by Fleet._migrate,
                     at most one fault per fleet step.
-    step:   engine step index ("step"/"alloc"/"client" sites), fleet
-            step index ("replica"/"migration" sites), or response
-            index ("socket" site) the fault fires at.
+            tier:   "demote" (the HBM -> host-pool page gather fails
+                    BEFORE the chain is stored — the preemption falls
+                    back to plain recompute, both tiers untouched),
+                    "promote" (the host-pool -> HBM swap-in fails
+                    AFTER pages were allocated — they are reclaimed
+                    exactly and the chain STAYS in the host pool for
+                    the next attempt; register-after-scatter means a
+                    mid-swap fault never exposes garbage via the
+                    prefix cache), "delay" (sleep delay_s inside the
+                    tier window).  Consumed by the engine's tier
+                    hooks, at most one per (step, kind).
+    step:   engine step index ("step"/"alloc"/"client"/"tier" sites),
+            fleet step index ("replica"/"migration" sites), or
+            response index ("socket" site) the fault fires at.
     count:  "transient" only — how many attempts fail before success.
     delay_s: "delay" only — injected stall length.
     victim: "raise" — index into the launch's request rows; the
@@ -179,7 +190,7 @@ class FaultInjector:
         self.schedule = list(schedule)
         for f in self.schedule:
             if f.site not in ("step", "alloc", "socket", "client",
-                              "replica", "migration"):
+                              "replica", "migration", "tier"):
                 raise ValueError(f"unknown fault site {f.site!r}")
             if f.site == "replica" and \
                     f.kind not in ("kill", "heartbeat", "drain"):
@@ -191,6 +202,11 @@ class FaultInjector:
                 raise ValueError(
                     f"unknown migration fault kind {f.kind!r} "
                     f"(export | import | delay)")
+            if f.site == "tier" and \
+                    f.kind not in ("demote", "promote", "delay"):
+                raise ValueError(
+                    f"unknown tier fault kind {f.kind!r} "
+                    f"(demote | promote | delay)")
         self.events = []
         self._step = -1          # current engine step index
         self._attempts = {}      # (site, step) -> attempts so far
@@ -201,16 +217,23 @@ class FaultInjector:
 
     @classmethod
     def random(cls, seed, steps=128, *, p_step=0.0, p_transient=0.0,
-               p_oom=0.0, p_delay=0.0, p_abort=0.0, delay_s=0.0,
-               max_victim=8):
+               p_oom=0.0, p_delay=0.0, p_abort=0.0, p_tier=0.0,
+               delay_s=0.0, max_victim=8):
         """Materialize a randomized schedule from ``seed`` — one
         Bernoulli draw per (site, step) in a fixed order, so the same
         seed always yields the same schedule (replayable by data, not
-        by accident of interleaving)."""
+        by accident of interleaving).  ``p_tier`` draws hierarchical-KV
+        faults (demote / promote / delay, uniformly) from a SEPARATE
+        stream derived from the same seed, so adding tier chaos never
+        perturbs the schedule an existing seed pins down."""
         rng = np.random.RandomState(int(seed))
+        trng = np.random.RandomState((int(seed) ^ 0x517CC1B7)
+                                     & 0x7FFFFFFF)
         schedule = []
         for s in range(int(steps)):
             draws = rng.uniform(size=5)
+            tdraw = trng.uniform()
+            tkind = ("demote", "promote", "delay")[int(trng.randint(3))]
             if draws[0] < p_step:
                 schedule.append(Fault("step", "raise", step=s,
                                       victim=int(rng.randint(max_victim))))
@@ -224,6 +247,9 @@ class FaultInjector:
                                       delay_s=delay_s))
             if draws[4] < p_abort:
                 schedule.append(Fault("client", "abort", step=s))
+            if tdraw < p_tier:
+                schedule.append(Fault("tier", tkind, step=s,
+                                      delay_s=delay_s))
         return cls(schedule=schedule, seed=seed)
 
     @classmethod
@@ -337,6 +363,31 @@ class FaultInjector:
             self.events.append((s, "migration", f.kind, 0))
             fired.append(f)
         return fired
+
+    def tier_fault(self, kind):
+        """Engine hook at the hierarchical-KV boundaries.  ``kind`` is
+        "demote" (consulted before a chain is stored in the host pool)
+        or "promote" (consulted inside the swap-in window, after pages
+        were allocated).  A due fault of that kind raises InjectedFault
+        — consumed, and recorded in ``events`` as ``(step, "tier",
+        kind, 0)``, exactly once, so a drained schedule replays to an
+        identical log.  A due "delay" fault sleeps (on the engine's
+        injected clock) once per step before either kind proceeds."""
+        for f in self.scheduled("tier"):
+            key = ("tier", self._step, f.kind)
+            if self._attempts.get(key):
+                continue
+            if f.kind == "delay":
+                self._attempts[key] = 1
+                self.events.append((self._step, "tier", "delay", 0))
+                self.sleep(f.delay_s)
+                continue
+            if f.kind != kind:
+                continue
+            self._attempts[key] = 1
+            self.events.append((self._step, "tier", f.kind, 0))
+            raise InjectedFault(
+                f"injected tier fault ({f.kind}) at step {self._step}")
 
     def alloc(self, what):
         """Consulted by the page allocator's entry points.  Returns

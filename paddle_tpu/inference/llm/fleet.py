@@ -99,6 +99,7 @@ import numpy as np
 from .engine import LLMEngine, RequestOutput
 from .interleave import interleave_point
 from .faults import FinishReason, MigrationError
+from .kv_tier import KVTierConfig
 from .scheduler import RUNNING
 
 # replica lifecycle states (three-state health machine + drain states)
@@ -270,7 +271,8 @@ class Router:
     """Prefix-affinity placement with deterministic least-loaded
     fallback (see the module docstring for the policy)."""
 
-    def __init__(self, replicas, warm_cap=4096, load_cap=None):
+    def __init__(self, replicas, warm_cap=4096, load_cap=None,
+                 prefix_store=None):
         if not isinstance(warm_cap, (int, np.integer)) or \
                 isinstance(warm_cap, bool) or warm_cap < 1:
             raise ValueError(
@@ -291,6 +293,11 @@ class Router:
         # (policy finding from the discrete-event simulator; see
         # docs/SIMULATOR.md)
         self.load_cap = None if load_cap is None else int(load_cap)
+        # fleet-wide prefix store (hierarchical KV): store-resident
+        # pages are adoptable from ANY replica, so they score the same
+        # everywhere — ties fall through to least-loaded, which stops
+        # a store-warm prefix from herding onto one replica
+        self.prefix_store = prefix_store
         self.routed = 0
         self.affinity_hits = 0
 
@@ -308,13 +315,18 @@ class Router:
     def score(self, replica, keys):
         """Warm-page affinity: longest leading run of ``keys`` this
         replica has seen dispatched, floored by the pages actually
-        resident in its cache right now."""
+        resident in its cache right now, and by the pages any replica
+        can adopt from the fleet-wide prefix store."""
         run = 0
         for h in keys:
             if h not in replica.warm_hashes:
                 break
             run += 1
-        return max(run, replica.engine.block_manager.match_prefix(keys))
+        score = max(run,
+                    replica.engine.block_manager.match_prefix(keys))
+        if self.prefix_store is not None:
+            score = max(score, self.prefix_store.match(keys))
+        return score
 
     def pick(self, keys, pool):
         """Highest affinity score wins; ties (including the score-0
@@ -455,6 +467,22 @@ class Fleet:
         self._model = model
         self._engine_kwargs = dict(engine_kwargs)
         self._engine_faults = list(engine_faults)
+        # hierarchical KV (inference/llm/kv_tier.py): the host page
+        # pool and the content-addressed prefix store are FLEET-wide —
+        # resolve the config once, build the tier instances once, and
+        # hand every replica engine the same objects, so a chain
+        # demoted by one replica can swap in on another and a page
+        # promoted anywhere warms admission everywhere
+        self.kv_tier = KVTierConfig.resolve(
+            self._engine_kwargs.pop("kv_tier", None))
+        self.host_pool = self.prefix_store = None
+        if self.kv_tier is not None:
+            self.host_pool, self.prefix_store = self.kv_tier.build()
+            self._engine_kwargs["kv_tier"] = KVTierConfig(
+                host_bytes=self.kv_tier.host_bytes,
+                store_bytes=self.kv_tier.store_bytes,
+                policy=self.kv_tier.policy,
+                host_pool=self.host_pool, store=self.prefix_store)
         # the fleet's own waits and timers ride the engines' injected
         # clock when one is given (simulator runs on a VirtualClock);
         # wall serving keeps monotonic/perf_counter/sleep
@@ -475,7 +503,8 @@ class Fleet:
             n_prefill = max(1, int(replicas) // 2)
             for r in self.replicas:
                 r.role = "prefill" if r.index < n_prefill else "decode"
-        self.router = Router(self.replicas, load_cap=router_load_cap)
+        self.router = Router(self.replicas, load_cap=router_load_cap,
+                             prefix_store=self.prefix_store)
         self._live = {}          # fleet rid -> _FleetRequest
         self._adapters = {}      # adapter_id -> weights (LoRA re-reg)
         self._early = []         # outputs finished without a step
@@ -490,7 +519,8 @@ class Fleet:
         self.stats = {"requeued": 0, "killed": 0, "drains": 0,
                       "restarts": 0, "shed": 0, "lost": 0,
                       "migrated": 0, "migration_recomputed": 0,
-                      "migration_failed": 0, "migrated_bytes": 0}
+                      "migration_failed": 0, "migrated_bytes": 0,
+                      "tier_rerouted": 0}
         # wall-clock handoff latencies (ms) — benches read this; it
         # never enters the event log, so seed replays stay identical
         self.migration_ms = []
@@ -990,6 +1020,68 @@ class Fleet:
         self.events.append((self._step_index, "migrate", rid,
                             src.index, dst.index, pages))
 
+    def _tier_reroute(self, rid, src):
+        """Drain fallback when direct migration didn't land: demote
+        the RUNNING sequence's chain into the SHARED host pool and
+        hand the request to a peer's waiting queue.  The peer swaps
+        the chain in at its own admission, so the handoff never waits
+        on destination HBM headroom — the reason direct migration most
+        often fails during a drain.  Policy-gated like any demote;
+        returns True when the request now lives on a peer.  On any
+        refusal both engines and both tiers are exactly as before (the
+        sequence finishes in place on ``src``)."""
+        if self.host_pool is None:
+            return False
+        fr = self._live.get(rid)
+        if fr is None or fr.replica != src.index or fr.aborting:
+            return False
+        eng = src.engine
+        req = eng._requests.get(rid)
+        if req is None or req.status != RUNNING or \
+                not eng.block_manager.has_seq(rid):
+            return False
+        # same committed-chain gate as the engine's demote path: only
+        # a decode-ready chain (every resident token committed) swaps
+        # token-exactly
+        if not req.prefill_done or req.num_cached <= 0 or \
+                eng.block_manager.num_tokens(rid) != req.num_cached:
+            return False
+        npages = len(eng.block_manager.block_table(rid))
+        nbytes = npages * eng.page_bytes * eng.tp
+        if rid in self.host_pool or not self.host_pool.fits(nbytes):
+            return False
+        if self.kv_tier.policy.decide(eng, req.num_cached, npages) \
+                != "swap":
+            return False
+        pool = self._routable(exclude=src)
+        if not pool:
+            return False
+        keys = self.router.affinity_keys(fr.prompt_ids)
+        dst, _ = self.router.pick(keys, pool)
+        # export is read-only; adopt validates (adapter known, id
+        # free) BEFORE src releases anything, so a refusal here leaves
+        # the sequence serving on src untouched
+        state = eng.export_request(rid)
+        try:
+            dst.engine.adopt_waiting(req)
+        except (MigrationError, ValueError):
+            return False
+        eng.release_request(rid)
+        # insert the chain LAST — release's tier cleanup must not see
+        # (and drop) the entry the peer is about to swap in
+        entry = {"seq": state["seq"], "k_pages": state["k_pages"],
+                 "v_pages": state["v_pages"],
+                 "k_scales": state.get("k_scales"),
+                 "v_scales": state.get("v_scales")}
+        for old in self.host_pool.put(rid, entry):
+            dst.engine._promote_chain(old)
+        fr.replica = dst.index
+        self.stats["tier_rerouted"] += 1
+        self.router.touch(dst, keys)
+        self.events.append((self._step_index, "tier_reroute", rid,
+                            src.index, dst.index, npages))
+        return True
+
     def _handoff_prefilled(self):
         """Disaggregated mode: every sequence on a prefill replica
         that has crossed the prefill→decode boundary (final chunk
@@ -1054,9 +1146,18 @@ class Fleet:
             # step and is swallowed by the ownership check
             keys = self.router.affinity_keys(fr.prompt_ids)
             target, score = self.router.pick(keys, pool)
+            # a demoted chain in the SHARED host pool must survive the
+            # abort (whose cleanup would otherwise drop it) — stash it
+            # and re-insert once the request lives on the target, so
+            # the target's admission swaps it in instead of prefilling
+            stash = (self.host_pool.pop(rid)
+                     if self.host_pool is not None else None)
             r.engine.abort_request(rid)
             target.engine.add_request(fr.prompt_ids, request_id=rid,
                                       **fr.kwargs)
+            if stash is not None:
+                for old in self.host_pool.put(rid, stash):
+                    target.engine._promote_chain(old)
             self.router.record(target, keys, score > 0)
             fr.replica = target.index
             fr.requeues += 1
@@ -1064,7 +1165,9 @@ class Fleet:
             self.events.append((self._step_index, "reroute", rid,
                                 r.index, target.index))
         for req in list(r.engine.scheduler.running):
-            self._try_migrate(req.request_id, r)
+            if self._try_migrate(req.request_id, r):
+                continue
+            self._tier_reroute(req.request_id, r)
         return True
 
     def restart_replica(self, index):
@@ -1189,6 +1292,7 @@ class Fleet:
                        "migration_recomputed"],
                    migration_failed=self.stats["migration_failed"],
                    migrated_bytes=self.stats["migrated_bytes"],
+                   tier_rerouted=self.stats["tier_rerouted"],
                    replicas=len(self.replicas),
                    replicas_live=sum(1 for r in self.replicas if r.live))
         return agg
@@ -1222,7 +1326,27 @@ class Fleet:
         return agg
 
     def check_invariants(self):
-        """Page books of every live replica must balance."""
+        """Page books of every live replica must balance — across
+        every tier: the engine-level check covers HBM plus the SHARED
+        host pool and prefix store, so pages are conserved globally
+        (one replica's demote is never double-resident anywhere)."""
         for r in self.replicas:
             if r.live:
-                r.engine.scheduler.check_invariants()
+                r.engine.check_invariants()
+
+    def tier_stats(self):
+        """Fleet view of the hierarchical-KV tiers: the SHARED pool
+        and store books (counted once — every replica holds the same
+        objects) plus the per-replica swapped-in token totals."""
+        if self.kv_tier is None:
+            raise ValueError("tier_stats() needs a kv_tier= fleet")
+        return {
+            "swapped_in_tokens": sum(
+                r.engine.scheduler.swapped_in_tokens
+                for r in self.replicas),
+            "tier_rerouted": self.stats["tier_rerouted"],
+            "host_pool": (self.host_pool.stats()
+                          if self.host_pool is not None else None),
+            "prefix_store": (self.prefix_store.stats()
+                             if self.prefix_store is not None else None),
+        }

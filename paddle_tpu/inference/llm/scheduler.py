@@ -191,6 +191,18 @@ class Scheduler:
         self.num_preemptions = 0
         self.prefix_hit_tokens = 0
         self.prompt_tokens = 0
+        # hierarchical KV (engine-provided, kv_tier.py): demote_hook
+        # runs inside _preempt between table removal and page free (the
+        # chain is still resident — last chance to stage it to the host
+        # tier); swap_in_hook runs at admission for requests whose
+        # chain is demoted (swap pages back instead of re-prefilling);
+        # prefix_fetch_hook runs after a normal admission to adopt
+        # store pages beyond the HBM prefix hit.  All None on a
+        # tier-less engine — the legacy paths are byte-identical.
+        self.demote_hook = None
+        self.swap_in_hook = None
+        self.prefix_fetch_hook = None
+        self.swapped_in_tokens = 0
 
     def add(self, request):
         self.waiting.append(request)
@@ -389,13 +401,43 @@ class Scheduler:
                     and len(live_adapters) >= self.lora_slots):
                 break
             n = len(req.all_ids)
+            margin = self.watermark_blocks if self.running else 0
+            # hierarchical KV: a chain demoted to the host tier swaps
+            # back in instead of re-prefilling.  The hook returns None
+            # (not demoted — fall through to the normal path), "retry"
+            # (demoted but cannot land this step: no room, or the
+            # attempt faulted — FIFO head-of-line, like the capacity
+            # breaks), or the swapped-in token count (pages allocated,
+            # payload scattered, num_cached already set).
+            if self.swap_in_hook is not None:
+                swapped = self.swap_in_hook(req, margin)
+                if swapped == "retry":
+                    break
+                if swapped is not None:
+                    self.waiting.pop(0)
+                    req.num_prefill_tokens = n
+                    req.status = RUNNING
+                    self.running.append(req)
+                    if req.adapter_id is not None:
+                        live_adapters.add(req.adapter_id)
+                    if req.n > 1 and not req._forked:
+                        reserved += req.n - 1
+                    self.prompt_tokens += n
+                    self.swapped_in_tokens += int(swapped)
+                    # the swapped chain covers n-1 tokens; the final
+                    # chunk recomputes only the last position, whose
+                    # logits seed the next token (token-exact, same as
+                    # a full-prefix-hit admission)
+                    c = min(budget, n - req.num_cached)
+                    chunks.append(PrefillChunk(req, req.num_cached, c))
+                    budget -= c
+                    continue
             # at least the last token must be computed (its logits seed
             # the first generated token), so cap reuse at n-1 tokens
             hashes = bm.prefix_chain_hashes(
                 req.all_ids, limit=(n - 1) // bm.block_size,
                 salt=req.adapter_id)
             k = bm.match_prefix(hashes)
-            margin = self.watermark_blocks if self.running else 0
             if not bm.can_allocate(n, margin=margin,
                                    cached_hashes=hashes[:k]):
                 break
@@ -409,6 +451,13 @@ class Scheduler:
                 self.waiting.insert(0, req)
                 break
             req.num_cached = k * bm.block_size
+            if self.prefix_fetch_hook is not None:
+                # fleet-wide prefix store: adopt full pages beyond the
+                # HBM hit run (payload scattered + registered by the
+                # engine; returns the page count, 0 on a faulted or
+                # policy-refused fetch — those pages just prefill)
+                req.num_cached += self.prefix_fetch_hook(
+                    req, hashes, k) * bm.block_size
             req.num_prefill_tokens = n
             req.status = RUNNING
             self.running.append(req)
@@ -417,7 +466,9 @@ class Scheduler:
             if req.n > 1 and not req._forked:
                 reserved += req.n - 1
             self.prompt_tokens += n
-            self.prefix_hit_tokens += req.num_cached
+            # HBM-resident hits only — store adoptions count in the
+            # engine's tier_stats, not the legacy hit rate
+            self.prefix_hit_tokens += k * bm.block_size
             c = min(budget, n - req.num_cached)
             chunks.append(PrefillChunk(req, req.num_cached, c))
             budget -= c
@@ -473,6 +524,12 @@ class Scheduler:
         pressure actually evicts them, so the recompute usually re-adopts
         most of its own work."""
         self.running.remove(victim)
+        if self.demote_hook is not None:
+            # hierarchical KV: the chain is out of the running set but
+            # still resident — the engine stages it to the host tier
+            # here (policy- and fault-gated; never raises), so the
+            # free below demotes instead of discarding
+            self.demote_hook(victim)
         self.block_manager.free(victim.request_id)
         victim.num_cached = 0
         victim.draft_tokens = []

@@ -304,11 +304,20 @@ def run_virtual(target, arrivals, prompts, new_tokens, *,
                 f"{len(pending)} arrivals pending")
         dt = 0.0
         for eng in _engines(target):
+            t = 0.0
             if eng.last_launches:
                 launches += len(eng.last_launches)
-                dt = max(dt, step_time_model.launches_seconds(
-                    eng.last_launches))
+                t = step_time_model.launches_seconds(eng.last_launches)
                 eng.last_launches = []   # dead replicas keep stale ones
+            tier_b = getattr(eng, "last_tier_bytes", 0)
+            if tier_b:
+                # hierarchical-KV traffic (demotes / swap-ins / store
+                # promotes+adopts) is host-staged and serial with the
+                # step's launches — it adds to THIS engine's step time
+                # before the across-replica max
+                t += step_time_model.tier_seconds(tier_b)
+                eng.last_tier_bytes = 0
+            dt = max(dt, t)
         if dt > 0.0:
             # the step's tokens exist at step END: advance before
             # stamping, or every TTFT would be one step early

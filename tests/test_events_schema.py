@@ -66,13 +66,20 @@ def _busy_scenario(eng):
 # schema shape
 # ----------------------------------------------------------------------
 def test_schema_is_versioned_and_named():
-    assert SCHEMA_VERSION == 4       # v4 added the lookahead kinds
+    assert SCHEMA_VERSION == 5       # v5 added the hierarchical-KV kinds
     assert "fork" in ENGINE_EVENT_FIELDS
     assert "adapter_register" in ENGINE_EVENT_FIELDS
     assert "adapter_load" in ENGINE_EVENT_FIELDS
     assert ENGINE_EVENT_FIELDS["step_staged"] == ("rows",)
     assert ENGINE_EVENT_FIELDS["draft_model_load"] == \
         ("layers", "pages")
+    # v5 hierarchical-KV kinds: host page tier + fleet prefix store
+    assert ENGINE_EVENT_FIELDS["demote"] == ("request_id", "pages")
+    assert ENGINE_EVENT_FIELDS["swap_in"] == ("request_id", "pages")
+    assert ENGINE_EVENT_FIELDS["promote"] == ("pages",)
+    assert ENGINE_EVENT_FIELDS["store_adopt"] == ("request_id", "pages")
+    assert FLEET_EVENT_FIELDS["tier_reroute"] == \
+        ("request_id", "src", "dst", "pages")
     assert set(EVENT_FIELDS) == \
         set(ENGINE_EVENT_FIELDS) | set(FLEET_EVENT_FIELDS)
     # the two shared kinds carry identical fields at both levels
@@ -98,24 +105,24 @@ def test_records_carry_named_fields():
                        (7, "adapter_load", "tenant-a", 3),
                        (8, "step_staged", 3),
                        (-1, "draft_model_load", 1, 24)])
-    assert recs[0] == {"schema_version": 4, "step": 3, "kind": "add",
+    assert recs[0] == {"schema_version": 5, "step": 3, "kind": "add",
                        "request_id": 7}
     assert recs[1]["reason"] == "stop"
-    assert recs[2] == {"schema_version": 4, "step": 5,
+    assert recs[2] == {"schema_version": 5, "step": 5,
                        "kind": "migrate", "request_id": 7, "src": 0,
                        "dst": 1, "pages": 4}
     # fork child ids are strings ("<parent>.<k>") — legal per the
     # int/str/None wall-clock-free rule
-    assert recs[3] == {"schema_version": 4, "step": 6, "kind": "fork",
+    assert recs[3] == {"schema_version": 5, "step": 6, "kind": "fork",
                        "request_id": 7, "child_id": "7.1"}
-    assert recs[4] == {"schema_version": 4, "step": 7,
+    assert recs[4] == {"schema_version": 5, "step": 7,
                        "kind": "adapter_load", "adapter_id": "tenant-a",
                        "slot": 3}
     # v4 lookahead kinds: a staged step-N+1 plan (row count only —
     # wall-clock-free) and the one-shot draft-model bring-up
-    assert recs[5] == {"schema_version": 4, "step": 8,
+    assert recs[5] == {"schema_version": 5, "step": 8,
                        "kind": "step_staged", "rows": 3}
-    assert recs[6] == {"schema_version": 4, "step": -1,
+    assert recs[6] == {"schema_version": 5, "step": -1,
                        "kind": "draft_model_load", "layers": 1,
                        "pages": 24}
     assert_wall_clock_free(recs)
